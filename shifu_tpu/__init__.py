@@ -1,0 +1,23 @@
+"""shifu-tpu: a TPU-native, config-driven tabular ML pipeline framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Shifu
+(reference: /root/reference, ml.shifu.shifu) — the Hadoop/Pig/Guagua
+pipeline `init → stats → norm → varselect → train → posttrain → eval →
+export` becomes:
+
+- an HBM-resident columnar feature matrix,
+- column stats / binning as jitted vectorized kernels (no Pig/MR),
+- iterative training (NN/LR/GBT/RF/WDL/MTL) as a single SPMD program
+  under `jax.jit` over a `jax.sharding.Mesh` (no Guagua/Netty/ZooKeeper),
+- bagging / grid-search parallelism as vmapped ensembles,
+- SE variable selection as a vmapped column-ablation pass.
+
+The user-facing config surface (ModelConfig.json / ColumnConfig.json)
+is JSON-compatible with the reference (container/obj/ModelConfig.java,
+ColumnConfig.java).
+"""
+
+__version__ = "0.1.0"
+
+from shifu_tpu.config.model_config import ModelConfig  # noqa: F401
+from shifu_tpu.config.column_config import ColumnConfig  # noqa: F401

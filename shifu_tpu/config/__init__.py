@@ -1,0 +1,26 @@
+from shifu_tpu.config.model_config import (  # noqa: F401
+    ModelConfig,
+    ModelBasicConf,
+    ModelSourceDataConf,
+    ModelStatsConf,
+    ModelVarSelectConf,
+    ModelNormalizeConf,
+    ModelTrainConf,
+    EvalConfig,
+    RunMode,
+    SourceType,
+    Algorithm,
+    NormType,
+    BinningMethod,
+    BinningAlgorithm,
+)
+from shifu_tpu.config.column_config import (  # noqa: F401
+    ColumnConfig,
+    ColumnStats,
+    ColumnBinning,
+    ColumnType,
+    ColumnFlag,
+    load_column_configs,
+    save_column_configs,
+)
+from shifu_tpu.config.path_finder import PathFinder  # noqa: F401

@@ -1,0 +1,136 @@
+"""ModelInspector — per-step semantic validation of ModelConfig.
+
+Mirrors `core/validator/ModelInspector.java:56-92` (step enum + probe).
+Returns a ValidateResult with a list of human-readable failure causes
+instead of throwing, like the reference's `ValidateResult`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import List
+
+from shifu_tpu.config.model_config import (Algorithm, ModelConfig, NormType)
+
+
+class ModelStep(Enum):
+    """`ModelInspector.java:60-62`."""
+    INIT = "INIT"
+    STATS = "STATS"
+    VARSELECT = "VARSELECT"
+    NORMALIZE = "NORMALIZE"
+    TRAIN = "TRAIN"
+    POSTTRAIN = "POSTTRAIN"
+    EVAL = "EVAL"
+    EXPORT = "EXPORT"
+    COMBO = "COMBO"
+    ENCODE = "ENCODE"
+    TEST = "TEST"
+
+
+@dataclass
+class ValidateResult:
+    status: bool = True
+    causes: List[str] = field(default_factory=list)
+
+    def fail(self, cause: str) -> None:
+        self.status = False
+        self.causes.append(cause)
+
+
+def probe(mc: ModelConfig, step: ModelStep) -> ValidateResult:
+    """Validate the config for a pipeline step
+    (`ModelInspector.probe`, `ModelInspector.java:92+`)."""
+    r = ValidateResult()
+    _check_basic(mc, r)
+    if step in (ModelStep.INIT, ModelStep.STATS, ModelStep.NORMALIZE,
+                ModelStep.TRAIN, ModelStep.POSTTRAIN):
+        _check_dataset(mc, r)
+    if step is ModelStep.STATS:
+        if mc.stats.maxNumBin <= 1:
+            r.fail(f"stats#maxNumBin must be > 1, got {mc.stats.maxNumBin}")
+        if not (0.0 < mc.stats.sampleRate <= 1.0):
+            r.fail(f"stats#sampleRate must be in (0,1], got {mc.stats.sampleRate}")
+    if step is ModelStep.VARSELECT:
+        vs = mc.varSelect
+        if vs.filterEnable and vs.filterNum <= 0 and vs.filterBy.upper() not in ("FI",):
+            r.fail(f"varSelect#filterNum must be positive, got {vs.filterNum}")
+        if vs.filterBy.upper() not in ("KS", "IV", "MIX", "PARETO", "SE", "ST", "FI"):
+            r.fail(f"varSelect#filterBy unknown: {vs.filterBy}")
+    if step is ModelStep.NORMALIZE:
+        if not (0.0 < mc.normalize.sampleRate <= 1.0):
+            r.fail(f"normalize#sampleRate must be in (0,1], got {mc.normalize.sampleRate}")
+        if mc.normalize.stdDevCutOff <= 0:
+            r.fail(f"normalize#stdDevCutOff must be positive, got {mc.normalize.stdDevCutOff}")
+    if step is ModelStep.TRAIN:
+        _check_train(mc, r)
+    if step is ModelStep.EVAL:
+        if not mc.evals:
+            r.fail("no eval sets configured under 'evals'")
+        for e in mc.evals:
+            if not e.dataSet.dataPath:
+                r.fail(f"eval {e.name}: dataSet#dataPath is empty")
+    return r
+
+
+def _check_basic(mc: ModelConfig, r: ValidateResult) -> None:
+    if not mc.basic.name:
+        r.fail("basic#name is empty")
+
+
+def _check_dataset(mc: ModelConfig, r: ValidateResult) -> None:
+    ds = mc.dataSet
+    if not ds.dataPath:
+        r.fail("dataSet#dataPath is empty")
+    if not ds.targetColumnName:
+        r.fail("dataSet#targetColumnName is empty")
+    if mc.is_regression:
+        overlap = set(mc.pos_tags) & set(mc.neg_tags)
+        if overlap:
+            r.fail(f"posTags and negTags overlap: {sorted(overlap)}")
+
+
+def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
+    """Train-step checks (`TrainModelProcessor.validateDistributedTrain:384-458`
+    condensed to what is semantically meaningful on TPU)."""
+    t = mc.train
+    if t.baggingNum <= 0:
+        r.fail(f"train#baggingNum must be >= 1, got {t.baggingNum}")
+    if not (0.0 <= t.validSetRate < 1.0):
+        r.fail(f"train#validSetRate must be in [0,1), got {t.validSetRate}")
+    if t.numTrainEpochs <= 0:
+        r.fail(f"train#numTrainEpochs must be positive, got {t.numTrainEpochs}")
+    alg = t.algorithm
+    norm = mc.normalize.normType
+    if alg in (Algorithm.WDL, Algorithm.MTL) and not norm.is_index:
+        # WDLWorker requires *_INDEX norm so categoricals arrive as
+        # embedding indices (TrainModelProcessor.java:441-448 analog).
+        r.fail(f"{alg.value} requires an *_INDEX normType for embeddings, got {norm.value}")
+    if alg is Algorithm.NN:
+        nh = t.get_param("NumHiddenLayers")
+        nodes = t.get_param("NumHiddenNodes")
+        acts = t.get_param("ActivationFunc")
+        if nh is not None and nodes is not None and not isinstance(nodes, dict):
+            n_layers = int(nh)
+            if isinstance(nodes, list) and not _grid_list(nodes) and len(nodes) != n_layers:
+                r.fail(f"NumHiddenNodes has {len(nodes)} entries but NumHiddenLayers={n_layers}")
+            if isinstance(acts, list) and not _grid_list(acts) and len(acts) != n_layers:
+                r.fail(f"ActivationFunc has {len(acts)} entries but NumHiddenLayers={n_layers}")
+    if alg.is_tree:
+        if norm.is_woe:
+            # Trees run on cleaned (unnormalized) values; WOE norm is fine
+            # for NN but trees ignore it — warn-level in reference.
+            pass
+        depth = t.get_param("MaxDepth")
+        if depth is not None and not isinstance(depth, list) and int(depth) <= 0:
+            r.fail(f"MaxDepth must be positive, got {depth}")
+    if t.numKFold is not None and t.numKFold > 1 and t.isContinuous:
+        r.fail("k-fold cross validation cannot be combined with isContinuous")
+
+
+def _grid_list(v) -> bool:
+    """Grid-search configs put a list *of lists* in a scalar-list slot
+    (`gs/GridSearch.java:44-65`)."""
+    return isinstance(v, list) and any(isinstance(x, list) for x in v)

@@ -1,0 +1,3 @@
+from shifu_tpu.data.reader import read_header, read_raw_table  # noqa: F401
+from shifu_tpu.data.dataset import ColumnarDataset, build_columnar  # noqa: F401
+from shifu_tpu.data.purifier import DataPurifier  # noqa: F401

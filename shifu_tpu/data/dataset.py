@@ -1,0 +1,153 @@
+"""ColumnarDataset — the HBM-ready columnar view of a tabular dataset.
+
+This replaces the reference's row-oriented dataset stack
+(`core/dtrain/dataset/MemoryDiskFloatMLDataSet.java` RAM→disk spill,
+per-worker HDFS splits): the whole table becomes two dense matrices —
+float32 numeric values (NaN = missing) and int32 categorical codes
+(-1 = missing) — plus tag/weight vectors. Dense static-shape matrices
+are what XLA wants: every stats / norm / train kernel is one jitted
+call over them, sharded over the row axis on a device mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.config.model_config import ModelConfig
+
+MISSING_CODE = -1  # categorical missing sentinel
+
+
+@dataclass
+class ColumnarDataset:
+    """Columnar matrices for the *candidate* columns of a model set."""
+    # numeric block
+    num_names: List[str]
+    num_column_nums: np.ndarray        # (Cn,) int32 — ColumnConfig columnNum
+    numeric: np.ndarray                # (R, Cn) float32, NaN = missing
+    # categorical block
+    cat_names: List[str]
+    cat_column_nums: np.ndarray        # (Cc,) int32
+    cat_codes: np.ndarray              # (R, Cc) int32, -1 = missing
+    vocabs: List[List[str]]            # per categorical column, sorted
+    # per-row
+    tags: np.ndarray                   # (R,) float32 — 1 pos / 0 neg; multi-class: class idx
+    weights: np.ndarray                # (R,) float32
+    # bookkeeping
+    meta: Dict[str, np.ndarray] = field(default_factory=dict)  # meta columns kept as strings
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.tags)
+
+    def select(self, row_mask: np.ndarray) -> "ColumnarDataset":
+        return ColumnarDataset(
+            num_names=self.num_names, num_column_nums=self.num_column_nums,
+            numeric=self.numeric[row_mask],
+            cat_names=self.cat_names, cat_column_nums=self.cat_column_nums,
+            cat_codes=self.cat_codes[row_mask],
+            vocabs=self.vocabs, tags=self.tags[row_mask],
+            weights=self.weights[row_mask],
+            meta={k: v[row_mask] for k, v in self.meta.items()})
+
+
+def parse_tags(raw: np.ndarray, pos_tags: Sequence[str],
+               neg_tags: Sequence[str]) -> np.ndarray:
+    """tag string → 1.0 (pos) / 0.0 (neg) / NaN (unknown → row dropped,
+    matching the reference's invalid-tag record skip in NNWorker.load)."""
+    raw = np.char.strip(raw.astype(str))
+    out = np.full(len(raw), np.nan, np.float32)
+    if pos_tags:
+        out[np.isin(raw, list(pos_tags))] = 1.0
+    if neg_tags:
+        out[np.isin(raw, list(neg_tags))] = 0.0
+    if not pos_tags and not neg_tags:
+        # pure regression target: parse as float
+        out = pd.to_numeric(pd.Series(raw), errors="coerce").to_numpy(np.float32)
+    return out
+
+
+def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
+                   df: pd.DataFrame,
+                   vocabs: Optional[Dict[int, List[str]]] = None,
+                   keep_meta: bool = False) -> ColumnarDataset:
+    """Convert a raw string frame into columnar matrices using column
+    types/flags from ColumnConfig.
+
+    `vocabs` pins the categorical vocabulary (from a previous stats run's
+    binCategory) so eval/scoring data maps unseen categories to the
+    missing bin, as `Normalizer` does for unknown categories.
+    """
+    missing = [str(m) for m in mc.dataSet.missingOrInvalidValues]
+    cc_by_name = {c.columnName: c for c in column_configs}
+
+    tag_col = weight_col = None
+    num_names, num_cols, cat_names, cat_cols = [], [], [], []
+    num_mats, cat_mats, out_vocabs = [], [], []
+    meta_cols: Dict[str, np.ndarray] = {}
+
+    for col in df.columns:
+        cc = cc_by_name.get(col)
+        if cc is None:
+            continue
+        sv = df[col].astype(str).str.strip()
+        if cc.is_target:
+            tag_col = sv.to_numpy()
+            continue
+        if cc.is_weight:
+            weight_col = pd.to_numeric(sv, errors="coerce").fillna(1.0) \
+                .to_numpy(np.float32)
+            continue
+        if cc.is_meta or cc.is_force_remove:
+            if keep_meta:
+                meta_cols[col] = sv.to_numpy()
+            continue
+        miss_mask = sv.isin(missing).to_numpy()
+        if cc.is_categorical:
+            if vocabs is not None and cc.columnNum in vocabs:
+                vocab = list(vocabs[cc.columnNum])
+                lut = {v: i for i, v in enumerate(vocab)}
+                codes = sv.map(lut).fillna(MISSING_CODE).to_numpy(np.int32)
+            else:
+                uniq = sorted(set(sv[~miss_mask].tolist()))
+                vocab = uniq
+                lut = {v: i for i, v in enumerate(uniq)}
+                codes = sv.map(lut).fillna(MISSING_CODE).to_numpy(np.int32)
+            codes[miss_mask] = MISSING_CODE
+            cat_names.append(col)
+            cat_cols.append(cc.columnNum)
+            cat_mats.append(codes)
+            out_vocabs.append(vocab)
+        else:
+            vals = pd.to_numeric(sv, errors="coerce").to_numpy(np.float32)
+            vals[miss_mask] = np.nan
+            num_names.append(col)
+            num_cols.append(cc.columnNum)
+            num_mats.append(vals)
+
+    n_rows = len(df)
+    tags = parse_tags(tag_col, mc.pos_tags, mc.neg_tags) if tag_col is not None \
+        else np.full(n_rows, np.nan, np.float32)
+    weights = weight_col if weight_col is not None else np.ones(n_rows, np.float32)
+
+    dset = ColumnarDataset(
+        num_names=num_names,
+        num_column_nums=np.asarray(num_cols, np.int32),
+        numeric=(np.stack(num_mats, axis=1) if num_mats
+                 else np.zeros((n_rows, 0), np.float32)),
+        cat_names=cat_names,
+        cat_column_nums=np.asarray(cat_cols, np.int32),
+        cat_codes=(np.stack(cat_mats, axis=1) if cat_mats
+                   else np.zeros((n_rows, 0), np.int32)),
+        vocabs=out_vocabs, tags=tags, weights=weights, meta=meta_cols)
+
+    # drop rows with unknown tags (reference skips invalid-tag records)
+    valid = ~np.isnan(tags)
+    if not valid.all():
+        dset = dset.select(valid)
+    return dset

@@ -1,0 +1,77 @@
+"""DataPurifier — row filtering by user expressions, vectorized.
+
+The reference evaluates a JEXL expression per record
+(`core/DataPurifier.java:42`, `udf/PurifyDataUDF.java`). Here the
+expression is evaluated once, vectorized over the whole frame via
+`pandas.eval`-style semantics with column names bound to Series. The
+common JEXL operators used in Shifu configs (`==`, `!=`, `<`, `>`,
+`and`, `or`, `&&`, `||`) are normalized to Python syntax.
+
+Only filtering semantics are reproduced — this is intentionally NOT a
+general JEXL engine. Expressions are evaluated with no builtins.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+_STRING_LIT = re.compile(r"""("([^"\\]|\\.)*"|'([^'\\]|\\.)*')""")
+
+
+def _normalize_expr(expr: str) -> str:
+    """Rewrite JEXL operators to Python, skipping quoted string literals
+    so values like "ne" or "a&&b" are never mangled."""
+    def fix(segment: str) -> str:
+        s = segment.replace("&&", " and ").replace("||", " or ")
+        # JEXL 'eq'/'ne'/'lt'/'gt'/'le'/'ge' word operators (must stand
+        # alone between spaces to avoid column names like 'le')
+        for word, op in (("eq", "=="), ("ne", "!="), ("lt", "<"),
+                         ("le", "<="), ("gt", ">"), ("ge", ">=")):
+            s = re.sub(rf"(?<=\s){word}(?=\s)", op, s)
+        return s
+
+    out, last = [], 0
+    for m in _STRING_LIT.finditer(expr):
+        out.append(fix(expr[last:m.start()]))
+        out.append(m.group(0))
+        last = m.end()
+    out.append(fix(expr[last:]))
+    return "".join(out).strip()
+
+
+class DataPurifier:
+    def __init__(self, filter_expressions: str):
+        self.raw = (filter_expressions or "").strip()
+        self.expr = _normalize_expr(self.raw) if self.raw else ""
+
+    def apply(self, df: pd.DataFrame) -> np.ndarray:
+        """Boolean keep-mask over rows. Column refs are resolved against
+        the frame; numeric-looking columns are auto-coerced so
+        `col > 5` works on string-typed raw frames."""
+        if not self.expr:
+            return np.ones(len(df), dtype=bool)
+        ns = {}
+        for col in df.columns:
+            if re.search(rf"\b{re.escape(col)}\b", self.expr):
+                s = df[col]
+                coerced = pd.to_numeric(s, errors="coerce")
+                ns[col] = coerced if coerced.notna().mean() > 0.9 else s
+        try:
+            # pandas parser: 'and'/'or' become elementwise &/| with correct
+            # precedence; python engine avoids numexpr restrictions
+            result = pd.eval(self.expr, engine="python", parser="pandas",
+                             local_dict=ns)
+        except Exception as exc:
+            raise ValueError(
+                f"failed to evaluate filterExpressions {self.raw!r}: {exc}") from exc
+        if isinstance(result, (bool, np.bool_)):
+            return np.full(len(df), bool(result))
+        mask = np.asarray(result)
+        if mask.dtype != bool:
+            mask = mask.astype(bool)
+        return mask

@@ -1,0 +1,128 @@
+"""Delimited-text ingestion: raw files → a string-typed pandas frame.
+
+Replaces the reference's split/scanner machinery
+(`fs/ShifuFileUtils.java` scanners over part files incl. gz/bz2,
+`core/mr/input/CombineInputFormat.java` small-file packing). On TPU the
+host side just needs a fast columnar parse — pandas' C reader — after
+which everything moves to device as a columnar matrix
+(`shifu_tpu/data/dataset.py`). Multi-host sharded ingestion slices the
+file list per process (`shifu_tpu/parallel/dist.py`).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from shifu_tpu.config.model_config import ModelConfig, ModelSourceDataConf
+
+_SKIP_BASENAMES = {"_SUCCESS", ".pig_header", ".pig_schema"}
+
+
+def expand_data_files(data_path: str) -> List[str]:
+    """A dataPath may be a file, a glob, or a directory of part files
+    (Hadoop layout). Hidden/marker files are skipped like the
+    reference's part-file scanners."""
+    if os.path.isdir(data_path):
+        files = sorted(
+            p for p in glob.glob(os.path.join(data_path, "*"))
+            if os.path.isfile(p) and os.path.basename(p) not in _SKIP_BASENAMES
+            and not os.path.basename(p).startswith((".", "_")))
+    elif os.path.isfile(data_path):
+        files = [data_path]
+    else:
+        files = sorted(p for p in glob.glob(data_path) if os.path.isfile(p))
+    if not files:
+        raise FileNotFoundError(f"no data files under {data_path!r}")
+    return files
+
+
+def read_header(ds: ModelSourceDataConf, base_resolver=None) -> List[str]:
+    """Read column names from headerPath (`.pig_header` style: one line,
+    delimiter-joined). If headerPath is empty, fall back to the first
+    line of the first data file (`CommonUtils.getHeaders` behavior).
+    Namespaced columns 'ns::name' keep only the final segment for
+    matching, like NSColumn."""
+    resolve = base_resolver or (lambda p: p)
+    if ds.headerPath:
+        hp = resolve(ds.headerPath)
+        with open(hp) as f:
+            line = f.readline().rstrip("\r\n")
+        delim = ds.headerDelimiter or "|"
+    else:
+        files = expand_data_files(resolve(ds.dataPath))
+        opener = _opener_for(files[0])
+        with opener(files[0]) as f:
+            line = f.readline().rstrip("\r\n")
+        delim = ds.dataDelimiter or "|"
+    return [c.strip() for c in line.split(delim)]
+
+
+def simple_column_name(name: str) -> str:
+    """NSColumn semantics: 'namespace::col' matches by its simple name."""
+    return name.split("::")[-1].strip()
+
+
+def _opener_for(path: str):
+    if path.endswith(".gz"):
+        import gzip
+        return lambda p: gzip.open(p, "rt")
+    if path.endswith(".bz2"):
+        import bz2
+        return lambda p: bz2.open(p, "rt")
+    return lambda p: open(p, "rt")
+
+
+def read_raw_table(mc: ModelConfig,
+                   ds: Optional[ModelSourceDataConf] = None,
+                   file_shard: Optional[tuple] = None,
+                   max_rows: Optional[int] = None) -> pd.DataFrame:
+    """Read the raw dataset as an all-string DataFrame with the header's
+    column names.
+
+    `file_shard=(index, count)` reads only every count-th file starting
+    at index — the multi-host ingestion split (each JAX process reads a
+    disjoint file subset; replaces per-worker HDFS splits).
+    """
+    ds = ds or mc.dataSet
+    header = read_header(ds, mc.resolve_path)
+    files = expand_data_files(mc.resolve_path(ds.dataPath))
+    first_file = files[0]  # the one holding the in-file header line, if any
+    if file_shard is not None:
+        idx, count = file_shard
+        files = files[idx::count] or files[idx % len(files):][:1]
+
+    has_header_line = not ds.headerPath  # header came from data file itself
+    frames = []
+    rows_left = max_rows
+    for path in files:
+        skip = 1 if (has_header_line and path == first_file) else 0
+        df = pd.read_csv(
+            path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
+            names=header, skiprows=skip, na_filter=False,
+            engine="c", compression="infer", quoting=3,
+            nrows=rows_left)
+        frames.append(df)
+        if rows_left is not None:
+            rows_left -= len(df)
+            if rows_left <= 0:
+                break
+    out = frames[0] if len(frames) == 1 else pd.concat(frames, ignore_index=True)
+    # NSColumn semantics: downstream matching is by simple name
+    # ('namespace::col' → 'col'), so expose simple names as the frame's
+    # columns (only when unambiguous).
+    simple = [simple_column_name(c) for c in header]
+    if len(set(simple)) == len(simple):
+        out.columns = simple
+    return out
+
+
+def missing_mask(values: np.ndarray, missing_values: Sequence[str]) -> np.ndarray:
+    """Boolean mask of missing/invalid tokens
+    (dataSet#missingOrInvalidValues)."""
+    miss = set(missing_values)
+    return np.isin(values, list(miss)) if miss else np.zeros(len(values), bool)

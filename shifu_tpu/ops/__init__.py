@@ -1,0 +1,1 @@
+from shifu_tpu.ops import binning, metrics, normalize, stats  # noqa: F401

@@ -1,0 +1,106 @@
+"""Binning drivers: ColumnarDataset → per-column boundaries/categories.
+
+Replaces `core/binning/*` (EqualPopulationBinning, MunroPatBinning,
+EqualIntervalBinning, CategoricalBinning) and the per-algorithm stats
+executors (`core/processor/stats/*`). All binning algorithms configured
+in `stats#binningAlgorithm` map to the exact batched kernels in
+`shifu_tpu/ops/stats.py` — distributed sketches are unnecessary when a
+full pass over the HBM-resident matrix is one kernel launch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.config.model_config import BinningMethod
+from shifu_tpu.ops import stats as stats_ops
+
+
+@dataclass
+class NumericBinning:
+    """Per-column numeric binning output (host side)."""
+    boundaries: List[np.ndarray]   # per column: [-inf, c1, ...] deduped
+    cuts_padded: np.ndarray        # (max_bins-1, C) device-ready, +inf padded
+
+
+def quantile_weights_for_method(method: BinningMethod, tags: np.ndarray,
+                                weights: np.ndarray) -> np.ndarray:
+    """Row weights defining the population that equal-population binning
+    equalizes over (`stats#binningMethod`):
+    EqualPositive → positives only, EqualNegative → negatives only,
+    EqualTotal → all rows, Weight* variants use the weight column
+    (`ModelStatsConf.BinningMethod`)."""
+    pos = tags > 0.5
+    base = {
+        BinningMethod.EqualPositive: pos.astype(np.float32),
+        BinningMethod.WeightEqualPositive: pos * weights,
+        BinningMethod.EqualNegative: (~pos).astype(np.float32),
+        BinningMethod.WeightEqualNegative: (~pos) * weights,
+        BinningMethod.EqualTotal: np.ones_like(weights),
+        BinningMethod.WeightEqualTotal: weights,
+        BinningMethod.EqualInterval: np.ones_like(weights),
+        BinningMethod.WeightEqualInterval: weights,
+    }[method]
+    return base.astype(np.float32)
+
+
+def compute_numeric_binning(values: np.ndarray, tags: np.ndarray,
+                            weights: np.ndarray, method: BinningMethod,
+                            max_bins: int) -> NumericBinning:
+    """values: (R, C) float32 NaN-missing. Produces ≤max_bins left-closed
+    bins per column with binBoundary[0] = -inf."""
+    r, c = values.shape
+    n_cuts = max(max_bins - 1, 1)
+    if c == 0:
+        return NumericBinning([], np.zeros((n_cuts, 0), np.float32))
+
+    if method in (BinningMethod.EqualInterval, BinningMethod.WeightEqualInterval):
+        vmin = np.nanmin(values, axis=0)
+        vmax = np.nanmax(values, axis=0)
+        steps = (np.arange(1, max_bins, dtype=np.float32)[:, None] / max_bins)
+        cuts = vmin[None, :] + steps * (vmax - vmin)[None, :]
+    else:
+        qw = quantile_weights_for_method(method, tags, weights)
+        cuts = np.asarray(stats_ops.weighted_quantiles(
+            jnp.asarray(values), jnp.broadcast_to(qw[:, None], (r, c)),
+            n_cuts))
+
+    boundaries: List[np.ndarray] = []
+    padded = np.full((n_cuts, c), np.inf, np.float32)
+    for j in range(c):
+        col = cuts[:, j]
+        col = col[~np.isnan(col) & ~np.isinf(col)]
+        uniq = np.unique(col)  # dedup: discrete columns collapse duplicates
+        boundaries.append(np.concatenate(([-np.inf], uniq)))
+        padded[:len(uniq), j] = uniq
+    return NumericBinning(boundaries, padded)
+
+
+@dataclass
+class CategoricalBinning:
+    """Per-column categorical binning: the bins ARE the categories;
+    the trailing bin is the missing/unseen bin
+    (`core/binning/CategoricalBinning.java`)."""
+    categories: List[List[str]]
+    vocab_lens: np.ndarray  # (C,) int32
+
+    @property
+    def max_slots(self) -> int:
+        return int(self.vocab_lens.max()) + 1 if len(self.vocab_lens) else 1
+
+
+def cap_categories(vocab: List[str], counts: Optional[np.ndarray],
+                   cate_max_bins: int) -> List[str]:
+    """Keep the most frequent `cate_max_bins` categories; the rest fold
+    into the missing bin (UpdateBinningInfoReducer.java:357-399 merges
+    small categories into the last/missing slot)."""
+    if cate_max_bins <= 0 or len(vocab) <= cate_max_bins:
+        return vocab
+    if counts is None:
+        return vocab[:cate_max_bins]
+    order = np.argsort(-np.asarray(counts))[:cate_max_bins]
+    return [vocab[i] for i in sorted(order)]

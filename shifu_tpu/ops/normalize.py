@@ -1,0 +1,401 @@
+"""Normalization kernels — all 29 NormType families, vectorized.
+
+The reference normalizes one value at a time in a Pig UDF
+(`core/Normalizer.java:124-380`, `udf/NormalizeUDF.java:146`). Here each
+family is one jitted elementwise/gather kernel over the whole
+(rows × cols) block; per-column parameters (mean/std/cuts/WOE tables)
+are stacked into dense LUTs so a bin-WOE lookup is a single fancy-index
+gather. Reference semantics reproduced exactly:
+
+- z-score clamps to mean ± cutoff·std and yields 0 when std ≤ 1e-5
+  (`Normalizer.computeZScore:890-905`);
+- missing numerics default to the mean (z-score 0,
+  `Normalizer.defaultMissingValue:723`);
+- categorical values map to their bin's posRate for z-score families
+  (`parseRawValue:643`, CategoryMissingNormType.POSRATE default);
+- WOE families read binCountWoe/binWeightedWoe with the trailing
+  missing bin (`woeNormalize:740-770`);
+- WOE_ZSCORE standardizes WOE by its count-weighted mean/std
+  (`calculateWoeMeanAndStdDev:849-876`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.config.model_config import NormType
+from shifu_tpu.ops.stats import bin_index_numeric
+
+STD_EPS = 1e-5  # Normalizer.computeZScore stdDev > 0.00001 guard
+
+
+# ---------------------------------------------------------------------------
+# Per-column parameter tables (host-built, device-consumed)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NumericNormTable:
+    """Stacked per-column parameters for the numeric block."""
+    mean: np.ndarray          # (C,)
+    std: np.ndarray           # (C,)
+    vmin: np.ndarray          # (C,)
+    vmax: np.ndarray          # (C,)
+    cuts: np.ndarray          # (B-1, C) interior boundaries, +inf padded
+    woe: np.ndarray           # (C, B+1) bin woe incl. trailing missing bin
+    weighted_woe: np.ndarray  # (C, B+1)
+    woe_mean: np.ndarray      # (C,) count-weighted woe mean
+    woe_std: np.ndarray       # (C,)
+    w_woe_mean: np.ndarray
+    w_woe_std: np.ndarray
+    bin_lower: np.ndarray     # (C, B+1) discrete-zscore value per bin
+    n_bins: np.ndarray        # (C,) real bin count per column
+
+
+@dataclass
+class CategoricalNormTable:
+    """Stacked per-column parameters for the categorical block."""
+    pos_rate: np.ndarray      # (C, V+1) bin posRate, trailing missing slot
+    woe: np.ndarray           # (C, V+1)
+    weighted_woe: np.ndarray  # (C, V+1)
+    woe_mean: np.ndarray      # (C,)
+    woe_std: np.ndarray
+    w_woe_mean: np.ndarray
+    w_woe_std: np.ndarray
+    mean: np.ndarray          # (C,) column mean (of posrate-encoded values)
+    std: np.ndarray
+    vocab_len: np.ndarray     # (C,) int32
+
+
+def _woe_mean_std(woe: np.ndarray, pos: np.ndarray, neg: np.ndarray) -> Tuple[float, float]:
+    """Count-weighted WOE mean/std (`Normalizer.calculateWoeMeanAndStdDev`)."""
+    cnt = np.asarray(pos, np.float64) + np.asarray(neg, np.float64)
+    total = cnt.sum()
+    if total <= 1:
+        return 0.0, 0.0
+    w = np.asarray(woe, np.float64)
+    s = float(np.sum(w * cnt))
+    sq = float(np.sum(w * w * cnt))
+    mean = s / total
+    std = float(np.sqrt(abs((sq - s * s / total) / (total - 1))))
+    return mean, std
+
+
+def _padded(rows: List[np.ndarray], width: int, fill: float) -> np.ndarray:
+    out = np.full((len(rows), width), fill, np.float32)
+    for i, r in enumerate(rows):
+        out[i, :min(len(r), width)] = r[:width]
+    return out
+
+
+def build_numeric_table(ccs: List[ColumnConfig], max_bins: int) -> NumericNormTable:
+    """Stack ColumnConfig binning/stats of numeric columns into LUTs.
+    `ccs` must be the numeric candidate columns in matrix order."""
+    c = len(ccs)
+    mean = np.zeros(c, np.float32)
+    std = np.ones(c, np.float32)
+    vmin = np.zeros(c, np.float32)
+    vmax = np.ones(c, np.float32)
+    cuts = np.full((max(max_bins - 1, 1), c), np.inf, np.float32)
+    woe_rows, wwoe_rows, lower_rows = [], [], []
+    n_bins = np.zeros(c, np.int32)
+    wm = np.zeros((4, c), np.float32)  # woe_mean, woe_std, w_woe_mean, w_woe_std
+    for j, cc in enumerate(ccs):
+        st, bn = cc.columnStats, cc.columnBinning
+        mean[j] = st.mean if st.mean is not None else 0.0
+        std[j] = st.stdDev if st.stdDev is not None else 1.0
+        vmin[j] = st.min if st.min is not None else 0.0
+        vmax[j] = st.max if st.max is not None else 1.0
+        bb = np.asarray(bn.binBoundary or [-np.inf], np.float64)
+        interior = bb[1:]
+        interior = interior[np.isfinite(interior)]
+        cuts[:len(interior), j] = interior
+        k = len(interior) + 1
+        n_bins[j] = k
+        woe = np.asarray(bn.binCountWoe or np.zeros(k + 1), np.float64)
+        wwoe = np.asarray(bn.binWeightedWoe if bn.binWeightedWoe is not None
+                          else woe, np.float64)
+        woe_rows.append(woe)
+        wwoe_rows.append(wwoe)
+        pos = np.asarray(bn.binCountPos or np.zeros(len(woe)), np.float64)
+        neg = np.asarray(bn.binCountNeg or np.zeros(len(woe)), np.float64)
+        wm[0, j], wm[1, j] = _woe_mean_std(woe, pos, neg)
+        wm[2, j], wm[3, j] = _woe_mean_std(wwoe, pos, neg)
+        # discrete-zscore values: bin0 → min, bin i → boundary i, missing → mean
+        lower = np.concatenate(([vmin[j]], interior, [mean[j]]))
+        lower_rows.append(lower)
+    width = max_bins + 1
+    return NumericNormTable(
+        mean=mean, std=std, vmin=vmin, vmax=vmax, cuts=cuts,
+        woe=_padded(woe_rows, width, 0.0),
+        weighted_woe=_padded(wwoe_rows, width, 0.0),
+        woe_mean=wm[0], woe_std=wm[1], w_woe_mean=wm[2], w_woe_std=wm[3],
+        bin_lower=_padded(lower_rows, width, 0.0), n_bins=n_bins)
+
+
+def build_categorical_table(ccs: List[ColumnConfig]) -> CategoricalNormTable:
+    """Stack categorical ColumnConfigs; slot layout matches the codes
+    produced by `build_columnar` with the column's binCategory as vocab
+    (missing/unseen = trailing slot)."""
+    c = len(ccs)
+    vlen = np.asarray([len(cc.columnBinning.binCategory or []) for cc in ccs],
+                      np.int32)
+    width = int(vlen.max()) + 1 if c else 1
+    pr_rows, woe_rows, wwoe_rows = [], [], []
+    wm = np.zeros((4, c), np.float32)
+    mean = np.zeros(c, np.float32)
+    std = np.ones(c, np.float32)
+    for j, cc in enumerate(ccs):
+        bn, st = cc.columnBinning, cc.columnStats
+        k = vlen[j]
+        pr = np.asarray(bn.binPosRate or np.zeros(k + 1), np.float64)
+        woe = np.asarray(bn.binCountWoe or np.zeros(k + 1), np.float64)
+        wwoe = np.asarray(bn.binWeightedWoe if bn.binWeightedWoe is not None
+                          else woe, np.float64)
+        pr_rows.append(pr)
+        woe_rows.append(woe)
+        wwoe_rows.append(wwoe)
+        pos = np.asarray(bn.binCountPos or np.zeros(len(woe)), np.float64)
+        neg = np.asarray(bn.binCountNeg or np.zeros(len(woe)), np.float64)
+        wm[0, j], wm[1, j] = _woe_mean_std(woe, pos, neg)
+        wm[2, j], wm[3, j] = _woe_mean_std(wwoe, pos, neg)
+        mean[j] = st.mean if st.mean is not None else 0.0
+        std[j] = st.stdDev if st.stdDev is not None else 1.0
+    return CategoricalNormTable(
+        pos_rate=_padded(pr_rows, width, 0.0),
+        woe=_padded(woe_rows, width, 0.0),
+        weighted_woe=_padded(wwoe_rows, width, 0.0),
+        woe_mean=wm[0], woe_std=wm[1], w_woe_mean=wm[2], w_woe_std=wm[3],
+        mean=mean, std=std, vocab_len=vlen)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def zscore(values: jax.Array, mean: jax.Array, std: jax.Array,
+           cutoff: float) -> jax.Array:
+    """`Normalizer.computeZScore` vectorized: clamp then scale; 0 when
+    std tiny; NaN (missing) → mean → 0."""
+    v = jnp.where(jnp.isnan(values), mean[None, :], values)
+    hi = mean + cutoff * std
+    lo = mean - cutoff * std
+    v = jnp.clip(v, lo[None, :], hi[None, :])
+    z = (v - mean[None, :]) / jnp.where(std < STD_EPS, 1.0, std)[None, :]
+    return jnp.where(std[None, :] < STD_EPS, 0.0, z)
+
+
+@jax.jit
+def maxmin(values: jax.Array, vmin: jax.Array, vmax: jax.Array) -> jax.Array:
+    rng = vmax - vmin
+    ok = rng > 1e-7
+    v = jnp.where(jnp.isnan(values), vmin[None, :], values)
+    out = (v - vmin[None, :]) / jnp.where(ok, rng, 1.0)[None, :]
+    return jnp.where(ok[None, :], out, 0.0)
+
+
+@jax.jit
+def gather_bin_lut(bin_idx: jax.Array, lut: jax.Array,
+                   n_bins: jax.Array) -> jax.Array:
+    """out[r,c] = lut[c, min(bin_idx[r,c], n_bins[c])] — the clamp routes
+    the device-side fixed missing slot onto each column's real missing
+    bin (ragged bin counts padded to a fixed width)."""
+    idx = jnp.minimum(bin_idx, n_bins[None, :])
+    c = lut.shape[0]
+    return lut[jnp.arange(c)[None, :], idx]
+
+
+@jax.jit
+def gather_cat_lut(codes: jax.Array, lut: jax.Array,
+                   vocab_len: jax.Array) -> jax.Array:
+    """Categorical value lookup; code −1 (missing/unseen) → trailing
+    missing slot at vocab_len[c]."""
+    idx = jnp.where(codes < 0, vocab_len[None, :], codes)
+    idx = jnp.minimum(idx, lut.shape[1] - 1)
+    c = lut.shape[0]
+    return lut[jnp.arange(c)[None, :], idx]
+
+
+# ---------------------------------------------------------------------------
+# Family dispatch
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NormResult:
+    """Normalized output blocks.
+
+    dense: (R, F) float32 model inputs (NN/LR/GBT consume this).
+    index: (R, K) int32 embedding indices (WDL/MTL; missing = vocab_len).
+    dense_names / index_names: per-output column names.
+    index_vocab_sizes: embedding table sizes (vocab_len + 1 missing slot).
+    """
+    dense: np.ndarray
+    dense_names: List[str]
+    index: np.ndarray = field(default_factory=lambda: np.zeros((0, 0), np.int32))
+    index_names: List[str] = field(default_factory=list)
+    index_vocab_sizes: List[int] = field(default_factory=list)
+
+
+def _num_family_value(norm_type: NormType, values, tbl: NumericNormTable,
+                      cutoff: float):
+    """Dense transform of the numeric block for a given family."""
+    cuts = jnp.asarray(tbl.cuts)
+    if norm_type in (NormType.WOE, NormType.WOE_INDEX, NormType.WOE_APPEND_INDEX,
+                     NormType.ASIS_WOE):
+        bi = bin_index_numeric(values, cuts)
+        return gather_bin_lut(bi, jnp.asarray(tbl.woe), jnp.asarray(tbl.n_bins))
+    if norm_type is NormType.WEIGHT_WOE:
+        bi = bin_index_numeric(values, cuts)
+        return gather_bin_lut(bi, jnp.asarray(tbl.weighted_woe),
+                              jnp.asarray(tbl.n_bins))
+    if norm_type in (NormType.WOE_ZSCORE, NormType.WOE_ZSCALE,
+                     NormType.WOE_ZSCALE_INDEX, NormType.WOE_ZSCALE_APPEND_INDEX):
+        bi = bin_index_numeric(values, cuts)
+        woe = gather_bin_lut(bi, jnp.asarray(tbl.woe), jnp.asarray(tbl.n_bins))
+        return zscore(woe, jnp.asarray(tbl.woe_mean), jnp.asarray(tbl.woe_std),
+                      cutoff)
+    if norm_type in (NormType.WEIGHT_WOE_ZSCORE, NormType.WEIGHT_WOE_ZSCALE):
+        bi = bin_index_numeric(values, cuts)
+        woe = gather_bin_lut(bi, jnp.asarray(tbl.weighted_woe),
+                             jnp.asarray(tbl.n_bins))
+        return zscore(woe, jnp.asarray(tbl.w_woe_mean),
+                      jnp.asarray(tbl.w_woe_std), cutoff)
+    if norm_type in (NormType.DISCRETE_ZSCORE, NormType.DISCRETE_ZSCALE):
+        bi = bin_index_numeric(values, cuts)
+        disc = gather_bin_lut(bi, jnp.asarray(tbl.bin_lower),
+                              jnp.asarray(tbl.n_bins))
+        return zscore(disc, jnp.asarray(tbl.mean), jnp.asarray(tbl.std), cutoff)
+    if norm_type is NormType.MAXMIN_INDEX:
+        return maxmin(values, jnp.asarray(tbl.vmin), jnp.asarray(tbl.vmax))
+    if norm_type is NormType.ASIS_PR:
+        return jnp.where(jnp.isnan(values), jnp.asarray(tbl.mean)[None, :], values)
+    # default: all z-score families (ZSCORE/ZSCALE/OLD_*/ZSCALE_ORDINAL/
+    # ZSCALE_ONEHOT numeric side/*_INDEX zscale / APPEND_INDEX)
+    return zscore(values, jnp.asarray(tbl.mean), jnp.asarray(tbl.std), cutoff)
+
+
+def _cat_family_value(norm_type: NormType, codes, tbl: CategoricalNormTable,
+                      cutoff: float):
+    """Dense transform of the categorical block (for families that keep
+    categoricals dense)."""
+    vl = jnp.asarray(tbl.vocab_len)
+    if norm_type.is_woe or norm_type is NormType.ASIS_WOE or \
+            norm_type in (NormType.HYBRID,):
+        lut = tbl.weighted_woe if norm_type.is_weighted else tbl.woe
+        woe = gather_cat_lut(codes, jnp.asarray(lut), vl)
+        if norm_type in (NormType.WOE_ZSCORE, NormType.WOE_ZSCALE):
+            return zscore(woe, jnp.asarray(tbl.woe_mean),
+                          jnp.asarray(tbl.woe_std), cutoff)
+        if norm_type in (NormType.WEIGHT_WOE_ZSCORE, NormType.WEIGHT_WOE_ZSCALE):
+            return zscore(woe, jnp.asarray(tbl.w_woe_mean),
+                          jnp.asarray(tbl.w_woe_std), cutoff)
+        return woe
+    if norm_type is NormType.WEIGHT_HYBRID:
+        return gather_cat_lut(codes, jnp.asarray(tbl.weighted_woe), vl)
+    if norm_type in (NormType.ZSCALE_ORDINAL,):
+        return jnp.where(codes < 0, vl[None, :], codes).astype(jnp.float32)
+    if norm_type in (NormType.OLD_ZSCORE, NormType.OLD_ZSCALE):
+        # old behavior: posRate value, NOT z-scored (Normalizer.java:545-547)
+        return gather_cat_lut(codes, jnp.asarray(tbl.pos_rate), vl)
+    if norm_type in (NormType.ASIS_PR,):
+        return gather_cat_lut(codes, jnp.asarray(tbl.pos_rate), vl)
+    # default z-score families: posRate then z-score (parseRawValue POSRATE)
+    pr = gather_cat_lut(codes, jnp.asarray(tbl.pos_rate), vl)
+    return zscore(pr, jnp.asarray(tbl.mean), jnp.asarray(tbl.std), cutoff)
+
+
+def _onehot_block(idx: np.ndarray, widths: np.ndarray, names: List[str]):
+    """Expand int bin/cat indices (R, C) to concatenated one-hot columns
+    (missing gets its own slot, matching OneHotNormalize)."""
+    cols, out_names = [], []
+    for j, w in enumerate(widths):
+        w = int(w) + 1
+        oh = np.eye(w, dtype=np.float32)[np.clip(idx[:, j], 0, w - 1)]
+        cols.append(oh)
+        out_names.extend(f"{names[j]}_{k}" for k in range(w))
+    if not cols:
+        return np.zeros((idx.shape[0], 0), np.float32), []
+    return np.concatenate(cols, axis=1), out_names
+
+
+def normalize_dataset(norm_type: NormType, cutoff: float,
+                      numeric: np.ndarray, num_names: List[str],
+                      num_tbl: Optional[NumericNormTable],
+                      cat_codes: np.ndarray, cat_names: List[str],
+                      cat_tbl: Optional[CategoricalNormTable]) -> NormResult:
+    """Full-dataset normalization: raw columnar blocks → model inputs.
+
+    Mirrors `Normalizer.normalize`/`fullNormalize` dispatch
+    (`Normalizer.java:233-400`) but as whole-matrix kernels. Outputs keep
+    numeric block first, categorical block second; multi-output families
+    (ONEHOT, APPEND_INDEX) expand in place.
+    """
+    r = numeric.shape[0] if numeric.size else cat_codes.shape[0]
+    dense_parts: List[np.ndarray] = []
+    dense_names: List[str] = []
+    index_mat = np.zeros((r, 0), np.int32)
+    index_names: List[str] = []
+    index_vocabs: List[int] = []
+
+    has_num = num_tbl is not None and numeric.shape[1] > 0
+    has_cat = cat_tbl is not None and cat_codes.shape[1] > 0
+
+    # ---- numeric block ----
+    if has_num:
+        jv = jnp.asarray(numeric)
+        if norm_type is NormType.ONEHOT:
+            bi = np.asarray(bin_index_numeric(jv, jnp.asarray(num_tbl.cuts)))
+            bi = np.minimum(bi, num_tbl.n_bins[None, :])
+            block, names = _onehot_block(bi, num_tbl.n_bins, num_names)
+            dense_parts.append(block)
+            dense_names.extend(names)
+        elif norm_type is NormType.INDEX:
+            bi = np.asarray(bin_index_numeric(jv, jnp.asarray(num_tbl.cuts)))
+            bi = np.minimum(bi, num_tbl.n_bins[None, :])
+            index_mat = np.concatenate([index_mat, bi.astype(np.int32)], axis=1)
+            index_names.extend(num_names)
+            index_vocabs.extend((num_tbl.n_bins + 1).tolist())
+        else:
+            dense = np.asarray(_num_family_value(norm_type, jv, num_tbl, cutoff))
+            dense_parts.append(dense)
+            dense_names.extend(num_names)
+            if norm_type in (NormType.ZSCALE_APPEND_INDEX,
+                             NormType.ZSCORE_APPEND_INDEX,
+                             NormType.WOE_APPEND_INDEX,
+                             NormType.WOE_ZSCALE_APPEND_INDEX):
+                bi = np.asarray(bin_index_numeric(jv, jnp.asarray(num_tbl.cuts)))
+                bi = np.minimum(bi, num_tbl.n_bins[None, :])
+                index_mat = np.concatenate([index_mat, bi.astype(np.int32)], axis=1)
+                index_names.extend(num_names)
+                index_vocabs.extend((num_tbl.n_bins + 1).tolist())
+
+    # ---- categorical block ----
+    if has_cat:
+        jc = jnp.asarray(cat_codes)
+        if norm_type in (NormType.ONEHOT, NormType.ZSCALE_ONEHOT):
+            codes = np.where(cat_codes < 0, cat_tbl.vocab_len[None, :], cat_codes)
+            block, names = _onehot_block(codes, cat_tbl.vocab_len, cat_names)
+            dense_parts.append(block)
+            dense_names.extend(names)
+        elif norm_type.is_index:
+            codes = np.where(cat_codes < 0, cat_tbl.vocab_len[None, :],
+                             cat_codes).astype(np.int32)
+            index_mat = np.concatenate([index_mat, codes], axis=1)
+            index_names.extend(cat_names)
+            index_vocabs.extend((cat_tbl.vocab_len + 1).tolist())
+        else:
+            dense = np.asarray(_cat_family_value(norm_type, jc, cat_tbl, cutoff))
+            dense_parts.append(dense)
+            dense_names.extend(cat_names)
+
+    dense = (np.concatenate(dense_parts, axis=1) if dense_parts
+             else np.zeros((r, 0), np.float32))
+    return NormResult(dense=dense.astype(np.float32), dense_names=dense_names,
+                      index=index_mat, index_names=index_names,
+                      index_vocab_sizes=index_vocabs)

@@ -1,0 +1,9 @@
+"""Pipeline-step processors — the orchestration layer (L6).
+
+One module per pipeline step, mirroring the reference's
+`core/processor/*Processor.java` layout: each exposes `run(ctx) -> int`
+(0 = success) over a shared ProcessorContext that loads/validates/saves
+the model-set configs (`BasicModelProcessor` lifecycle).
+"""
+
+from shifu_tpu.processor.base import ProcessorContext  # noqa: F401

@@ -1,0 +1,57 @@
+"""Shared processor lifecycle — `core/processor/BasicModelProcessor.java`.
+
+Load ModelConfig/ColumnConfig, validate for the step
+(`ModelInspector.probe`), run, write ColumnConfig back. The reference
+also syncs configs to HDFS here; with a single filesystem namespace
+that step disappears.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from shifu_tpu.config.column_config import (ColumnConfig, load_column_configs,
+                                            save_column_configs)
+from shifu_tpu.config.inspector import ModelStep, probe
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.config.path_finder import PathFinder
+
+log = logging.getLogger("shifu_tpu")
+
+
+@dataclass
+class ProcessorContext:
+    model_config: ModelConfig
+    column_configs: List[ColumnConfig] = field(default_factory=list)
+    path_finder: PathFinder = None  # type: ignore[assignment]
+
+    @classmethod
+    def load(cls, model_set_dir: str, need_columns: bool = True
+             ) -> "ProcessorContext":
+        mc = ModelConfig.load(model_set_dir)
+        pf = PathFinder(mc, root=model_set_dir if os.path.isdir(model_set_dir)
+                        else os.path.dirname(model_set_dir))
+        ccs: List[ColumnConfig] = []
+        cc_path = pf.column_config_path()
+        if need_columns and os.path.exists(cc_path):
+            ccs = load_column_configs(cc_path)
+        return cls(model_config=mc, column_configs=ccs, path_finder=pf)
+
+    def validate(self, step: ModelStep) -> None:
+        res = probe(self.model_config, step)
+        if not res.status:
+            raise ValueError(
+                f"ModelConfig validation failed for step {step.value}: "
+                + "; ".join(res.causes))
+
+    def save_column_configs(self) -> None:
+        save_column_configs(self.column_configs, self.path_finder.column_config_path())
+
+    def require_columns(self) -> None:
+        if not self.column_configs:
+            raise FileNotFoundError(
+                f"ColumnConfig.json not found under {self.path_finder.root}; "
+                "run `init` first")

@@ -1,0 +1,101 @@
+"""`shifu init` — build ColumnConfig.json from the data header.
+
+Mirrors `core/processor/InitModelProcessor.java:75-117`: read header,
+create one ColumnConfig per column, set flags from
+target/weight/meta/categorical/forceselect/forceremove config, and
+auto-detect column types. The reference runs a distinct-count MapReduce
+job with a HyperLogLog-ish sketch (`core/autotype/
+AutoTypeDistinctCountMapper.java` + CountAndFrequentItemsWritable);
+here a host-side sample pass computes exact distinct counts and
+numeric-parse ratios — the dataset sample fits comfortably in host RAM.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Set
+
+import numpy as np
+import pandas as pd
+
+from shifu_tpu.config.column_config import (ColumnConfig, ColumnFlag,
+                                            ColumnType)
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.data.reader import read_header, read_raw_table, simple_column_name
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+# auto-type thresholds (AutoTypeDistinctCountReducer semantics: a column
+# whose values mostly fail double-parse, or with few distinct values, is
+# categorical)
+NUMERIC_PARSE_RATIO = 0.95
+AUTOTYPE_SAMPLE_ROWS = 100_000
+
+
+def run(ctx: ProcessorContext, auto_type: bool = True,
+        sample_rows: int = AUTOTYPE_SAMPLE_ROWS) -> int:
+    mc = ctx.model_config
+    ctx.validate(ModelStep.INIT)
+    header = read_header(mc.dataSet, mc.resolve_path)
+
+    target = simple_column_name(mc.dataSet.targetColumnName)
+    weight = simple_column_name(mc.dataSet.weightColumnName) \
+        if mc.dataSet.weightColumnName else ""
+    meta = {simple_column_name(n) for n in
+            mc.column_names_from_file(mc.dataSet.metaColumnNameFile)}
+    categorical = {simple_column_name(n) for n in
+                   mc.column_names_from_file(mc.dataSet.categoricalColumnNameFile)}
+    force_sel = {simple_column_name(n) for n in
+                 mc.column_names_from_file(mc.varSelect.forceSelectColumnNameFile)}
+    force_rem = {simple_column_name(n) for n in
+                 mc.column_names_from_file(mc.varSelect.forceRemoveColumnNameFile)}
+
+    sample: Optional[pd.DataFrame] = None
+    if auto_type:
+        sample = read_raw_table(mc, max_rows=sample_rows)
+
+    ccs = []
+    for i, name in enumerate(header):
+        sname = simple_column_name(name)
+        cc = ColumnConfig(columnNum=i, columnName=sname,
+                          version=mc.basic.version)
+        if sname == target:
+            cc.columnFlag = ColumnFlag.Target
+        elif weight and sname == weight:
+            cc.columnFlag = ColumnFlag.Weight
+        elif sname in meta:
+            cc.columnFlag = ColumnFlag.Meta
+        elif sname in force_rem:
+            cc.columnFlag = ColumnFlag.ForceRemove
+        elif sname in force_sel:
+            cc.columnFlag = ColumnFlag.ForceSelect
+            cc.finalSelect = True
+        if sname in categorical:
+            cc.columnType = ColumnType.C
+        elif auto_type and sample is not None and sname in sample.columns \
+                and cc.columnFlag not in (ColumnFlag.Target, ColumnFlag.Weight):
+            cc.columnType = _detect_type(sample[sname], mc)
+        ccs.append(cc)
+
+    ctx.column_configs = ccs
+    ctx.save_column_configs()
+    log.info("init: %d columns (%d categorical), target=%s", len(ccs),
+             sum(1 for c in ccs if c.is_categorical), target)
+    return 0
+
+
+def _detect_type(series: pd.Series, mc: ModelConfig) -> ColumnType:
+    """Numeric-parse-ratio + distinct-count auto-typing
+    (InitModelProcessor distinct-count job's decision rule)."""
+    s = series.astype(str).str.strip()
+    miss = s.isin([str(m) for m in mc.dataSet.missingOrInvalidValues])
+    valid = s[~miss]
+    if len(valid) == 0:
+        return ColumnType.N
+    parsed = pd.to_numeric(valid, errors="coerce")
+    ratio = float(parsed.notna().mean())
+    if ratio < NUMERIC_PARSE_RATIO:
+        return ColumnType.C
+    return ColumnType.N

@@ -1,0 +1,144 @@
+"""`shifu norm` — produce the normalized training matrix.
+
+Replaces `core/processor/NormalizeModelProcessor.java:47-79` +
+`pig/Normalize.pig:35-42` + `udf/NormalizeUDF.java:146`. Output is a
+columnar .npz (dense float block, embedding-index block, tags, weights)
+plus a JSON sidecar of output names/vocab sizes — the direct HBM-load
+format for training, replacing the delimited text the reference writes
+back to HDFS. Tree algorithms read "cleaned" (raw numeric + category
+codes) data instead of normalized values
+(`TrainModelProcessor.prepareCommonParams:1547-1550`); `run_clean`
+produces that variant.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.config.model_config import ModelConfig, NormType
+from shifu_tpu.data.dataset import ColumnarDataset, build_columnar
+from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.reader import read_raw_table
+from shifu_tpu.ops.normalize import (build_categorical_table,
+                                     build_numeric_table, normalize_dataset,
+                                     NormResult)
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def selected_candidates(ccs: List[ColumnConfig]) -> List[ColumnConfig]:
+    """Columns that feed the model: finalSelect ones if varselect ran,
+    else all candidates (NormalizeUDF column-selection rule)."""
+    final = [c for c in ccs if c.finalSelect and c.is_candidate]
+    if final:
+        return final
+    return [c for c in ccs if c.is_candidate]
+
+
+def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
+                             cols: List[ColumnConfig],
+                             ds_conf=None,
+                             apply_filter: bool = True) -> ColumnarDataset:
+    """Read raw data and build columnar blocks for `cols`, with
+    categorical vocabularies pinned to ColumnConfig binCategory so codes
+    line up with the stats phase."""
+    df = read_raw_table(mc, ds=ds_conf)
+    ds_conf = ds_conf or mc.dataSet
+    if apply_filter and ds_conf.filterExpressions:
+        keep = DataPurifier(ds_conf.filterExpressions).apply(df)
+        df = df[keep].reset_index(drop=True)
+    vocabs = {c.columnNum: (c.columnBinning.binCategory or [])
+              for c in cols if c.is_categorical}
+    return build_columnar(mc, _restrict(ccs, cols), df, vocabs=vocabs)
+
+
+def _restrict(ccs: List[ColumnConfig], cols: List[ColumnConfig]):
+    """Keep target/weight/meta flags but only `cols` as candidates."""
+    keep_nums = {c.columnNum for c in cols}
+    out = []
+    for c in ccs:
+        if c.is_meta or c.columnNum in keep_nums:
+            out.append(c)
+    return out
+
+
+def normalize_columns(mc: ModelConfig, cols: List[ColumnConfig],
+                      dset: ColumnarDataset) -> NormResult:
+    num_ccs = [c for c in cols if c.is_numerical
+               and c.columnNum in set(dset.num_column_nums.tolist())]
+    # order must match matrix order
+    num_by_num = {c.columnNum: c for c in num_ccs}
+    num_ordered = [num_by_num[int(n)] for n in dset.num_column_nums
+                   if int(n) in num_by_num]
+    cat_by_num = {c.columnNum: c for c in cols if c.is_categorical}
+    cat_ordered = [cat_by_num[int(n)] for n in dset.cat_column_nums
+                   if int(n) in cat_by_num]
+
+    num_tbl = build_numeric_table(num_ordered, mc.stats.maxNumBin) \
+        if num_ordered else None
+    cat_tbl = build_categorical_table(cat_ordered) if cat_ordered else None
+    return normalize_dataset(
+        mc.normalize.normType, mc.normalize.stdDevCutOff,
+        dset.numeric, dset.num_names, num_tbl,
+        dset.cat_codes, dset.cat_names, cat_tbl)
+
+
+def save_normalized(path: str, result: NormResult, tags: np.ndarray,
+                    weights: np.ndarray) -> None:
+    os.makedirs(path, exist_ok=True)
+    np.savez_compressed(
+        os.path.join(path, "data.npz"),
+        dense=result.dense, index=result.index,
+        tags=tags.astype(np.float32), weights=weights.astype(np.float32))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"denseNames": result.dense_names,
+                   "indexNames": result.index_names,
+                   "indexVocabSizes": result.index_vocab_sizes}, f, indent=1)
+
+
+def load_normalized(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
+    data = dict(np.load(os.path.join(path, "data.npz")))
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    return data, meta
+
+
+def run(ctx: ProcessorContext,
+        dataset: Optional[ColumnarDataset] = None) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.validate(ModelStep.NORMALIZE)
+    ctx.require_columns()
+    cols = selected_candidates(ctx.column_configs)
+    if dataset is None:
+        dataset = load_dataset_for_columns(mc, ctx.column_configs, cols)
+    result = normalize_columns(mc, cols, dataset)
+    out = ctx.path_finder.normalized_data_path()
+    save_normalized(out, result, dataset.tags, dataset.weights)
+
+    # cleaned data for tree algorithms: raw numeric (NaN = missing, trees
+    # route it explicitly) + category codes with missing → vocab_len slot
+    if dataset.cat_codes.shape[1]:
+        vlen = np.asarray([len(v) for v in dataset.vocabs], np.int32)
+        codes = np.where(dataset.cat_codes < 0, vlen[None, :],
+                         dataset.cat_codes).astype(np.int32)
+    else:
+        codes = dataset.cat_codes
+    clean = NormResult(
+        dense=dataset.numeric, dense_names=dataset.num_names,
+        index=codes, index_names=dataset.cat_names,
+        index_vocab_sizes=[len(v) + 1 for v in dataset.vocabs])
+    save_normalized(ctx.path_finder.cleaned_data_path(), clean,
+                    dataset.tags, dataset.weights)
+    log.info("norm: %d rows → dense %s, index %s in %.2fs", dataset.num_rows,
+             result.dense.shape, result.index.shape, time.time() - t0)
+    return 0

@@ -1,0 +1,233 @@
+"""`shifu stats` — per-column binning + statistics, TPU-native.
+
+Replaces the reference's Pig/MR stats plane
+(`core/processor/StatsModelProcessor.java:105`, stats executors under
+`core/processor/stats/`, `pig/stats/hadoop2/Stats.pig:19-34`,
+`UpdateBinningInfo` MR job): the raw table becomes columnar matrices in
+HBM and both passes (sketch + exact recount) collapse into the batched
+kernels of `shifu_tpu/ops/stats.py`. All binningAlgorithm settings give
+exact results here (see ops/binning.py docstring).
+
+Writes back every ColumnConfig field the reference's
+`updateColumnConfigWithPreTrainingStats`
+(`MapReducerStatsWorker.java:149`) fills: binning arrays, counts,
+posRate, woe tables, ks/iv/mean/stddev/min/max/median/missing, and
+weighted variants.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.data.dataset import ColumnarDataset, build_columnar
+from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.reader import read_raw_table
+from shifu_tpu.ops import stats as stats_ops
+from shifu_tpu.ops.binning import (cap_categories, compute_numeric_binning)
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
+        seed: int = 12306) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.validate(ModelStep.STATS)
+    ctx.require_columns()
+    ccs = ctx.column_configs
+
+    if dataset is None:
+        df = read_raw_table(mc)
+        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+        if mc.stats.sampleRate < 1.0:
+            rng = np.random.default_rng(seed)
+            samp = rng.random(len(df)) < mc.stats.sampleRate
+            if mc.stats.sampleNegOnly:
+                # sample only negatives, keep all positives (DataSampler)
+                from shifu_tpu.data.reader import simple_column_name
+                tgt_col = simple_column_name(mc.dataSet.targetColumnName)
+                tgt = df[tgt_col].astype(str).str.strip()
+                samp |= tgt.isin(mc.pos_tags).to_numpy()
+            keep &= samp
+        df = df[keep].reset_index(drop=True)
+        dataset = build_columnar(mc, ccs, df)
+
+    compute_stats(ctx, dataset)
+    ctx.save_column_configs()
+    log.info("stats: %d rows, %d num + %d cat columns in %.2fs",
+             dataset.num_rows, len(dataset.num_names), len(dataset.cat_names),
+             time.time() - t0)
+    return 0
+
+
+def compute_stats(ctx: ProcessorContext, dset: ColumnarDataset) -> None:
+    mc = ctx.model_config
+    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
+    tags, weights = dset.tags, dset.weights
+    jt, jw = jnp.asarray(tags), jnp.asarray(weights)
+    max_bins = mc.stats.maxNumBin
+
+    # ---------------- numeric columns ----------------
+    if dset.numeric.shape[1] > 0:
+        values = jnp.asarray(dset.numeric)
+        binning = compute_numeric_binning(dset.numeric, tags, weights,
+                                          mc.stats.binningMethod, max_bins)
+        bin_idx = stats_ops.bin_index_numeric(values, jnp.asarray(binning.cuts_padded))
+        counts = {k: np.asarray(v) for k, v in stats_ops.bin_accumulate(
+            bin_idx, jt, jw, max_bins + 1).items()}
+        moments = {k: np.asarray(v) for k, v in
+                   stats_ops.moment_stats(values).items()}
+        quartiles = np.asarray(stats_ops.weighted_quantiles(
+            values, jnp.ones_like(values), 3))  # p25 / median / p75
+
+        for j, col_num in enumerate(dset.num_column_nums):
+            cc = cc_by_num[int(col_num)]
+            bounds = binning.boundaries[j]
+            k = len(bounds)
+            _fill_numeric(cc, bounds, k, j, counts, moments, quartiles,
+                          max_bins, dset.num_rows)
+
+    # ---------------- categorical columns ----------------
+    if dset.cat_codes.shape[1] > 0:
+        vocab_lens = np.asarray([len(v) for v in dset.vocabs], np.int32)
+        slots = int(vocab_lens.max()) + 1 if len(vocab_lens) else 1
+        ccounts = {k: np.asarray(v) for k, v in stats_ops.cat_bin_accumulate(
+            jnp.asarray(dset.cat_codes), jt, jw, jnp.asarray(vocab_lens),
+            slots).items()}
+        for j, col_num in enumerate(dset.cat_column_nums):
+            cc = cc_by_num[int(col_num)]
+            vocab = dset.vocabs[j]
+            # optional cardinality cap: fold smallest categories into missing
+            cap = mc.stats.cateMaxNumBin
+            kept = vocab
+            if cap > 0 and len(vocab) > cap:
+                tot = ccounts["count_pos"][j] + ccounts["count_neg"][j]
+                kept = cap_categories(vocab, tot[:len(vocab)], cap)
+            _fill_categorical(cc, vocab, kept, j, ccounts, int(vocab_lens[j]),
+                              dset.num_rows, dset.cat_codes[:, j], tags,
+                              weights)
+
+
+def _fill_numeric(cc: ColumnConfig, bounds: np.ndarray, k: int, j: int,
+                  counts, moments, quartiles, max_bins: int, n_rows: int) -> None:
+    """Write numeric binning + stats into one ColumnConfig.
+
+    Device count arrays are fixed-width (max_bins+1 slots, missing at
+    slot max_bins); the column's real bins are slots 0..k-1, so arrays
+    written to JSON are [real bins..., missing] of length k+1 — the
+    reference's binSize+1 layout (UpdateBinningInfoReducer.java:200)."""
+    def squeeze(arr):
+        row = arr[j]
+        return np.concatenate([row[:k], [row[max_bins]]])
+
+    pos = squeeze(counts["count_pos"])
+    neg = squeeze(counts["count_neg"])
+    wpos = squeeze(counts["weight_pos"])
+    wneg = squeeze(counts["weight_neg"])
+    ks, iv, woe, bin_woe = stats_ops.column_metrics(pos, neg)
+    wks, wiv, wwoe, wbin_woe = stats_ops.column_metrics(wpos, wneg)
+
+    bn = cc.columnBinning
+    bn.length = k
+    bn.binBoundary = [float(b) for b in bounds]
+    bn.binCategory = None
+    bn.binCountPos = [int(x) for x in pos]
+    bn.binCountNeg = [int(x) for x in neg]
+    bn.binWeightedPos = [float(x) for x in wpos]
+    bn.binWeightedNeg = [float(x) for x in wneg]
+    tot = pos + neg
+    bn.binPosRate = [float(p / t) if t > 0 else 0.0 for p, t in zip(pos, tot)]
+    bn.binCountWoe = [float(x) for x in bin_woe]
+    bn.binWeightedWoe = [float(x) for x in wbin_woe]
+
+    st = cc.columnStats
+    st.totalCount = int(n_rows)
+    st.missingCount = int(moments["missing"][j])
+    st.missingPercentage = float(st.missingCount / max(n_rows, 1))
+    st.mean = float(moments["mean"][j])
+    st.stdDev = float(moments["std"][j])
+    st.min = float(moments["min"][j])
+    st.max = float(moments["max"][j])
+    st.skewness = float(moments["skewness"][j])
+    st.kurtosis = float(moments["kurtosis"][j])
+    st.p25th = float(quartiles[0, j])
+    st.median = float(quartiles[1, j])
+    st.p75th = float(quartiles[2, j])
+    st.validNumCount = int(n_rows - st.missingCount)
+    st.ks, st.iv, st.woe = ks, iv, woe
+    st.weightedKs, st.weightedIv, st.weightedWoe = wks, wiv, wwoe
+
+
+def _fill_categorical(cc: ColumnConfig, orig_vocab, vocab, j: int, counts,
+                      vocab_len: int, n_rows: int, codes: np.ndarray,
+                      tags: np.ndarray, weights: np.ndarray) -> None:
+    """Write categorical binning + stats into one ColumnConfig.
+
+    When `vocab` is the full original vocabulary, the device-accumulated
+    counts are used directly (missing slot at vocab_len). When the
+    cateMaxNumBin cap dropped categories, the dropped ones' counts fold
+    into the missing bin by remapping the original per-slot counts on
+    host (UpdateBinningInfoReducer.java:357-399 small-category merge)."""
+    row_p = counts["count_pos"][j]
+    row_n = counts["count_neg"][j]
+    row_wp = counts["weight_pos"][j]
+    row_wn = counts["weight_neg"][j]
+    if len(vocab) == vocab_len:
+        def squeeze(row):
+            return np.concatenate([row[:vocab_len], [row[vocab_len]]])
+        pos, neg = squeeze(row_p), squeeze(row_n)
+        wpos, wneg = squeeze(row_wp), squeeze(row_wn)
+    else:
+        orig_index = {v: i for i, v in enumerate(orig_vocab)}
+        kept_of_orig = {orig_index[v]: i for i, v in enumerate(vocab)}
+        k = len(vocab)
+        pos, neg = np.zeros(k + 1), np.zeros(k + 1)
+        wpos, wneg = np.zeros(k + 1), np.zeros(k + 1)
+        for oi in range(vocab_len + 1):
+            ki = kept_of_orig.get(oi, k) if oi < vocab_len else k
+            pos[ki] += row_p[oi]
+            neg[ki] += row_n[oi]
+            wpos[ki] += row_wp[oi]
+            wneg[ki] += row_wn[oi]
+
+    ks, iv, woe, bin_woe = stats_ops.column_metrics(pos, neg)
+    wks, wiv, wwoe, wbin_woe = stats_ops.column_metrics(wpos, wneg)
+
+    bn = cc.columnBinning
+    bn.length = len(vocab)
+    bn.binBoundary = None
+    bn.binCategory = list(vocab)
+    bn.binCountPos = [int(x) for x in pos]
+    bn.binCountNeg = [int(x) for x in neg]
+    bn.binWeightedPos = [float(x) for x in wpos]
+    bn.binWeightedNeg = [float(x) for x in wneg]
+    tot = pos + neg
+    bn.binPosRate = [float(p / t) if t > 0 else 0.0 for p, t in zip(pos, tot)]
+    bn.binCountWoe = [float(x) for x in bin_woe]
+    bn.binWeightedWoe = [float(x) for x in wbin_woe]
+
+    st = cc.columnStats
+    st.totalCount = int(n_rows)
+    st.missingCount = int((codes < 0).sum())
+    st.missingPercentage = float(st.missingCount / max(n_rows, 1))
+    st.distinctCount = len(vocab)
+    # categorical mean/std over posrate-encoded values (parseRawValue
+    # POSRATE path feeds zscore families) — from bin counts, no row pass
+    pr = np.asarray(bn.binPosRate)
+    tot_all = tot.sum()
+    if tot_all > 0:
+        mean = float(np.sum(pr * tot) / tot_all)
+        var = float(np.sum(tot * (pr - mean) ** 2) / max(tot_all - 1, 1))
+        st.mean, st.stdDev = mean, float(np.sqrt(var))
+    else:
+        st.mean, st.stdDev = 0.0, 0.0
+    st.ks, st.iv, st.woe = ks, iv, woe
+    st.weightedKs, st.weightedIv, st.weightedWoe = wks, wiv, wwoe

@@ -1,0 +1,125 @@
+"""Synthetic model-set generator for tests — a separable binary tabular
+dataset with numeric + categorical + meta + weight columns, written in
+the pipe-delimited layout the reference's tutorial datasets use."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def make_raw_frame(rng, n_rows: int = 2000, n_num: int = 6, n_cat: int = 2,
+                   missing_rate: float = 0.02):
+    """Returns (header, rows, y) where informative numeric columns are
+    Gaussians shifted by class and categoricals have class-skewed
+    frequencies."""
+    y = (rng.random(n_rows) < 0.35).astype(int)
+    cols = {}
+    for j in range(n_num):
+        shift = (j + 1) * 0.5 if j % 2 == 0 else 0.0  # odd columns are noise
+        x = rng.normal(0, 1, n_rows) + shift * y
+        cols[f"num_{j}"] = np.round(x, 6).astype(str)
+    cats = ["aa", "bb", "cc", "dd"]
+    for j in range(n_cat):
+        p_pos = np.array([0.5, 0.3, 0.15, 0.05])
+        p_neg = np.array([0.1, 0.2, 0.3, 0.4])
+        vals = np.where(y == 1,
+                        rng.choice(cats, n_rows, p=p_pos),
+                        rng.choice(cats, n_rows, p=p_neg))
+        cols[f"cat_{j}"] = vals
+    # inject missing tokens
+    for name in list(cols):
+        mask = rng.random(n_rows) < missing_rate
+        v = cols[name].copy()
+        v[mask] = "?"
+        cols[name] = v
+    cols["wgt"] = np.round(rng.uniform(0.5, 2.0, n_rows), 4).astype(str)
+    cols["rowid"] = np.arange(n_rows).astype(str)
+    cols["diagnosis"] = np.where(y == 1, "M", "B")
+    header = list(cols.keys())
+    rows = np.stack([cols[h] for h in header], axis=1)
+    return header, rows, y
+
+
+def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
+                   algorithm: str = "NN", train_params: dict | None = None):
+    root = os.path.join(str(tmp_path), "ModelSet")
+    data_dir = os.path.join(root, "data")
+    eval_dir = os.path.join(root, "evaldata")
+    os.makedirs(data_dir, exist_ok=True)
+    os.makedirs(eval_dir, exist_ok=True)
+    os.makedirs(os.path.join(root, "columns"), exist_ok=True)
+
+    header, rows, _ = make_raw_frame(rng, n_rows)
+    with open(os.path.join(data_dir, ".pig_header"), "w") as f:
+        f.write("|".join(header) + "\n")
+    split = int(n_rows * 0.8)
+    with open(os.path.join(data_dir, "part-00000"), "w") as f:
+        for r in rows[:split]:
+            f.write("|".join(r) + "\n")
+    with open(os.path.join(eval_dir, ".pig_header"), "w") as f:
+        f.write("|".join(header) + "\n")
+    with open(os.path.join(eval_dir, "part-00000"), "w") as f:
+        for r in rows[split:]:
+            f.write("|".join(r) + "\n")
+    with open(os.path.join(root, "columns", "meta.column.names"), "w") as f:
+        f.write("rowid\n")
+    with open(os.path.join(root, "columns", "categorical.column.names"), "w") as f:
+        f.write("cat_0\ncat_1\n")
+
+    mc = {
+        "basic": {"name": "SynthTest", "author": "test", "description": "",
+                  "version": "0.1.0", "runMode": "LOCAL", "postTrainOn": False,
+                  "customPaths": {}},
+        "dataSet": {
+            "source": "LOCAL", "dataPath": data_dir, "dataDelimiter": "|",
+            "headerPath": os.path.join(data_dir, ".pig_header"),
+            "headerDelimiter": "|", "filterExpressions": "",
+            "weightColumnName": "wgt", "targetColumnName": "diagnosis",
+            "posTags": ["M"], "negTags": ["B"],
+            "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"],
+            "metaColumnNameFile": os.path.join(root, "columns", "meta.column.names"),
+            "categoricalColumnNameFile": os.path.join(root, "columns",
+                                                      "categorical.column.names"),
+        },
+        "stats": {"maxNumBin": 10, "binningMethod": "EqualPositive",
+                  "sampleRate": 1.0, "sampleNegOnly": False,
+                  "binningAlgorithm": "SPDTI", "psiColumnName": ""},
+        "varSelect": {"forceEnable": False, "forceSelectColumnNameFile": "",
+                      "forceRemoveColumnNameFile": "", "filterEnable": True,
+                      "filterNum": 200, "filterBy": "KS",
+                      "wrapperEnabled": False, "wrapperNum": 50,
+                      "wrapperRatio": 0.05, "wrapperBy": "S",
+                      "missingRateThreshold": 0.98, "filterBySE": True,
+                      "params": None},
+        "normalize": {"stdDevCutOff": 4.0, "sampleRate": 1.0,
+                      "sampleNegOnly": False, "normType": norm_type},
+        "train": {
+            "baggingNum": 1, "baggingWithReplacement": False,
+            "baggingSampleRate": 1.0, "validSetRate": 0.2,
+            "numTrainEpochs": 40, "epochsPerIteration": 1,
+            "trainOnDisk": False, "isContinuous": False,
+            "workerThreadCount": 4, "algorithm": algorithm,
+            "params": train_params or {
+                "NumHiddenLayers": 1, "ActivationFunc": ["tanh"],
+                "NumHiddenNodes": [10], "RegularizedConstant": 0.0,
+                "LearningRate": 0.1, "Propagation": "ADAM"},
+            "customPaths": {}},
+        "evals": [{
+            "name": "Eval1",
+            "dataSet": {
+                "source": "LOCAL", "dataPath": eval_dir, "dataDelimiter": "|",
+                "headerPath": os.path.join(eval_dir, ".pig_header"),
+                "headerDelimiter": "|", "filterExpressions": "",
+                "weightColumnName": "wgt",
+                "targetColumnName": "diagnosis",
+                "posTags": ["M"], "negTags": ["B"],
+                "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"]},
+            "performanceBucketNum": 10, "performanceScoreSelector": "mean",
+            "scoreMetaColumnNameFile": "", "customPaths": {}}],
+    }
+    with open(os.path.join(root, "ModelConfig.json"), "w") as f:
+        json.dump(mc, f, indent=2)
+    return root

@@ -1,0 +1,154 @@
+"""Config layer tests: JSON round-trip compatibility with reference
+schemas (SURVEY.md §4.5 golden-compat analog for configs)."""
+
+import json
+import math
+
+from shifu_tpu.config.column_config import (ColumnConfig, load_column_configs,
+                                            save_column_configs)
+from shifu_tpu.config.model_config import (Algorithm, ModelConfig, NormType,
+                                           RunMode)
+
+
+REF_MODEL_CONFIG = {
+    "basic": {"name": "T", "author": "a", "description": "d",
+              "version": "0.2.0", "runMode": "LOCAL", "postTrainOn": False,
+              "customPaths": {}},
+    "dataSet": {"source": "LOCAL", "dataPath": "./x", "dataDelimiter": "|",
+                "headerPath": "./h", "headerDelimiter": "|",
+                "filterExpressions": "", "weightColumnName": "",
+                "targetColumnName": "diagnosis", "posTags": ["M"],
+                "negTags": ["B"],
+                "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"],
+                "metaColumnNameFile": "m", "categoricalColumnNameFile": "c"},
+    "stats": {"maxNumBin": 10, "binningMethod": "EqualPositive",
+              "sampleRate": 0.8, "sampleNegOnly": False,
+              "binningAlgorithm": "SPDTI", "psiColumnName": ""},
+    "varSelect": {"forceEnable": True, "forceSelectColumnNameFile": "f",
+                  "forceRemoveColumnNameFile": "r", "filterEnable": True,
+                  "filterNum": 200, "filterBy": "KS", "wrapperEnabled": False,
+                  "wrapperNum": 50, "wrapperRatio": 0.05, "wrapperBy": "S",
+                  "missingRateThreshold": 0.5, "filterBySE": True,
+                  "params": None},
+    "normalize": {"stdDevCutOff": 4.0, "sampleRate": 1.0,
+                  "sampleNegOnly": False, "normType": "WOE_ZSCORE"},
+    "train": {"baggingNum": 5, "baggingWithReplacement": True,
+              "baggingSampleRate": 1.0, "validSetRate": 0.2,
+              "numTrainEpochs": 100, "epochsPerIteration": 1,
+              "trainOnDisk": False, "isContinuous": False,
+              "workerThreadCount": 4, "algorithm": "NN",
+              "params": {"NumHiddenLayers": 1, "ActivationFunc": ["tanh"],
+                         "NumHiddenNodes": [50], "LearningRate": 0.1,
+                         "Propagation": "Q"},
+              "customPaths": {}},
+    "evals": [{"name": "Eval1",
+               "dataSet": {"source": "LOCAL", "dataPath": "./e",
+                           "dataDelimiter": "|", "headerPath": "./eh",
+                           "headerDelimiter": "|", "filterExpressions": "",
+                           "weightColumnName": ""},
+               "performanceBucketNum": 10,
+               "performanceScoreSelector": "mean",
+               "scoreMetaColumnNameFile": "", "customPaths": {}}],
+}
+
+
+def test_model_config_roundtrip(tmp_path):
+    mc = ModelConfig.from_dict(REF_MODEL_CONFIG)
+    assert mc.basic.name == "T"
+    assert mc.basic.runMode is RunMode.LOCAL
+    assert mc.train.algorithm is Algorithm.NN
+    assert mc.normalize.normType is NormType.WOE_ZSCORE
+    assert mc.pos_tags == ["M"] and mc.neg_tags == ["B"]
+    assert mc.train.get_param("learningrate") == 0.1
+
+    out = mc.to_dict()
+    # every original key survives with equal value
+    def check(ref, got, path=""):
+        for k, v in ref.items():
+            assert k in got, f"missing {path}{k}"
+            if isinstance(v, dict):
+                check(v, got[k], f"{path}{k}.")
+            elif isinstance(v, list) and v and isinstance(v[0], dict):
+                for i, (rv, gv) in enumerate(zip(v, got[k])):
+                    check(rv, gv, f"{path}{k}[{i}].")
+            else:
+                assert got[k] == v, f"{path}{k}: {got[k]!r} != {v!r}"
+    check(REF_MODEL_CONFIG, out)
+
+    p = tmp_path / "ModelConfig.json"
+    mc.save(str(p))
+    mc2 = ModelConfig.load(str(p))
+    assert mc2.to_dict() == out
+
+
+def test_unknown_keys_preserved():
+    d = dict(REF_MODEL_CONFIG)
+    d["somethingNew"] = {"x": 1}
+    d["train"] = dict(d["train"], extraKnob=7)
+    mc = ModelConfig.from_dict(d)
+    out = mc.to_dict()
+    assert out["somethingNew"] == {"x": 1}
+    assert out["train"]["extraKnob"] == 7
+
+
+REF_COLUMN = {
+    "columnNum": 1, "columnName": "column_3", "version": "0.2.0",
+    "columnType": "N", "columnFlag": None, "finalSelect": True,
+    "columnStats": {"max": 27.42, "min": 6.981, "mean": 13.96, "median": 13.05,
+                    "totalCount": 429, "distinctCount": None,
+                    "missingCount": 0, "stdDev": 3.477,
+                    "missingPercentage": 0.0, "woe": -0.672, "ks": 66.8,
+                    "iv": 10.05, "weightedKs": 66.8, "weightedIv": 10.05,
+                    "weightedWoe": -0.672, "skewness": None, "kurtosis": None,
+                    "psi": None, "unitStats": None},
+    "columnBinning": {"length": 3,
+                      "binBoundary": ["-Infinity", 13.2, 14.29],
+                      "binCategory": None, "binCountNeg": [170, 36, 29],
+                      "binCountPos": [13, 12, 95],
+                      "binPosRate": [0.071, 0.25, 0.766],
+                      "binAvgScore": None,
+                      "binWeightedNeg": [170.0, 36.0, 29.0],
+                      "binWeightedPos": [13.0, 12.0, 95.0],
+                      "binCountWoe": [-1.89, -0.42, 1.85],
+                      "binWeightedWoe": [-1.89, -0.42, 1.85]},
+}
+
+
+def test_column_config_roundtrip(tmp_path):
+    cc = ColumnConfig.from_dict(REF_COLUMN)
+    assert cc.columnNum == 1
+    assert cc.is_numerical and not cc.is_categorical
+    assert cc.bin_boundaries[0] == float("-inf")
+    assert cc.bin_boundaries[1] == 13.2
+
+    out = cc.to_dict()
+    assert out["columnBinning"]["binBoundary"][0] == "-Infinity"
+    assert out["columnBinning"]["binBoundary"][1] == 13.2
+    assert out["columnStats"]["ks"] == 66.8
+
+    p = tmp_path / "ColumnConfig.json"
+    save_column_configs([cc], str(p))
+    loaded = load_column_configs(str(p))
+    assert len(loaded) == 1
+    assert loaded[0].to_dict() == out
+    # file is valid strict JSON (no bare Infinity tokens)
+    with open(p) as f:
+        json.loads(f.read())
+
+
+def test_reference_example_config_loads_if_present():
+    """Load the actual reference example configs when mounted (API-surface
+    compatibility check against real Jackson output)."""
+    import glob
+    import os
+    files = glob.glob("/root/reference/src/test/resources/example/"
+                      "cancer-judgement/ModelStore/ModelSet1/ModelConfig.json")
+    if not files:
+        return
+    mc = ModelConfig.load(files[0])
+    assert mc.dataSet.targetColumnName == "diagnosis"
+    ccf = os.path.join(os.path.dirname(files[0]), "ColumnConfig.json")
+    if os.path.exists(ccf):
+        ccs = load_column_configs(ccf)
+        assert len(ccs) > 10
+        assert any(c.is_target for c in ccs)

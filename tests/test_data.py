@@ -1,0 +1,79 @@
+"""Data-plane unit tests: reader namespacing, sharded reads, purifier."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.data.purifier import DataPurifier, _normalize_expr
+from shifu_tpu.data.reader import read_raw_table, simple_column_name
+
+
+def _write_ms(tmp_path, header, files):
+    root = tmp_path / "ns"
+    os.makedirs(root / "data")
+    for name, rows in files.items():
+        with open(root / "data" / name, "w") as f:
+            for r in rows:
+                f.write("|".join(r) + "\n")
+    mc = ModelConfig.from_dict({
+        "basic": {"name": "t"},
+        "dataSet": {"dataPath": str(root / "data"), "dataDelimiter": "|",
+                    "targetColumnName": "y", "posTags": ["1"],
+                    "negTags": ["0"]},
+    })
+    return mc, header
+
+
+def test_namespaced_header_simplified(tmp_path):
+    """'ns::col' headers must be matchable by simple name
+    (NSColumn semantics; reader renames frame columns)."""
+    mc, _ = _write_ms(tmp_path, None, {
+        "part-0": [["acct::bal", "acct::y"], ["1.5", "1"], ["2.5", "0"]]})
+    df = read_raw_table(mc)
+    assert list(df.columns) == ["bal", "y"]
+    assert simple_column_name("acct::bal") == "bal"
+
+
+def test_file_shard_header_skip(tmp_path):
+    """Header-line skip applies only to the file that holds it, not to
+    the first file of each shard."""
+    mc, _ = _write_ms(tmp_path, None, {
+        "part-0": [["x", "y"], ["1", "1"], ["2", "0"]],
+        "part-1": [["3", "1"], ["4", "0"]]})
+    full = read_raw_table(mc)
+    assert len(full) == 4
+    shard0 = read_raw_table(mc, file_shard=(0, 2))
+    shard1 = read_raw_table(mc, file_shard=(1, 2))
+    assert len(shard0) + len(shard1) == 4
+    assert "3" in shard1["x"].tolist()  # first row of part-1 not dropped
+
+
+def test_purifier_basic():
+    df = pd.DataFrame({"a": ["1", "2", "3"], "b": ["x", "y", "z"]})
+    assert DataPurifier("a > 1").apply(df).tolist() == [False, True, True]
+    assert DataPurifier("b == 'y'").apply(df).tolist() == [False, True, False]
+    assert DataPurifier("").apply(df).all()
+
+
+def test_purifier_jexl_operators():
+    df = pd.DataFrame({"a": ["1", "2", "3"]})
+    assert DataPurifier("a ge 2 && a lt 3").apply(df).tolist() == \
+        [False, True, False]
+
+
+def test_purifier_string_literals_untouched():
+    """Word operators / && inside quoted literals must not be rewritten."""
+    assert _normalize_expr('region eq "ne"') == 'region == "ne"'
+    assert _normalize_expr("v == 'a&&b'") == "v == 'a&&b'"
+    df = pd.DataFrame({"region": ["ne", "sw", "!="]})
+    assert DataPurifier('region eq "ne"').apply(df).tolist() == \
+        [True, False, False]
+
+
+def test_purifier_bad_expression_raises():
+    df = pd.DataFrame({"a": ["1"]})
+    with pytest.raises(ValueError):
+        DataPurifier("a !!>> zz").apply(df)

@@ -1,0 +1,116 @@
+"""End-to-end init → stats → norm on a synthetic model set (the
+LOCAL-mode CLI pipeline test pattern, SURVEY.md §4.4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config.column_config import load_column_configs
+from shifu_tpu.config.model_config import ModelConfig, NormType
+from shifu_tpu.processor import init as init_proc
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor import stats as stats_proc
+from shifu_tpu.processor.base import ProcessorContext
+
+
+@pytest.fixture()
+def inited(model_set):
+    ctx = ProcessorContext.load(model_set)
+    assert init_proc.run(ctx) == 0
+    return model_set
+
+
+@pytest.fixture()
+def statsed(inited):
+    ctx = ProcessorContext.load(inited)
+    assert stats_proc.run(ctx) == 0
+    return inited
+
+
+def test_init_builds_column_config(inited):
+    ccs = load_column_configs(os.path.join(inited, "ColumnConfig.json"))
+    by_name = {c.columnName: c for c in ccs}
+    assert by_name["diagnosis"].is_target
+    assert by_name["wgt"].is_weight
+    assert by_name["rowid"].is_meta
+    assert by_name["cat_0"].is_categorical
+    assert by_name["num_0"].is_numerical
+    assert [c.columnNum for c in ccs] == list(range(len(ccs)))
+
+
+def test_stats_fills_column_config(statsed):
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    by_name = {c.columnName: c for c in ccs}
+
+    num0 = by_name["num_0"]  # informative column
+    assert num0.columnStats.ks is not None and num0.columnStats.ks > 10
+    assert num0.columnStats.iv > 0.1
+    assert num0.columnBinning.length >= 5
+    assert num0.columnBinning.binBoundary[0] == float("-inf")
+    # counts arrays are length+1 (trailing missing bin)
+    assert len(num0.columnBinning.binCountPos) == num0.columnBinning.length + 1
+    assert num0.columnStats.totalCount == 1600
+    assert num0.columnStats.mean is not None
+
+    noise = by_name["num_1"]  # pure-noise column
+    assert noise.columnStats.ks < num0.columnStats.ks
+
+    cat = by_name["cat_0"]
+    assert cat.columnBinning.binCategory == ["aa", "bb", "cc", "dd"]
+    assert len(cat.columnBinning.binCountPos) == 5
+    assert cat.columnStats.ks > 10
+    assert cat.columnStats.distinctCount == 4
+
+    # missing accounting: ~2% injected
+    assert 0.0 < num0.columnStats.missingPercentage < 0.1
+
+
+def test_stats_equal_positive_bins(statsed):
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    num0 = next(c for c in ccs if c.columnName == "num_0")
+    pos = np.array(num0.columnBinning.binCountPos[:-1], float)
+    assert pos.std() / pos.mean() < 0.25  # near-equal positives per bin
+
+
+@pytest.mark.parametrize("norm_type", ["ZSCALE", "WOE", "WOE_ZSCORE",
+                                       "HYBRID", "ONEHOT", "ZSCALE_INDEX"])
+def test_norm_families(statsed, norm_type):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.normalize.normType = NormType.parse(norm_type)
+    assert norm_proc.run(ctx) == 0
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    dense, tags = data["dense"], data["tags"]
+    assert len(tags) == 1600
+    assert not np.isnan(dense).any()
+    if norm_type == "ZSCALE":
+        assert dense.shape[1] == 8  # 6 numeric + 2 cat (posrate-zscored)
+        assert abs(dense.mean()) < 0.5
+        assert (np.abs(dense) <= 4.0 + 1e-5).all()
+    if norm_type == "WOE":
+        assert dense.shape[1] == 8
+    if norm_type == "ONEHOT":
+        assert dense.shape[1] > 8  # expanded
+        assert set(np.unique(dense)).issubset({0.0, 1.0})
+    if norm_type == "ZSCALE_INDEX":
+        assert dense.shape[1] == 6  # numeric only
+        assert data["index"].shape[1] == 2
+        assert meta["indexVocabSizes"] == [5, 5]
+
+
+def test_woe_norm_values_match_lut(statsed):
+    """WOE norm output equals the per-bin woe recorded in ColumnConfig."""
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.normalize.normType = NormType.WOE
+    norm_proc.run(ctx)
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    ccs = ctx.column_configs
+    cat0 = next(c for c in ccs if c.columnName == "cat_0")
+    col_idx = meta["denseNames"].index("cat_0")
+    got = np.unique(data["dense"][:, col_idx])
+    expect = np.asarray(cat0.columnBinning.binCountWoe)
+    for g in got:
+        assert np.isclose(expect, g, atol=1e-5).any(), g

@@ -1,0 +1,107 @@
+"""Stats/binning kernel tests — exact-math unit tests in the style of
+the reference's ColumnStatsCalculatorTest / EqualPopulationBinningTest
+(SURVEY.md §4.1)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from shifu_tpu.ops import stats as stats_ops
+from shifu_tpu.ops.binning import compute_numeric_binning
+from shifu_tpu.config.model_config import BinningMethod
+
+
+def test_column_metrics_matches_reference_formulas():
+    # hand-computed from ColumnStatsCalculator.java semantics
+    pos = np.array([13.0, 12.0, 95.0, 0.0])
+    neg = np.array([170.0, 36.0, 29.0, 0.0])
+    ks, iv, woe, bin_woe = stats_ops.column_metrics(pos, neg)
+    sum_p, sum_n = pos.sum(), neg.sum()
+    pr, nr = pos / sum_p, neg / sum_n
+    exp_woe = np.log((pr + 1e-10) / (nr + 1e-10))
+    np.testing.assert_allclose(bin_woe, exp_woe, rtol=1e-12)
+    assert iv == pytest.approx(float(np.sum((pr - nr) * exp_woe)))
+    assert ks == pytest.approx(
+        100 * np.max(np.abs(np.cumsum(pr) - np.cumsum(nr))))
+    assert woe == pytest.approx(np.log(sum_p / sum_n), rel=1e-6)
+
+
+def test_column_metrics_single_class_returns_none():
+    ks, iv, woe, _ = stats_ops.column_metrics(np.zeros(3), np.ones(3))
+    assert ks is None and iv is None and woe is None
+
+
+def test_weighted_quantiles_exact():
+    v = np.arange(100, dtype=np.float32).reshape(-1, 1)
+    w = np.ones_like(v)
+    q = np.asarray(stats_ops.weighted_quantiles(jnp.asarray(v),
+                                                jnp.asarray(w), 9))
+    # deciles of 0..99
+    np.testing.assert_allclose(q[:, 0], [9, 19, 29, 39, 49, 59, 69, 79, 89],
+                               atol=1)
+
+
+def test_weighted_quantiles_respects_weights():
+    v = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    w = np.array([[100.0], [1.0], [1.0], [1.0]], np.float32)
+    q = np.asarray(stats_ops.weighted_quantiles(jnp.asarray(v),
+                                                jnp.asarray(w), 1))
+    assert q[0, 0] == 1.0  # median dominated by the heavy row
+
+
+def test_bin_index_left_closed():
+    cuts = jnp.asarray(np.array([[1.0], [2.0]], np.float32))  # bins (-inf,1),[1,2),[2,inf)
+    v = jnp.asarray(np.array([[0.5], [1.0], [1.5], [2.0], [np.nan]], np.float32))
+    idx = np.asarray(stats_ops.bin_index_numeric(v, cuts))
+    np.testing.assert_array_equal(idx[:, 0], [0, 1, 1, 2, 3])  # NaN → missing slot
+
+
+def test_bin_accumulate_counts():
+    bin_idx = jnp.asarray(np.array([[0], [0], [1], [2], [2]], np.int32))
+    tags = jnp.asarray(np.array([1, 0, 1, 0, 1], np.float32))
+    w = jnp.asarray(np.array([1.0, 2.0, 1.0, 1.0, 3.0], np.float32))
+    c = stats_ops.bin_accumulate(bin_idx, tags, w, 4)
+    np.testing.assert_array_equal(np.asarray(c["count_pos"])[0], [1, 1, 1, 0])
+    np.testing.assert_array_equal(np.asarray(c["count_neg"])[0], [1, 0, 1, 0])
+    np.testing.assert_array_equal(np.asarray(c["weight_pos"])[0], [1, 1, 3, 0])
+    np.testing.assert_array_equal(np.asarray(c["weight_neg"])[0], [2, 0, 1, 0])
+
+
+def test_equal_positive_binning_balances_positives(rng):
+    n = 5000
+    y = (rng.random(n) < 0.3).astype(np.float32)
+    x = rng.normal(0, 1, n).astype(np.float32) + y
+    vals = x.reshape(-1, 1)
+    b = compute_numeric_binning(vals, y, np.ones(n, np.float32),
+                                BinningMethod.EqualPositive, 10)
+    cuts = b.boundaries[0][1:]
+    # positives per bin should be near-equal
+    pos_vals = x[y == 1]
+    counts, _ = np.histogram(pos_vals, bins=np.concatenate(
+        ([-np.inf], cuts, [np.inf])))
+    assert counts.std() / counts.mean() < 0.15
+
+
+def test_equal_interval_binning():
+    vals = np.linspace(0, 10, 101, dtype=np.float32).reshape(-1, 1)
+    b = compute_numeric_binning(vals, np.zeros(101, np.float32),
+                                np.ones(101, np.float32),
+                                BinningMethod.EqualInterval, 5)
+    np.testing.assert_allclose(b.boundaries[0][1:], [2, 4, 6, 8], atol=1e-5)
+
+
+def test_moment_stats_nan_aware():
+    v = jnp.asarray(np.array([[1.0], [2.0], [3.0], [np.nan]], np.float32))
+    m = {k: np.asarray(x) for k, x in stats_ops.moment_stats(v).items()}
+    assert m["mean"][0] == pytest.approx(2.0)
+    assert m["missing"][0] == 1
+    assert m["std"][0] == pytest.approx(1.0)
+    assert m["min"][0] == 1.0 and m["max"][0] == 3.0
+
+
+def test_psi():
+    e = np.array([0.5, 0.5])
+    a = np.array([0.6, 0.4])
+    psi = stats_ops.psi_metric(e, a)
+    assert psi == pytest.approx((0.5 - 0.6) * np.log(0.5 / 0.6)
+                                + (0.5 - 0.4) * np.log(0.5 / 0.4), rel=1e-6)
