@@ -83,7 +83,7 @@ class PathFinder:
         return self._p(self.CHECKPOINT_DIR, f"bag{bag_index}")
 
     def val_error_path(self) -> str:
-        return self._p("tmp", "valerr")
+        return self._p("tmp", "valerr.json")
 
     # -- varselect ----------------------------------------------------------
     def varsel_path(self) -> str:

@@ -1,0 +1,1 @@
+from shifu_tpu.eval.scorer import Scorer, score_matrix  # noqa: F401

@@ -1,0 +1,95 @@
+"""Scorer — ensemble scoring over trained model specs.
+
+Replaces `core/Scorer.java:57,108-242` (per-record ensemble compute over
+BasicML models) and the embeddable `core/ModelRunner.java:57,170-202`:
+here scoring is one batched forward per model over the whole matrix,
+then an assemble reduction (mean/max/min/median —
+`EvalConfig#performanceScoreSelector`). GBT raw scores can be converted
+per `gbtScoreConvertStrategy` (RAW/SIGMOID/MAXMIN_SCALE/CUTOFF) like
+`Scorer.convertTreeModelScore`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.models.spec import load_model, list_models
+
+
+def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
+                 dense: np.ndarray,
+                 index: Optional[np.ndarray] = None) -> np.ndarray:
+    """Score one model over the normalized matrix → (N,) scores."""
+    if kind in ("nn", "lr"):
+        sd = dict(meta["spec"])
+        sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
+        sd["activations"] = tuple(sd.get("activations", ()))
+        spec = nn_mod.MLPSpec(**sd)
+        out = nn_mod.forward(spec, jax.tree.map(jnp.asarray, params),
+                             jnp.asarray(dense))
+        return np.asarray(out)
+    if kind in ("gbt", "rf"):
+        from shifu_tpu.models import gbdt
+        return gbdt.predict(meta, params, dense, index)
+    if kind == "wdl":
+        from shifu_tpu.models import wdl
+        return wdl.predict(meta, params, dense, index)
+    if kind == "mtl":
+        from shifu_tpu.models import mtl
+        return mtl.predict(meta, params, dense, index)
+    raise ValueError(f"unknown model kind {kind!r}")
+
+
+def convert_tree_score(raw: np.ndarray, strategy: str) -> np.ndarray:
+    """`Scorer` GBT score conversion: RAW passes margins through,
+    SIGMOID squashes, MAXMIN_SCALE rescales to [0,1], CUTOFF clips."""
+    s = (strategy or "RAW").upper()
+    if s == "SIGMOID":
+        return 1.0 / (1.0 + np.exp(-np.clip(raw, -30, 30)))
+    if s in ("MAXMIN", "MAXMIN_SCALE"):
+        lo, hi = raw.min(), raw.max()
+        return (raw - lo) / (hi - lo) if hi > lo else np.zeros_like(raw)
+    if s == "CUTOFF":
+        return np.clip(raw, 0.0, 1.0)
+    return raw
+
+
+class Scorer:
+    """Ensemble of the model specs under models/."""
+
+    def __init__(self, model_paths: List[str],
+                 score_selector: str = "mean",
+                 gbt_convert: str = "RAW"):
+        self.models = [load_model(p) for p in model_paths]
+        self.selector = (score_selector or "mean").lower()
+        self.gbt_convert = gbt_convert
+        if not self.models:
+            raise FileNotFoundError("no model specs to score with")
+
+    @classmethod
+    def from_dir(cls, models_dir: str, **kw) -> "Scorer":
+        return cls(list_models(models_dir), **kw)
+
+    def score(self, dense: np.ndarray,
+              index: Optional[np.ndarray] = None) -> Dict[str, np.ndarray]:
+        """→ {"mean","max","min","median","model0".."modelN"} like the
+        reference EvalScore output columns."""
+        per_model = []
+        for kind, meta, params in self.models:
+            s = score_matrix(kind, meta, params, dense, index)
+            if kind in ("gbt",):
+                s = convert_tree_score(s, self.gbt_convert)
+            per_model.append(s)
+        stack = np.stack(per_model, axis=0)  # (M, N)
+        out = {f"model{i}": per_model[i] for i in range(len(per_model))}
+        out["mean"] = stack.mean(axis=0)
+        out["max"] = stack.max(axis=0)
+        out["min"] = stack.min(axis=0)
+        out["median"] = np.median(stack, axis=0)
+        out["final"] = out.get(self.selector, out["mean"])
+        return out

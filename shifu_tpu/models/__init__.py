@@ -1,0 +1,1 @@
+from shifu_tpu.models import nn  # noqa: F401

@@ -1,0 +1,197 @@
+"""Feed-forward NN — the flagship model family, as a JAX pytree.
+
+Replaces the reference's Encog-derived float network stack
+(`core/dtrain/dataset/FloatFlatNetwork.java`, `BasicFloatNetwork`,
+backprop kernel `core/dtrain/Gradient.java:171-194`) with a functional
+MLP: parameters are a pytree, the forward pass is pure, gradients come
+from `jax.grad`, and the whole train step jits onto the MXU as batched
+matmuls — per-record Java loops become (batch × features) GEMMs.
+
+Config surface matches `train#params` of the reference
+(`ModelTrainConf.createParamsByAlg`, NNTrainer/NNMaster):
+NumHiddenLayers, NumHiddenNodes, ActivationFunc, RegularizedConstant,
+L1orL2, Propagation, LearningRate, LearningDecay, DropoutRate,
+WeightInitializer, Loss, FixedLayers, Momentum/AdamBeta1/AdamBeta2.
+
+Activations mirror `core/dtrain/layer/activation/*`
+(Sigmoid, TanH, ReLU, LeakyReLU, Swish, Gaussian, Log, Sin, Linear).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = List[Dict[str, jax.Array]]
+
+
+# ---------------------------------------------------------------------------
+# Activations (core/dtrain/layer/activation/*.java + ActivationFactory)
+# ---------------------------------------------------------------------------
+
+def _log_act(x):
+    """Encog ActivationLOG: sign-symmetric log."""
+    return jnp.where(x >= 0, jnp.log1p(x), -jnp.log1p(-x))
+
+
+ACTIVATIONS: Dict[str, Callable] = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "swish": lambda x: x * jax.nn.sigmoid(x),
+    "gaussian": lambda x: jnp.exp(-jnp.square(x)),
+    "log": _log_act,
+    "sin": jnp.sin,
+    "linear": lambda x: x,
+    "ptanh": jnp.tanh,  # reference alias
+}
+
+
+def activation(name: str) -> Callable:
+    fn = ACTIVATIONS.get(str(name).lower())
+    if fn is None:
+        raise ValueError(f"unknown ActivationFunc {name!r}; known: "
+                         f"{sorted(ACTIVATIONS)}")
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Architecture
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MLPSpec:
+    """Static architecture derived from train#params. Frozen/hashable so
+    it can be a static argument of jitted train steps; list-like fields
+    are tuples."""
+    input_dim: int
+    hidden_dims: tuple
+    activations: tuple
+    output_dim: int = 1
+    output_activation: str = "sigmoid"  # Encog nets end in sigmoid for binary
+    dropout_rate: float = 0.0
+    l2: float = 0.0
+    l1: float = 0.0
+    loss: str = "squared"  # squared | log | absolute (core/dtrain/loss/*)
+    weight_init: str = "xavier"  # xavier | he | lecun | zero | default
+
+    @classmethod
+    def from_train_params(cls, params: Dict[str, Any], input_dim: int,
+                          output_dim: int = 1) -> "MLPSpec":
+        def get(key, default=None):
+            for k, v in params.items():
+                if k.lower() == key.lower():
+                    return v
+            return default
+
+        n_layers = int(get("NumHiddenLayers", 1) or 0)
+        nodes = get("NumHiddenNodes", [50])
+        acts = get("ActivationFunc", ["tanh"])
+        if not isinstance(nodes, list):
+            nodes = [nodes]
+        if not isinstance(acts, list):
+            acts = [acts]
+        nodes = [int(n) for n in nodes][:n_layers] if n_layers else []
+        acts = [str(a) for a in acts][:n_layers] if n_layers else []
+        while len(nodes) < n_layers:
+            nodes.append(nodes[-1] if nodes else 50)
+        while len(acts) < n_layers:
+            acts.append(acts[-1] if acts else "tanh")
+        reg = float(get("RegularizedConstant", 0.0) or 0.0)
+        l1orl2 = str(get("L1orL2", "L2") or "L2").upper()
+        return cls(
+            input_dim=input_dim, hidden_dims=tuple(nodes),
+            activations=tuple(acts), output_dim=output_dim,
+            dropout_rate=float(get("DropoutRate", 0.0) or 0.0),
+            l2=reg if l1orl2 != "L1" else 0.0,
+            l1=reg if l1orl2 == "L1" else 0.0,
+            loss=str(get("Loss", "squared") or "squared").lower(),
+            weight_init=str(get("WeightInitializer", "xavier") or "xavier").lower(),
+        )
+
+    @property
+    def layer_dims(self) -> List[int]:
+        return [self.input_dim] + list(self.hidden_dims) + [self.output_dim]
+
+
+def init_params(spec: MLPSpec, key: jax.Array) -> Params:
+    """Weight init families from `core/dtrain/random/*`
+    (Xavier/He/Lecun + uniform default)."""
+    params: Params = []
+    dims = spec.layer_dims
+    for i in range(len(dims) - 1):
+        key, sub = jax.random.split(key)
+        fan_in, fan_out = dims[i], dims[i + 1]
+        if spec.weight_init == "he":
+            w = jax.random.normal(sub, (fan_in, fan_out)) * math.sqrt(2.0 / fan_in)
+        elif spec.weight_init == "lecun":
+            w = jax.random.normal(sub, (fan_in, fan_out)) * math.sqrt(1.0 / fan_in)
+        elif spec.weight_init == "zero":
+            w = jnp.zeros((fan_in, fan_out))
+        else:  # xavier / default
+            limit = math.sqrt(6.0 / (fan_in + fan_out))
+            w = jax.random.uniform(sub, (fan_in, fan_out), minval=-limit,
+                                   maxval=limit)
+        params.append({"w": w.astype(jnp.float32),
+                       "b": jnp.zeros((fan_out,), jnp.float32)})
+    return params
+
+
+def forward(spec: MLPSpec, params: Params, x: jax.Array,
+            dropout_key: Optional[jax.Array] = None) -> jax.Array:
+    """Batched forward pass → (N,) score in (0,1) for binary output.
+    Dropout (train-time only) mirrors NNMaster's per-iteration node
+    sampling (`NNMaster.doCompute:323` dropout nodes)."""
+    h = x
+    for i, layer in enumerate(params[:-1]):
+        h = h @ layer["w"] + layer["b"]
+        h = activation(spec.activations[i])(h)
+        if dropout_key is not None and spec.dropout_rate > 0.0:
+            dropout_key, sub = jax.random.split(dropout_key)
+            keep = jax.random.bernoulli(sub, 1.0 - spec.dropout_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - spec.dropout_rate), 0.0)
+    out = h @ params[-1]["w"] + params[-1]["b"]
+    out = activation(spec.output_activation)(out)
+    return out[..., 0] if spec.output_dim == 1 else out
+
+
+def loss_fn(spec: MLPSpec, params: Params, x: jax.Array, y: jax.Array,
+            w: jax.Array, dropout_key: Optional[jax.Array] = None) -> jax.Array:
+    """Weighted loss (`core/dtrain/loss/*`: squared / log / absolute) +
+    L1/L2 regularization (`Weight.java` reg terms). Weights double as
+    bagging sample multipliers (Poisson/Bernoulli masks)."""
+    pred = forward(spec, params, x, dropout_key)
+    if spec.loss.startswith("log"):
+        eps = 1e-7
+        per = -(y * jnp.log(pred + eps) + (1 - y) * jnp.log(1 - pred + eps))
+    elif spec.loss.startswith("abs"):
+        per = jnp.abs(y - pred)
+    else:
+        per = 0.5 * jnp.square(y - pred)
+    total_w = jnp.maximum(jnp.sum(w), 1e-12)
+    loss = jnp.sum(per * w) / total_w
+    if spec.l2 > 0.0:
+        loss = loss + spec.l2 * sum(jnp.sum(jnp.square(p["w"])) for p in params)
+    if spec.l1 > 0.0:
+        loss = loss + spec.l1 * sum(jnp.sum(jnp.abs(p["w"])) for p in params)
+    return loss
+
+
+def mse(spec: MLPSpec, params: Params, x: jax.Array, y: jax.Array,
+        w: jax.Array) -> jax.Array:
+    """Validation error metric — the reference reports mean squared error
+    per epoch regardless of training loss (NNMaster trainError)."""
+    pred = forward(spec, params, x)
+    total_w = jnp.maximum(jnp.sum(w), 1e-12)
+    return jnp.sum(jnp.square(y - pred) * w) / total_w
+
+
+def num_params(spec: MLPSpec) -> int:
+    dims = spec.layer_dims
+    return sum(dims[i] * dims[i + 1] + dims[i + 1] for i in range(len(dims) - 1))

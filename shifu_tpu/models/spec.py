@@ -1,0 +1,87 @@
+"""Model-spec serialization — the binary model format.
+
+Replaces the reference's per-algorithm binary specs
+(`nn/BinaryNNSerializer.java`, `dt/BinaryDTSerializer.java`,
+`wdl/BinaryWDLSerializer.java`) and their zero-dependency loaders
+(`IndependentNNModel/IndependentTreeModel/IndependentWDLModel`). One
+container format for every family: an .npz holding the parameter
+arrays plus a JSON header (architecture, norm metadata, version) —
+loadable with numpy alone, no JAX required, which is the
+"Independent*Model" property (`core/dtrain/dt/IndependentTreeModel.
+java:50-55`: dependency-free scoring).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _flatten(params: Any, prefix: str = "p") -> Dict[str, np.ndarray]:
+    """Flatten a nested list/dict pytree of arrays into npz-friendly
+    keys like 'p.0.w'."""
+    out = {}
+    if isinstance(params, (list, tuple)):
+        for i, v in enumerate(params):
+            out.update(_flatten(v, f"{prefix}.{i}"))
+    elif isinstance(params, dict):
+        for k, v in params.items():
+            out.update(_flatten(v, f"{prefix}.{k}"))
+    else:
+        out[prefix] = np.asarray(params)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray], prefix: str = "p") -> Any:
+    """Inverse of _flatten."""
+    children: Dict[str, Dict[str, np.ndarray]] = {}
+    for key, v in flat.items():
+        if key == prefix:
+            return v
+        rest = key[len(prefix) + 1:]
+        head = rest.split(".")[0]
+        children.setdefault(head, {})[key] = v
+    if not children:
+        return None
+    if all(k.isdigit() for k in children):
+        return [_unflatten(children[str(i)], f"{prefix}.{i}")
+                for i in range(len(children))]
+    return {k: _unflatten(children[k], f"{prefix}.{k}") for k in children}
+
+
+def save_model(path: str, kind: str, meta: Dict[str, Any], params: Any) -> None:
+    """Write a model spec: npz of arrays + embedded JSON header."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    flat = _flatten(params)
+    header = json.dumps({"format": FORMAT_VERSION, "kind": kind, "meta": meta})
+    np.savez_compressed(path if path.endswith(".npz") else path + ".tmp.npz",
+                        __header__=np.frombuffer(header.encode(), np.uint8),
+                        **flat)
+    if not path.endswith(".npz"):
+        os.replace(path + ".tmp.npz", path)
+
+
+def load_model(path: str) -> Tuple[str, Dict[str, Any], Any]:
+    """Read a model spec → (kind, meta, params pytree). numpy-only."""
+    with np.load(path, allow_pickle=False) as z:
+        header = json.loads(bytes(z["__header__"].tolist()).decode())
+        flat = {k: z[k] for k in z.files if k != "__header__"}
+    if header.get("format") != FORMAT_VERSION:
+        raise ValueError(f"unsupported model format {header.get('format')}")
+    return header["kind"], header["meta"], _unflatten(flat)
+
+
+def list_models(models_dir: str) -> List[str]:
+    """All model specs in a models/ dir, sorted by bag index
+    (`ModelSpecLoaderUtils.loadBasicModels` analog)."""
+    if not os.path.isdir(models_dir):
+        return []
+    out = [os.path.join(models_dir, f) for f in sorted(os.listdir(models_dir))
+           if f.startswith("model") and not f.endswith(".json")]
+    return out

@@ -1,0 +1,126 @@
+"""`shifu eval` — score eval sets, confusion matrix, perf curves, charts.
+
+Replaces `core/processor/EvalModelProcessor.java:76-1110`: the Pig
+EvalScore job (every mapper loads all models and scores its split,
+`udf/EvalScoreUDF.java`) becomes one batched ensemble scoring pass;
+the sort-based streaming ConfusionMatrix
+(`core/ConfusionMatrix.java:255-284`) becomes the device-sort kernel in
+`shifu_tpu/ops/metrics.py`. Outputs under evals/<name>/: EvalScore.csv,
+EvalPerformance.json, EvalConfusionMatrix.csv, gainchart.html/csv.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.config.model_config import EvalConfig, ModelConfig
+from shifu_tpu.data.dataset import build_columnar
+from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.reader import read_raw_table
+from shifu_tpu.eval import gain_chart
+from shifu_tpu.eval.scorer import Scorer
+from shifu_tpu.ops.metrics import confusion_matrix_table, performance_result
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def run(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
+    mc = ctx.model_config
+    ctx.validate(ModelStep.EVAL)
+    ctx.require_columns()
+    evals = [e for e in mc.evals if eval_name is None or e.name == eval_name]
+    if not evals:
+        raise ValueError(f"no eval set named {eval_name!r}; have "
+                         f"{[e.name for e in mc.evals]}")
+    for ec in evals:
+        run_one(ctx, ec)
+    return 0
+
+
+def effective_dataset_conf(mc: ModelConfig, ec: EvalConfig):
+    """Eval dataSet inherits target/tags from the model dataSet when
+    unset (`EvalConfig.java` falls back to ModelConfig's dataSet)."""
+    ds = copy.copy(ec.dataSet)
+    base = mc.dataSet
+    if not ds.targetColumnName:
+        ds.targetColumnName = base.targetColumnName
+    if not ds.posTags:
+        ds.posTags = base.posTags
+    if not ds.negTags:
+        ds.negTags = base.negTags
+    if not ds.missingOrInvalidValues:
+        ds.missingOrInvalidValues = base.missingOrInvalidValues
+    return ds
+
+
+def score_eval_set(ctx: ProcessorContext, ec: EvalConfig):
+    """Read + normalize + ensemble-score one eval set. Returns
+    (scores dict, tags, weights)."""
+    mc = ctx.model_config
+    ds = effective_dataset_conf(mc, ec)
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+
+    # tags for the eval set come from its own pos/neg tags
+    eval_mc = copy.copy(mc)
+    eval_mc.dataSet = ds
+    dset = norm_proc.load_dataset_for_columns(eval_mc, ctx.column_configs,
+                                              cols, ds_conf=ds)
+    result = norm_proc.normalize_columns(mc, cols, dset)
+    scorer = Scorer.from_dir(ctx.path_finder.models_path(),
+                             score_selector=ec.performanceScoreSelector,
+                             gbt_convert=ec.gbtScoreConvertStrategy)
+    scores = scorer.score(result.dense,
+                          result.index if result.index.size else None)
+    return scores, dset.tags, dset.weights, dset
+
+
+def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
+    t0 = time.time()
+    mc = ctx.model_config
+    scores, tags, weights, dset = score_eval_set(ctx, ec)
+    final = scores["final"]
+
+    base = ctx.path_finder.eval_base_path(ec.name)
+    os.makedirs(base, exist_ok=True)
+
+    # EvalScore.csv: tag | weight | per-model scores | ensemble
+    model_cols = sorted(k for k in scores if k.startswith("model"))
+    with open(ctx.path_finder.eval_score_path(ec.name), "w") as f:
+        f.write("tag,weight," + ",".join(model_cols) + ",mean,max,min,median\n")
+        arr = np.stack([scores[c] for c in model_cols]
+                       + [scores["mean"], scores["max"], scores["min"],
+                          scores["median"]], axis=1)
+        for i in range(len(final)):
+            f.write(f"{int(tags[i])},{weights[i]:.6g},"
+                    + ",".join(f"{v:.6f}" for v in arr[i]) + "\n")
+
+    perf = performance_result(final, tags, weights,
+                              n_buckets=ec.performanceBucketNum)
+    with open(ctx.path_finder.eval_performance_path(ec.name), "w") as f:
+        json.dump(perf, f, indent=1)
+
+    cm = confusion_matrix_table(final, tags, weights)
+    with open(ctx.path_finder.eval_confusion_path(ec.name), "w") as f:
+        f.write("threshold,tp,fp,tn,fn,weightedTp,weightedFp,weightedTn,"
+                "weightedFn\n")
+        for row in cm:
+            f.write(",".join(f"{v:.6g}" for v in row) + "\n")
+
+    gain_chart.write_html(ctx.path_finder.gain_chart_path(ec.name, "html"),
+                          perf, f"{mc.model_set_name} — {ec.name}")
+    gain_chart.write_csv(ctx.path_finder.gain_chart_path(ec.name, "csv"), perf)
+
+    log.info("eval[%s]: %d rows, AUC=%.4f (weighted %.4f) in %.2fs",
+             ec.name, len(final), perf["areaUnderRoc"],
+             perf["weightedAreaUnderRoc"], time.time() - t0)
+    return perf

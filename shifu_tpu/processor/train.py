@@ -1,0 +1,227 @@
+"""`shifu train` — dispatch to the per-algorithm TPU trainers.
+
+Mirrors `core/processor/TrainModelProcessor.java:225-458` orchestration:
+validate, pick algorithm, handle bagging / grid search / k-fold /
+continuous training, write models + tmp artifacts. The Guagua job
+submission machinery (`runDistributedTrain:773`,
+`GuaguaMapReduceClient`) disappears — LOCAL and TPU run modes execute
+the same jitted program, differing only in device mesh
+(`shifu_tpu/parallel/mesh.py`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.config.model_config import Algorithm, ModelConfig
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.models.spec import load_model, save_model
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.train import grid_search
+from shifu_tpu.train.trainer import TrainResult, train_nn
+
+log = logging.getLogger("shifu_tpu")
+
+
+def run(ctx: ProcessorContext, seed: int = 12306) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.validate(ModelStep.TRAIN)
+    ctx.require_columns()
+    alg = mc.train.algorithm
+
+    if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
+        result = _train_dense(ctx, seed)
+    elif alg.is_tree:
+        from shifu_tpu.processor import train_tree
+        result = train_tree.run_tree(ctx, seed)
+    elif alg in (Algorithm.WDL,):
+        from shifu_tpu.processor import train_wdl
+        result = train_wdl.run_wdl(ctx, seed)
+    elif alg in (Algorithm.MTL,):
+        from shifu_tpu.processor import train_mtl
+        result = train_mtl.run_mtl(ctx, seed)
+    elif alg is Algorithm.TENSORFLOW:
+        raise NotImplementedError(
+            "TENSORFLOW bridge: train with NN and export via jax2tf "
+            "(shifu_tpu export -tf)")
+    else:
+        raise ValueError(f"unsupported algorithm {alg}")
+    log.info("train[%s] done in %.2fs", alg.value, time.time() - t0)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# NN / LR / SVM (dense-input gradient models)
+# ---------------------------------------------------------------------------
+
+def _load_dense_training_data(ctx: ProcessorContext):
+    path = ctx.path_finder.normalized_data_path()
+    if not os.path.exists(os.path.join(path, "data.npz")):
+        raise FileNotFoundError(
+            f"normalized data not found at {path}; run `norm` first")
+    data, meta = norm_proc.load_normalized(path)
+    return data, meta
+
+
+def _lr_spec(params: Dict[str, Any], input_dim: int) -> nn_mod.MLPSpec:
+    """LR = zero-hidden-layer sigmoid net with log loss
+    (`lr/LogisticRegressionWorker.java:312-332` gradient ≡ ∇ of this)."""
+    import dataclasses
+    spec = nn_mod.MLPSpec.from_train_params(params, input_dim)
+    return dataclasses.replace(spec, hidden_dims=(), activations=(),
+                               loss="log")
+
+
+def _svm_spec(params: Dict[str, Any], input_dim: int) -> nn_mod.MLPSpec:
+    """SVM maps to a linear model with squared hinge via log-loss
+    approximation — the reference's SVMTrainer is an Encog SVM used only
+    in LOCAL mode; we train a linear margin classifier."""
+    spec = _lr_spec(params, input_dim)
+    return spec
+
+
+def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
+    mc = ctx.model_config
+    data, meta = _load_dense_training_data(ctx)
+    x = data["dense"].astype(np.float32)
+    y = data["tags"].astype(np.float32)
+    w = data["weights"].astype(np.float32)
+    alg = mc.train.algorithm
+
+    combos = grid_search.expand(mc.train.params)
+    if mc.train.gridConfigFile:
+        gc = grid_search.parse_grid_config_file(
+            mc.resolve_path(mc.train.gridConfigFile))
+        merged = dict(mc.train.params)
+        merged.update(gc)
+        combos = grid_search.expand(merged)
+
+    is_gs = len(combos) > 1
+    kfold = mc.train.numKFold if mc.train.numKFold and mc.train.numKFold > 1 else 0
+
+    def make_spec(params):
+        if alg is Algorithm.LR:
+            return _lr_spec(params, x.shape[1])
+        if alg is Algorithm.SVM:
+            return _svm_spec(params, x.shape[1])
+        return nn_mod.MLPSpec.from_train_params(params, x.shape[1])
+
+    results: List[Tuple[Dict[str, Any], TrainResult]] = []
+    for ci, params in enumerate(combos):
+        tc = mc.train
+        spec = make_spec(params)
+        conf = _conf_with_params(tc, params)
+        if kfold:
+            res = _train_kfold(conf, spec, x, y, w, kfold, seed)
+        else:
+            init_params, fixed = _continuous_init(ctx, spec)
+            res = train_nn(conf, x, y, w, seed=seed + ci, spec=spec,
+                           init_params=init_params, fixed_layers=fixed)
+        results.append((params, res))
+        if is_gs:
+            log.info("grid[%d/%d] %s → val %.6f", ci + 1, len(combos),
+                     params, float(res.best_val.min()))
+
+    best_params, best = min(results, key=lambda pr: float(pr[1].best_val.min()))
+    if is_gs:
+        log.info("grid search best params: %s", best_params)
+
+    _save_dense_models(ctx, best, alg)
+    _write_val_errors(ctx, best)
+    return [best]
+
+
+def _conf_with_params(tc, params):
+    import copy
+    conf = copy.copy(tc)
+    conf.params = params
+    return conf
+
+
+def _continuous_init(ctx: ProcessorContext, spec: nn_mod.MLPSpec):
+    """Continuous training: resume from models/model0 when structure
+    matches (`NNMaster.initOrRecoverParams:356-387` +
+    `NNStructureComparator`); FixedLayers freeze
+    (TrainModelProcessor.inputOutputModelCheckSuccess:1389-1450)."""
+    mc = ctx.model_config
+    if not mc.train.isContinuous:
+        return None, None
+    path = ctx.path_finder.model_path(0)
+    if not os.path.exists(path):
+        log.info("continuous training: no existing model at %s, fresh start",
+                 path)
+        return None, None
+    kind, meta, params = load_model(path)
+    old_dims = meta.get("spec", {}).get("hidden_dims")
+    if old_dims != list(spec.hidden_dims) or \
+            meta.get("spec", {}).get("input_dim") != spec.input_dim:
+        log.warning("continuous training: structure changed %s→%s, fresh start",
+                    old_dims, spec.hidden_dims)
+        return None, None
+    fixed = mc.train.get_param("FixedLayers") or None
+    if fixed is not None:
+        fixed = [int(i) for i in fixed]
+    return params, fixed
+
+
+def _train_kfold(conf, spec, x, y, w, k: int, seed: int) -> TrainResult:
+    """K-fold CV: average validation error across folds, keep the
+    best-fold model (`TrainModelProcessor.postProcess4KFoldCV:929-954`)."""
+    rng = np.random.default_rng(seed)
+    fold_of = rng.integers(0, k, len(y))
+    fold_results = []
+    for f in range(k):
+        vmask = fold_of == f
+        res = train_nn(conf, x[~vmask], y[~vmask], w[~vmask], seed=seed + f,
+                       spec=spec, val_data=(x[vmask], y[vmask], w[vmask]))
+        fold_results.append(res)
+    avg_val = float(np.mean([r.best_val.min() for r in fold_results]))
+    log.info("k-fold (%d folds) average val error: %.6f", k, avg_val)
+    best = min(fold_results, key=lambda r: float(r.best_val.min()))
+    return best
+
+
+def _save_dense_models(ctx: ProcessorContext, res: TrainResult,
+                       alg: Algorithm) -> None:
+    mc = ctx.model_config
+    _, meta = _load_dense_training_data(ctx)
+    kind = {"NN": "nn", "LR": "lr", "SVM": "lr"}.get(alg.value, "nn")
+    spec_meta = {
+        "spec": {
+            "input_dim": res.spec.input_dim,
+            "hidden_dims": list(res.spec.hidden_dims),
+            "activations": list(res.spec.activations),
+            "output_dim": res.spec.output_dim,
+            "output_activation": res.spec.output_activation,
+            "dropout_rate": 0.0,  # inference never drops
+            "l2": res.spec.l2, "l1": res.spec.l1,
+            "loss": res.spec.loss, "weight_init": res.spec.weight_init,
+        },
+        "inputNames": meta["denseNames"],
+        "normType": mc.normalize.normType.value,
+        "modelSetName": mc.model_set_name,
+    }
+    for i, params in enumerate(res.params_per_bag):
+        path = ctx.path_finder.model_path(i, kind)
+        ctx.path_finder.ensure(path)
+        save_model(path, kind, spec_meta, params)
+    log.info("saved %d %s model(s) under %s", len(res.params_per_bag),
+             kind, ctx.path_finder.models_path())
+
+
+def _write_val_errors(ctx: ProcessorContext, res: TrainResult) -> None:
+    path = ctx.path_finder.val_error_path()
+    ctx.path_finder.ensure(path)
+    with open(path, "w") as f:
+        json.dump({"bestValError": [float(v) for v in res.best_val],
+                   "bestEpoch": [int(e) for e in res.best_epoch],
+                   "wallSeconds": res.wall_seconds}, f, indent=1)

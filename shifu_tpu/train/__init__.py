@@ -1,0 +1,1 @@
+from shifu_tpu.train import optimizers, trainer  # noqa: F401

@@ -1,0 +1,228 @@
+"""NN/LR training loop — the TPU replacement for Guagua BSP training.
+
+The reference's flagship path (`TrainModelProcessor.runDistributedTrain`
+→ Guagua master/worker iterations: workers run per-record backprop over
+their HDFS split (`nn/ParallelGradient.java:186-297`), the master
+aggregates gradients and applies `Weight.calculateWeights`
+(`nn/NNMaster.java:214-337`)) collapses into ONE jitted program:
+
+- "worker gradient over split, master aggregate" ≡ a full-batch
+  `jax.grad` over the (sharded) HBM-resident matrix — the mean over
+  rows IS the aggregation; under `shard_map` it is a `psum` over ICI.
+- "iteration" ≡ one step of a `lax.scan` over epochs.
+- "bagging jobs in parallel" (≤5 concurrent YARN jobs,
+  `TrainModelProcessor.java:1016-1135`) ≡ `vmap` over the bag axis —
+  every bag trains simultaneously on the same device pass, with
+  per-bag Poisson/Bernoulli sample weights reproducing
+  `AbstractNNWorker`'s Poisson bagging.
+- early stop (window + convergence: `core/dtrain/earlystop/
+  WindowEarlyStop.java`, `ConvergeAndValidToleranceEarlyStop.java`)
+  runs in-graph: a stopped bag's parameters freeze while the scan
+  completes, and best-validation parameters are tracked in the carry
+  (NNOutput keeps the best tmp model).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from shifu_tpu.config.model_config import ModelTrainConf
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.train.optimizers import optimizer_from_params
+
+log = logging.getLogger("shifu_tpu")
+
+
+@dataclass
+class TrainResult:
+    spec: nn_mod.MLPSpec
+    params_per_bag: List[Any]          # best-validation params, host-side
+    train_errors: np.ndarray           # (bags, epochs)
+    val_errors: np.ndarray             # (bags, epochs)
+    best_val: np.ndarray               # (bags,)
+    best_epoch: np.ndarray             # (bags,)
+    wall_seconds: float = 0.0
+
+
+def split_validation(n: int, valid_rate: float, seed: int,
+                     cross_over: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Random train/valid split (`AbstractNNWorker.init` validation
+    sampling). Returns boolean masks (train, valid)."""
+    rng = np.random.default_rng(seed)
+    is_val = rng.random(n) < valid_rate
+    if valid_rate <= 0.0:
+        return np.ones(n, bool), np.zeros(n, bool)
+    if is_val.all():
+        is_val[0] = False
+    if not is_val.any():
+        is_val[-1] = True
+    return ~is_val, is_val
+
+
+def bagging_weights(n: int, n_bags: int, sample_rate: float,
+                    with_replacement: bool, seed: int) -> np.ndarray:
+    """(bags, n) per-row multiplicities: Poisson(rate) for
+    with-replacement (AbstractNNWorker Poisson bagging), Bernoulli mask
+    otherwise. Bag 0 of a 1-bag run sees the full data (reference runs
+    the plain training as bag 0)."""
+    rng = np.random.default_rng(seed)
+    if n_bags == 1 and sample_rate >= 1.0 and not with_replacement:
+        return np.ones((1, n), np.float32)
+    if with_replacement:
+        w = rng.poisson(sample_rate, size=(n_bags, n)).astype(np.float32)
+    else:
+        w = (rng.random((n_bags, n)) < sample_rate).astype(np.float32)
+    # guard: a bag with zero total weight would divide by ~0
+    empty = w.sum(axis=1) == 0
+    w[empty] = 1.0
+    return w
+
+
+@partial(jax.jit, static_argnames=("spec", "optimizer", "n_epochs",
+                                   "early_stop_window"))
+def _train_bags(spec: nn_mod.MLPSpec, optimizer, n_epochs: int,
+                early_stop_window: int, convergence_threshold: float,
+                stacked_params, x_train, y_train, w_train_bags,
+                x_val, y_val, w_val, dropout_keys, grad_mask):
+    """vmapped-over-bags, scanned-over-epochs full-batch training.
+
+    stacked_params: pytree with leading bag axis. w_train_bags: (B, Nt)
+    per-bag sample weights (bagging multiplicity × row weight).
+    grad_mask: pytree of {0,1} masking fixed layers (continuous
+    training's frozen-layer fitting, NNMaster.java:369-379).
+    """
+
+    def one_bag(params, w_train, key):
+        opt_state = optimizer.init(params)
+
+        def epoch_step(carry, e):
+            params, opt_state, best, stop_state, key = carry
+            best_params, best_val, bad_count, stopped = (
+                best["params"], best["val"], stop_state["bad"],
+                stop_state["stopped"])
+            key, sub = jax.random.split(key)
+            dkey = sub if spec.dropout_rate > 0 else None
+            train_err, grads = jax.value_and_grad(nn_mod.loss_fn, argnums=1)(
+                spec, params, x_train, y_train, w_train, dkey)
+            grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # freeze when stopped (scan must run to fixed length)
+            keep = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda a, b: jnp.where(stopped, b, a), new, old)
+            params2 = keep(new_params, params)
+            opt_state2 = jax.tree.map(
+                lambda a, b: jnp.where(stopped, b, a) if a.shape == b.shape else a,
+                new_opt_state, opt_state)
+            val_err = nn_mod.mse(spec, params2, x_val, y_val, w_val)
+            improved = val_err < best_val
+            best_params2 = jax.tree.map(
+                lambda bp, p: jnp.where(improved & ~stopped, p, bp),
+                best_params, params2)
+            best_val2 = jnp.where(improved & ~stopped, val_err, best_val)
+            bad2 = jnp.where(stopped, bad_count,
+                             jnp.where(improved, 0, bad_count + 1))
+            window_stop = (early_stop_window > 0) & (bad2 >= early_stop_window)
+            converge_stop = (convergence_threshold > 0.0) & \
+                (train_err <= convergence_threshold)
+            stopped2 = stopped | window_stop | converge_stop
+            carry2 = (params2, opt_state2,
+                      {"params": best_params2, "val": best_val2},
+                      {"bad": bad2, "stopped": stopped2}, key)
+            return carry2, (train_err, val_err)
+
+        init = (params, opt_state,
+                {"params": params, "val": jnp.asarray(jnp.inf)},
+                {"bad": jnp.asarray(0, jnp.int32),
+                 "stopped": jnp.asarray(False)}, key)
+        carry, (train_errs, val_errs) = jax.lax.scan(
+            epoch_step, init, jnp.arange(n_epochs))
+        best = carry[2]
+        best_epoch = jnp.argmin(val_errs)
+        return best["params"], train_errs, val_errs, best["val"], best_epoch
+
+    return jax.vmap(one_bag)(stacked_params, w_train_bags, dropout_keys)
+
+
+def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
+             w: np.ndarray, seed: int = 12306,
+             spec: Optional[nn_mod.MLPSpec] = None,
+             init_params: Optional[Any] = None,
+             fixed_layers: Optional[List[int]] = None,
+             val_data: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+             ) -> TrainResult:
+    """Train `baggingNum` NN models at once.
+
+    val_data overrides the random validSetRate split (the reference's
+    separate validation dir, ShifuInputFormat). init_params enables
+    continuous training (resume from models/model0.nn);
+    fixed_layers freezes those layer indices.
+    """
+    t0 = time.time()
+    spec = spec or nn_mod.MLPSpec.from_train_params(
+        train_conf.params, input_dim=x.shape[1])
+    n_bags = max(train_conf.baggingNum, 1)
+
+    if val_data is not None:
+        x_tr, y_tr, w_tr = x, y, w
+        x_v, y_v, w_v = val_data
+    else:
+        tr_mask, val_mask = split_validation(len(y), train_conf.validSetRate,
+                                             seed)
+        x_tr, y_tr, w_tr = x[tr_mask], y[tr_mask], w[tr_mask]
+        x_v, y_v, w_v = x[val_mask], y[val_mask], w[val_mask]
+
+    bag_w = bagging_weights(len(y_tr), n_bags, train_conf.baggingSampleRate,
+                            train_conf.baggingWithReplacement, seed) \
+        * w_tr[None, :]
+
+    key = jax.random.PRNGKey(seed)
+    bag_keys = jax.random.split(key, n_bags + 1)
+    if init_params is not None:
+        stacked = jax.tree.map(
+            lambda p: jnp.broadcast_to(p, (n_bags,) + p.shape), init_params)
+    else:
+        stacked = jax.vmap(lambda k: nn_mod.init_params(spec, k))(bag_keys[:-1])
+
+    grad_mask = jax.tree.map(jnp.ones_like,
+                             jax.tree.map(lambda l: l[0], stacked)
+                             if init_params is None else init_params)
+    if fixed_layers:
+        mask_list = []
+        for i, layer in enumerate(grad_mask):
+            z = 0.0 if i in fixed_layers else 1.0
+            mask_list.append({k: jnp.full_like(v, z)
+                              for k, v in layer.items()})
+        grad_mask = mask_list
+
+    optimizer = optimizer_from_params(train_conf.params)
+    early_window = train_conf.earlyStoppingRounds
+    best_params, train_errs, val_errs, best_val, best_epoch = _train_bags(
+        spec, optimizer, train_conf.numTrainEpochs,
+        early_window if early_window and early_window > 0 else 0,
+        float(train_conf.convergenceThreshold or 0.0),
+        stacked, jnp.asarray(x_tr), jnp.asarray(y_tr), jnp.asarray(bag_w),
+        jnp.asarray(x_v), jnp.asarray(y_v), jnp.asarray(w_v),
+        bag_keys[:-1], grad_mask)
+
+    params_per_bag = [
+        jax.tree.map(lambda p, i=i: np.asarray(p[i]), best_params)
+        for i in range(n_bags)]
+    res = TrainResult(
+        spec=spec, params_per_bag=params_per_bag,
+        train_errors=np.asarray(train_errs), val_errors=np.asarray(val_errs),
+        best_val=np.asarray(best_val), best_epoch=np.asarray(best_epoch),
+        wall_seconds=time.time() - t0)
+    log.info("train: %d bag(s), %d epochs, best val err %s in %.2fs",
+             n_bags, train_conf.numTrainEpochs,
+             np.round(res.best_val, 6).tolist(), res.wall_seconds)
+    return res

@@ -1,0 +1,214 @@
+"""Training-slice tests: optimizers, trainer semantics, and the full
+init→stats→norm→train→eval pipeline (the "one model end-to-end"
+milestone; SURVEY.md §7 phase 3)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.config.model_config import ModelConfig, ModelTrainConf
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.processor import eval as eval_proc
+from shifu_tpu.processor import init as init_proc
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor import stats as stats_proc
+from shifu_tpu.processor import train as train_proc
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.train.optimizers import make_optimizer
+from shifu_tpu.train.trainer import bagging_weights, split_validation, train_nn
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prop", ["B", "Q", "R", "M", "N", "ADAM",
+                                  "ADAGRAD", "RMSPROP"])
+def test_optimizer_reduces_quadratic(prop):
+    """Every Propagation mapping minimizes a quadratic (the reference's
+    DTrainTest asserts error decreases per optimizer)."""
+    opt = make_optimizer(prop, learning_rate=0.3)
+    params = {"w": jnp.asarray([3.0, -2.0])}
+    state = opt.init(params)
+    loss = lambda p: jnp.sum(jnp.square(p["w"]))  # noqa: E731
+    l0 = loss(params)
+    for _ in range(60):
+        g = jax.grad(loss)(params)
+        upd, state = opt.update(g, state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, upd)
+    assert float(loss(params)) < float(l0) * 0.05
+
+
+def test_unknown_propagation_raises():
+    with pytest.raises(ValueError):
+        make_optimizer("XYZ", 0.1)
+
+
+# ---------------------------------------------------------------------------
+# trainer pieces
+# ---------------------------------------------------------------------------
+
+def test_split_validation():
+    tr, va = split_validation(1000, 0.2, seed=1)
+    assert tr.sum() + va.sum() == 1000
+    assert 100 < va.sum() < 300
+
+
+def test_bagging_weights_poisson():
+    w = bagging_weights(1000, 4, 1.0, with_replacement=True, seed=1)
+    assert w.shape == (4, 1000)
+    assert abs(w.mean() - 1.0) < 0.15
+    assert (w >= 0).all() and (w == np.floor(w)).all()
+    # bags differ
+    assert not np.array_equal(w[0], w[1])
+
+
+def test_bagging_weights_single_full_bag():
+    w = bagging_weights(100, 1, 1.0, with_replacement=False, seed=1)
+    assert (w == 1.0).all()
+
+
+def test_train_nn_learns_xor_ish(rng):
+    """Separable data: the trained net must beat chance massively."""
+    n = 2000
+    x = rng.normal(0, 1, (n, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    conf = ModelTrainConf.from_dict({
+        "numTrainEpochs": 60, "baggingNum": 1, "validSetRate": 0.2,
+        "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                   "ActivationFunc": ["tanh"], "LearningRate": 0.2,
+                   "Propagation": "ADAM"}})
+    res = train_nn(conf, x, y, w, seed=3)
+    assert float(res.best_val.min()) < 0.08
+    assert res.train_errors.shape == (1, 60)
+
+
+def test_train_nn_convergence_stop_freezes():
+    """convergenceThreshold (ConvergeAndValidToleranceEarlyStop): once
+    train error dips below the threshold, parameters freeze for the
+    remaining scan epochs — val error exactly constant afterwards."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (400, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    conf = ModelTrainConf.from_dict({
+        "numTrainEpochs": 60, "baggingNum": 1, "validSetRate": 0.25,
+        "convergenceThreshold": 0.12,
+        "params": {"NumHiddenLayers": 0, "NumHiddenNodes": [],
+                   "ActivationFunc": [], "LearningRate": 0.5,
+                   "Propagation": "B"}})
+    res = train_nn(conf, x, y, np.ones(400, np.float32), seed=4)
+    t = res.train_errors[0]
+    assert t.min() <= 0.12  # threshold was reached
+    v = res.val_errors[0]
+    tail = v[-3:]
+    assert np.allclose(tail, tail[0])
+
+
+def test_train_nn_window_early_stop_on_overfit():
+    """WindowEarlyStop: a big net on a tiny noisy set overfits, val
+    error stops improving, the window triggers and updates freeze
+    (exactly-constant val tail); the same run without earlyStoppingRounds
+    keeps moving."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(0, 1, (80, 6)).astype(np.float32)
+    y = ((x[:, 0] + rng.normal(0, 1.0, 80)) > 0).astype(np.float32)
+    base = {"numTrainEpochs": 150, "baggingNum": 1, "validSetRate": 0.4,
+            "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [32],
+                       "ActivationFunc": ["tanh"], "LearningRate": 0.5,
+                       "Propagation": "ADAM"}}
+    w = np.ones(80, np.float32)
+    stop = train_nn(ModelTrainConf.from_dict(
+        {**base, "earlyStoppingRounds": 5}), x, y, w, seed=4)
+    free = train_nn(ModelTrainConf.from_dict(base), x, y, w, seed=4)
+    v_stop, v_free = stop.val_errors[0], free.val_errors[0]
+    assert np.all(v_stop[-50:] == v_stop[-1])     # frozen
+    assert not np.all(v_free[-50:] == v_free[-1])  # still training
+
+
+def test_bagging_vmap_trains_distinct_models(rng):
+    x = rng.normal(0, 1, (600, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    conf = ModelTrainConf.from_dict({
+        "numTrainEpochs": 10, "baggingNum": 3, "baggingWithReplacement": True,
+        "validSetRate": 0.2,
+        "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                   "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                   "Propagation": "ADAM"}})
+    res = train_nn(conf, x, y, np.ones(600, np.float32), seed=6)
+    assert len(res.params_per_bag) == 3
+    w0 = res.params_per_bag[0][0]["w"]
+    w1 = res.params_per_bag[1][0]["w"]
+    assert not np.allclose(w0, w1)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end pipeline
+# ---------------------------------------------------------------------------
+
+def run_pipeline(root):
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    assert eval_proc.run(ctx) == 0
+    return ctx
+
+
+def test_full_pipeline_nn(model_set):
+    ctx = run_pipeline(model_set)
+    perf_path = ctx.path_finder.eval_performance_path("Eval1")
+    with open(perf_path) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.85  # separable synthetic data
+    assert os.path.exists(ctx.path_finder.model_path(0, "nn"))
+    assert os.path.exists(ctx.path_finder.gain_chart_path("Eval1", "html"))
+    assert os.path.exists(ctx.path_finder.eval_score_path("Eval1"))
+    # gains distinct from pr/roc structures
+    assert "actionRate" in perf["gains"][0]
+    assert "precision" in perf["pr"][0]
+    assert "fpr" in perf["roc"][0]
+
+
+def test_full_pipeline_lr(tmp_path, rng):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1500, algorithm="LR",
+                          train_params={"LearningRate": 0.5,
+                                        "Propagation": "ADAM",
+                                        "RegularizedConstant": 0.001})
+    ctx = run_pipeline(root)
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.85
+
+
+def test_grid_search_selects_best(tmp_path, rng):
+    from tests.synth import make_model_set
+    root = make_model_set(
+        tmp_path, rng, n_rows=1000,
+        train_params={"NumHiddenLayers": 1, "NumHiddenNodes": [[4], [8]],
+                      "ActivationFunc": ["tanh"],
+                      "LearningRate": [0.05, 0.2], "Propagation": "ADAM"})
+    for proc in (init_proc, stats_proc, norm_proc):
+        ctx = ProcessorContext.load(root)
+        proc.run(ctx)
+    ctx = ProcessorContext.load(root)
+    assert train_proc.run(ctx) == 0
+    assert os.path.exists(ctx.path_finder.model_path(0, "nn"))
+
+
+def test_model_spec_roundtrip(tmp_path):
+    from shifu_tpu.models.spec import load_model, save_model
+    params = [{"w": np.ones((3, 2), np.float32), "b": np.zeros(2, np.float32)},
+              {"w": np.ones((2, 1), np.float32), "b": np.zeros(1, np.float32)}]
+    p = str(tmp_path / "model0.nn")
+    save_model(p, "nn", {"spec": {"input_dim": 3}}, params)
+    kind, meta, loaded = load_model(p)
+    assert kind == "nn"
+    assert meta["spec"]["input_dim"] == 3
+    np.testing.assert_array_equal(loaded[0]["w"], params[0]["w"])
+    np.testing.assert_array_equal(loaded[1]["b"], params[1]["b"])
