@@ -23,8 +23,14 @@ from shifu_tpu.models.spec import load_model, list_models
 
 def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
                  dense: np.ndarray,
-                 index: Optional[np.ndarray] = None) -> np.ndarray:
-    """Score one model over the normalized matrix → (N,) scores."""
+                 index: Optional[np.ndarray] = None,
+                 raw_dense: Optional[np.ndarray] = None,
+                 raw_codes: Optional[np.ndarray] = None) -> np.ndarray:
+    """Score one model → (N,) scores. NN-family models consume the
+    NORMALIZED blocks (dense/index); tree models consume the CLEANED
+    raw features (raw_dense numeric with NaN missing, raw_codes with
+    −1/vocab_len missing) — mirroring the reference's split where trees
+    train on cleaned data (TrainModelProcessor:1547-1550)."""
     if kind in ("nn", "lr"):
         sd = dict(meta["spec"])
         sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
@@ -35,7 +41,9 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
         return np.asarray(out)
     if kind in ("gbt", "rf"):
         from shifu_tpu.models import gbdt
-        return gbdt.predict(meta, params, dense, index)
+        rd = raw_dense if raw_dense is not None else dense
+        rc = raw_codes if raw_codes is not None else index
+        return gbdt.predict(meta, params, rd, rc)
     if kind == "wdl":
         from shifu_tpu.models import wdl
         return wdl.predict(meta, params, dense, index)
@@ -76,12 +84,15 @@ class Scorer:
         return cls(list_models(models_dir), **kw)
 
     def score(self, dense: np.ndarray,
-              index: Optional[np.ndarray] = None) -> Dict[str, np.ndarray]:
+              index: Optional[np.ndarray] = None,
+              raw_dense: Optional[np.ndarray] = None,
+              raw_codes: Optional[np.ndarray] = None) -> Dict[str, np.ndarray]:
         """→ {"mean","max","min","median","model0".."modelN"} like the
         reference EvalScore output columns."""
         per_model = []
         for kind, meta, params in self.models:
-            s = score_matrix(kind, meta, params, dense, index)
+            s = score_matrix(kind, meta, params, dense, index,
+                             raw_dense=raw_dense, raw_codes=raw_codes)
             if kind in ("gbt",):
                 s = convert_tree_score(s, self.gbt_convert)
             per_model.append(s)

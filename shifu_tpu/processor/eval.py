@@ -79,8 +79,16 @@ def score_eval_set(ctx: ProcessorContext, ec: EvalConfig):
     scorer = Scorer.from_dir(ctx.path_finder.models_path(),
                              score_selector=ec.performanceScoreSelector,
                              gbt_convert=ec.gbtScoreConvertStrategy)
+    # cleaned-form raw blocks for tree models (codes: missing → vocab_len)
+    if dset.cat_codes.shape[1]:
+        vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+        raw_codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                             dset.cat_codes).astype(np.int32)
+    else:
+        raw_codes = dset.cat_codes
     scores = scorer.score(result.dense,
-                          result.index if result.index.size else None)
+                          result.index if result.index.size else None,
+                          raw_dense=dset.numeric, raw_codes=raw_codes)
     return scores, dset.tags, dset.weights, dset
 
 

@@ -1,0 +1,158 @@
+"""Tree-algorithm training step (GBT / RF / DT).
+
+Mirrors the tree branch of `TrainModelProcessor` (input = cleaned, not
+normalized, data — `prepareCommonParams:1547-1550`; iterations = one
+node batch per Guagua iteration, here one level per kernel). Binning
+tables come straight from the stats phase's ColumnConfig (binBoundary /
+binPosRate) so trees split on the same boundaries the reference's
+DTWorker quantizes with (`dt/DTWorker.java:102-104` bin-indexed
+instances).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.config.model_config import Algorithm, ModelConfig
+from shifu_tpu.models import gbdt
+from shifu_tpu.models.spec import load_model, save_model
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.train.trainer import split_validation
+
+log = logging.getLogger("shifu_tpu")
+
+
+def tree_config_from_params(mc: ModelConfig) -> gbdt.TreeConfig:
+    t = mc.train
+    max_bins_cfg = mc.stats.maxNumBin
+    return gbdt.TreeConfig(
+        max_depth=int(t.get_param("MaxDepth", 6) or 6),
+        n_bins=0,  # filled by caller once tables are known
+        min_instances_per_node=int(t.get_param("MinInstancesPerNode", 1) or 1),
+        min_info_gain=float(t.get_param("MinInfoGain", 0.0) or 0.0),
+        reg_lambda=float(t.get_param("RegLambda", 1.0) or 1.0),
+        learning_rate=float(t.get_param("LearningRate", 0.1) or 0.1),
+        loss=str(t.get_param("Loss", "squared") or "squared").lower(),
+    )
+
+
+def build_tables(ccs_num: List[ColumnConfig], ccs_cat: List[ColumnConfig],
+                 max_bins: int):
+    """Numeric cuts + posRate-ordered categorical maps from stats."""
+    n_cuts = max(max_bins - 1, 1)
+    cuts = np.full((n_cuts, len(ccs_num)), np.inf, np.float32)
+    for j, cc in enumerate(ccs_num):
+        bb = np.asarray(cc.columnBinning.binBoundary or [-np.inf], np.float64)
+        interior = bb[1:][np.isfinite(bb[1:])]
+        cuts[:len(interior), j] = interior
+    cat_orders = []
+    for cc in ccs_cat:
+        pr = np.asarray(cc.columnBinning.binPosRate or [0.0], np.float64)
+        v = len(cc.columnBinning.binCategory or [])
+        order = np.argsort(np.argsort(pr[:v], kind="stable")).astype(np.int32) \
+            if v else np.zeros(0, np.int32)
+        cat_orders.append(order)
+    return cuts, cat_orders
+
+
+def run_tree(ctx: ProcessorContext, seed: int = 12306):
+    t0 = time.time()
+    mc = ctx.model_config
+    alg = mc.train.algorithm
+
+    clean_path = ctx.path_finder.cleaned_data_path()
+    if not os.path.exists(os.path.join(clean_path, "data.npz")):
+        raise FileNotFoundError(
+            f"cleaned data not found at {clean_path}; run `norm` first")
+    data, meta = norm_proc.load_normalized(clean_path)
+    dense = data["dense"].astype(np.float32)
+    codes = data["index"].astype(np.int32)
+    y = data["tags"].astype(np.float32)
+    w = data["weights"].astype(np.float32)
+
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    by_name = {c.columnName: c for c in cols}
+    ccs_num = [by_name[n] for n in meta["denseNames"] if n in by_name]
+    ccs_cat = [by_name[n] for n in meta["indexNames"] if n in by_name]
+
+    max_bins = mc.stats.maxNumBin
+    cuts, cat_orders = build_tables(ccs_num, ccs_cat, max_bins)
+    # histogram width: enough for numeric cut slots, every categorical
+    # vocab, plus the shared missing slot (last)
+    value_slots = max([cuts.shape[0] + 1]
+                      + [len(o) for o in cat_orders]) if (len(ccs_num) or
+                                                          len(ccs_cat)) else 2
+    n_bins = value_slots + 1
+    import dataclasses
+    cfg = dataclasses.replace(tree_config_from_params(mc), n_bins=n_bins)
+
+    tables = gbdt.make_bin_tables(cuts, cat_orders, n_bins)
+    bins = gbdt.bin_dataset(tables, dense, codes, n_bins)
+
+    n_trees = int(mc.train.get_param("TreeNum", 10 if alg is Algorithm.RF
+                                     else 100) or 10)
+    if alg is Algorithm.DT:
+        n_trees = 1
+    subset = str(mc.train.get_param("FeatureSubsetStrategy", "ALL") or "ALL")
+
+    tr_mask, val_mask = split_validation(len(y), mc.train.validSetRate, seed)
+
+    spec_meta = {
+        "kind": alg.value.lower() if alg is not Algorithm.DT else "rf",
+        "treeConfig": {"max_depth": cfg.max_depth, "n_bins": cfg.n_bins,
+                       "learning_rate": cfg.learning_rate, "loss": cfg.loss},
+        "denseNames": meta["denseNames"], "indexNames": meta["indexNames"],
+        "modelSetName": mc.model_set_name, "nTrees": n_trees,
+    }
+
+    n_bags = max(mc.train.baggingNum, 1) if alg is Algorithm.GBT else 1
+    for bag in range(n_bags):
+        if alg is Algorithm.GBT:
+            init_trees = _continuous_trees(ctx, mc, bag)
+            trees, val_errs = gbdt.build_gbt(
+                cfg, bins[tr_mask], y[tr_mask], w[tr_mask], n_trees,
+                init_trees=init_trees,
+                val_data=(bins[val_mask], y[val_mask]) if val_mask.any() else None,
+                early_stop_window=int(mc.train.get_param(
+                    "EnableEarlyStop", 0) and 10),
+            )
+            kind = "gbt"
+        else:
+            trees = gbdt.build_rf(cfg, bins[tr_mask], y[tr_mask], w[tr_mask],
+                                  n_trees, subset,
+                                  mc.train.baggingSampleRate, seed + bag)
+            val_errs = []
+            kind = "rf"
+        path = ctx.path_finder.model_path(bag, kind)
+        ctx.path_finder.ensure(path)
+        save_model(path, kind, spec_meta,
+                   {"trees": trees, "tables": tables})
+        if val_errs:
+            log.info("tree bag %d: %d trees, final val err %.6f", bag,
+                     trees["feature"].shape[0], val_errs[-1])
+    log.info("train[%s]: %d bag(s) × %d trees, depth %d, %d bins in %.2fs",
+             alg.value, n_bags, n_trees, cfg.max_depth, n_bins,
+             time.time() - t0)
+    return None
+
+
+def _continuous_trees(ctx: ProcessorContext, mc: ModelConfig, bag: int):
+    """GBT continuous training appends trees to the existing ensemble
+    (`TrainModelProcessor.java:1064-1073` tree-count check)."""
+    if not mc.train.isContinuous:
+        return None
+    path = ctx.path_finder.model_path(bag, "gbt")
+    if not os.path.exists(path):
+        return None
+    _, _, params = load_model(path)
+    import jax.numpy as jnp
+    import jax
+    return jax.tree.map(jnp.asarray, params["trees"])
