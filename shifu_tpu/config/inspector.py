@@ -104,9 +104,10 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
         r.fail(f"train#numTrainEpochs must be positive, got {t.numTrainEpochs}")
     alg = t.algorithm
     norm = mc.normalize.normType
-    if alg in (Algorithm.WDL, Algorithm.MTL) and not norm.is_index:
+    if alg is Algorithm.WDL and not norm.is_index:
         # WDLWorker requires *_INDEX norm so categoricals arrive as
-        # embedding indices (TrainModelProcessor.java:441-448 analog).
+        # embedding indices (TrainModelProcessor.java:441-448 analog);
+        # MTL consumes the dense block and takes any normType.
         r.fail(f"{alg.value} requires an *_INDEX normType for embeddings, got {norm.value}")
     if alg is Algorithm.NN:
         nh = t.get_param("NumHiddenLayers")

@@ -40,6 +40,10 @@ class ColumnarDataset:
     weights: np.ndarray                # (R,) float32
     # bookkeeping
     meta: Dict[str, np.ndarray] = field(default_factory=dict)  # meta columns kept as strings
+    # MTL: (R, T) per-task tags in targetColumnName order (NaN = task
+    # unlabeled for the row); empty for single-task model sets
+    task_tags: np.ndarray = field(
+        default_factory=lambda: np.zeros((0, 0), np.float32))
 
     @property
     def num_rows(self) -> int:
@@ -53,7 +57,9 @@ class ColumnarDataset:
             cat_codes=self.cat_codes[row_mask],
             vocabs=self.vocabs, tags=self.tags[row_mask],
             weights=self.weights[row_mask],
-            meta={k: v[row_mask] for k, v in self.meta.items()})
+            meta={k: v[row_mask] for k, v in self.meta.items()},
+            task_tags=(self.task_tags[row_mask] if self.task_tags.size
+                       else self.task_tags))
 
 
 def parse_tags(raw: np.ndarray, pos_tags: Sequence[str],
@@ -83,10 +89,16 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
     binCategory) so eval/scoring data maps unseen categories to the
     missing bin, as `Normalizer` does for unknown categories.
     """
+    from shifu_tpu.data.reader import simple_column_name
     missing = [str(m) for m in mc.dataSet.missingOrInvalidValues]
     cc_by_name = {c.columnName: c for c in column_configs}
+    # MTL flags several Target columns; the primary tag is task 0
+    task_names = [simple_column_name(t)
+                  for t in mc.dataSet.targetColumnName.split("|") if t.strip()]
+    primary_target = task_names[0] if task_names else ""
 
     tag_col = weight_col = None
+    task_cols: Dict[str, np.ndarray] = {}
     num_names, num_cols, cat_names, cat_cols = [], [], [], []
     num_mats, cat_mats, out_vocabs = [], [], []
     meta_cols: Dict[str, np.ndarray] = {}
@@ -97,7 +109,10 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
             continue
         sv = df[col].astype(str).str.strip()
         if cc.is_target:
-            tag_col = sv.to_numpy()
+            if tag_col is None or col == primary_target:
+                tag_col = sv.to_numpy()
+            if col in task_names:
+                task_cols[col] = sv.to_numpy()
             continue
         if cc.is_weight:
             weight_col = pd.to_numeric(sv, errors="coerce").fillna(1.0) \
@@ -134,6 +149,13 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
     tags = parse_tags(tag_col, mc.pos_tags, mc.neg_tags) if tag_col is not None \
         else np.full(n_rows, np.nan, np.float32)
     weights = weight_col if weight_col is not None else np.ones(n_rows, np.float32)
+    if len(task_names) > 1 and task_cols:
+        task_tags = np.stack(
+            [parse_tags(task_cols[t], mc.pos_tags, mc.neg_tags)
+             if t in task_cols else np.full(n_rows, np.nan, np.float32)
+             for t in task_names], axis=1)
+    else:
+        task_tags = np.zeros((n_rows, 0), np.float32)
 
     dset = ColumnarDataset(
         num_names=num_names,
@@ -144,7 +166,8 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
         cat_column_nums=np.asarray(cat_cols, np.int32),
         cat_codes=(np.stack(cat_mats, axis=1) if cat_mats
                    else np.zeros((n_rows, 0), np.int32)),
-        vocabs=out_vocabs, tags=tags, weights=weights, meta=meta_cols)
+        vocabs=out_vocabs, tags=tags, weights=weights, meta=meta_cols,
+        task_tags=task_tags)
 
     # drop rows with unknown tags (reference skips invalid-tag records)
     valid = ~np.isnan(tags)

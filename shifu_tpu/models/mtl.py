@@ -1,0 +1,109 @@
+"""Multi-task learning — shared trunk + per-task heads.
+
+Replaces `mtl/MultiTaskModel.java:72-219` (shared hidden DenseLayers +
+per-task final DenseLayer + logistic outputs; `MTLWorker.java:81`
+parses one tag per task). targetColumnName with '|'-separated names
+activates MTL (`ModelConfig.isMultiTask`), and each task may carry its
+own ColumnConfig (`mtlcolumnconfig/ColumnConfig.json.{i}`,
+`PathFinder.getMTLColumnConfigPath`) — here tasks share the input
+matrix and differ in target column.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.models import nn as nn_mod
+
+
+@dataclass(frozen=True)
+class MTLSpec:
+    input_dim: int
+    n_tasks: int
+    hidden_dims: tuple = (64, 32)
+    activations: tuple = ("relu", "relu")
+    l2: float = 0.0
+
+    @classmethod
+    def from_train_params(cls, params: Dict[str, Any], input_dim: int,
+                          n_tasks: int) -> "MTLSpec":
+        get = nn_mod.param_getter(params)
+        nodes, acts = nn_mod.parse_arch_params(
+            params, default_nodes=(64, 32), default_acts=("relu",),
+            honor_num_layers=False)
+        return cls(input_dim=input_dim, n_tasks=n_tasks,
+                   hidden_dims=nodes, activations=acts,
+                   l2=float(get("RegularizedConstant", 0.0) or 0.0))
+
+    @property
+    def trunk_spec(self) -> nn_mod.MLPSpec:
+        trunk_out = self.hidden_dims[-1] if self.hidden_dims else self.input_dim
+        return nn_mod.MLPSpec(
+            input_dim=self.input_dim,
+            hidden_dims=self.hidden_dims[:-1] if self.hidden_dims else (),
+            activations=self.activations[:-1] if self.hidden_dims else (),
+            output_dim=trunk_out,
+            output_activation=self.activations[-1] if self.hidden_dims
+            else "linear")
+
+
+def init_params(spec: MTLSpec, key: jax.Array) -> Dict[str, Any]:
+    k_trunk, k_heads = jax.random.split(key)
+    trunk = nn_mod.init_params(spec.trunk_spec, k_trunk)
+    trunk_out = spec.hidden_dims[-1] if spec.hidden_dims else spec.input_dim
+    heads_w = jax.random.normal(k_heads, (spec.n_tasks, trunk_out)) \
+        * (1.0 / np.sqrt(trunk_out))
+    return {"trunk": trunk, "heads_w": heads_w,
+            "heads_b": jnp.zeros((spec.n_tasks,))}
+
+
+def forward(spec: MTLSpec, params, x: jax.Array) -> jax.Array:
+    """(N, D) → (N, T) per-task probabilities."""
+    h = nn_mod.forward(spec.trunk_spec, params["trunk"], x)
+    logits = h @ params["heads_w"].T + params["heads_b"][None, :]
+    return jax.nn.sigmoid(logits)
+
+
+def loss_fn(spec: MTLSpec, params, x, y, w) -> jax.Array:
+    """Sum of per-task weighted cross-entropies; NaN targets (task
+    unlabeled for a row) are masked out."""
+    p = forward(spec, params, x)
+    eps = 1e-7
+    valid = ~jnp.isnan(y)
+    ys = jnp.where(valid, y, 0.0)
+    per = -(ys * jnp.log(p + eps) + (1 - ys) * jnp.log(1 - p + eps))
+    per = jnp.where(valid, per, 0.0) * w[:, None]
+    loss = jnp.sum(per) / jnp.maximum(jnp.sum(valid * w[:, None]), 1e-12)
+    if spec.l2 > 0:
+        reg = sum(jnp.sum(jnp.square(l["w"])) for l in params["trunk"])
+        loss = loss + spec.l2 * (reg + jnp.sum(jnp.square(params["heads_w"])))
+    return loss
+
+
+def mse(spec: MTLSpec, params, x, y, w) -> jax.Array:
+    p = forward(spec, params, x)
+    valid = ~jnp.isnan(y)
+    err = jnp.where(valid, jnp.square(jnp.where(valid, y, 0.0) - p), 0.0)
+    return jnp.sum(err * w[:, None]) / \
+        jnp.maximum(jnp.sum(valid * w[:, None]), 1e-12)
+
+
+def predict(meta: Dict[str, Any], params: Any, dense: np.ndarray,
+            idx: Optional[np.ndarray] = None) -> np.ndarray:
+    """(N,) mean-over-tasks score (Scorer MTL path averages task
+    outputs; per-task scores via predict_tasks)."""
+    return predict_tasks(meta, params, dense).mean(axis=1)
+
+
+def predict_tasks(meta: Dict[str, Any], params: Any,
+                  dense: np.ndarray) -> np.ndarray:
+    spec = MTLSpec(**{**meta["spec"],
+                      "hidden_dims": tuple(meta["spec"]["hidden_dims"]),
+                      "activations": tuple(meta["spec"]["activations"])})
+    return np.asarray(forward(spec, jax.tree.map(jnp.asarray, params),
+                              jnp.asarray(dense)))

@@ -65,6 +65,44 @@ def activation(name: str) -> Callable:
 # Architecture
 # ---------------------------------------------------------------------------
 
+def param_getter(params: Dict[str, Any]):
+    """Case-insensitive train#params lookup (reference keys are
+    TitleCase: NumHiddenLayers, LearningRate, ...). Shared by every
+    model family's from_train_params."""
+    def get(key, default=None):
+        for k, v in params.items():
+            if k.lower() == key.lower():
+                return v
+        return default
+    return get
+
+
+def parse_arch_params(params: Dict[str, Any],
+                      default_nodes=(50,), default_acts=("tanh",),
+                      honor_num_layers: bool = True):
+    """Normalize NumHiddenNodes / ActivationFunc lists (scalars become
+    one-element lists; short lists repeat their tail; NumHiddenLayers
+    truncates/extends when honored). Returns (nodes, acts)."""
+    get = param_getter(params)
+    nodes = get("NumHiddenNodes", list(default_nodes))
+    acts = get("ActivationFunc", list(default_acts))
+    if not isinstance(nodes, list):
+        nodes = [nodes]
+    if not isinstance(acts, list):
+        acts = [acts]
+    nodes = [int(n) for n in nodes]
+    acts = [str(a) for a in acts]
+    if honor_num_layers:
+        n_layers = int(get("NumHiddenLayers", len(nodes)) or 0)
+        nodes = nodes[:n_layers]
+        acts = acts[:n_layers]
+        while len(nodes) < n_layers:
+            nodes.append(nodes[-1] if nodes else int(default_nodes[0]))
+    while len(acts) < len(nodes):
+        acts.append(acts[-1] if acts else str(default_acts[0]))
+    return tuple(nodes), tuple(acts[:len(nodes)])
+
+
 @dataclass(frozen=True)
 class MLPSpec:
     """Static architecture derived from train#params. Frozen/hashable so
@@ -84,30 +122,13 @@ class MLPSpec:
     @classmethod
     def from_train_params(cls, params: Dict[str, Any], input_dim: int,
                           output_dim: int = 1) -> "MLPSpec":
-        def get(key, default=None):
-            for k, v in params.items():
-                if k.lower() == key.lower():
-                    return v
-            return default
-
-        n_layers = int(get("NumHiddenLayers", 1) or 0)
-        nodes = get("NumHiddenNodes", [50])
-        acts = get("ActivationFunc", ["tanh"])
-        if not isinstance(nodes, list):
-            nodes = [nodes]
-        if not isinstance(acts, list):
-            acts = [acts]
-        nodes = [int(n) for n in nodes][:n_layers] if n_layers else []
-        acts = [str(a) for a in acts][:n_layers] if n_layers else []
-        while len(nodes) < n_layers:
-            nodes.append(nodes[-1] if nodes else 50)
-        while len(acts) < n_layers:
-            acts.append(acts[-1] if acts else "tanh")
+        get = param_getter(params)
+        nodes, acts = parse_arch_params(params)
         reg = float(get("RegularizedConstant", 0.0) or 0.0)
         l1orl2 = str(get("L1orL2", "L2") or "L2").upper()
         return cls(
-            input_dim=input_dim, hidden_dims=tuple(nodes),
-            activations=tuple(acts), output_dim=output_dim,
+            input_dim=input_dim, hidden_dims=nodes,
+            activations=acts, output_dim=output_dim,
             dropout_rate=float(get("DropoutRate", 0.0) or 0.0),
             l2=reg if l1orl2 != "L1" else 0.0,
             l1=reg if l1orl2 == "L1" else 0.0,

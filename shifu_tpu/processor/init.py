@@ -40,7 +40,11 @@ def run(ctx: ProcessorContext, auto_type: bool = True,
     ctx.validate(ModelStep.INIT)
     header = read_header(mc.dataSet, mc.resolve_path)
 
-    target = simple_column_name(mc.dataSet.targetColumnName)
+    # MTL: '|'-separated targetColumnName flags every task column as
+    # Target (ModelConfig.isMultiTask)
+    targets = {simple_column_name(t)
+               for t in mc.dataSet.targetColumnName.split("|") if t.strip()}
+    target = simple_column_name(mc.dataSet.targetColumnName.split("|")[0])
     weight = simple_column_name(mc.dataSet.weightColumnName) \
         if mc.dataSet.weightColumnName else ""
     meta = {simple_column_name(n) for n in
@@ -61,7 +65,7 @@ def run(ctx: ProcessorContext, auto_type: bool = True,
         sname = simple_column_name(name)
         cc = ColumnConfig(columnNum=i, columnName=sname,
                           version=mc.basic.version)
-        if sname == target:
+        if sname in targets:
             cc.columnFlag = ColumnFlag.Target
         elif weight and sname == weight:
             cc.columnFlag = ColumnFlag.Weight

@@ -93,12 +93,17 @@ def normalize_columns(mc: ModelConfig, cols: List[ColumnConfig],
 
 
 def save_normalized(path: str, result: NormResult, tags: np.ndarray,
-                    weights: np.ndarray) -> None:
+                    weights: np.ndarray,
+                    task_tags: Optional[np.ndarray] = None) -> None:
     os.makedirs(path, exist_ok=True)
+    extra = {}
+    if task_tags is not None and task_tags.size:
+        extra["task_tags"] = task_tags.astype(np.float32)
     np.savez_compressed(
         os.path.join(path, "data.npz"),
         dense=result.dense, index=result.index,
-        tags=tags.astype(np.float32), weights=weights.astype(np.float32))
+        tags=tags.astype(np.float32), weights=weights.astype(np.float32),
+        **extra)
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,
@@ -123,7 +128,8 @@ def run(ctx: ProcessorContext,
         dataset = load_dataset_for_columns(mc, ctx.column_configs, cols)
     result = normalize_columns(mc, cols, dataset)
     out = ctx.path_finder.normalized_data_path()
-    save_normalized(out, result, dataset.tags, dataset.weights)
+    save_normalized(out, result, dataset.tags, dataset.weights,
+                    task_tags=dataset.task_tags)
 
     # cleaned data for tree algorithms: raw numeric (NaN = missing, trees
     # route it explicitly) + category codes with missing → vocab_len slot
@@ -138,7 +144,8 @@ def run(ctx: ProcessorContext,
         index=codes, index_names=dataset.cat_names,
         index_vocab_sizes=[len(v) + 1 for v in dataset.vocabs])
     save_normalized(ctx.path_finder.cleaned_data_path(), clean,
-                    dataset.tags, dataset.weights)
+                    dataset.tags, dataset.weights,
+                    task_tags=dataset.task_tags)
     log.info("norm: %d rows → dense %s, index %s in %.2fs", dataset.num_rows,
              result.dense.shape, result.index.shape, time.time() - t0)
     return 0

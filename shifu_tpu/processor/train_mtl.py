@@ -1,0 +1,127 @@
+"""MTL training step — shared-trunk multi-task model.
+
+Mirrors `mtl/MTLMaster/MTLWorker` wiring
+(`TrainModelProcessor.prepareMTLParams:1658-1673`): '|'-separated
+targetColumnName defines the task list; each task is a binary tag
+parsed with the shared pos/neg tags. Rows missing a task's label
+contribute no loss for that task (NaN-masked). Round-1 limitation:
+rows missing the FIRST task's label are dropped by the norm step's
+row filter."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.data.dataset import parse_tags
+from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.reader import read_raw_table, simple_column_name
+from shifu_tpu.models import mtl
+from shifu_tpu.models.spec import save_model
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.train.optimizers import optimizer_from_params
+from shifu_tpu.train.trainer import (bagging_weights, split_validation,
+                                     train_bags)
+
+log = logging.getLogger("shifu_tpu")
+
+
+def task_names(mc) -> list:
+    return [simple_column_name(t) for t in
+            mc.dataSet.targetColumnName.split("|") if t.strip()]
+
+
+def load_task_targets(ctx: ProcessorContext, data: dict) -> np.ndarray:
+    """(R, T) per-task tags. The norm step persists them in data.npz
+    (`task_tags`), already aligned with its row filter; a raw re-read
+    fallback covers normalized data written before MTL support."""
+    if "task_tags" in data and data["task_tags"].size:
+        return data["task_tags"].astype(np.float32)
+    mc = ctx.model_config
+    df = read_raw_table(mc)
+    if mc.dataSet.filterExpressions:
+        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+        df = df[keep].reset_index(drop=True)
+    names = task_names(mc)
+    cols = []
+    for t in names:
+        raw = df[t].astype(str).str.strip().to_numpy()
+        cols.append(parse_tags(raw, mc.pos_tags, mc.neg_tags))
+    y = np.stack(cols, axis=1)
+    # norm step drops rows whose FIRST task tag is invalid — align
+    return y[~np.isnan(y[:, 0])]
+
+
+def run_mtl(ctx: ProcessorContext, seed: int = 12306):
+    t0 = time.time()
+    mc = ctx.model_config
+    path = ctx.path_finder.normalized_data_path()
+    if not os.path.exists(os.path.join(path, "data.npz")):
+        raise FileNotFoundError(f"normalized data not found at {path}; "
+                                "run `norm` first")
+    data, meta = norm_proc.load_normalized(path)
+    dense = data["dense"].astype(np.float32)
+    w = data["weights"].astype(np.float32)
+    y = load_task_targets(ctx, data)
+    if len(y) != len(dense):
+        raise ValueError(f"MTL target rows {len(y)} != normalized rows "
+                         f"{len(dense)}")
+    names = task_names(mc)
+    spec = mtl.MTLSpec.from_train_params(mc.train.params, dense.shape[1],
+                                         len(names))
+
+    tr_mask, val_mask = split_validation(len(y), mc.train.validSetRate, seed)
+    n_bags = max(mc.train.baggingNum, 1)
+    bag_w = bagging_weights(int(tr_mask.sum()), n_bags,
+                            mc.train.baggingSampleRate,
+                            mc.train.baggingWithReplacement, seed) \
+        * w[tr_mask][None, :]
+
+    key = jax.random.PRNGKey(seed)
+    bag_keys = jax.random.split(key, n_bags)
+    stacked = jax.vmap(lambda k: mtl.init_params(spec, k))(bag_keys)
+    grad_mask = jax.tree.map(lambda l: jnp.ones_like(l[0]), stacked)
+
+    def loss(params, inputs, w_, key_):
+        x_, y_ = inputs
+        return mtl.loss_fn(spec, params, x_, y_, w_)
+
+    def metric(params, inputs, w_):
+        x_, y_ = inputs
+        return mtl.mse(spec, params, x_, y_, w_)
+
+    optimizer = optimizer_from_params(mc.train.params)
+    ew = mc.train.earlyStoppingRounds
+    best_params, _, _, best_val, _ = train_bags(
+        loss, metric, optimizer, mc.train.numTrainEpochs,
+        ew if ew and ew > 0 else 0,
+        float(mc.train.convergenceThreshold or 0.0),
+        stacked, (jnp.asarray(dense[tr_mask]), jnp.asarray(y[tr_mask])),
+        jnp.asarray(bag_w),
+        (jnp.asarray(dense[val_mask]), jnp.asarray(y[val_mask])),
+        jnp.asarray(w[val_mask]), bag_keys, grad_mask)
+
+    spec_meta = {
+        "kind": "mtl",
+        "spec": {"input_dim": spec.input_dim, "n_tasks": spec.n_tasks,
+                 "hidden_dims": list(spec.hidden_dims),
+                 "activations": list(spec.activations), "l2": spec.l2},
+        "taskNames": names, "denseNames": meta["denseNames"],
+        "normType": mc.normalize.normType.value,
+        "modelSetName": mc.model_set_name,
+    }
+    for i in range(n_bags):
+        p = jax.tree.map(lambda a, i=i: np.asarray(a[i]), best_params)
+        mpath = ctx.path_finder.model_path(i, "mtl")
+        ctx.path_finder.ensure(mpath)
+        save_model(mpath, "mtl", spec_meta, p)
+    log.info("train[MTL]: %d tasks, %d bag(s), best val %s in %.2fs",
+             len(names), n_bags, np.round(np.asarray(best_val), 6).tolist(),
+             time.time() - t0)
+    return None

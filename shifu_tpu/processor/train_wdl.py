@@ -1,0 +1,101 @@
+"""WDL training step — wide-and-deep over *_INDEX-normalized data
+(mirrors `wdl/WDLMaster/WDLWorker` wiring in
+`TrainModelProcessor.prepareWDLParams:1675-1690`)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.models import wdl
+from shifu_tpu.models.spec import save_model
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.train.optimizers import optimizer_from_params
+from shifu_tpu.train.trainer import (bagging_weights, split_validation,
+                                     train_bags)
+
+log = logging.getLogger("shifu_tpu")
+
+
+def run_wdl(ctx: ProcessorContext, seed: int = 12306):
+    t0 = time.time()
+    mc = ctx.model_config
+    path = ctx.path_finder.normalized_data_path()
+    if not os.path.exists(os.path.join(path, "data.npz")):
+        raise FileNotFoundError(f"normalized data not found at {path}; "
+                                "run `norm` first (WDL needs an *_INDEX "
+                                "normType)")
+    data, meta = norm_proc.load_normalized(path)
+    dense = data["dense"].astype(np.float32)
+    idx = data["index"].astype(np.int32)
+    y = data["tags"].astype(np.float32)
+    w = data["weights"].astype(np.float32)
+    if idx.shape[1] == 0:
+        log.warning("WDL without categorical index block — deep-only model")
+
+    vocab = max(meta["indexVocabSizes"], default=1)
+    spec = wdl.WDLSpec.from_train_params(mc.train.params, dense.shape[1],
+                                         idx.shape[1], vocab)
+
+    tr_mask, val_mask = split_validation(len(y), mc.train.validSetRate, seed)
+    n_bags = max(mc.train.baggingNum, 1)
+    bag_w = bagging_weights(int(tr_mask.sum()), n_bags,
+                            mc.train.baggingSampleRate,
+                            mc.train.baggingWithReplacement, seed) \
+        * w[tr_mask][None, :]
+
+    key = jax.random.PRNGKey(seed)
+    bag_keys = jax.random.split(key, n_bags)
+    stacked = jax.vmap(lambda k: wdl.init_params(spec, k))(bag_keys)
+    grad_mask = jax.tree.map(lambda l: jnp.ones_like(l[0]), stacked)
+
+    def loss(params, inputs, w_, key_):
+        d_, i_, y_ = inputs
+        return wdl.loss_fn(spec, params, d_, i_, y_, w_)
+
+    def metric(params, inputs, w_):
+        d_, i_, y_ = inputs
+        return wdl.mse(spec, params, d_, i_, y_, w_)
+
+    optimizer = optimizer_from_params(mc.train.params)
+    ew = mc.train.earlyStoppingRounds
+    best_params, train_errs, val_errs, best_val, best_epoch = train_bags(
+        loss, metric, optimizer, mc.train.numTrainEpochs,
+        ew if ew and ew > 0 else 0,
+        float(mc.train.convergenceThreshold or 0.0),
+        stacked,
+        (jnp.asarray(dense[tr_mask]), jnp.asarray(idx[tr_mask]),
+         jnp.asarray(y[tr_mask])),
+        jnp.asarray(bag_w),
+        (jnp.asarray(dense[val_mask]), jnp.asarray(idx[val_mask]),
+         jnp.asarray(y[val_mask])),
+        jnp.asarray(w[val_mask]), bag_keys, grad_mask)
+
+    spec_meta = {
+        "kind": "wdl",
+        "spec": {"dense_dim": spec.dense_dim, "n_cat": spec.n_cat,
+                 "vocab_size": spec.vocab_size,
+                 "embed_size": spec.embed_size,
+                 "hidden_dims": list(spec.hidden_dims),
+                 "activations": list(spec.activations), "l2": spec.l2,
+                 "wide_enable": spec.wide_enable,
+                 "deep_enable": spec.deep_enable},
+        "denseNames": meta["denseNames"], "indexNames": meta["indexNames"],
+        "indexVocabSizes": meta["indexVocabSizes"],
+        "normType": mc.normalize.normType.value,
+        "modelSetName": mc.model_set_name,
+    }
+    for i in range(n_bags):
+        p = jax.tree.map(lambda a, i=i: np.asarray(a[i]), best_params)
+        path = ctx.path_finder.model_path(i, "wdl")
+        ctx.path_finder.ensure(path)
+        save_model(path, "wdl", spec_meta, p)
+    log.info("train[WDL]: %d bag(s), best val %s in %.2fs", n_bags,
+             np.round(np.asarray(best_val), 6).tolist(), time.time() - t0)
+    return None

@@ -87,14 +87,17 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
     return w
 
 
-@partial(jax.jit, static_argnames=("spec", "optimizer", "n_epochs",
-                                   "early_stop_window"))
-def _train_bags(spec: nn_mod.MLPSpec, optimizer, n_epochs: int,
-                early_stop_window: int, convergence_threshold: float,
-                stacked_params, x_train, y_train, w_train_bags,
-                x_val, y_val, w_val, dropout_keys, grad_mask):
-    """vmapped-over-bags, scanned-over-epochs full-batch training.
+@partial(jax.jit, static_argnames=("loss_fn", "metric_fn", "optimizer",
+                                   "n_epochs", "early_stop_window"))
+def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
+               early_stop_window: int, convergence_threshold: float,
+               stacked_params, train_inputs, w_train_bags,
+               val_inputs, w_val, dropout_keys, grad_mask):
+    """Generic vmapped-over-bags, scanned-over-epochs full-batch trainer
+    (shared by NN/LR/WDL/MTL).
 
+    loss_fn(params, inputs_tuple, w, key) → scalar training loss;
+    metric_fn(params, inputs_tuple, w) → scalar validation error.
     stacked_params: pytree with leading bag axis. w_train_bags: (B, Nt)
     per-bag sample weights (bagging multiplicity × row weight).
     grad_mask: pytree of {0,1} masking fixed layers (continuous
@@ -110,9 +113,8 @@ def _train_bags(spec: nn_mod.MLPSpec, optimizer, n_epochs: int,
                 best["params"], best["val"], stop_state["bad"],
                 stop_state["stopped"])
             key, sub = jax.random.split(key)
-            dkey = sub if spec.dropout_rate > 0 else None
-            train_err, grads = jax.value_and_grad(nn_mod.loss_fn, argnums=1)(
-                spec, params, x_train, y_train, w_train, dkey)
+            train_err, grads = jax.value_and_grad(loss_fn)(
+                params, train_inputs, w_train, sub)
             grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
@@ -123,7 +125,7 @@ def _train_bags(spec: nn_mod.MLPSpec, optimizer, n_epochs: int,
             opt_state2 = jax.tree.map(
                 lambda a, b: jnp.where(stopped, b, a) if a.shape == b.shape else a,
                 new_opt_state, opt_state)
-            val_err = nn_mod.mse(spec, params2, x_val, y_val, w_val)
+            val_err = metric_fn(params2, val_inputs, w_val)
             improved = val_err < best_val
             best_params2 = jax.tree.map(
                 lambda bp, p: jnp.where(improved & ~stopped, p, bp),
@@ -206,12 +208,22 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
 
     optimizer = optimizer_from_params(train_conf.params)
     early_window = train_conf.earlyStoppingRounds
-    best_params, train_errs, val_errs, best_val, best_epoch = _train_bags(
-        spec, optimizer, train_conf.numTrainEpochs,
+
+    def nn_loss(params, inputs, w, key):
+        x_, y_ = inputs
+        dkey = key if spec.dropout_rate > 0 else None
+        return nn_mod.loss_fn(spec, params, x_, y_, w, dkey)
+
+    def nn_metric(params, inputs, w):
+        x_, y_ = inputs
+        return nn_mod.mse(spec, params, x_, y_, w)
+
+    best_params, train_errs, val_errs, best_val, best_epoch = train_bags(
+        nn_loss, nn_metric, optimizer, train_conf.numTrainEpochs,
         early_window if early_window and early_window > 0 else 0,
         float(train_conf.convergenceThreshold or 0.0),
-        stacked, jnp.asarray(x_tr), jnp.asarray(y_tr), jnp.asarray(bag_w),
-        jnp.asarray(x_v), jnp.asarray(y_v), jnp.asarray(w_v),
+        stacked, (jnp.asarray(x_tr), jnp.asarray(y_tr)), jnp.asarray(bag_w),
+        (jnp.asarray(x_v), jnp.asarray(y_v)), jnp.asarray(w_v),
         bag_keys[:-1], grad_mask)
 
     params_per_bag = [

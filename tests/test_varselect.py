@@ -1,0 +1,83 @@
+"""Var-select tests: KS/IV filters, SE sensitivity ablation."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config.column_config import load_column_configs
+from shifu_tpu.processor import (init as init_proc, norm as norm_proc,
+                                 stats as stats_proc,
+                                 varselect as varsel_proc)
+from shifu_tpu.processor.base import ProcessorContext
+
+
+@pytest.fixture()
+def statsed(model_set):
+    ctx = ProcessorContext.load(model_set)
+    init_proc.run(ctx)
+    ctx = ProcessorContext.load(model_set)
+    stats_proc.run(ctx)
+    return model_set
+
+
+def test_ks_filter_selects_informative(statsed):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.filterBy = "KS"
+    ctx.model_config.varSelect.filterNum = 4
+    assert varsel_proc.run(ctx) == 0
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    sel = {c.columnName for c in ccs if c.finalSelect}
+    assert len(sel) == 4
+    # informative columns (even num_* get class shift; cat_* skewed)
+    assert "num_0" in sel or "cat_0" in sel
+    # pure-noise odd columns should rank last
+    assert "num_1" not in sel
+
+
+def test_iv_filter(statsed):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.filterBy = "IV"
+    ctx.model_config.varSelect.filterNum = 3
+    assert varsel_proc.run(ctx) == 0
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    assert sum(c.finalSelect for c in ccs) == 3
+
+
+def test_missing_rate_threshold(statsed):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.missingRateThreshold = 0.001  # below 2% injected
+    ctx.model_config.varSelect.filterBy = "KS"
+    assert varsel_proc.run(ctx) == 0
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    assert sum(c.finalSelect for c in ccs) == 0  # all filtered by missing rate
+
+
+def test_se_sensitivity(statsed):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.filterBy = "SE"
+    ctx.model_config.varSelect.filterNum = 4
+    assert varsel_proc.run(ctx) == 0
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    sel = {c.columnName for c in ccs if c.finalSelect}
+    assert len(sel) == 4
+    # the se.0 ranking file exists with one line per source column
+    se_path = ctx.path_finder.se_path(0)
+    assert os.path.exists(se_path)
+    lines = open(se_path).read().strip().splitlines()
+    assert len(lines) == 8  # 6 numeric + 2 categorical
+    # deltas sorted descending
+    deltas = [float(l.split("\t")[1]) for l in lines]
+    assert deltas == sorted(deltas, reverse=True)
+
+
+def test_norm_after_varsel_uses_selection(statsed):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.filterBy = "KS"
+    ctx.model_config.varSelect.filterNum = 3
+    varsel_proc.run(ctx)
+    ctx = ProcessorContext.load(statsed)
+    norm_proc.run(ctx)
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    assert data["dense"].shape[1] == 3
