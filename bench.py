@@ -1,0 +1,94 @@
+"""Benchmark: flagship NN training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numeric benchmarks (BASELINE.md: no
+benchmarks/ dir, qualitative "days to hours" only), so vs_baseline is
+computed against the reference's own operational sizing instead: a
+Guagua NN worker processes its ~150MB split (~500k rows at 30 float
+features) once per iteration on 4 threads
+(`TrainModelProcessor.java:1824-1838`, `ModelTrainConf.java:143`); an
+optimistic JVM full-batch backprop throughput for that setup is
+~2M row-epochs/s/worker (per-record FloatFlatNetwork forward+backward,
+`Gradient.java:171-194`). vs_baseline = our single-chip row-epochs/s
+over that per-worker figure — i.e. how many reference workers one chip
+replaces on the flagship path.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_WORKER_ROW_EPOCHS_PER_SEC = 2.0e6  # see module docstring
+
+N_ROWS = 2_000_000
+N_FEATURES = 32
+HIDDEN = 64
+WARMUP_EPOCHS = 3
+BENCH_EPOCHS = 30
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from shifu_tpu.models import nn as nn_mod
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    beta = rng.normal(0, 1, N_FEATURES).astype(np.float32)
+    x = rng.normal(0, 1, (N_ROWS, N_FEATURES)).astype(np.float32)
+    logits = x @ beta * 0.7 + rng.normal(0, 1, N_ROWS)
+    y = (logits > 0).astype(np.float32)
+    print(f"data: {N_ROWS}x{N_FEATURES} in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    spec = nn_mod.MLPSpec(input_dim=N_FEATURES, hidden_dims=(HIDDEN,),
+                          activations=("tanh",), loss="squared")
+    params = nn_mod.init_params(spec, jax.random.PRNGKey(0))
+    optimizer = optax.adam(0.05)
+    opt_state = optimizer.init(params)
+    jx = jnp.asarray(x)
+    jy = jnp.asarray(y)
+    jw = jnp.ones(N_ROWS, jnp.float32)
+
+    @jax.jit
+    def epoch(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: nn_mod.loss_fn(spec, p, jx, jy, jw))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(WARMUP_EPOCHS):
+        params, opt_state, loss = epoch(params, opt_state)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for _ in range(BENCH_EPOCHS):
+        params, opt_state, loss = epoch(params, opt_state)
+    jax.block_until_ready(loss)
+    wall = time.time() - t0
+
+    row_epochs_per_sec = N_ROWS * BENCH_EPOCHS / wall
+    # sanity: the model must actually have learned
+    from shifu_tpu.ops.metrics import auc
+    scores = nn_mod.forward(spec, params, jx[:200_000])
+    a = float(auc(scores, jy[:200_000]))
+    print(f"bench: {BENCH_EPOCHS} full-batch epochs over {N_ROWS} rows in "
+          f"{wall:.2f}s, AUC {a:.4f}", file=sys.stderr)
+    assert a > 0.75, f"model failed to learn (AUC {a})"
+
+    print(json.dumps({
+        "metric": "nn_fullbatch_train_throughput",
+        "value": round(row_epochs_per_sec / 1e6, 3),
+        "unit": "Mrow-epochs/s (1-chip, 32 feat, 64 hidden)",
+        "vs_baseline": round(row_epochs_per_sec /
+                             REFERENCE_WORKER_ROW_EPOCHS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

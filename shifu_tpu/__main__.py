@@ -1,0 +1,5 @@
+import sys
+
+from shifu_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,204 @@
+"""Command-line interface — the `shifu` command surface, TPU-native.
+
+Mirrors `shifu/ShifuCLI.java:162,887-941` (command parse + dispatch to
+one processor per step, `-Dkey=value` overrides into a global
+Environment). Commands:
+
+  new <name>      create a model-set scaffold (CreateModelProcessor)
+  init            header → ColumnConfig.json (InitModelProcessor)
+  stats           column stats + binning       (StatsModelProcessor)
+  norm|normalize  normalized/cleaned matrices  (NormalizeModelProcessor)
+  varsel|varselect variable selection          (VarSelectModelProcessor)
+  train           train models                 (TrainModelProcessor)
+  posttrain       bin-avg scores + feature importance
+  eval [-run name] score + confusion + perf    (EvalModelProcessor)
+  export [-t ...] columnstats / correlation export
+  test            dry-run filter expressions   (ShifuTestProcessor)
+  version
+
+Run inside a model-set directory (where ModelConfig.json lives), like
+the reference CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import List, Optional
+
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s [%(levelname)s] %(message)s")
+log = logging.getLogger("shifu_tpu")
+
+
+def _ctx(args):
+    from shifu_tpu.processor.base import ProcessorContext
+    return ProcessorContext.load(args.dir)
+
+
+def cmd_new(args) -> int:
+    """`shifu new <name>` — scaffold ModelConfig.json + columns/ dir
+    (CreateModelProcessor)."""
+    from shifu_tpu.config.model_config import ModelConfig
+    name = args.name
+    root = os.path.join(args.dir, name)
+    if os.path.exists(os.path.join(root, "ModelConfig.json")):
+        log.error("model set %s already exists", name)
+        return 1
+    os.makedirs(os.path.join(root, "columns"), exist_ok=True)
+    mc = ModelConfig()
+    mc.basic.name = name
+    mc.basic.author = os.environ.get("USER", "user")
+    mc.basic.description = f"Created at {time.strftime('%Y-%m-%d %H:%M:%S')}"
+    mc.dataSet.dataPath = "./data"
+    mc.dataSet.metaColumnNameFile = "columns/meta.column.names"
+    mc.dataSet.categoricalColumnNameFile = "columns/categorical.column.names"
+    mc.varSelect.forceSelectColumnNameFile = "columns/forceselect.column.names"
+    mc.varSelect.forceRemoveColumnNameFile = "columns/forceremove.column.names"
+    mc.train.params = {"NumHiddenLayers": 1, "NumHiddenNodes": [50],
+                       "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                       "Propagation": "Q", "RegularizedConstant": 0.0}
+    mc.save(root)
+    for f in ("meta", "categorical", "forceselect", "forceremove"):
+        open(os.path.join(root, "columns", f + ".column.names"), "a").close()
+    log.info("created model set %s", root)
+    return 0
+
+
+def cmd_init(args) -> int:
+    from shifu_tpu.processor import init as p
+    return p.run(_ctx(args))
+
+
+def cmd_stats(args) -> int:
+    ctx = _ctx(args)
+    if args.correlation:
+        from shifu_tpu.processor import correlation as p
+        return p.run(ctx)
+    if args.psi:
+        from shifu_tpu.processor import psi as p
+        return p.run(ctx)
+    from shifu_tpu.processor import stats as p
+    return p.run(ctx)
+
+
+def cmd_norm(args) -> int:
+    from shifu_tpu.processor import norm as p
+    return p.run(_ctx(args))
+
+
+def cmd_varselect(args) -> int:
+    from shifu_tpu.processor import varselect as p
+    return p.run(_ctx(args), recursive=args.recursive)
+
+
+def cmd_train(args) -> int:
+    from shifu_tpu.processor import train as p
+    from shifu_tpu.parallel import dist
+    dist.initialize()
+    return p.run(_ctx(args))
+
+
+def cmd_posttrain(args) -> int:
+    from shifu_tpu.processor import posttrain as p
+    return p.run(_ctx(args))
+
+
+def cmd_eval(args) -> int:
+    from shifu_tpu.processor import eval as p
+    return p.run(_ctx(args), eval_name=args.run)
+
+
+def cmd_export(args) -> int:
+    from shifu_tpu.processor import export as p
+    return p.run(_ctx(args), export_type=args.type)
+
+
+def cmd_test(args) -> int:
+    """Dry-run the dataSet filterExpressions on N records
+    (ShifuTestProcessor / DataPurifier)."""
+    from shifu_tpu.data.purifier import DataPurifier
+    from shifu_tpu.data.reader import read_raw_table
+    ctx = _ctx(args)
+    mc = ctx.model_config
+    df = read_raw_table(mc, max_rows=args.n)
+    keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+    log.info("filter %r keeps %d / %d sampled records",
+             mc.dataSet.filterExpressions, int(keep.sum()), len(df))
+    return 0
+
+
+def cmd_version(args) -> int:
+    import shifu_tpu
+    print(f"shifu-tpu {shifu_tpu.__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="shifu_tpu",
+        description="TPU-native config-driven ML pipeline (Shifu-compatible "
+                    "ModelConfig.json/ColumnConfig.json)")
+    ap.add_argument("-D", dest="defines", action="append", default=[],
+                    metavar="key=value",
+                    help="environment overrides (ShifuCLI -D)")
+    ap.add_argument("--dir", default=".", help="model-set directory")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("new", help="create a model set")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_new)
+    sub.add_parser("init", help="build ColumnConfig from header") \
+        .set_defaults(fn=cmd_init)
+    p = sub.add_parser("stats", help="column stats + binning")
+    p.add_argument("-correlation", "--correlation", action="store_true")
+    p.add_argument("-psi", "--psi", action="store_true")
+    p.set_defaults(fn=cmd_stats)
+    for alias in ("norm", "normalize"):
+        sub.add_parser(alias, help="normalize data").set_defaults(fn=cmd_norm)
+    for alias in ("varsel", "varselect"):
+        p = sub.add_parser(alias, help="variable selection")
+        p.add_argument("-r", "--recursive", type=int, default=0)
+        p.set_defaults(fn=cmd_varselect)
+    sub.add_parser("train", help="train models").set_defaults(fn=cmd_train)
+    sub.add_parser("posttrain", help="post-train analysis") \
+        .set_defaults(fn=cmd_posttrain)
+    p = sub.add_parser("eval", help="evaluate models")
+    p.add_argument("-run", "--run", default=None, metavar="EVAL_NAME")
+    p.set_defaults(fn=cmd_eval)
+    p = sub.add_parser("export", help="export model/stats")
+    p.add_argument("-t", "--type", default="columnstats",
+                   choices=["columnstats", "correlation", "woemapping",
+                            "pmml"])
+    p.set_defaults(fn=cmd_export)
+    p = sub.add_parser("test", help="dry-run filter expressions")
+    p.add_argument("-n", type=int, default=100)
+    p.set_defaults(fn=cmd_test)
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    # -D overrides → environment (ShifuCLI.cleanArgs:468-492)
+    for kv in args.defines:
+        if "=" in kv:
+            k, v = kv.split("=", 1)
+            os.environ[k.strip()] = v.strip()
+    t0 = time.time()
+    try:
+        rc = args.fn(args)
+    except (FileNotFoundError, ValueError, NotImplementedError) as e:
+        log.error("%s", e)
+        return 1
+    log.info("command %s finished (rc=%s) in %.2fs", args.command, rc,
+             time.time() - t0)
+    return int(rc or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

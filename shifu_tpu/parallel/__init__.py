@@ -1,0 +1,2 @@
+from shifu_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, data_sharding, replicated, shard_rows)

@@ -1,0 +1,56 @@
+"""Multi-host initialization + sharded ingestion.
+
+The reference scales out by adding YARN containers, each reading its
+own HDFS split (`ShifuInputFormat`, `CombineInputFormat`). Here
+multi-host scale-out is `jax.distributed.initialize` (DCN between
+hosts, ICI within), and each process reads a disjoint subset of the
+part files (`read_raw_table(file_shard=(process_index, process_count))`)
+before placing its rows into the global row-sharded array via
+`jax.make_array_from_process_local_data`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+log = logging.getLogger("shifu_tpu")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the multi-host runtime. No-op when single-process or
+    already initialized. Env fallbacks: SHIFU_TPU_COORDINATOR,
+    SHIFU_TPU_NUM_PROCESSES, SHIFU_TPU_PROCESS_ID (on Cloud TPU these
+    resolve automatically from the metadata server)."""
+    coordinator_address = coordinator_address or \
+        os.environ.get("SHIFU_TPU_COORDINATOR")
+    if num_processes is None and "SHIFU_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["SHIFU_TPU_NUM_PROCESSES"])
+    if process_id is None and "SHIFU_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["SHIFU_TPU_PROCESS_ID"])
+    if num_processes in (None, 1) and coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("distributed: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(), jax.device_count())
+
+
+def process_shard() -> tuple:
+    """(index, count) for sharded file reads in this process."""
+    return jax.process_index(), jax.process_count()
+
+
+def global_row_array(mesh, local_rows: np.ndarray):
+    """Assemble a process-local row block into the global row-sharded
+    array (each host contributes its file shard's rows)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P("data", *([None] * (local_rows.ndim - 1))))
+    return jax.make_array_from_process_local_data(sharding, local_rows)

@@ -1,0 +1,106 @@
+"""Device-mesh construction and sharding layouts.
+
+This module is the whole replacement for the reference's distributed
+substrate (Guagua master–worker over YARN + Netty parameter shipping +
+ZooKeeper coordination, SURVEY.md §2.9): in SPMD JAX there is no
+master — the "aggregate worker gradients" step IS the psum XLA inserts
+when a mean over a row-sharded matrix feeds replicated parameter
+updates; "broadcast new weights" is the replicated sharding of params.
+One jitted train step under a Mesh replaces the whole BSP protocol,
+with collectives riding ICI (and DCN between hosts via
+`jax.distributed`, see parallel/dist.py).
+
+Axes:
+- "data": rows of the feature matrix (the reference's worker-split
+  axis; ~150MB/worker sizing in TrainModelProcessor.java:1789-1838
+  becomes simply R/n_devices rows per chip);
+- "model": wide parameter dimensions — MLP hidden units (tensor
+  parallel) and WDL per-column embedding tables (the expert-parallel
+  analog for tabular data).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ("data", "model") mesh. Defaults to all devices on the
+    data axis — pure data parallel, the reference's only strategy."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_model
+    assert n_data * n_model <= len(devices), \
+        f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, " \
+        f"have {len(devices)}"
+    arr = np.asarray(devices[:n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(arr, ("data", "model"))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (row) axis across 'data'; trailing axes
+    replicated."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place row-major host arrays onto the mesh sharded by row.
+    Pads the row count to a multiple of the data-axis size (padding
+    rows carry zero weight downstream, so results are unchanged)."""
+    n_data = mesh.shape["data"]
+    out = []
+    for a in arrays:
+        r = a.shape[0]
+        pad = (-r) % n_data
+        if pad:
+            a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+        out.append(jax.device_put(a, data_sharding(mesh, a.ndim)))
+    return out if len(out) > 1 else out[0]
+
+
+def mlp_param_shardings(mesh: Mesh, n_layers: int):
+    """Tensor-parallel layout for an MLP parameter pytree
+    [{'w','b'}...]: first hidden layer column-sharded over 'model',
+    last layer row-sharded, middle layers replicated (keeps exactly one
+    all-reduce pair per forward, the standard Megatron split)."""
+    layouts = []
+    for i in range(n_layers):
+        if n_layers == 1:
+            w, b = P(), P()
+        elif i == 0:
+            w, b = P(None, "model"), P("model")
+        elif i == n_layers - 1:
+            w, b = P("model", None), P()
+        else:
+            w, b = P(), P()
+        layouts.append({"w": NamedSharding(mesh, w),
+                       "b": NamedSharding(mesh, b)})
+    return layouts
+
+
+def wdl_param_shardings(mesh: Mesh, params) -> dict:
+    """WDL layout: embedding + wide tables sharded over 'model' on the
+    per-column axis (each shard owns a subset of categorical columns —
+    expert-parallel for tabular), deep MLP tensor-parallel."""
+    out = {}
+    if "embed" in params:
+        out["embed"] = NamedSharding(mesh, P("model", None, None))
+        out["wide_cat"] = NamedSharding(mesh, P("model", None))
+    out["wide_dense"] = NamedSharding(mesh, P())
+    out["wide_bias"] = NamedSharding(mesh, P())
+    out["deep"] = mlp_param_shardings(mesh, len(params["deep"]))
+    return out
+
+
+def place(params, shardings):
+    """device_put a pytree with a matching pytree of shardings."""
+    return jax.tree.map(jax.device_put, params, shardings)

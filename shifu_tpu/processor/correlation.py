@@ -1,0 +1,82 @@
+"""`shifu stats -correlation` — Pearson correlation across columns.
+
+Replaces the reference's multithreaded correlation MapReduce job
+(`core/correlation/CorrelationMapper.java:52`, `FastCorrelationMapper`,
+`CorrelationReducer`, 2k LoC): on TPU the full C×C Pearson matrix is
+one standardized X^T X matmul on the MXU — the all-pairs loop
+disappears entirely.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+@jax.jit
+def pearson_matrix(x: jax.Array) -> jax.Array:
+    """(R, C) with NaN missing → (C, C) Pearson correlations computed
+    over each pair's co-valid rows."""
+    valid = ~jnp.isnan(x)
+    xv = jnp.where(valid, x, 0.0)
+    v = valid.astype(jnp.float32)
+    n = v.T @ v                           # pairwise co-valid counts
+    s = xv.T @ v                          # pairwise sums of x over co-valid
+    ss = (xv * xv).T @ v                  # pairwise sums of x^2
+    p = xv.T @ xv                         # pairwise cross products
+    n = jnp.maximum(n, 1.0)
+    mean_i = s / n
+    mean_j = s.T / n
+    cov = p / n - mean_i * mean_j
+    var_i = ss / n - mean_i ** 2
+    var_j = ss.T / n - mean_j ** 2
+    denom = jnp.sqrt(jnp.maximum(var_i, 1e-12) * jnp.maximum(var_j, 1e-12))
+    return jnp.clip(cov / denom, -1.0, 1.0)
+
+
+def run(ctx: ProcessorContext) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.require_columns()
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols)
+
+    # numeric raw values + categorical posRate encodings, like
+    # NormPearson mode correlating normalized values
+    blocks, names = [], []
+    if dset.numeric.shape[1]:
+        blocks.append(dset.numeric)
+        names.extend(dset.num_names)
+    if dset.cat_codes.shape[1]:
+        from shifu_tpu.ops.normalize import build_categorical_table, gather_cat_lut
+        cat_by_num = {c.columnNum: c for c in cols if c.is_categorical}
+        ordered = [cat_by_num[int(n)] for n in dset.cat_column_nums
+                   if int(n) in cat_by_num]
+        tbl = build_categorical_table(ordered)
+        pr = np.asarray(gather_cat_lut(jnp.asarray(dset.cat_codes),
+                                       jnp.asarray(tbl.pos_rate),
+                                       jnp.asarray(tbl.vocab_len)))
+        blocks.append(pr)
+        names.extend(dset.cat_names)
+    x = np.concatenate(blocks, axis=1).astype(np.float32)
+
+    corr = np.asarray(pearson_matrix(jnp.asarray(x)))
+    out = ctx.path_finder.correlation_path()
+    ctx.path_finder.ensure(out)
+    with open(out, "w") as f:
+        f.write("column," + ",".join(names) + "\n")
+        for i, n in enumerate(names):
+            f.write(n + "," + ",".join(f"{v:.6f}" for v in corr[i]) + "\n")
+    log.info("correlation: %dx%d matrix → %s in %.2fs", len(names),
+             len(names), out, time.time() - t0)
+    return 0

@@ -1,0 +1,83 @@
+"""`shifu export` — columnstats / woemapping / correlation exports.
+
+Mirrors `core/processor/ExportModelProcessor.java:87-103` variants:
+columnstats (per-column metrics CSV), woemapping (bin → WOE CSV).
+PMML export is staged for a later round — the numpy-only model spec
+(`shifu_tpu/models/spec.py`) is the current cross-runtime format.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+COLUMNSTATS_FIELDS = [
+    "columnNum", "columnName", "columnType", "finalSelect", "ks", "iv",
+    "weightedKs", "weightedIv", "mean", "stdDev", "min", "max", "median",
+    "missingCount", "totalCount", "missingPercentage", "woe", "weightedWoe",
+    "skewness", "kurtosis", "distinctCount", "psi",
+]
+
+
+def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
+    t0 = time.time()
+    ctx.require_columns()
+    et = (export_type or "columnstats").lower()
+    if et == "columnstats":
+        out = _export_columnstats(ctx)
+    elif et == "woemapping":
+        out = _export_woemapping(ctx)
+    elif et == "correlation":
+        from shifu_tpu.processor import correlation
+        correlation.run(ctx)
+        out = ctx.path_finder.correlation_path()
+    elif et == "pmml":
+        raise NotImplementedError(
+            "PMML export is not yet native; use the npz model spec "
+            "(models/model*.npz-compatible) for cross-runtime scoring")
+    else:
+        raise ValueError(f"unknown export type {export_type!r}")
+    log.info("export[%s] → %s in %.2fs", et, out, time.time() - t0)
+    return 0
+
+
+def _export_columnstats(ctx: ProcessorContext) -> str:
+    out = ctx.path_finder.column_stats_export_path()
+    ctx.path_finder.ensure(out)
+    with open(out, "w") as f:
+        f.write(",".join(COLUMNSTATS_FIELDS) + "\n")
+        for cc in ctx.column_configs:
+            st = cc.columnStats
+            row = [cc.columnNum, cc.columnName,
+                   cc.columnType.value if cc.columnType else "",
+                   cc.finalSelect, st.ks, st.iv, st.weightedKs, st.weightedIv,
+                   st.mean, st.stdDev, st.min, st.max, st.median,
+                   st.missingCount, st.totalCount, st.missingPercentage,
+                   st.woe, st.weightedWoe, st.skewness, st.kurtosis,
+                   st.distinctCount, st.psi]
+            f.write(",".join("" if v is None else str(v) for v in row) + "\n")
+    return out
+
+
+def _export_woemapping(ctx: ProcessorContext) -> str:
+    out = os.path.join(ctx.path_finder.root, "woemapping.csv")
+    with open(out, "w") as f:
+        f.write("columnName,binIndex,binLow/category,binCountWoe,"
+                "binWeightedWoe\n")
+        for cc in ctx.column_configs:
+            bn = cc.columnBinning
+            if not bn.binCountWoe:
+                continue
+            labels = (bn.binCategory if bn.binCategory is not None
+                      else (bn.binBoundary or []))
+            for i, woe in enumerate(bn.binCountWoe):
+                label = labels[i] if i < len(labels) else "MISSING"
+                wwoe = bn.binWeightedWoe[i] if bn.binWeightedWoe and \
+                    i < len(bn.binWeightedWoe) else ""
+                f.write(f"{cc.columnName},{i},{label},{woe},{wwoe}\n")
+    return out

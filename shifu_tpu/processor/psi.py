@@ -1,0 +1,108 @@
+"""`shifu stats -psi` — population stability index per column.
+
+Replaces `pig/PSI.pig` + `udf/PSIByColumnUDF` / `PSICalculatorUDF`:
+rows are grouped by the `stats#psiColumnName` cohort column (e.g. a
+month field); each column's per-cohort bin distribution is compared to
+its global distribution; psi = Σ (p_cohort − p_global)·ln(p_cohort /
+p_global) averaged over cohorts. Written back to
+`columnStats.psi` + `unitStats` (per-cohort values) and psi.csv.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.data.reader import read_raw_table, simple_column_name
+from shifu_tpu.ops import stats as stats_ops
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def run(ctx: ProcessorContext) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.require_columns()
+    psi_col = simple_column_name(mc.stats.psiColumnName)
+    if not psi_col:
+        raise ValueError("stats#psiColumnName is empty — set it to the "
+                         "cohort column (e.g. a month field) to compute PSI")
+
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    df = read_raw_table(mc)
+    if mc.dataSet.filterExpressions:
+        from shifu_tpu.data.purifier import DataPurifier
+        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+        df = df[keep].reset_index(drop=True)
+    if psi_col not in df.columns:
+        raise ValueError(f"psiColumnName {psi_col!r} not in data header")
+    cohorts = df[psi_col].astype(str).str.strip().to_numpy()
+    from shifu_tpu.data.dataset import build_columnar
+    vocabs = {c.columnNum: (c.columnBinning.binCategory or [])
+              for c in cols if c.is_categorical}
+    dset = build_columnar(mc, norm_proc._restrict(ctx.column_configs, cols),
+                          df, vocabs=vocabs)
+    # row filter may drop rows — rebuild cohorts aligned (build_columnar
+    # only drops invalid-tag rows; replicate its mask)
+    from shifu_tpu.data.dataset import parse_tags
+    tgt = simple_column_name(mc.dataSet.targetColumnName.split("|")[0])
+    tags_all = parse_tags(df[tgt].astype(str).str.strip().to_numpy(),
+                          mc.pos_tags, mc.neg_tags)
+    cohorts = cohorts[~np.isnan(tags_all)]
+
+    uniq = sorted(set(cohorts.tolist()))
+    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
+    max_bins = mc.stats.maxNumBin
+
+    # numeric: bin with stored boundaries; categorical: codes
+    from shifu_tpu.ops.normalize import build_numeric_table
+    num_by = {c.columnNum: c for c in cols if c.is_numerical}
+    num_ordered = [num_by[int(n)] for n in dset.num_column_nums
+                   if int(n) in num_by]
+    rows: List[str] = []
+    results: Dict[int, List[float]] = {}
+
+    def accumulate(bin_idx: np.ndarray, col_nums, n_slots):
+        for j, cn in enumerate(col_nums):
+            cc = cc_by_num[int(cn)]
+            global_counts = np.bincount(bin_idx[:, j], minlength=n_slots)
+            g = global_counts / max(global_counts.sum(), 1)
+            unit = []
+            for u in uniq:
+                m = cohorts == u
+                c_counts = np.bincount(bin_idx[m, j], minlength=n_slots)
+                c_dist = c_counts / max(c_counts.sum(), 1)
+                unit.append(stats_ops.psi_metric(c_dist, g))
+            cc.columnStats.psi = float(np.mean(unit)) if unit else 0.0
+            cc.columnStats.unitStats = [f"{u}:{v:.6f}"
+                                        for u, v in zip(uniq, unit)]
+            results[int(cn)] = unit
+            rows.append(f"{cc.columnName},{cc.columnStats.psi:.6f}," +
+                        ",".join(f"{v:.6f}" for v in unit))
+
+    if dset.numeric.shape[1]:
+        tbl = build_numeric_table(num_ordered, max_bins)
+        bi = np.asarray(stats_ops.bin_index_numeric(
+            jnp.asarray(dset.numeric), jnp.asarray(tbl.cuts)))
+        accumulate(bi, dset.num_column_nums, tbl.cuts.shape[0] + 2)
+    if dset.cat_codes.shape[1]:
+        vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+        codes = np.where(dset.cat_codes < 0, vlen[None, :], dset.cat_codes)
+        accumulate(codes, dset.cat_column_nums, int(vlen.max()) + 2)
+
+    out = ctx.path_finder.psi_path()
+    ctx.path_finder.ensure(out)
+    with open(out, "w") as f:
+        f.write("column,psi," + ",".join(uniq) + "\n")
+        f.write("\n".join(rows) + "\n")
+    ctx.save_column_configs()
+    log.info("psi: %d cohorts × %d columns → %s in %.2fs", len(uniq),
+             len(rows), out, time.time() - t0)
+    return 0

@@ -51,9 +51,11 @@ def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
             rng = np.random.default_rng(seed)
             samp = rng.random(len(df)) < mc.stats.sampleRate
             if mc.stats.sampleNegOnly:
-                # sample only negatives, keep all positives (DataSampler)
+                # sample only negatives, keep all positives (DataSampler);
+                # MTL: sample on the primary (task-0) tag
                 from shifu_tpu.data.reader import simple_column_name
-                tgt_col = simple_column_name(mc.dataSet.targetColumnName)
+                tgt_col = simple_column_name(
+                    mc.dataSet.targetColumnName.split("|")[0])
                 tgt = df[tgt_col].astype(str).str.strip()
                 samp |= tgt.isin(mc.pos_tags).to_numpy()
             keep &= samp

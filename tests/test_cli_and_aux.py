@@ -1,0 +1,150 @@
+"""CLI end-to-end + auxiliary processors (correlation, PSI, posttrain,
+export) — the ShifuCLITest analog (SURVEY.md §4.4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.config.column_config import load_column_configs
+from shifu_tpu.processor.base import ProcessorContext
+
+
+def test_cli_full_pipeline(model_set):
+    for cmd in (["init"], ["stats"], ["varsel"], ["norm"], ["train"],
+                ["eval"], ["posttrain"], ["export", "-t", "columnstats"]):
+        rc = cli_main(["--dir", model_set] + cmd)
+        assert rc == 0, f"command {cmd} failed"
+    ctx = ProcessorContext.load(model_set)
+    assert os.path.exists(ctx.path_finder.model_path(0, "nn"))
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.8
+    assert os.path.exists(ctx.path_finder.column_stats_export_path())
+    # posttrain wrote binAvgScore + feature importance
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    selected = [c for c in ccs if c.finalSelect and c.is_numerical]
+    assert any(c.columnBinning.binAvgScore for c in selected)
+    assert os.path.exists(os.path.join(model_set, "featureimportance.csv"))
+
+
+def test_cli_new_scaffold(tmp_path):
+    rc = cli_main(["--dir", str(tmp_path), "new", "MyModel"])
+    assert rc == 0
+    root = tmp_path / "MyModel"
+    assert (root / "ModelConfig.json").exists()
+    assert (root / "columns" / "meta.column.names").exists()
+    # re-creating fails
+    assert cli_main(["--dir", str(tmp_path), "new", "MyModel"]) == 1
+
+
+def test_cli_version(capsys):
+    assert cli_main(["version"]) == 0
+    assert "shifu-tpu" in capsys.readouterr().out
+
+
+def test_cli_test_command(model_set, caplog):
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = json.load(open(mc_path))
+    mc["dataSet"]["filterExpressions"] = "num_0 > 0"
+    json.dump(mc, open(mc_path, "w"))
+    assert cli_main(["--dir", model_set, "test", "-n", "200"]) == 0
+
+
+def test_correlation(model_set):
+    for cmd in (["init"], ["stats"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    assert cli_main(["--dir", model_set, "stats", "-correlation"]) == 0
+    ctx = ProcessorContext.load(model_set)
+    path = ctx.path_finder.correlation_path()
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 9  # header + 8 columns
+    # diagonal == 1
+    first = lines[1].split(",")
+    assert abs(float(first[1]) - 1.0) < 1e-4
+
+
+def test_psi(model_set):
+    """PSI over a synthetic cohort column: add a 'month' column and
+    point psiColumnName at it."""
+    import pandas as pd
+    for sub in ("data",):
+        dpath = os.path.join(model_set, sub, "part-00000")
+        hpath = os.path.join(model_set, sub, ".pig_header")
+        header = open(hpath).read().strip().split("|")
+        df = pd.read_csv(dpath, sep="|", names=header, dtype=str)
+        df["month"] = np.where(np.arange(len(df)) % 2 == 0, "m1", "m2")
+        df.to_csv(dpath, sep="|", header=False, index=False)
+        with open(hpath, "w") as f:
+            f.write("|".join(header + ["month"]) + "\n")
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = json.load(open(mc_path))
+    mc["stats"]["psiColumnName"] = "month"
+    meta_file = mc["dataSet"]["metaColumnNameFile"]
+    with open(meta_file, "a") as f:
+        f.write("month\n")
+    json.dump(mc, open(mc_path, "w"))
+
+    for cmd in (["init"], ["stats"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    assert cli_main(["--dir", model_set, "stats", "-psi"]) == 0
+    ctx = ProcessorContext.load(model_set)
+    assert os.path.exists(ctx.path_finder.psi_path())
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    num0 = next(c for c in ccs if c.columnName == "num_0")
+    # random even/odd cohorts: distributions nearly identical → tiny PSI
+    assert num0.columnStats.psi is not None
+    assert num0.columnStats.psi < 0.05
+    assert len(num0.columnStats.unitStats) == 2
+
+
+def test_export_woemapping(model_set):
+    for cmd in (["init"], ["stats"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    ctx = ProcessorContext.load(model_set)
+    from shifu_tpu.processor import export as export_proc
+    assert export_proc.run(ctx, "woemapping") == 0
+    path = os.path.join(model_set, "woemapping.csv")
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) > 8 * 5  # 8 columns × ≥5 bins each
+
+
+def test_mesh_sharded_training_matches_single_device(rng):
+    """Same training step, 8-device mesh vs single device → same loss
+    trajectory (the SPMD program is numerically the BSP aggregate;
+    GuaguaMRUnitDriver analog on a virtual mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    spec = nn_mod.MLPSpec(input_dim=6, hidden_dims=(8,),
+                          activations=("tanh",))
+    params0 = nn_mod.init_params(spec, jax.random.PRNGKey(0))
+    x = rng.normal(0, 1, (512, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    w = np.ones(512, np.float32)
+    opt = optax.sgd(0.5)
+
+    def losses(params, jx, jy, jw, steps=5):
+        state = opt.init(params)
+        out = []
+        for _ in range(steps):
+            l, g = jax.value_and_grad(
+                lambda p: nn_mod.loss_fn(spec, p, jx, jy, jw))(params)
+            upd, state = opt.update(g, state, params)
+            params = optax.apply_updates(params, upd)
+            out.append(float(l))
+        return out
+
+    single = losses(params0, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+
+    mesh = mesh_mod.make_mesh(n_data=4, n_model=2)
+    jx, jy, jw = mesh_mod.shard_rows(mesh, x, y, w)
+    sharded_params = mesh_mod.place(
+        params0, mesh_mod.mlp_param_shardings(mesh, 2))
+    sharded = losses(sharded_params, jx, jy, jw)
+    np.testing.assert_allclose(single, sharded, rtol=2e-4)
