@@ -110,6 +110,8 @@ def cmd_posttrain(args) -> int:
 
 def cmd_eval(args) -> int:
     from shifu_tpu.processor import eval as p
+    if args.norm:
+        return p.run_norm(_ctx(args), eval_name=args.run)
     return p.run(_ctx(args), eval_name=args.run)
 
 
@@ -130,6 +132,26 @@ def cmd_test(args) -> int:
     log.info("filter %r keeps %d / %d sampled records",
              mc.dataSet.filterExpressions, int(keep.sum()), len(df))
     return 0
+
+
+def cmd_encode(args) -> int:
+    from shifu_tpu.processor import encode as p
+    return p.run(_ctx(args))
+
+
+def cmd_save(args) -> int:
+    from shifu_tpu.processor import manage as p
+    return p.save(_ctx(args), args.name)
+
+
+def cmd_switch(args) -> int:
+    from shifu_tpu.processor import manage as p
+    return p.switch(_ctx(args), args.name)
+
+
+def cmd_show(args) -> int:
+    from shifu_tpu.processor import manage as p
+    return p.show(_ctx(args))
 
 
 def cmd_version(args) -> int:
@@ -169,6 +191,8 @@ def build_parser() -> argparse.ArgumentParser:
         .set_defaults(fn=cmd_posttrain)
     p = sub.add_parser("eval", help="evaluate models")
     p.add_argument("-run", "--run", default=None, metavar="EVAL_NAME")
+    p.add_argument("-norm", "--norm", action="store_true",
+                   help="export normalized eval data instead of scoring")
     p.set_defaults(fn=cmd_eval)
     p = sub.add_parser("export", help="export model/stats")
     p.add_argument("-t", "--type", default="columnstats",
@@ -178,6 +202,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("test", help="dry-run filter expressions")
     p.add_argument("-n", type=int, default=100)
     p.set_defaults(fn=cmd_test)
+    sub.add_parser("encode", help="tree-leaf-path encode the dataset") \
+        .set_defaults(fn=cmd_encode)
+    p = sub.add_parser("save", help="snapshot the model set")
+    p.add_argument("name", nargs="?", default=None)
+    p.set_defaults(fn=cmd_save)
+    p = sub.add_parser("switch", help="restore a model-set snapshot")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_switch)
+    sub.add_parser("show", help="list model-set snapshots") \
+        .set_defaults(fn=cmd_show)
     sub.add_parser("version").set_defaults(fn=cmd_version)
     return ap
 

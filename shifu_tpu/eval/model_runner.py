@@ -1,0 +1,115 @@
+"""ModelRunner — the embeddable scoring API (no pipeline required).
+
+Replaces `core/ModelRunner.java:57,170-202` (raw delimited record or
+map → normalize → Scorer → CaseScoreResult, the production Java
+embedding API) and the dependency-free Independent*Model loaders: a
+ModelRunner owns ModelConfig + ColumnConfig + the model specs, and
+scores raw records (dicts, lists, or a whole DataFrame) through the
+same normalize kernels the pipeline used. Single records are batched
+internally — TPU or CPU, the path is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from shifu_tpu.config.column_config import ColumnConfig, load_column_configs
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.data.dataset import build_columnar
+from shifu_tpu.eval.scorer import Scorer
+from shifu_tpu.processor import norm as norm_proc
+
+
+class CaseScoreResult:
+    """`container/CaseScoreResult.java` — per-record ensemble scores."""
+
+    def __init__(self, scores: Dict[str, float]):
+        self.scores = scores
+
+    @property
+    def avg_score(self) -> float:
+        return self.scores["mean"]
+
+    @property
+    def max_score(self) -> float:
+        return self.scores["max"]
+
+    @property
+    def min_score(self) -> float:
+        return self.scores["min"]
+
+    @property
+    def median_score(self) -> float:
+        return self.scores["median"]
+
+    def model_score(self, i: int) -> float:
+        return self.scores[f"model{i}"]
+
+
+class ModelRunner:
+    def __init__(self, model_config: ModelConfig,
+                 column_configs: List[ColumnConfig],
+                 models_dir: str,
+                 score_selector: str = "mean"):
+        self.mc = model_config
+        self.ccs = column_configs
+        self.cols = norm_proc.selected_candidates(column_configs)
+        self.scorer = Scorer.from_dir(models_dir,
+                                      score_selector=score_selector)
+        self.header = [c.columnName for c in
+                       sorted(column_configs, key=lambda c: c.columnNum)]
+
+    @classmethod
+    def from_model_set(cls, model_set_dir: str, **kw) -> "ModelRunner":
+        import os
+        mc = ModelConfig.load(model_set_dir)
+        ccs = load_column_configs(os.path.join(model_set_dir,
+                                               "ColumnConfig.json"))
+        return cls(mc, ccs, os.path.join(model_set_dir, "models"), **kw)
+
+    # -- batch path ---------------------------------------------------------
+
+    def score_frame(self, df: pd.DataFrame) -> Dict[str, np.ndarray]:
+        """Score a raw string-typed frame (columns by name; missing
+        columns are treated as all-missing)."""
+        for c in self.cols:
+            if c.columnName not in df.columns:
+                df = df.assign(**{c.columnName: ""})
+        df = df.astype(str)
+        dset = build_columnar(
+            self.mc, norm_proc._restrict(self.ccs, self.cols), df,
+            vocabs={c.columnNum: (c.columnBinning.binCategory or [])
+                    for c in self.cols if c.is_categorical})
+        result = norm_proc.normalize_columns(self.mc, self.cols, dset)
+        if dset.cat_codes.shape[1]:
+            vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+            raw_codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                                 dset.cat_codes).astype(np.int32)
+        else:
+            raw_codes = dset.cat_codes
+        return self.scorer.score(
+            result.dense, result.index if result.index.size else None,
+            raw_dense=dset.numeric, raw_codes=raw_codes)
+
+    # -- single-record path (ModelRunner.compute) ---------------------------
+
+    def compute(self, record: Union[Dict[str, str], Sequence[str], str]
+                ) -> CaseScoreResult:
+        """Score one raw record: a name→value map, an ordered value
+        list, or a delimited string (`ModelRunner.compute(Map)` /
+        `compute(String)`)."""
+        if isinstance(record, str):
+            record = record.split(self.mc.dataSet.dataDelimiter or "|")
+        if isinstance(record, (list, tuple)):
+            record = dict(zip(self.header, [str(v) for v in record]))
+        # target is irrelevant for scoring; fill a neg tag so the row is
+        # not dropped by the invalid-tag filter
+        tgt = self.mc.dataSet.targetColumnName.split("|")[0].split("::")[-1]
+        if not record.get(tgt) and self.mc.neg_tags:
+            record = dict(record, **{tgt: self.mc.neg_tags[0]})
+        df = pd.DataFrame([record], dtype=str)
+        scores = self.score_frame(df)
+        return CaseScoreResult({k: float(v[0]) for k, v in scores.items()})

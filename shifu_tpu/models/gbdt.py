@@ -248,6 +248,31 @@ def predict_trees(trees, bins, max_depth: int, n_bins: int):
     return jax.vmap(one_tree)(trees)
 
 
+@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+def leaf_indices(trees, bins, max_depth: int, n_bins: int):
+    """Per-tree landing leaf id for every row — the tree-path encoding
+    of `udf/EncodeDataUDF.java` (each record becomes one categorical
+    value per tree). Returns (T, R) int32 node ids."""
+
+    def one_tree(tree):
+        r = bins.shape[0]
+        node = jnp.zeros(r, jnp.int32)
+        for _ in range(max_depth):
+            feat = tree["feature"][node]
+            sbin = tree["bin"][node]
+            dl = tree["default_left"][node]
+            leaf = tree["is_leaf"][node]
+            row_bin = jnp.take_along_axis(
+                bins, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
+            miss = row_bin == (n_bins - 1)
+            go_left = jnp.where(miss, dl, row_bin <= sbin)
+            nxt = 2 * node + jnp.where(go_left, 1, 2)
+            node = jnp.where(leaf | (feat < 0), node, nxt)
+        return node
+
+    return jax.vmap(one_tree)(trees)
+
+
 # ---------------------------------------------------------------------------
 # Forest builders
 # ---------------------------------------------------------------------------

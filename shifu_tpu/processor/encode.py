@@ -1,0 +1,75 @@
+"""`shifu encode` — tree-leaf-path encoding of a dataset.
+
+Replaces `core/processor/ModelDataEncodeProcessor.java` +
+`udf/EncodeDataUDF.java`: every record is pushed through the trained
+tree ensemble and each tree's landing-leaf id becomes one categorical
+output column ("tree_<i>"), a learned high-order feature cross usable
+by a downstream model set (`encodeRefModel` workflow). One vectorized
+pass via `gbdt.leaf_indices` instead of a per-record UDF.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.models import gbdt
+from shifu_tpu.models.spec import load_model
+from shifu_tpu.processor import norm as norm_proc
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def run(ctx: ProcessorContext, out_dir: Optional[str] = None) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.require_columns()
+    model_path = None
+    for ext in ("gbt", "rf"):
+        p = ctx.path_finder.model_path(0, ext)
+        if os.path.exists(p):
+            model_path = p
+            break
+    if model_path is None:
+        raise FileNotFoundError(
+            "encode needs a trained tree model (models/model0.gbt|rf); "
+            "train with algorithm GBT/RF first")
+    kind, meta, params = load_model(model_path)
+    cfg_meta = meta["treeConfig"]
+    n_bins = int(cfg_meta["n_bins"])
+
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols)
+    if dset.cat_codes.shape[1]:
+        vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+        codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                         dset.cat_codes).astype(np.int32)
+    else:
+        codes = dset.cat_codes
+    tables = {"num_cuts": np.asarray(params["tables"]["num_cuts"]),
+              "cat_map": np.asarray(params["tables"]["cat_map"])}
+    bins = gbdt.bin_dataset(tables, dset.numeric, codes, n_bins)
+    leaves = np.asarray(gbdt.leaf_indices(
+        jax.tree.map(jnp.asarray, params["trees"]), jnp.asarray(bins),
+        int(cfg_meta["max_depth"]), n_bins)).T  # (R, T)
+
+    out_dir = out_dir or os.path.join(ctx.path_finder.root, "encoded")
+    os.makedirs(out_dir, exist_ok=True)
+    n_trees = leaves.shape[1]
+    header = ["tag", "weight"] + [f"tree_{i}" for i in range(n_trees)]
+    with open(os.path.join(out_dir, ".pig_header"), "w") as f:
+        f.write("|".join(header) + "\n")
+    with open(os.path.join(out_dir, "part-00000"), "w") as f:
+        for i in range(leaves.shape[0]):
+            f.write(f"{int(dset.tags[i])}|{dset.weights[i]:.6g}|"
+                    + "|".join(str(int(v)) for v in leaves[i]) + "\n")
+    log.info("encode: %d rows × %d trees → %s in %.2fs", leaves.shape[0],
+             n_trees, out_dir, time.time() - t0)
+    return 0

@@ -92,6 +92,38 @@ def score_eval_set(ctx: ProcessorContext, ec: EvalConfig):
     return scores, dset.tags, dset.weights, dset
 
 
+def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
+    """`shifu eval -norm` — write the eval set's normalized matrix as
+    CSV (`EvalModelProcessor` NORM step / `udf/EvalNormUDF.java`)."""
+    mc = ctx.model_config
+    ctx.require_columns()
+    for ec in mc.evals:
+        if eval_name is not None and ec.name != eval_name:
+            continue
+        ds = effective_dataset_conf(mc, ec)
+        cols = norm_proc.selected_candidates(ctx.column_configs)
+        eval_mc = copy.copy(mc)
+        eval_mc.dataSet = ds
+        dset = norm_proc.load_dataset_for_columns(eval_mc, ctx.column_configs,
+                                                  cols, ds_conf=ds)
+        result = norm_proc.normalize_columns(mc, cols, dset)
+        out = ctx.path_finder.eval_norm_path(ec.name)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            f.write("tag,weight," + ",".join(result.dense_names)
+                    + ("," if result.index_names else "")
+                    + ",".join(result.index_names) + "\n")
+            for i in range(len(dset.tags)):
+                row = [f"{int(dset.tags[i])}", f"{dset.weights[i]:.6g}"]
+                row += [f"{v:.6f}" for v in result.dense[i]]
+                if result.index_names:
+                    row += [str(int(v)) for v in result.index[i]]
+                f.write(",".join(row) + "\n")
+        log.info("eval[%s] -norm → %s (%d rows)", ec.name, out,
+                 len(dset.tags))
+    return 0
+
+
 def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     t0 = time.time()
     mc = ctx.model_config

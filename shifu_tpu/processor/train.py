@@ -97,6 +97,11 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
     w = data["weights"].astype(np.float32)
     alg = mc.train.algorithm
 
+    if mc.train.upSampleWeight != 1.0:
+        # duplicate-positive rebalance expressed as weight upsampling
+        # (core/shuffle rebalance + train#upSampleWeight)
+        w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
+
     combos = grid_search.expand(mc.train.params)
     if mc.train.gridConfigFile:
         gc = grid_search.parse_grid_config_file(
@@ -124,8 +129,15 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
             res = _train_kfold(conf, spec, x, y, w, kfold, seed)
         else:
             init_params, fixed = _continuous_init(ctx, spec)
+            # mid-training fault tolerance: CheckpointInterval epochs per
+            # orbax checkpoint (NNOutput tmp models / DTMaster
+            # checkpointInterval analog); grid-search combos skip it
+            ck_int = int(tc.get_param("CheckpointInterval", 0) or 0)
             res = train_nn(conf, x, y, w, seed=seed + ci, spec=spec,
-                           init_params=init_params, fixed_layers=fixed)
+                           init_params=init_params, fixed_layers=fixed,
+                           checkpoint_dir=(ctx.path_finder.checkpoint_path(0)
+                                           if ck_int and not is_gs else None),
+                           checkpoint_interval=ck_int)
         results.append((params, res))
         if is_gs:
             log.info("grid[%d/%d] %s → val %.6f", ci + 1, len(combos),

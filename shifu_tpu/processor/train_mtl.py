@@ -69,6 +69,9 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
     dense = data["dense"].astype(np.float32)
     w = data["weights"].astype(np.float32)
     y = load_task_targets(ctx, data)
+    if mc.train.upSampleWeight != 1.0:
+        w = w * np.where(y[:, 0] > 0.5, np.float32(mc.train.upSampleWeight),
+                         1.0)
     if len(y) != len(dense):
         raise ValueError(f"MTL target rows {len(y)} != normalized rows "
                          f"{len(dense)}")

@@ -78,6 +78,11 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     y = data["tags"].astype(np.float32)
     w = data["weights"].astype(np.float32)
 
+    if mc.train.upSampleWeight != 1.0:
+        # duplicate-positive rebalance expressed as weight upsampling
+        # (core/shuffle rebalance + train#upSampleWeight)
+        w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
+
     cols = norm_proc.selected_candidates(ctx.column_configs)
     by_name = {c.columnName: c for c in cols}
     ccs_num = [by_name[n] for n in meta["denseNames"] if n in by_name]

@@ -36,6 +36,11 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
     idx = data["index"].astype(np.int32)
     y = data["tags"].astype(np.float32)
     w = data["weights"].astype(np.float32)
+
+    if mc.train.upSampleWeight != 1.0:
+        # duplicate-positive rebalance expressed as weight upsampling
+        # (core/shuffle rebalance + train#upSampleWeight)
+        w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
     if idx.shape[1] == 0:
         log.warning("WDL without categorical index block — deep-only model")
 

@@ -89,23 +89,23 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
 
 @partial(jax.jit, static_argnames=("loss_fn", "metric_fn", "optimizer",
                                    "n_epochs", "early_stop_window"))
-def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
-               early_stop_window: int, convergence_threshold: float,
-               stacked_params, train_inputs, w_train_bags,
-               val_inputs, w_val, dropout_keys, grad_mask):
+def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
+                     early_stop_window: int, convergence_threshold: float,
+                     carry_in, train_inputs, w_train_bags,
+                     val_inputs, w_val, grad_mask):
     """Generic vmapped-over-bags, scanned-over-epochs full-batch trainer
-    (shared by NN/LR/WDL/MTL).
+    (shared by NN/LR/WDL/MTL), resumable: takes and returns the full
+    per-bag training carry (see init_train_carry) so callers can run in
+    checkpointed chunks.
 
     loss_fn(params, inputs_tuple, w, key) → scalar training loss;
     metric_fn(params, inputs_tuple, w) → scalar validation error.
-    stacked_params: pytree with leading bag axis. w_train_bags: (B, Nt)
-    per-bag sample weights (bagging multiplicity × row weight).
-    grad_mask: pytree of {0,1} masking fixed layers (continuous
-    training's frozen-layer fitting, NNMaster.java:369-379).
+    w_train_bags: (B, Nt) per-bag sample weights (bagging multiplicity ×
+    row weight). grad_mask: pytree of {0,1} masking fixed layers
+    (continuous training's frozen-layer fitting, NNMaster.java:369-379).
     """
 
-    def one_bag(params, w_train, key):
-        opt_state = optimizer.init(params)
+    def one_bag(carry_in, w_train):
 
         def epoch_step(carry, e):
             params, opt_state, best, stop_state, key = carry
@@ -142,17 +142,82 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
                       {"bad": bad2, "stopped": stopped2}, key)
             return carry2, (train_err, val_err)
 
-        init = (params, opt_state,
-                {"params": params, "val": jnp.asarray(jnp.inf)},
-                {"bad": jnp.asarray(0, jnp.int32),
-                 "stopped": jnp.asarray(False)}, key)
         carry, (train_errs, val_errs) = jax.lax.scan(
-            epoch_step, init, jnp.arange(n_epochs))
-        best = carry[2]
-        best_epoch = jnp.argmin(val_errs)
-        return best["params"], train_errs, val_errs, best["val"], best_epoch
+            epoch_step, carry_in, jnp.arange(n_epochs))
+        return carry, train_errs, val_errs
 
-    return jax.vmap(one_bag)(stacked_params, w_train_bags, dropout_keys)
+    return jax.vmap(one_bag)(carry_in, w_train_bags)
+
+
+# keep the jit cache keyed on the callables/optimizer/epoch-count
+train_bags_carry = partial(jax.jit, static_argnames=(
+    "loss_fn", "metric_fn", "optimizer", "n_epochs",
+    "early_stop_window"))(train_bags_carry)
+
+
+def init_train_carry(optimizer, stacked_params, keys):
+    """Fresh per-bag training carry (params, opt_state, best tracker,
+    early-stop state, PRNG key) — the checkpointable training state
+    (NNOutput tmp-model + NNMaster recovery state in one pytree)."""
+    opt_state = jax.vmap(optimizer.init)(stacked_params)
+    n_bags = keys.shape[0]
+    return (stacked_params, opt_state,
+            {"params": stacked_params,
+             "val": jnp.full((n_bags,), jnp.inf)},
+            {"bad": jnp.zeros((n_bags,), jnp.int32),
+             "stopped": jnp.zeros((n_bags,), bool)},
+            keys)
+
+
+def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
+               early_stop_window: int, convergence_threshold: float,
+               stacked_params, train_inputs, w_train_bags,
+               val_inputs, w_val, dropout_keys, grad_mask,
+               checkpoint_dir: Optional[str] = None,
+               checkpoint_interval: int = 0):
+    """Non-resumable façade over train_bags_carry, with optional
+    checkpointing: when checkpoint_dir is set, training runs in
+    `checkpoint_interval`-epoch chunks, saving the full carry after each
+    (and restoring an existing checkpoint before starting)."""
+    carry = init_train_carry(optimizer, stacked_params, dropout_keys)
+    done = 0
+    tr_chunks, va_chunks = [], []
+    if checkpoint_dir and checkpoint_interval > 0:
+        from shifu_tpu.train import checkpoint as ckpt
+        last = ckpt.latest_step(checkpoint_dir)
+        if last is not None and 0 < last <= n_epochs:
+            carry = ckpt.restore_state(checkpoint_dir, last, carry)
+            carry = jax.tree.map(jnp.asarray, carry)
+            done = last
+            log.info("checkpoint: resumed at epoch %d from %s", last,
+                     checkpoint_dir)
+        while done < n_epochs:
+            chunk = min(checkpoint_interval, n_epochs - done)
+            carry, tr, va = train_bags_carry(
+                loss_fn, metric_fn, optimizer, chunk, early_stop_window,
+                convergence_threshold, carry, train_inputs, w_train_bags,
+                val_inputs, w_val, grad_mask)
+            tr_chunks.append(np.asarray(tr))
+            va_chunks.append(np.asarray(va))
+            done += chunk
+            ckpt.save_state(checkpoint_dir, done, carry)
+        if tr_chunks:
+            train_errs = np.concatenate(tr_chunks, axis=1)
+            val_errs = np.concatenate(va_chunks, axis=1)
+        else:  # resumed an already-finished run
+            n_bags = w_train_bags.shape[0]
+            train_errs = np.zeros((n_bags, 0), np.float32)
+            val_errs = np.asarray(carry[2]["val"], np.float32).reshape(-1, 1)
+    else:
+        carry, train_errs, val_errs = train_bags_carry(
+            loss_fn, metric_fn, optimizer, n_epochs, early_stop_window,
+            convergence_threshold, carry, train_inputs, w_train_bags,
+            val_inputs, w_val, grad_mask)
+        train_errs = np.asarray(train_errs)
+        val_errs = np.asarray(val_errs)
+    best = carry[2]
+    best_epoch = jnp.argmin(jnp.asarray(val_errs), axis=1)
+    return best["params"], train_errs, val_errs, best["val"], best_epoch
 
 
 def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
@@ -161,6 +226,8 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
              init_params: Optional[Any] = None,
              fixed_layers: Optional[List[int]] = None,
              val_data: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+             checkpoint_dir: Optional[str] = None,
+             checkpoint_interval: int = 0,
              ) -> TrainResult:
     """Train `baggingNum` NN models at once.
 
@@ -224,7 +291,9 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
         float(train_conf.convergenceThreshold or 0.0),
         stacked, (jnp.asarray(x_tr), jnp.asarray(y_tr)), jnp.asarray(bag_w),
         (jnp.asarray(x_v), jnp.asarray(y_v)), jnp.asarray(w_v),
-        bag_keys[:-1], grad_mask)
+        bag_keys[:-1], grad_mask,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_interval=checkpoint_interval)
 
     params_per_bag = [
         jax.tree.map(lambda p, i=i: np.asarray(p[i]), best_params)

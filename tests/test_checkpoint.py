@@ -1,0 +1,79 @@
+"""Checkpoint/resume tests — the fault-model analog of the reference's
+NNOutput tmp models + NNMaster recovery (SURVEY.md §5)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config.model_config import ModelTrainConf
+from shifu_tpu.train import checkpoint as ckpt
+from shifu_tpu.train.trainer import train_nn
+
+
+def _data(rng, n=600):
+    x = rng.normal(0, 1, (n, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    return x, y, np.ones(n, np.float32)
+
+
+def _conf(epochs):
+    return ModelTrainConf.from_dict({
+        "numTrainEpochs": epochs, "baggingNum": 2, "validSetRate": 0.2,
+        "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [6],
+                   "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                   "Propagation": "ADAM"}})
+
+
+def test_checkpointed_equals_straight(tmp_path, rng):
+    """Chunked+checkpointed training produces the same result as one
+    uninterrupted scan (determinism of the resumable carry)."""
+    x, y, w = _data(rng)
+    straight = train_nn(_conf(30), x, y, w, seed=7)
+    ck = train_nn(_conf(30), x, y, w, seed=7,
+                  checkpoint_dir=str(tmp_path / "ck"),
+                  checkpoint_interval=10)
+    np.testing.assert_allclose(straight.val_errors, ck.val_errors, rtol=1e-5)
+    for a, b in zip(straight.params_per_bag[0], ck.params_per_bag[0]):
+        np.testing.assert_allclose(a["w"], b["w"], rtol=1e-5)
+
+
+def test_resume_after_kill(tmp_path, rng):
+    """Simulate a mid-training failure: run 30 epochs with interval 10,
+    then delete nothing and re-run — it resumes from the last
+    checkpoint instead of restarting, and the final state matches the
+    uninterrupted run."""
+    x, y, w = _data(rng)
+    ckdir = str(tmp_path / "ck")
+    # "crashed" run: only the first 2 chunks happened
+    train_nn(_conf(20), x, y, w, seed=7, checkpoint_dir=ckdir,
+             checkpoint_interval=10)
+    assert ckpt.latest_step(ckdir) == 20
+    # restart with the full epoch budget — resumes at 20
+    res = train_nn(_conf(30), x, y, w, seed=7, checkpoint_dir=ckdir,
+                   checkpoint_interval=10)
+    assert ckpt.latest_step(ckdir) == 30
+    # only 10 fresh epochs were computed after resume
+    assert res.val_errors.shape[1] == 10
+    straight = train_nn(_conf(30), x, y, w, seed=7)
+    # resumed final val error ≈ straight-run final val error
+    assert np.allclose(res.best_val, straight.best_val, rtol=1e-4)
+
+
+def test_state_roundtrip(tmp_path):
+    state = ({"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+             {"count": np.asarray([3], np.int64)})
+    ckpt.save_state(str(tmp_path / "s"), 5, state)
+    assert ckpt.latest_step(str(tmp_path / "s")) == 5
+    restored = ckpt.restore_state(str(tmp_path / "s"), 5, state)
+    np.testing.assert_array_equal(restored[0]["w"], state[0]["w"])
+    np.testing.assert_array_equal(restored[1]["count"], state[1]["count"])
+
+
+def test_only_latest_checkpoint_kept(tmp_path):
+    state = {"a": np.ones(2, np.float32)}
+    d = str(tmp_path / "s")
+    ckpt.save_state(d, 1, state)
+    ckpt.save_state(d, 2, state)
+    names = [n for n in os.listdir(d) if n.startswith("step_")]
+    assert len(names) == 1 and "2" in names[0]

@@ -1,0 +1,119 @@
+"""ModelRunner embedding API, encode, manage, eval-norm, upsample."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.processor.base import ProcessorContext
+
+
+@pytest.fixture()
+def trained(model_set):
+    for cmd in (["init"], ["stats"], ["norm"], ["train"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    return model_set
+
+
+def test_model_runner_single_record(trained):
+    from shifu_tpu.eval.model_runner import ModelRunner
+    runner = ModelRunner.from_model_set(trained)
+    rec = {"num_0": "1.2", "num_1": "0.1", "num_2": "2.0", "num_3": "-0.5",
+           "num_4": "1.5", "num_5": "0.3", "cat_0": "aa", "cat_1": "bb",
+           "wgt": "1.0", "rowid": "x"}
+    result = runner.compute(rec)
+    assert 0.0 <= result.avg_score <= 1.0
+    assert result.max_score >= result.avg_score >= result.min_score
+    # positive-leaning record (high num_0..4, cat 'aa') scores higher than
+    # a negative-leaning one
+    neg = dict(rec, num_0="-2", num_2="-2", num_4="-2", cat_0="dd", cat_1="dd")
+    assert runner.compute(rec).avg_score > runner.compute(neg).avg_score
+
+
+def test_model_runner_missing_columns(trained):
+    """Records lacking some feature columns still score (missing
+    treatment, like ModelRunner's map path)."""
+    from shifu_tpu.eval.model_runner import ModelRunner
+    runner = ModelRunner.from_model_set(trained)
+    result = runner.compute({"num_0": "1.0", "cat_0": "aa"})
+    assert 0.0 <= result.avg_score <= 1.0
+
+
+def test_model_runner_delimited_string(trained):
+    from shifu_tpu.eval.model_runner import ModelRunner
+    runner = ModelRunner.from_model_set(trained)
+    header = runner.header
+    values = {"num_0": "1.2", "num_1": "0", "num_2": "1", "num_3": "0",
+              "num_4": "1", "num_5": "0", "cat_0": "aa", "cat_1": "aa",
+              "wgt": "1", "rowid": "1", "diagnosis": "M"}
+    line = "|".join(values.get(h, "") for h in header)
+    result = runner.compute(line)
+    assert 0.0 <= result.avg_score <= 1.0
+
+
+def test_eval_norm_export(trained):
+    assert cli_main(["--dir", trained, "eval", "-norm"]) == 0
+    ctx = ProcessorContext.load(trained)
+    path = ctx.path_finder.eval_norm_path("Eval1")
+    lines = open(path).read().splitlines()
+    assert lines[0].startswith("tag,weight,")
+    assert len(lines) > 100
+
+
+def test_encode_requires_tree_model(trained):
+    assert cli_main(["--dir", trained, "encode"]) == 1  # NN trained, no tree
+
+
+def test_encode_with_gbt(tmp_path, rng):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=800, algorithm="GBT",
+                          train_params={"TreeNum": 4, "MaxDepth": 3,
+                                        "LearningRate": 0.3, "Loss": "log"})
+    for cmd in (["init"], ["stats"], ["norm"], ["train"], ["encode"]):
+        assert cli_main(["--dir", root] + cmd) == 0
+    enc = os.path.join(root, "encoded")
+    header = open(os.path.join(enc, ".pig_header")).read().strip().split("|")
+    assert header == ["tag", "weight", "tree_0", "tree_1", "tree_2", "tree_3"]
+    rows = open(os.path.join(enc, "part-00000")).read().splitlines()
+    assert len(rows) == 640  # synth splits 80% into the train dir
+    leaf = int(rows[0].split("|")[2])
+    assert leaf >= 3  # landed at depth ≥ 1 (beyond root region)
+
+
+def test_manage_save_switch_show(trained):
+    assert cli_main(["--dir", trained, "save", "v1"]) == 0
+    # mutate: deselect everything
+    ctx = ProcessorContext.load(trained)
+    for cc in ctx.column_configs:
+        cc.finalSelect = False
+    ctx.save_column_configs()
+    assert cli_main(["--dir", trained, "switch", "v1"]) == 0
+    ctx = ProcessorContext.load(trained)
+    # v1 had no finalSelect either (train before varsel), but models/ restored
+    assert os.path.exists(ctx.path_finder.model_path(0, "nn"))
+    assert cli_main(["--dir", trained, "show"]) == 0
+    from shifu_tpu.processor import manage
+    assert set(manage.list_versions(ctx)) == {"v1", "master"}
+    # switching to a nonexistent version errors cleanly
+    assert cli_main(["--dir", trained, "switch", "nope"]) == 1
+
+
+def test_upsample_weight_changes_training(rng, tmp_path):
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (init as init_proc, stats as stats_proc,
+                                     norm as norm_proc, train as train_proc)
+    root = make_model_set(tmp_path, rng, n_rows=800)
+    for proc in (init_proc, stats_proc, norm_proc):
+        ctx = ProcessorContext.load(root)
+        proc.run(ctx)
+    ctx = ProcessorContext.load(root)
+    train_proc.run(ctx)
+    from shifu_tpu.models.spec import load_model
+    _, _, p1 = load_model(ctx.path_finder.model_path(0, "nn"))
+    ctx = ProcessorContext.load(root)
+    ctx.model_config.train.upSampleWeight = 5.0
+    train_proc.run(ctx)
+    _, _, p2 = load_model(ctx.path_finder.model_path(0, "nn"))
+    assert not np.allclose(p1[0]["w"], p2[0]["w"])
