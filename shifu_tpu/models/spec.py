@@ -79,9 +79,15 @@ def load_model(path: str) -> Tuple[str, Dict[str, Any], Any]:
 
 def list_models(models_dir: str) -> List[str]:
     """All model specs in a models/ dir, sorted by bag index
-    (`ModelSpecLoaderUtils.loadBasicModels` analog)."""
+    (`ModelSpecLoaderUtils.loadBasicModels` analog). Numeric sort, so
+    model10 follows model9, not model1."""
     if not os.path.isdir(models_dir):
         return []
-    out = [os.path.join(models_dir, f) for f in sorted(os.listdir(models_dir))
-           if f.startswith("model") and not f.endswith(".json")]
-    return out
+
+    def bag_index(name: str):
+        digits = "".join(c for c in name.split(".")[0] if c.isdigit())
+        return (int(digits) if digits else -1, name)
+
+    return [os.path.join(models_dir, f)
+            for f in sorted(os.listdir(models_dir), key=bag_index)
+            if f.startswith("model") and not f.endswith(".json")]

@@ -1,9 +1,10 @@
-"""`shifu export` — columnstats / woemapping / correlation exports.
+"""`shifu export` — columnstats / woemapping / correlation / pmml.
 
 Mirrors `core/processor/ExportModelProcessor.java:87-103` variants:
-columnstats (per-column metrics CSV), woemapping (bin → WOE CSV).
-PMML export is staged for a later round — the numpy-only model spec
-(`shifu_tpu/models/spec.py`) is the current cross-runtime format.
+columnstats (per-column metrics CSV), woemapping (bin → WOE CSV),
+correlation, and pmml (one PMML 4.2 document per trained model spec,
+`shifu_tpu/pmml.py`). The numpy-only npz model spec
+(`shifu_tpu/models/spec.py`) remains the native cross-runtime format.
 """
 
 from __future__ import annotations
@@ -37,9 +38,7 @@ def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
         correlation.run(ctx)
         out = ctx.path_finder.correlation_path()
     elif et == "pmml":
-        raise NotImplementedError(
-            "PMML export is not yet native; use the npz model spec "
-            "(models/model*.npz-compatible) for cross-runtime scoring")
+        out = _export_pmml(ctx)
     else:
         raise ValueError(f"unknown export type {export_type!r}")
     log.info("export[%s] → %s in %.2fs", et, out, time.time() - t0)
@@ -62,6 +61,30 @@ def _export_columnstats(ctx: ProcessorContext) -> str:
                    st.distinctCount, st.psi]
             f.write(",".join("" if v is None else str(v) for v in row) + "\n")
     return out
+
+
+def _export_pmml(ctx: ProcessorContext) -> str:
+    """One .pmml per model spec under models/, written to pmmls/
+    (`ExportModelProcessor.exportPmml`)."""
+    from shifu_tpu import pmml as pmml_mod
+    from shifu_tpu.models.spec import list_models, load_model
+
+    paths = list_models(ctx.path_finder.models_path())
+    if not paths:
+        raise FileNotFoundError("no trained models to export; run "
+                                "`shifu train` first")
+    out_dir = None
+    for i, p in enumerate(paths):
+        kind, meta, params = load_model(p)
+        root = pmml_mod.build_pmml(ctx.model_config, ctx.column_configs,
+                                   kind, meta, params)
+        out = ctx.path_finder.pmml_path(i)
+        ctx.path_finder.ensure(out)
+        out_dir = os.path.dirname(out)
+        with open(out, "w") as f:
+            f.write(pmml_mod.to_string(root))
+        log.info("pmml: %s → %s", os.path.basename(p), out)
+    return out_dir
 
 
 def _export_woemapping(ctx: ProcessorContext) -> str:

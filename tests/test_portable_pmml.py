@@ -1,0 +1,203 @@
+"""Portable numpy-only scorers + PMML export/conformance.
+
+Mirrors the reference's Independent*Model tests
+(`core/dtrain/{NNModelEvalAndScore,IndependentTreeModel}Test.java`) and
+jpmml conformance tests (`core/pmml/PMMLTranslatorTest.java`,
+`PMMLVerifySuit.java`): the portable scorer must agree with the native
+JAX scorer bit-for-bit-ish, and a PMML document scored from RAW records
+must agree with the pipeline's normalized-scoring path.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.processor.base import ProcessorContext
+
+
+def _pipeline(model_set, *extra):
+    for cmd in (["init"], ["stats"], ["norm"], ["train"], *extra):
+        assert cli_main(["--dir", model_set] + list(cmd)) == 0
+    return model_set
+
+
+@pytest.fixture()
+def trained_nn(model_set):
+    return _pipeline(model_set)
+
+
+def _norm_blocks(root):
+    from shifu_tpu.processor import norm as norm_proc
+    ctx = ProcessorContext.load(root)
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    return ctx, data, meta
+
+
+# ---------------------------------------------------------------------------
+# Portable scorer parity
+# ---------------------------------------------------------------------------
+
+def test_portable_imports_without_jax(trained_nn):
+    """The zero-dependency property itself: importing and using
+    shifu_tpu.portable must not pull jax into the process."""
+    models_dir = os.path.join(trained_nn, "models")
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "from shifu_tpu.portable import PortableScorer\n"
+        "assert 'jax' not in sys.modules, 'portable pulled in jax'\n"
+        f"s = PortableScorer({models_dir!r})\n"
+        "out = s.score(dense=np.zeros((3, s.models[0][2][0]['w'].shape[0]),"
+        " np.float32))\n"
+        "assert out['mean'].shape == (3,)\n"
+        "assert 'jax' not in sys.modules, 'scoring pulled in jax'\n"
+    )
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    subprocess.run([sys.executable, "-c", code], check=True, env=env)
+
+
+def test_portable_nn_matches_native(trained_nn):
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.portable import PortableScorer
+    ctx, data, meta = _norm_blocks(trained_nn)
+    native = Scorer.from_dir(ctx.path_finder.models_path())
+    portable = PortableScorer(ctx.path_finder.models_path())
+    a = native.score(data["dense"])["mean"]
+    b = portable.score(dense=data["dense"])["mean"]
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("algorithm", ["GBT", "RF"])
+def test_portable_trees_match_native(tmp_path, rng, algorithm):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1500, algorithm=algorithm,
+                          train_params={"TreeNum": 5, "MaxDepth": 4,
+                                        "LearningRate": 0.1,
+                                        "Loss": "squared"})
+    _pipeline(root)
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.portable import PortableScorer
+    from shifu_tpu.processor import norm as norm_proc
+    from shifu_tpu.processor.norm import load_dataset_for_columns
+    ctx = ProcessorContext.load(root)
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    dset = load_dataset_for_columns(ctx.model_config, ctx.column_configs,
+                                    cols)
+    vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+    raw_codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                         dset.cat_codes).astype(np.int32) \
+        if dset.cat_codes.shape[1] else dset.cat_codes
+    native = Scorer.from_dir(ctx.path_finder.models_path())
+    portable = PortableScorer(ctx.path_finder.models_path())
+    a = native.score(dset.numeric, raw_dense=dset.numeric,
+                     raw_codes=raw_codes)["mean"]
+    b = portable.score(raw_dense=dset.numeric, raw_codes=raw_codes)["mean"]
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_portable_wdl_matches_native(tmp_path, rng):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1200, algorithm="WDL",
+                          norm_type="ZSCALE_INDEX",
+                          train_params={"NumHiddenNodes": [8],
+                                        "ActivationFunc": ["relu"],
+                                        "EmbedSize": 4,
+                                        "LearningRate": 0.05})
+    _pipeline(root)
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.portable import PortableScorer
+    ctx, data, meta = _norm_blocks(root)
+    native = Scorer.from_dir(ctx.path_finder.models_path())
+    portable = PortableScorer(ctx.path_finder.models_path())
+    a = native.score(data["dense"], data["index"])["mean"]
+    b = portable.score(dense=data["dense"], index=data["index"])["mean"]
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# PMML export + conformance
+# ---------------------------------------------------------------------------
+
+def _raw_eval_frame(root):
+    """The raw eval split as a string DataFrame (missing token '?' →
+    empty)."""
+    hdr = open(os.path.join(root, "evaldata", ".pig_header")).read() \
+        .strip().split("|")
+    rows = [ln.split("|") for ln in
+            open(os.path.join(root, "evaldata", "part-00000"))
+            .read().splitlines()]
+    df = pd.DataFrame(rows, columns=hdr, dtype=str)
+    return df.replace("?", "")
+
+
+def _native_scores(root, df):
+    from shifu_tpu.eval.model_runner import ModelRunner
+    runner = ModelRunner.from_model_set(root)
+    return runner.score_frame(df)["mean"]
+
+
+def test_pmml_nn_zscore_conformance(trained_nn):
+    from shifu_tpu import pmml as pmml_mod
+    assert cli_main(["--dir", trained_nn, "export", "-t", "pmml"]) == 0
+    path = ProcessorContext.load(trained_nn).path_finder.pmml_path(0)
+    assert os.path.exists(path)
+    df = _raw_eval_frame(trained_nn).head(200)
+    got = pmml_mod.evaluate_pmml(open(path).read(), df)
+    want = _native_scores(trained_nn, df.copy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pmml_nn_woe_conformance(tmp_path, rng):
+    from tests.synth import make_model_set
+    from shifu_tpu import pmml as pmml_mod
+    root = make_model_set(tmp_path, rng, n_rows=1500, norm_type="WOE")
+    _pipeline(root)
+    assert cli_main(["--dir", root, "export", "-t", "pmml"]) == 0
+    path = ProcessorContext.load(root).path_finder.pmml_path(0)
+    df = _raw_eval_frame(root).head(200)
+    got = pmml_mod.evaluate_pmml(open(path).read(), df)
+    want = _native_scores(root, df.copy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pmml_gbt_conformance(tmp_path, rng):
+    from tests.synth import make_model_set
+    from shifu_tpu import pmml as pmml_mod
+    root = make_model_set(tmp_path, rng, n_rows=1500, algorithm="GBT",
+                          train_params={"TreeNum": 4, "MaxDepth": 3,
+                                        "LearningRate": 0.1,
+                                        "Loss": "log"})
+    _pipeline(root)
+    assert cli_main(["--dir", root, "export", "-t", "pmml"]) == 0
+    path = ProcessorContext.load(root).path_finder.pmml_path(0)
+    df = _raw_eval_frame(root).head(150)
+    # unseen categories must route like the native scorer (missing-bin →
+    # default-direction child, expressed as isNotIn in the PMML)
+    df.loc[df.index[:10], "cat_0"] = "never_seen_in_training"
+    got = pmml_mod.evaluate_pmml(open(path).read(), df)
+
+    from shifu_tpu.eval.model_runner import ModelRunner
+    runner = ModelRunner.from_model_set(root)
+    want = runner.score_frame(df.copy())["mean"]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pmml_unsupported_norm_rejected(tmp_path, rng):
+    from tests.synth import make_model_set
+    from shifu_tpu import pmml as pmml_mod
+    from shifu_tpu.models.spec import list_models, load_model
+    root = make_model_set(tmp_path, rng, n_rows=800, norm_type="ONEHOT")
+    _pipeline(root)
+    ctx = ProcessorContext.load(root)
+    kind, meta, params = load_model(
+        list_models(ctx.path_finder.models_path())[0])
+    with pytest.raises(ValueError):
+        pmml_mod.build_pmml(ctx.model_config, ctx.column_configs, kind,
+                            meta, params)
