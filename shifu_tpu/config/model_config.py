@@ -674,6 +674,20 @@ class ModelConfig:
             "|" in self.dataSet.targetColumnName
 
     @property
+    def class_tags(self) -> List[str]:
+        """Flattened class list for multi-class modeling: posTags then
+        negTags, preserving order (`CommonUtils.flattenTags` /
+        `ModelConfig.getFlattenTags`). Class index = position here."""
+        return self.pos_tags + self.neg_tags
+
+    @property
+    def is_multi_classification(self) -> bool:
+        """>2 distinct tags → multi-class (the reference's
+        isClassification with multiple tags; decomposition strategy in
+        `train#multiClassifyMethod`, ModelTrainConf.java:74-90)."""
+        return len(self.class_tags) > 2
+
+    @property
     def pos_tags(self) -> List[str]:
         return [str(t) for t in self.dataSet.posTags]
 

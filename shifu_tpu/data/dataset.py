@@ -63,11 +63,18 @@ class ColumnarDataset:
 
 
 def parse_tags(raw: np.ndarray, pos_tags: Sequence[str],
-               neg_tags: Sequence[str]) -> np.ndarray:
+               neg_tags: Sequence[str],
+               classes: Optional[Sequence[str]] = None) -> np.ndarray:
     """tag string → 1.0 (pos) / 0.0 (neg) / NaN (unknown → row dropped,
-    matching the reference's invalid-tag record skip in NNWorker.load)."""
+    matching the reference's invalid-tag record skip in NNWorker.load).
+    With `classes` (multi-class, >2 flattened tags), the tag maps to its
+    class index instead."""
     raw = np.char.strip(raw.astype(str))
     out = np.full(len(raw), np.nan, np.float32)
+    if classes:
+        for i, c in enumerate(classes):
+            out[raw == str(c).strip()] = float(i)
+        return out
     if pos_tags:
         out[np.isin(raw, list(pos_tags))] = 1.0
     if neg_tags:
@@ -146,8 +153,9 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
             num_mats.append(vals)
 
     n_rows = len(df)
-    tags = parse_tags(tag_col, mc.pos_tags, mc.neg_tags) if tag_col is not None \
-        else np.full(n_rows, np.nan, np.float32)
+    classes = mc.class_tags if mc.is_multi_classification else None
+    tags = parse_tags(tag_col, mc.pos_tags, mc.neg_tags, classes) \
+        if tag_col is not None else np.full(n_rows, np.nan, np.float32)
     weights = weight_col if weight_col is not None else np.ones(n_rows, np.float32)
     if len(task_names) > 1 and task_cols:
         task_tags = np.stack(

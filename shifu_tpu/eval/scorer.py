@@ -104,3 +104,43 @@ class Scorer:
         out["median"] = np.median(stack, axis=0)
         out["final"] = out.get(self.selector, out["mean"])
         return out
+
+    def score_multiclass(self, dense: np.ndarray,
+                         index: Optional[np.ndarray] = None,
+                         raw_dense: Optional[np.ndarray] = None,
+                         raw_codes: Optional[np.ndarray] = None
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """Multi-class ensemble → ((N, C) class scores, (N,) argmax
+        predicted class). NATIVE models contribute their softmax rows;
+        ONEVSALL models (meta `ovaClass`) fill their class's column —
+        mirroring `Scorer`'s per-tag max-score pick for classification.
+        """
+        native, ova = [], {}
+        n_classes = 0
+        for kind, meta, params in self.models:
+            s = score_matrix(kind, meta, params, dense, index,
+                             raw_dense=raw_dense, raw_codes=raw_codes)
+            if "ovaClass" in meta:
+                c = int(meta["ovaClass"])
+                ova.setdefault(c, []).append(np.asarray(s).reshape(-1))
+                n_classes = max(n_classes, c + 1,
+                                len(meta.get("classes") or []))
+            else:
+                s = np.asarray(s)
+                if s.ndim == 1:
+                    raise ValueError(
+                        "binary model in a multi-class eval — retrain "
+                        "with multi-class tags")
+                native.append(s)
+                n_classes = max(n_classes, s.shape[1])
+        parts = []
+        if native:
+            parts.append(np.mean(np.stack(native, axis=0), axis=0))
+        if ova:
+            n_rows = len(next(iter(ova.values()))[0])
+            probs = np.zeros((n_rows, n_classes), np.float32)
+            for c, ss in ova.items():
+                probs[:, c] = np.mean(np.stack(ss, axis=0), axis=0)
+            parts.append(probs)
+        scores = np.mean(np.stack(parts, axis=0), axis=0)
+        return scores, np.argmax(scores, axis=1).astype(np.int32)

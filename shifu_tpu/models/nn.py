@@ -178,6 +178,11 @@ def forward(spec: MLPSpec, params: Params, x: jax.Array,
             keep = jax.random.bernoulli(sub, 1.0 - spec.dropout_rate, h.shape)
             h = jnp.where(keep, h / (1.0 - spec.dropout_rate), 0.0)
     out = h @ params[-1]["w"] + params[-1]["b"]
+    if spec.output_activation == "softmax":
+        # multi-class NATIVE head: one unit per flattened tag
+        # (train#multiClassifyMethod NATIVE — the reference builds an
+        # Encog net with tags.size() output neurons)
+        return jax.nn.softmax(out, axis=-1)
     out = activation(spec.output_activation)(out)
     return out[..., 0] if spec.output_dim == 1 else out
 
@@ -188,6 +193,23 @@ def loss_fn(spec: MLPSpec, params: Params, x: jax.Array, y: jax.Array,
     L1/L2 regularization (`Weight.java` reg terms). Weights double as
     bagging sample multipliers (Poisson/Bernoulli masks)."""
     pred = forward(spec, params, x, dropout_key)
+    if spec.output_dim > 1:
+        # multi-class: y holds class indices; cross-entropy on the
+        # softmax probabilities (log loss) or Brier vs one-hot (squared)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
+        if spec.loss.startswith("log"):
+            per = -jnp.sum(onehot * jnp.log(pred + 1e-7), axis=-1)
+        else:
+            per = 0.5 * jnp.sum(jnp.square(onehot - pred), axis=-1)
+        total_w = jnp.maximum(jnp.sum(w), 1e-12)
+        loss = jnp.sum(per * w) / total_w
+        if spec.l2 > 0.0:
+            loss = loss + spec.l2 * sum(jnp.sum(jnp.square(p["w"]))
+                                        for p in params)
+        if spec.l1 > 0.0:
+            loss = loss + spec.l1 * sum(jnp.sum(jnp.abs(p["w"]))
+                                        for p in params)
+        return loss
     if spec.loss.startswith("log"):
         eps = 1e-7
         per = -(y * jnp.log(pred + eps) + (1 - y) * jnp.log(1 - pred + eps))
@@ -210,6 +232,10 @@ def mse(spec: MLPSpec, params: Params, x: jax.Array, y: jax.Array,
     per epoch regardless of training loss (NNMaster trainError)."""
     pred = forward(spec, params, x)
     total_w = jnp.maximum(jnp.sum(w), 1e-12)
+    if spec.output_dim > 1:
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
+        per = jnp.mean(jnp.square(onehot - pred), axis=-1)
+        return jnp.sum(per * w) / total_w
     return jnp.sum(jnp.square(y - pred) * w) / total_w
 
 

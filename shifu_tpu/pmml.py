@@ -373,13 +373,13 @@ def build_tree_pmml(mc: ModelConfig, ccs: List[ColumnConfig],
     num_cuts = np.asarray(params["tables"]["num_cuts"], np.float64)
     cat_map = np.asarray(params["tables"]["cat_map"])
     ccs_by_name = {c.columnName: c for c in ccs}
-    num_col_of = [dense_names.index(nm) if k == "num" else -1
-                  for nm, k in zip(feat_name, feat_kind)]
-    cat_col_of = {f: j for j, f in enumerate(
-        range(len(dense_names), len(feat_name)))}
+    # feat_name = dense_names + index_names, so feature f maps to dense
+    # column f (numeric) or categorical column f - len(dense_names)
+    n_dense = len(dense_names)
+    num_col_of = [f if f < n_dense else -1 for f in range(len(feat_name))]
 
     def cat_left_sets(f: int, sbin: int, left: bool) -> List[str]:
-        j = cat_col_of[f]
+        j = f - n_dense
         cc = ccs_by_name.get(feat_name[f])
         vocab = (cc.columnBinning.binCategory or []) if cc else []
         out = []

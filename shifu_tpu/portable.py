@@ -100,7 +100,12 @@ def mlp_forward(spec: Dict[str, Any], params: List[Dict[str, np.ndarray]],
         h = h @ layer["w"] + layer["b"]
         h = _act(acts[i])(h)
     out = h @ params[-1]["w"] + params[-1]["b"]
-    out = _act(spec.get("output_activation", "sigmoid"))(out)
+    oact = str(spec.get("output_activation", "sigmoid")).lower()
+    if oact == "softmax":  # NATIVE multi-class head
+        z = out - out.max(axis=-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=-1, keepdims=True)
+    out = _act(oact)(out)
     return out[..., 0] if int(spec.get("output_dim", 1)) == 1 else out
 
 
@@ -263,7 +268,13 @@ class PortableScorer:
         import os
         if isinstance(model_paths, str):
             d = model_paths
-            model_paths = [os.path.join(d, f) for f in sorted(os.listdir(d))
+
+            def bag_index(name):  # numeric sort: model10 after model9
+                digits = "".join(c for c in name.split(".")[0] if c.isdigit())
+                return (int(digits) if digits else -1, name)
+
+            model_paths = [os.path.join(d, f)
+                           for f in sorted(os.listdir(d), key=bag_index)
                            if f.startswith("model") and not f.endswith(".json")]
         self.models = [load_model(p) for p in model_paths]
         self.selector = (score_selector or "mean").lower()

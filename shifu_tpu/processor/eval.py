@@ -86,6 +86,13 @@ def score_eval_set(ctx: ProcessorContext, ec: EvalConfig):
                              dset.cat_codes).astype(np.int32)
     else:
         raw_codes = dset.cat_codes
+    if mc.is_multi_classification:
+        probs, pred = scorer.score_multiclass(
+            result.dense, result.index if result.index.size else None,
+            raw_dense=dset.numeric, raw_codes=raw_codes)
+        scores = {f"class{c}": probs[:, c] for c in range(probs.shape[1])}
+        scores["final"] = pred.astype(np.float32)
+        return scores, dset.tags, dset.weights, dset
     scores = scorer.score(result.dense,
                           result.index if result.index.size else None,
                           raw_dense=dset.numeric, raw_codes=raw_codes)
@@ -130,6 +137,9 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     scores, tags, weights, dset = score_eval_set(ctx, ec)
     final = scores["final"]
 
+    if mc.is_multi_classification:
+        return _finish_multiclass(ctx, ec, scores, tags, weights, t0)
+
     base = ctx.path_finder.eval_base_path(ec.name)
     os.makedirs(base, exist_ok=True)
 
@@ -163,4 +173,58 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     log.info("eval[%s]: %d rows, AUC=%.4f (weighted %.4f) in %.2fs",
              ec.name, len(final), perf["areaUnderRoc"],
              perf["weightedAreaUnderRoc"], time.time() - t0)
+    return perf
+
+
+def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
+                       scores: Dict[str, np.ndarray], tags: np.ndarray,
+                       weights: np.ndarray, t0: float) -> Dict:
+    """Multi-class eval outputs: per-class score columns, C×C weighted
+    confusion matrix, accuracy + per-class precision/recall/F1
+    (`ConfusionMatrix.computeConfusionMatixForMultipleClassification`)."""
+    mc = ctx.model_config
+    classes = mc.class_tags
+    n_c = len(classes)
+    pred = scores["final"].astype(np.int32)
+    true = tags.astype(np.int32)
+
+    base = ctx.path_finder.eval_base_path(ec.name)
+    os.makedirs(base, exist_ok=True)
+
+    class_cols = [f"class{c}" for c in range(n_c)]
+    with open(ctx.path_finder.eval_score_path(ec.name), "w") as f:
+        f.write("tag,weight," + ",".join(class_cols) + ",predicted\n")
+        for i in range(len(pred)):
+            f.write(f"{true[i]},{weights[i]:.6g},"
+                    + ",".join(f"{scores[c][i]:.6f}" for c in class_cols)
+                    + f",{pred[i]}\n")
+
+    # weighted C×C confusion matrix: rows = actual, cols = predicted
+    cm = np.zeros((n_c, n_c), np.float64)
+    np.add.at(cm, (true, pred), weights)
+    with open(ctx.path_finder.eval_confusion_path(ec.name), "w") as f:
+        f.write("actual\\predicted," + ",".join(str(c) for c in classes) + "\n")
+        for a in range(n_c):
+            f.write(str(classes[a]) + ","
+                    + ",".join(f"{v:.6g}" for v in cm[a]) + "\n")
+
+    total = float(weights.sum())
+    acc = float(np.sum((pred == true) * weights) / max(total, 1e-12))
+    per_class = []
+    for c in range(n_c):
+        tp = float(cm[c, c])
+        fp = float(cm[:, c].sum() - tp)
+        fn = float(cm[c].sum() - tp)
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        per_class.append({
+            "tag": str(classes[c]), "precision": prec, "recall": rec,
+            "f1": 2 * prec * rec / max(prec + rec, 1e-12),
+            "support": float(cm[c].sum())})
+    perf = {"accuracy": acc, "records": int(len(pred)),
+            "classes": [str(c) for c in classes], "perClass": per_class}
+    with open(ctx.path_finder.eval_performance_path(ec.name), "w") as f:
+        json.dump(perf, f, indent=1)
+    log.info("eval[%s]: %d rows, multi-class accuracy=%.4f in %.2fs",
+             ec.name, len(pred), acc, time.time() - t0)
     return perf

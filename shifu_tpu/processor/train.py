@@ -38,6 +38,13 @@ def run(ctx: ProcessorContext, seed: int = 12306) -> int:
     ctx.require_columns()
     alg = mc.train.algorithm
 
+    if mc.is_multi_classification and \
+            alg not in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
+        raise ValueError(
+            f"multi-class (>2 tags) is supported for NN/LR/SVM, not "
+            f"{alg.value}; the reference likewise restricts "
+            f"multiClassifyMethod to its NN-family trainers")
+
     if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
         result = _train_dense(ctx, seed)
     elif alg.is_tree:
@@ -97,10 +104,22 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
     w = data["weights"].astype(np.float32)
     alg = mc.train.algorithm
 
+    classes = mc.class_tags if mc.is_multi_classification else None
     if mc.train.upSampleWeight != 1.0:
-        # duplicate-positive rebalance expressed as weight upsampling
-        # (core/shuffle rebalance + train#upSampleWeight)
-        w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
+        if classes:
+            # reference upsampling is positive-vs-negative only; for
+            # multi-class y holds class indices, so y>0.5 would be wrong
+            log.warning("upSampleWeight ignored for multi-class training")
+        else:
+            # duplicate-positive rebalance expressed as weight upsampling
+            # (core/shuffle rebalance + train#upSampleWeight)
+            w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
+
+    if classes and mc.train.multiClassifyMethod.value == "ONEVSALL":
+        # one-vs-all decomposition: one binary model per class, trained
+        # as parallel independent regressions
+        # (TrainModelProcessor.validateDistributedTrain:403-405)
+        return _train_dense_ovr(ctx, x, y, w, classes, seed)
 
     combos = grid_search.expand(mc.train.params)
     if mc.train.gridConfigFile:
@@ -115,10 +134,18 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
 
     def make_spec(params):
         if alg is Algorithm.LR:
-            return _lr_spec(params, x.shape[1])
-        if alg is Algorithm.SVM:
-            return _svm_spec(params, x.shape[1])
-        return nn_mod.MLPSpec.from_train_params(params, x.shape[1])
+            spec = _lr_spec(params, x.shape[1])
+        elif alg is Algorithm.SVM:
+            spec = _svm_spec(params, x.shape[1])
+        else:
+            spec = nn_mod.MLPSpec.from_train_params(params, x.shape[1])
+        if classes:
+            # NATIVE multi-class: softmax head, one unit per tag
+            import dataclasses
+            spec = dataclasses.replace(
+                spec, output_dim=len(classes), output_activation="softmax",
+                loss="log")
+        return spec
 
     results: List[Tuple[Dict[str, Any], TrainResult]] = []
     for ci, params in enumerate(combos):
@@ -202,32 +229,93 @@ def _train_kfold(conf, spec, x, y, w, k: int, seed: int) -> TrainResult:
     return best
 
 
-def _save_dense_models(ctx: ProcessorContext, res: TrainResult,
-                       alg: Algorithm) -> None:
+def _dense_spec_meta(ctx: ProcessorContext, spec: nn_mod.MLPSpec,
+                     meta: Optional[Dict] = None) -> Dict:
     mc = ctx.model_config
-    _, meta = _load_dense_training_data(ctx)
-    kind = {"NN": "nn", "LR": "lr", "SVM": "lr"}.get(alg.value, "nn")
-    spec_meta = {
+    if meta is None:
+        _, meta = _load_dense_training_data(ctx)
+    out = {
         "spec": {
-            "input_dim": res.spec.input_dim,
-            "hidden_dims": list(res.spec.hidden_dims),
-            "activations": list(res.spec.activations),
-            "output_dim": res.spec.output_dim,
-            "output_activation": res.spec.output_activation,
+            "input_dim": spec.input_dim,
+            "hidden_dims": list(spec.hidden_dims),
+            "activations": list(spec.activations),
+            "output_dim": spec.output_dim,
+            "output_activation": spec.output_activation,
             "dropout_rate": 0.0,  # inference never drops
-            "l2": res.spec.l2, "l1": res.spec.l1,
-            "loss": res.spec.loss, "weight_init": res.spec.weight_init,
+            "l2": spec.l2, "l1": spec.l1,
+            "loss": spec.loss, "weight_init": spec.weight_init,
         },
         "inputNames": meta["denseNames"],
         "normType": mc.normalize.normType.value,
         "modelSetName": mc.model_set_name,
     }
+    if mc.is_multi_classification:
+        out["classes"] = mc.class_tags
+    return out
+
+
+def _save_dense_models(ctx: ProcessorContext, res: TrainResult,
+                       alg: Algorithm) -> None:
+    kind = {"NN": "nn", "LR": "lr", "SVM": "lr"}.get(alg.value, "nn")
+    spec_meta = _dense_spec_meta(ctx, res.spec)
     for i, params in enumerate(res.params_per_bag):
         path = ctx.path_finder.model_path(i, kind)
         ctx.path_finder.ensure(path)
         save_model(path, kind, spec_meta, params)
     log.info("saved %d %s model(s) under %s", len(res.params_per_bag),
              kind, ctx.path_finder.models_path())
+
+
+def _train_dense_ovr(ctx: ProcessorContext, x: np.ndarray, y: np.ndarray,
+                     w: np.ndarray, classes: List[str],
+                     seed: int) -> List[TrainResult]:
+    """ONEVSALL multi-class: class c's model is a binary model on
+    y==c — the reference submits these as parallel one-vs-all
+    regression jobs; here they are sequential jitted trainings sharing
+    the compiled step (identical shapes → one XLA compile).
+    Grid search / k-fold are not combined with ONEVSALL (first combo
+    wins, as the reference never tunes per-class jobs)."""
+    mc = ctx.model_config
+    alg = mc.train.algorithm
+    kind = {"NN": "nn", "LR": "lr", "SVM": "lr"}.get(alg.value, "nn")
+
+    combos = grid_search.expand(mc.train.params)
+    if len(combos) > 1 or (mc.train.numKFold or 0) > 1:
+        log.warning("ONEVSALL: grid search / k-fold ignored; using the "
+                    "first parameter combination")
+    params0 = combos[0]
+
+    def make_spec():
+        if alg is Algorithm.LR:
+            return _lr_spec(params0, x.shape[1])
+        if alg is Algorithm.SVM:
+            return _svm_spec(params0, x.shape[1])
+        return nn_mod.MLPSpec.from_train_params(params0, x.shape[1])
+
+    conf = _conf_with_params(mc.train, params0)
+    conf.baggingNum = 1  # one model per class, like one job per class
+    _, norm_meta = _load_dense_training_data(ctx)
+    results: List[TrainResult] = []
+    for c in range(len(classes)):
+        y_c = (y == c).astype(np.float32)
+        res = train_nn(conf, x, y_c, w, seed=seed + c, spec=make_spec())
+        meta = _dense_spec_meta(ctx, res.spec, norm_meta)
+        meta["ovaClass"] = c
+        path = ctx.path_finder.model_path(c, kind)
+        ctx.path_finder.ensure(path)
+        save_model(path, kind, meta, res.params_per_bag[0])
+        results.append(res)
+        log.info("one-vs-all class %d (%s): best val err %.6f", c,
+                 classes[c], float(res.best_val.min()))
+    # per-class validation curves, one entry per class model
+    vpath = ctx.path_finder.val_error_path()
+    ctx.path_finder.ensure(vpath)
+    with open(vpath, "w") as f:
+        json.dump({"bestValError": [float(r.best_val.min()) for r in results],
+                   "bestEpoch": [int(r.best_epoch[0]) for r in results],
+                   "wallSeconds": sum(r.wall_seconds for r in results),
+                   "classes": [str(c) for c in classes]}, f, indent=1)
+    return results
 
 
 def _write_val_errors(ctx: ProcessorContext, res: TrainResult) -> None:

@@ -11,11 +11,14 @@ import numpy as np
 
 
 def make_raw_frame(rng, n_rows: int = 2000, n_num: int = 6, n_cat: int = 2,
-                   missing_rate: float = 0.02):
+                   missing_rate: float = 0.02, n_classes: int = 2):
     """Returns (header, rows, y) where informative numeric columns are
     Gaussians shifted by class and categoricals have class-skewed
-    frequencies."""
-    y = (rng.random(n_rows) < 0.35).astype(int)
+    frequencies. n_classes>2 produces tags c0..c{K-1}."""
+    if n_classes > 2:
+        y = rng.integers(0, n_classes, n_rows)
+    else:
+        y = (rng.random(n_rows) < 0.35).astype(int)
     cols = {}
     for j in range(n_num):
         shift = (j + 1) * 0.5 if j % 2 == 0 else 0.0  # odd columns are noise
@@ -37,14 +40,18 @@ def make_raw_frame(rng, n_rows: int = 2000, n_num: int = 6, n_cat: int = 2,
         cols[name] = v
     cols["wgt"] = np.round(rng.uniform(0.5, 2.0, n_rows), 4).astype(str)
     cols["rowid"] = np.arange(n_rows).astype(str)
-    cols["diagnosis"] = np.where(y == 1, "M", "B")
+    if n_classes > 2:
+        cols["diagnosis"] = np.array([f"c{v}" for v in y])
+    else:
+        cols["diagnosis"] = np.where(y == 1, "M", "B")
     header = list(cols.keys())
     rows = np.stack([cols[h] for h in header], axis=1)
     return header, rows, y
 
 
 def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
-                   algorithm: str = "NN", train_params: dict | None = None):
+                   algorithm: str = "NN", train_params: dict | None = None,
+                   n_classes: int = 2, multi_classify: str = "NATIVE"):
     root = os.path.join(str(tmp_path), "ModelSet")
     data_dir = os.path.join(root, "data")
     eval_dir = os.path.join(root, "evaldata")
@@ -52,7 +59,11 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
     os.makedirs(eval_dir, exist_ok=True)
     os.makedirs(os.path.join(root, "columns"), exist_ok=True)
 
-    header, rows, _ = make_raw_frame(rng, n_rows)
+    header, rows, _ = make_raw_frame(rng, n_rows, n_classes=n_classes)
+    if n_classes > 2:
+        pos_tags, neg_tags = ["c0"], [f"c{k}" for k in range(1, n_classes)]
+    else:
+        pos_tags, neg_tags = ["M"], ["B"]
     with open(os.path.join(data_dir, ".pig_header"), "w") as f:
         f.write("|".join(header) + "\n")
     split = int(n_rows * 0.8)
@@ -78,7 +89,7 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
             "headerPath": os.path.join(data_dir, ".pig_header"),
             "headerDelimiter": "|", "filterExpressions": "",
             "weightColumnName": "wgt", "targetColumnName": "diagnosis",
-            "posTags": ["M"], "negTags": ["B"],
+            "posTags": pos_tags, "negTags": neg_tags,
             "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"],
             "metaColumnNameFile": os.path.join(root, "columns", "meta.column.names"),
             "categoricalColumnNameFile": os.path.join(root, "columns",
@@ -102,6 +113,7 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
             "numTrainEpochs": 40, "epochsPerIteration": 1,
             "trainOnDisk": False, "isContinuous": False,
             "workerThreadCount": 4, "algorithm": algorithm,
+            "multiClassifyMethod": multi_classify,
             "params": train_params or {
                 "NumHiddenLayers": 1, "ActivationFunc": ["tanh"],
                 "NumHiddenNodes": [10], "RegularizedConstant": 0.0,
@@ -115,7 +127,7 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
                 "headerDelimiter": "|", "filterExpressions": "",
                 "weightColumnName": "wgt",
                 "targetColumnName": "diagnosis",
-                "posTags": ["M"], "negTags": ["B"],
+                "posTags": pos_tags, "negTags": neg_tags,
                 "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"]},
             "performanceBucketNum": 10, "performanceScoreSelector": "mean",
             "scoreMetaColumnNameFile": "", "customPaths": {}}],

@@ -74,6 +74,26 @@ def test_portable_nn_matches_native(trained_nn):
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+def test_portable_softmax_matches_native():
+    """NATIVE multi-class specs (softmax head) score identically through
+    the numpy-only forward."""
+    import jax
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.portable import mlp_forward
+    spec = nn_mod.MLPSpec(input_dim=5, hidden_dims=(8,),
+                          activations=("tanh",), output_dim=3,
+                          output_activation="softmax", loss="log")
+    params = nn_mod.init_params(spec, jax.random.PRNGKey(3))
+    x = np.random.default_rng(0).normal(0, 1, (16, 5)).astype(np.float32)
+    native = np.asarray(nn_mod.forward(spec, params, x))
+    np_params = jax.tree.map(np.asarray, params)
+    portable = mlp_forward(
+        {"activations": ["tanh"], "output_activation": "softmax",
+         "output_dim": 3}, np_params, x)
+    np.testing.assert_allclose(native, portable, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(portable.sum(axis=1), 1.0, rtol=1e-5)
+
+
 @pytest.mark.parametrize("algorithm", ["GBT", "RF"])
 def test_portable_trees_match_native(tmp_path, rng, algorithm):
     from tests.synth import make_model_set

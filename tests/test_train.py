@@ -186,6 +186,35 @@ def test_full_pipeline_lr(tmp_path, rng):
     assert perf["areaUnderRoc"] > 0.85
 
 
+@pytest.mark.parametrize("method", ["NATIVE", "ONEVSALL"])
+def test_full_pipeline_multiclass(tmp_path, rng, method):
+    """3-class pipeline: NATIVE = softmax head, ONEVSALL = one binary
+    model per class (the reference's multiClassifyMethod decomposition,
+    ModelTrainConf.java:74-90)."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=2400, n_classes=3,
+                          multi_classify=method,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [12],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM"})
+    ctx = run_pipeline(root)
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    # classes are linearly shifted in feature space → far above chance
+    assert perf["accuracy"] > 0.55
+    assert perf["classes"] == ["c0", "c1", "c2"]
+    assert len(perf["perClass"]) == 3
+    n_models = 3 if method == "ONEVSALL" else 1
+    assert os.path.exists(ctx.path_finder.model_path(n_models - 1, "nn"))
+    assert os.path.exists(ctx.path_finder.eval_confusion_path("Eval1"))
+    with open(ctx.path_finder.eval_score_path("Eval1")) as f:
+        header = f.readline().strip().split(",")
+    assert header == ["tag", "weight", "class0", "class1", "class2",
+                      "predicted"]
+
+
 def test_grid_search_selects_best(tmp_path, rng):
     from tests.synth import make_model_set
     root = make_model_set(
