@@ -83,6 +83,10 @@ def cmd_stats(args) -> int:
         from shifu_tpu.processor import psi as p
         return p.run(ctx)
     from shifu_tpu.processor import stats as p
+    if args.rebin:
+        return p.run_rebin(ctx, request_vars=args.vars,
+                           expect_bin_num=args.n,
+                           iv_keep_ratio=args.ivr, min_inst_cnt=args.bic)
     return p.run(ctx)
 
 
@@ -179,6 +183,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("stats", help="column stats + binning")
     p.add_argument("-correlation", "--correlation", action="store_true")
     p.add_argument("-psi", "--psi", action="store_true")
+    p.add_argument("-rebin", "--rebin", action="store_true",
+                   help="merge existing bins for higher-IV coarse binning")
+    p.add_argument("-vars", "--vars", default=None,
+                   help="comma-separated columns to rebin")
+    p.add_argument("-n", type=int, default=-1,
+                   help="expected max bin number after rebin")
+    p.add_argument("-ivr", type=float, default=1.0,
+                   help="IV keep ratio while shrinking bins")
+    p.add_argument("-bic", type=int, default=0,
+                   help="minimum instance count per bin")
     p.set_defaults(fn=cmd_stats)
     for alias in ("norm", "normalize"):
         sub.add_parser(alias, help="normalize data").set_defaults(fn=cmd_norm)

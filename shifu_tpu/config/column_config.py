@@ -276,6 +276,12 @@ class ColumnConfig:
     # -- predicates mirroring ColumnConfig.java -----------------------------
 
     @property
+    def is_segment(self) -> bool:
+        """Segment-expansion copy (`ColumnConfig.isSegment`); round-trips
+        through _extras as the JSON `segment` property."""
+        return bool(self._extras.get("segment", False))
+
+    @property
     def is_target(self) -> bool:
         return self.columnFlag is ColumnFlag.Target
 

@@ -65,6 +65,9 @@ class PathFinder:
     def psi_path(self) -> str:
         return self._p(self.STATS_DIR, "psi.csv")
 
+    def date_stats_path(self) -> str:
+        return self._p(self.STATS_DIR, "DateStats.csv")
+
     # -- models -------------------------------------------------------------
     def models_path(self) -> str:
         return self._p(self.MODELS_DIR)

@@ -85,6 +85,22 @@ def parse_tags(raw: np.ndarray, pos_tags: Sequence[str],
     return out
 
 
+def valid_tag_mask(mc: ModelConfig, df: pd.DataFrame) -> np.ndarray:
+    """The keep-mask build_columnar applies (invalid-tag rows dropped);
+    exposed so callers can align row-parallel arrays taken from the raw
+    frame (e.g. the date column) with the built dataset."""
+    from shifu_tpu.data.reader import simple_column_name
+    names = [simple_column_name(t)
+             for t in mc.dataSet.targetColumnName.split("|") if t.strip()]
+    tgt = names[0] if names else None
+    if not tgt or tgt not in df.columns:
+        return np.ones(len(df), bool)
+    classes = mc.class_tags if mc.is_multi_classification else None
+    tags = parse_tags(df[tgt].astype(str).str.strip().to_numpy(),
+                      mc.pos_tags, mc.neg_tags, classes)
+    return ~np.isnan(tags)
+
+
 def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
                    df: pd.DataFrame,
                    vocabs: Optional[Dict[int, List[str]]] = None,
@@ -133,7 +149,10 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
         if cc.is_categorical:
             if vocabs is not None and cc.columnNum in vocabs:
                 vocab = list(vocabs[cc.columnNum])
-                lut = {v: i for i, v in enumerate(vocab)}
+                # after `stats -rebin`, entries may be "@^"-joined
+                # category groups; every member maps to the group's bin
+                from shifu_tpu.ops.rebin import expand_group_vocab
+                lut = expand_group_vocab(vocab)
                 codes = sv.map(lut).fillna(MISSING_CODE).to_numpy(np.int32)
             else:
                 uniq = sorted(set(sv[~miss_mask].tolist()))

@@ -1,0 +1,109 @@
+"""Segment (column) expansion — per-segment variable copies.
+
+The reference's "Segment Expansion Support" (CHANGES.txt): the user
+lists JEXL filter expressions, one per line, in
+`dataSet#segExpressionFile` (`ModelConfig.getSegmentFilterExpressions`,
+`container/obj/ModelConfig.java:887-905`). With K expressions and N
+base columns, every column i gains K copies named `<name>_seg<k>`
+(`MapReducerStatsWorker.java:660-672`) with columnNum = k*N + i
+(`util/updater/BasicUpdater.java:231-249`), marked `segment: true`.
+A segment copy's value is the base value on rows passing filter k and
+missing otherwise — stats UDFs only emit matching rows
+(`udf/AddColumnNumAndFilterUDF.java:181-217`) and normalization feeds
+segments like any other column (`udf/NormalizeUDF.java:395`).
+
+Here the expansion happens once on the raw frame (masked copies with a
+missing token), so the columnar/stats/norm/training kernels treat
+segment columns exactly like base columns. Deviation from the
+reference: Target AND Weight flags both become Meta on copies (the
+reference only remaps Target, leaving a second Weight column — a
+latent bug we do not reproduce); the filter-with-new-tag variant
+(`DataPurifier.isNewTag`) is not supported.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import List, Optional
+
+import pandas as pd
+
+from shifu_tpu.config.column_config import ColumnConfig, ColumnFlag
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.data.purifier import DataPurifier
+
+log = logging.getLogger("shifu_tpu")
+
+_SEG_SUFFIX = re.compile(r"_seg[0-9]+$")
+
+
+def seg_name(name: str, k: int) -> str:
+    return f"{name}_seg{k}"
+
+
+def base_name(name: str) -> str:
+    """Strip the `_seg<k>` suffix (`CommonUtils.getSimpleColumnName`
+    regex, CommonUtils.java:1696)."""
+    return _SEG_SUFFIX.sub("", name)
+
+
+def segment_expressions(mc: ModelConfig) -> List[str]:
+    """Filter expressions from dataSet#segExpressionFile, one per line;
+    blank lines and #-comments skipped. Missing file → warn + empty
+    (ModelConfig.java:899)."""
+    f = str(mc.dataSet._extras.get("segExpressionFile") or "").strip()
+    if not f:
+        return []
+    path = mc.resolve_path(f)
+    if not os.path.exists(path):
+        log.warning("segExpressionFile %s does not exist; segment "
+                    "expansion disabled", path)
+        return []
+    with open(path) as fh:
+        return [ln.strip() for ln in fh
+                if ln.strip() and not ln.strip().startswith("#")]
+
+
+def expand_column_configs(base: List[ColumnConfig],
+                          exprs: List[str]) -> List[ColumnConfig]:
+    """Segment ColumnConfigs for K expressions: copy k of column i gets
+    columnNum = k*N + i and name `<name>_seg<k>`
+    (BasicUpdater.java:238-241, MapReducerStatsWorker.java:655-672)."""
+    n = len(base)
+    out: List[ColumnConfig] = []
+    for k in range(1, len(exprs) + 1):
+        for cc in base:
+            flag = cc.columnFlag
+            if flag in (ColumnFlag.Target, ColumnFlag.Weight):
+                flag = ColumnFlag.Meta
+            seg = ColumnConfig(
+                columnNum=k * n + cc.columnNum,
+                columnName=seg_name(cc.columnName, k),
+                version=cc.version, columnType=cc.columnType,
+                columnFlag=flag)
+            seg._extras["segment"] = True
+            out.append(seg)
+    return out
+
+
+def expand_raw_frame(df: pd.DataFrame, mc: ModelConfig, exprs: List[str],
+                     only_bases: Optional[set] = None) -> pd.DataFrame:
+    """Append `<col>_seg<k>` columns: base value where filter k passes,
+    the missing token elsewhere (so every downstream kernel sees a
+    normal column with extra missing rows). `only_bases` limits copies
+    to those base columns (skip copies nobody will consume)."""
+    if not exprs:
+        return df
+    missing_token = (mc.dataSet.missingOrInvalidValues or [""])[0]
+    wanted = [c for c in df.columns
+              if only_bases is None or c in only_bases]
+    parts = {col: df[col] for col in df.columns}
+    for k, expr in enumerate(exprs, start=1):
+        mask = pd.Series(DataPurifier(expr).apply(df), index=df.index)
+        for col in wanted:
+            parts[seg_name(col, k)] = df[col].where(mask, missing_token)
+    return pd.DataFrame(parts)
+
+

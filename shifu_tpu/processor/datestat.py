@@ -1,0 +1,112 @@
+"""Per-date per-column stats — variable stability over time.
+
+Replaces the reference's date-stats MapReduce job
+(`core/datestat/DateStatComputeMapper.java` + `DateStatComputeReducer`,
+wired in `MapReducerStatsWorker.java:296-321`): when
+`dataSet#dateColumnName` is set, every numeric column gets count /
+missing / mean / stdDev / min / max / sum and pos-neg counts per
+distinct date value, for monitoring drift across time.
+
+TPU formulation: the date column becomes segment ids and every metric
+is one `jax.ops.segment_sum`/`segment_min`/`segment_max` over the
+(rows × columns) matrix — the MR shuffle-by-(date,column) becomes a
+device scatter-add.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.data.dataset import ColumnarDataset
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def date_column_name(mc) -> str:
+    return str(mc.dataSet._extras.get("dateColumnName") or "").strip()
+
+
+def compute_date_stats(values: np.ndarray, tags: np.ndarray,
+                       date_ids: np.ndarray, n_dates: int):
+    """(R, C) values + (R,) date segment ids → dict of (D, C) arrays."""
+    v = jnp.asarray(values)
+    miss = jnp.isnan(v)
+    filled = jnp.where(miss, 0.0, v)
+    ids = jnp.asarray(date_ids)
+    pos = jnp.asarray((tags > 0.5).astype(np.float32))[:, None]
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, ids, n_dates)
+
+    cnt = seg_sum(jnp.where(miss, 0.0, 1.0))
+    s = seg_sum(filled)
+    s2 = seg_sum(jnp.square(filled))
+    missing = seg_sum(miss.astype(jnp.float32))
+    pos_cnt = seg_sum(jnp.broadcast_to(pos, v.shape) * (~miss))
+    vmin = jax.ops.segment_min(jnp.where(miss, jnp.inf, v), ids, n_dates)
+    vmax = jax.ops.segment_max(jnp.where(miss, -jnp.inf, v), ids, n_dates)
+    mean = s / jnp.maximum(cnt, 1.0)
+    var = s2 / jnp.maximum(cnt, 1.0) - jnp.square(mean)
+    return {k: np.asarray(a) for k, a in {
+        "count": cnt, "missing": missing, "sum": s, "mean": mean,
+        "stdDev": jnp.sqrt(jnp.maximum(var, 0.0)), "min": vmin, "max": vmax,
+        "posCount": pos_cnt}.items()}
+
+
+def run(ctx: ProcessorContext, df=None,
+        dataset: Optional[ColumnarDataset] = None) -> int:
+    """Compute + write DateStats.csv. `df` (the already-read, filtered
+    raw frame) avoids a second table read when called from stats; the
+    built dataset drops invalid-tag rows, so the date column is aligned
+    through the same valid-tag mask."""
+    t0 = time.time()
+    mc = ctx.model_config
+    date_col = date_column_name(mc)
+    if not date_col:
+        log.warning("dataSet#dateColumnName not set; skipping date stats")
+        return 0
+    ctx.require_columns()
+
+    from shifu_tpu.data.dataset import build_columnar, valid_tag_mask
+    if df is None:
+        from shifu_tpu.data.purifier import DataPurifier
+        from shifu_tpu.data.reader import read_raw_table
+        df = read_raw_table(mc)
+        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+        df = df[keep].reset_index(drop=True)
+    if date_col not in df.columns:
+        raise ValueError(f"dateColumnName {date_col!r} not in data "
+                         f"header {list(df.columns)[:8]}...")
+    valid = valid_tag_mask(mc, df)
+    dates_raw = df[date_col].astype(str).str.strip().to_numpy()[valid]
+    if dataset is None:
+        dataset = build_columnar(
+            mc, [c for c in ctx.column_configs if not c.is_segment], df)
+    assert len(dates_raw) == dataset.num_rows, \
+        "date column misaligned with built dataset"
+
+    uniq, date_ids = np.unique(dates_raw, return_inverse=True)
+    stats = compute_date_stats(dataset.numeric, dataset.tags,
+                               date_ids.astype(np.int32), len(uniq))
+
+    out = ctx.path_finder.date_stats_path()
+    ctx.path_finder.ensure(out)
+    metrics = ["count", "missing", "mean", "stdDev", "min", "max", "sum",
+               "posCount"]
+    with open(out, "w") as f:
+        f.write("date,column," + ",".join(metrics) + "\n")
+        for d in range(len(uniq)):
+            for j, name in enumerate(dataset.num_names):
+                f.write(f"{uniq[d]},{name},"
+                        + ",".join(f"{stats[m][d, j]:.6g}" for m in metrics)
+                        + "\n")
+    log.info("date stats: %d dates × %d columns → %s in %.2fs",
+             len(uniq), len(dataset.num_names), out, time.time() - t0)
+    return 0

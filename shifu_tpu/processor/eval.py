@@ -60,6 +60,11 @@ def effective_dataset_conf(mc: ModelConfig, ec: EvalConfig):
         ds.negTags = base.negTags
     if not ds.missingOrInvalidValues:
         ds.missingOrInvalidValues = base.missingOrInvalidValues
+    if "segExpressionFile" not in ds._extras and \
+            base._extras.get("segExpressionFile"):
+        # segment expansion applies to eval data too (EvalScoreUDF segs)
+        ds._extras = dict(ds._extras,
+                          segExpressionFile=base._extras["segExpressionFile"])
     return ds
 
 

@@ -56,6 +56,16 @@ def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
     if apply_filter and ds_conf.filterExpressions:
         keep = DataPurifier(ds_conf.filterExpressions).apply(df)
         df = df[keep].reset_index(drop=True)
+    if any(c.is_segment for c in ccs):
+        # segment columns were created by stats; recreate their masked
+        # raw values on this read (NormalizeUDF seg handling), copying
+        # only the base columns whose seg copies will be consumed
+        from shifu_tpu.data import segment
+        bases = {segment.base_name(c.columnName)
+                 for c in cols if c.is_segment}
+        df = segment.expand_raw_frame(df, mc,
+                                      segment.segment_expressions(mc),
+                                      only_bases=bases)
     vocabs = {c.columnNum: (c.columnBinning.binCategory or [])
               for c in cols if c.is_categorical}
     return build_columnar(mc, _restrict(ccs, cols), df, vocabs=vocabs)
@@ -92,22 +102,50 @@ def normalize_columns(mc: ModelConfig, cols: List[ColumnConfig],
         dset.cat_codes, dset.cat_names, cat_tbl)
 
 
+def precision_type(mc: ModelConfig) -> str:
+    """Output precision of normalized values
+    (`udf/norm/PrecisionType.java:20-56`): FLOAT7 / FLOAT16 / FLOAT32 /
+    DOUBLE64, from -Dshifu.precision.type or normalize#precisionType."""
+    p = str(os.environ.get("shifu.precision.type")
+            or mc.normalize._extras.get("precisionType")
+            or "FLOAT32").upper()
+    if p not in ("FLOAT7", "FLOAT16", "FLOAT32", "DOUBLE64"):
+        raise ValueError(f"unknown precisionType {p!r}; expected one of "
+                         "FLOAT7/FLOAT16/FLOAT32/DOUBLE64")
+    return p
+
+
+def apply_precision(dense: np.ndarray, ptype: str) -> np.ndarray:
+    """Quantize the dense block. FLOAT16 rounds through half precision
+    (storage stays float32 — TPUs compute in bf16/f32 anyway, this
+    reproduces the reference's value truncation, not its byte layout)."""
+    if ptype == "FLOAT16":
+        return dense.astype(np.float16).astype(np.float32)
+    if ptype == "DOUBLE64":
+        return dense.astype(np.float64)
+    if ptype == "FLOAT7":  # 7 fraction digits (PrecisionType DECIMAL_FORMAT)
+        return np.round(dense.astype(np.float32), 7)
+    return dense.astype(np.float32)
+
+
 def save_normalized(path: str, result: NormResult, tags: np.ndarray,
                     weights: np.ndarray,
-                    task_tags: Optional[np.ndarray] = None) -> None:
+                    task_tags: Optional[np.ndarray] = None,
+                    ptype: str = "FLOAT32") -> None:
     os.makedirs(path, exist_ok=True)
     extra = {}
     if task_tags is not None and task_tags.size:
         extra["task_tags"] = task_tags.astype(np.float32)
     np.savez_compressed(
         os.path.join(path, "data.npz"),
-        dense=result.dense, index=result.index,
+        dense=apply_precision(result.dense, ptype), index=result.index,
         tags=tags.astype(np.float32), weights=weights.astype(np.float32),
         **extra)
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,
-                   "indexVocabSizes": result.index_vocab_sizes}, f, indent=1)
+                   "indexVocabSizes": result.index_vocab_sizes,
+                   "precisionType": ptype}, f, indent=1)
 
 
 def load_normalized(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
@@ -129,7 +167,7 @@ def run(ctx: ProcessorContext,
     result = normalize_columns(mc, cols, dataset)
     out = ctx.path_finder.normalized_data_path()
     save_normalized(out, result, dataset.tags, dataset.weights,
-                    task_tags=dataset.task_tags)
+                    task_tags=dataset.task_tags, ptype=precision_type(mc))
 
     # cleaned data for tree algorithms: raw numeric (NaN = missing, trees
     # route it explicitly) + category codes with missing → vocab_len slot

@@ -43,6 +43,7 @@ def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
     ctx.validate(ModelStep.STATS)
     ctx.require_columns()
     ccs = ctx.column_configs
+    df = None
 
     if dataset is None:
         df = read_raw_table(mc)
@@ -60,19 +61,61 @@ def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
                 samp |= tgt.isin(mc.pos_tags).to_numpy()
             keep &= samp
         df = df[keep].reset_index(drop=True)
-        dataset = build_columnar(mc, ccs, df)
+        dataset = build_columnar(mc, [c for c in ccs if not c.is_segment],
+                                 df)
 
     compute_stats(ctx, dataset)
+
+    # segment expansion: per-segment ColumnConfig copies whose stats are
+    # computed over only the rows passing that segment's filter (the
+    # stats UDF emits seg tuples only for matching rows,
+    # AddColumnNumAndFilterUDF.java:181-217; configs created like
+    # MapReducerStatsWorker.java:655-672)
+    from shifu_tpu.data import segment
+    exprs = segment.segment_expressions(mc)
+    if df is not None and not exprs and any(c.is_segment for c in ccs):
+        # expressions removed since the last run: drop orphaned copies
+        ccs = [c for c in ccs if not c.is_segment]
+        ctx.column_configs = ccs
+    if exprs and df is not None:
+        # rebuild seg configs from scratch each run — the expression
+        # list may have changed, and stats refills them anyway
+        base = [c for c in ccs if not c.is_segment]
+        ccs = base + segment.expand_column_configs(base, exprs)
+        ctx.column_configs = ccs
+        n_base = len(base)
+        by_num = {c.columnNum: c for c in ccs}
+        for k, expr in enumerate(exprs, start=1):
+            mask = DataPurifier(expr).apply(df)
+            sub = df[mask].reset_index(drop=True)
+            dset_k = build_columnar(mc, base, sub)
+            cc_map = {c.columnNum: by_num[k * n_base + c.columnNum]
+                      for c in base}
+            compute_stats(ctx, dset_k, cc_map=cc_map)
+            log.info("segment %d (%s): %d/%d rows", k, expr,
+                     int(mask.sum()), len(df))
     ctx.save_column_configs()
+
+    # per-date per-column stats job analog, config-driven like the
+    # reference (runs when dataSet#dateColumnName is set,
+    # MapReducerStatsWorker.java:296-321); reuses this run's filtered +
+    # sampled frame so DateStats counts stay consistent with columnStats
+    from shifu_tpu.processor import datestat
+    if datestat.date_column_name(mc):
+        datestat.run(ctx, df=df, dataset=dataset if df is not None else None)
+
     log.info("stats: %d rows, %d num + %d cat columns in %.2fs",
              dataset.num_rows, len(dataset.num_names), len(dataset.cat_names),
              time.time() - t0)
     return 0
 
 
-def compute_stats(ctx: ProcessorContext, dset: ColumnarDataset) -> None:
+def compute_stats(ctx: ProcessorContext, dset: ColumnarDataset,
+                  cc_map=None) -> None:
+    """Fill stats into ColumnConfigs; `cc_map` redirects a dataset
+    column's number to a different target config (segment copies)."""
     mc = ctx.model_config
-    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
+    cc_by_num = cc_map or {c.columnNum: c for c in ctx.column_configs}
     tags, weights = dset.tags, dset.weights
     jt, jw = jnp.asarray(tags), jnp.asarray(weights)
     max_bins = mc.stats.maxNumBin
@@ -233,3 +276,30 @@ def _fill_categorical(cc: ColumnConfig, orig_vocab, vocab, j: int, counts,
         st.mean, st.stdDev = 0.0, 0.0
     st.ks, st.iv, st.woe = ks, iv, woe
     st.weightedKs, st.weightedIv, st.weightedWoe = wks, wiv, wwoe
+
+
+def run_rebin(ctx: ProcessorContext, request_vars: Optional[str] = None,
+              expect_bin_num: int = -1, iv_keep_ratio: float = 1.0,
+              min_inst_cnt: int = 0) -> int:
+    """`shifu stats -rebin [-vars a,b] [-n N] [-ivr r] [-bic c]` —
+    merge existing bins per column for higher-IV coarser binning, no
+    data pass needed (StatsModelProcessor.java:173-218, doReBin:712)."""
+    from shifu_tpu.ops.rebin import rebin_column
+    ctx.require_columns()
+    wanted = {v.strip() for v in (request_vars or "").split(",") if v.strip()}
+    n_done = 0
+    for cc in ctx.column_configs:
+        if wanted and cc.columnName not in wanted:
+            continue
+        if not cc.is_candidate:
+            if wanted:
+                log.warning("column %s is not a good candidate, skip",
+                            cc.columnName)
+            continue
+        if rebin_column(cc, expect_bin_num=expect_bin_num,
+                        iv_keep_ratio=iv_keep_ratio,
+                        min_inst_cnt=min_inst_cnt):
+            n_done += 1
+    ctx.save_column_configs()
+    log.info("rebin: %d column(s) re-binned", n_done)
+    return 0

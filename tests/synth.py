@@ -51,7 +51,8 @@ def make_raw_frame(rng, n_rows: int = 2000, n_num: int = 6, n_cat: int = 2,
 
 def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
                    algorithm: str = "NN", train_params: dict | None = None,
-                   n_classes: int = 2, multi_classify: str = "NATIVE"):
+                   n_classes: int = 2, multi_classify: str = "NATIVE",
+                   seg_expressions: list | None = None):
     root = os.path.join(str(tmp_path), "ModelSet")
     data_dir = os.path.join(root, "data")
     eval_dir = os.path.join(root, "evaldata")
@@ -132,6 +133,12 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
             "performanceBucketNum": 10, "performanceScoreSelector": "mean",
             "scoreMetaColumnNameFile": "", "customPaths": {}}],
     }
+    if seg_expressions:
+        seg_file = os.path.join(root, "columns", "segments.txt")
+        with open(seg_file, "w") as f:
+            f.write("\n".join(seg_expressions) + "\n")
+        mc["dataSet"]["segExpressionFile"] = seg_file
+
     with open(os.path.join(root, "ModelConfig.json"), "w") as f:
         json.dump(mc, f, indent=2)
     return root

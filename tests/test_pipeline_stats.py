@@ -114,3 +114,147 @@ def test_woe_norm_values_match_lut(statsed):
     expect = np.asarray(cat0.columnBinning.binCountWoe)
     for g in got:
         assert np.isclose(expect, g, atol=1e-5).any(), g
+
+
+def test_segment_expansion_pipeline(tmp_path, rng):
+    """Segment expansion: K filter expressions create per-segment column
+    copies (columnNum = k*N + i, `<name>_seg<k>`) whose stats cover
+    only filter-passing rows, and that flow through norm/train/eval
+    (BasicUpdater.java:231-249, AddColumnNumAndFilterUDF.java:181-217)."""
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import eval as eval_proc
+    from shifu_tpu.processor import train as train_proc
+
+    root = make_model_set(tmp_path, rng, n_rows=1500,
+                          seg_expressions=["num_1 > 0"])
+    ctx = ProcessorContext.load(root)
+    assert init_proc.run(ctx) == 0
+    n_base = len(ctx.column_configs)
+    ctx = ProcessorContext.load(root)
+    assert stats_proc.run(ctx) == 0
+
+    ccs = load_column_configs(os.path.join(root, "ColumnConfig.json"))
+    assert len(ccs) == 2 * n_base
+    seg = next(c for c in ccs if c.columnName == "num_0_seg1")
+    base = next(c for c in ccs if c.columnName == "num_0")
+    assert seg.is_segment and not base.is_segment
+    assert seg.columnNum == base.columnNum + n_base
+    # segment stats cover only the filtered subpopulation
+    assert 0 < seg.columnStats.totalCount < base.columnStats.totalCount
+    assert seg.columnStats.ks is not None
+    # target/weight copies are demoted to Meta
+    tgt_seg = next(c for c in ccs if c.columnName == "diagnosis_seg1")
+    assert tgt_seg.is_meta and not tgt_seg.is_target
+
+    for proc in (norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    assert any(n.endswith("_seg1") for n in meta["denseNames"])
+    ctx = ProcessorContext.load(root)
+    assert eval_proc.run(ctx) == 0
+    perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
+    assert perf["areaUnderRoc"] > 0.85
+
+
+def test_rebin_merges_bins_and_keeps_iv(statsed):
+    """`stats -rebin` merges bins down while retaining IV
+    (ColumnConfigDynamicBinning.run + AutoDynamicBinning.merge)."""
+    ctx = ProcessorContext.load(statsed)
+    before = {c.columnName: (len(c.columnBinning.binCountPos or []),
+                             c.columnStats.iv)
+              for c in ctx.column_configs if c.is_candidate}
+    assert stats_proc.run_rebin(ctx, expect_bin_num=4) == 0
+
+    ctx2 = ProcessorContext.load(statsed)
+    for cc in ctx2.column_configs:
+        if not cc.is_candidate or cc.columnName not in before:
+            continue
+        n_before, iv_before = before[cc.columnName]
+        n_after = len(cc.columnBinning.binCountPos or [])
+        assert n_after <= max(n_before, 5)
+        assert n_after <= 5  # 4 bins + missing
+        # count/boundary arrays stay consistent
+        bn = cc.columnBinning
+        if cc.is_categorical:
+            assert len(bn.binCategory) == n_after - 1
+        else:
+            assert len(bn.binBoundary) == n_after - 1
+        assert bn.length == n_after - 1  # real bins, missing slot excluded
+        if iv_before is not None and iv_before > 0:
+            assert cc.columnStats.iv is not None
+            assert cc.columnStats.iv <= iv_before + 1e-9
+
+    # re-norm still works with "@^"-grouped categories
+    ctx3 = ProcessorContext.load(statsed)
+    assert norm_proc.run(ctx3) == 0
+
+
+def test_rebin_grouped_vocab_lut():
+    from shifu_tpu.ops.rebin import expand_group_vocab
+    lut = expand_group_vocab(["aa@^bb", "cc"])
+    assert lut == {"aa": 0, "bb": 0, "cc": 1}
+
+
+def test_date_stats(tmp_path, rng):
+    """Per-date per-column stats (DateStatComputeMapper analog) written
+    when dataSet#dateColumnName is set."""
+    import pandas as pd
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import datestat
+
+    root = make_model_set(tmp_path, rng, n_rows=900)
+    # inject a date column into data + header + config
+    data_file = os.path.join(root, "data", "part-00000")
+    hdr_file = os.path.join(root, "data", ".pig_header")
+    hdr = open(hdr_file).read().strip().split("|")
+    rows = [ln.rstrip("\n").split("|") for ln in open(data_file)]
+    dates = ["2026-07-%02d" % (1 + i % 3) for i in range(len(rows))]
+    with open(hdr_file, "w") as f:
+        f.write("|".join(hdr + ["dt"]) + "\n")
+    with open(data_file, "w") as f:
+        for r, d in zip(rows, dates):
+            f.write("|".join(r + [d]) + "\n")
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["dataSet"]["dateColumnName"] = "dt"
+    # dt must be meta so it is not modeled
+    with open(os.path.join(root, "columns", "meta.column.names"), "a") as f:
+        f.write("dt\n")
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+
+    ctx = ProcessorContext.load(root)
+    assert init_proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    assert stats_proc.run(ctx) == 0  # runs date stats automatically
+
+    out = ctx.path_finder.date_stats_path()
+    assert os.path.exists(out)
+    ds = pd.read_csv(out)
+    assert set(ds["date"]) == {"2026-07-01", "2026-07-02", "2026-07-03"}
+    assert set(ds["column"]) == {f"num_{j}" for j in range(6)}
+    one = ds[(ds["date"] == "2026-07-01") & (ds["column"] == "num_0")]
+    assert float(one["count"].iloc[0]) > 0
+    # per-date counts sum to total valid count
+    num0 = ds[ds["column"] == "num_0"]
+    cc = next(c for c in ctx.column_configs if c.columnName == "num_0")
+    assert int(num0["count"].sum() + num0["missing"].sum()) \
+        == cc.columnStats.totalCount
+
+
+@pytest.mark.parametrize("ptype,decimals", [("FLOAT16", 2), ("DOUBLE64", 9)])
+def test_norm_precision_types(statsed, ptype, decimals):
+    """-Dshifu.precision.type quantizes normalized output
+    (udf/norm/PrecisionType.java)."""
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.normalize._extras["precisionType"] = ptype
+    assert norm_proc.run(ctx) == 0
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    assert meta["precisionType"] == ptype
+    if ptype == "FLOAT16":
+        # every value survives a half-precision round trip unchanged
+        d = data["dense"]
+        assert np.allclose(d, d.astype(np.float16).astype(np.float32))
+    else:
+        assert data["dense"].dtype == np.float64
