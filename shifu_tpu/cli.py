@@ -143,6 +143,20 @@ def cmd_encode(args) -> int:
     return p.run(_ctx(args))
 
 
+def cmd_combo(args) -> int:
+    from shifu_tpu.processor import combo as p
+    ctx = _ctx(args)
+    if args.new:
+        return p.new(ctx, args.new)
+    if args.init:
+        return p.init(ctx)
+    if args.run:
+        return p.run(ctx, resume=args.resume)
+    if args.eval:
+        return p.evaluate(ctx)
+    raise SystemExit("combo: pass one of -new ALGS / -init / -run / -eval")
+
+
 def cmd_save(args) -> int:
     from shifu_tpu.processor import manage as p
     return p.save(_ctx(args), args.name)
@@ -218,6 +232,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_test)
     sub.add_parser("encode", help="tree-leaf-path encode the dataset") \
         .set_defaults(fn=cmd_encode)
+    p = sub.add_parser("combo", help="assembled multi-algorithm models")
+    p.add_argument("-new", "--new", default=None, metavar="ALG1,ALG2,...",
+                   help="create ComboTrain.json (last alg = assemble model)")
+    p.add_argument("-init", "--init", action="store_true",
+                   help="scaffold sub-model workspaces")
+    p.add_argument("-run", "--run", action="store_true",
+                   help="train sub-models + assemble model")
+    p.add_argument("-eval", "--eval", action="store_true",
+                   help="evaluate the assembled model")
+    p.add_argument("-resume", "--resume", action="store_true",
+                   help="skip already-trained sub-models")
+    p.set_defaults(fn=cmd_combo)
+
     p = sub.add_parser("save", help="snapshot the model set")
     p.add_argument("name", nargs="?", default=None)
     p.set_defaults(fn=cmd_save)
@@ -230,6 +257,22 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _honor_jax_platforms() -> None:
+    """Make JAX_PLATFORMS authoritative even when a pre-registered
+    accelerator plugin pinned jax_platforms via jax.config at
+    interpreter start (same shim as __graft_entry__.dryrun_multichip);
+    without this, `JAX_PLATFORMS=cpu shifu_tpu ...` can still try —
+    and hang on — an unreachable accelerator backend."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     # -D overrides → environment (ShifuCLI.cleanArgs:468-492)
@@ -237,6 +280,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if "=" in kv:
             k, v = kv.split("=", 1)
             os.environ[k.strip()] = v.strip()
+    _honor_jax_platforms()
     t0 = time.time()
     try:
         rc = args.fn(args)

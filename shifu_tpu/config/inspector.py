@@ -57,7 +57,8 @@ def probe(mc: ModelConfig, step: ModelStep) -> ValidateResult:
         vs = mc.varSelect
         if vs.filterEnable and vs.filterNum <= 0 and vs.filterBy.upper() not in ("FI",):
             r.fail(f"varSelect#filterNum must be positive, got {vs.filterNum}")
-        if vs.filterBy.upper() not in ("KS", "IV", "MIX", "PARETO", "SE", "ST", "FI"):
+        if vs.filterBy.upper() not in ("KS", "IV", "MIX", "PARETO", "SE",
+                                       "ST", "SC", "V", "FI"):
             r.fail(f"varSelect#filterBy unknown: {vs.filterBy}")
     if step is ModelStep.NORMALIZE:
         if not (0.0 < mc.normalize.sampleRate <= 1.0):

@@ -175,6 +175,7 @@ def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask):
     default_left = jnp.zeros(n_nodes, bool)
     is_leaf = jnp.zeros(n_nodes, bool)
     leaf_value = jnp.zeros(n_nodes, jnp.float32)
+    node_gain = jnp.zeros(n_nodes, jnp.float32)  # for feature importance
     node_of_row = jnp.zeros(r, jnp.int32)  # all rows at root
 
     for depth in range(cfg.max_depth):
@@ -189,6 +190,7 @@ def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask):
         feature = feature.at[ids].set(jnp.where(can_split, s["feature"], -1))
         split_bin = split_bin.at[ids].set(s["bin"])
         default_left = default_left.at[ids].set(s["default_left"])
+        node_gain = node_gain.at[ids].set(jnp.where(can_split, s["gain"], 0.0))
         # nodes that don't split become leaves with value -G/(H+λ);
         # g_tot/h_tot are identical across features — take feature 0
         val = -s["g_tot"][:, 0] / (s["h_tot"][:, 0] + cfg.reg_lambda)
@@ -220,7 +222,7 @@ def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask):
     leaf_value = leaf_value.at[ids].set(-g_tot / (h_tot + cfg.reg_lambda))
     return {"feature": feature, "bin": split_bin,
             "default_left": default_left, "is_leaf": is_leaf,
-            "leaf_value": leaf_value}
+            "leaf_value": leaf_value, "gain": node_gain}
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins"))

@@ -68,6 +68,18 @@ def effective_dataset_conf(mc: ModelConfig, ec: EvalConfig):
     return ds
 
 
+def score_meta_columns(ctx: ProcessorContext, ec: EvalConfig) -> List[str]:
+    """Champion/benchmark score column names
+    (`EvalConfig#scoreMetaColumnNameFile`, capped at 5 —
+    EvalModelProcessor.java:686-691)."""
+    names = ctx.model_config.column_names_from_file(
+        ec.scoreMetaColumnNameFile)
+    if len(names) > 5:
+        raise ValueError("scoreMetaColumns is limited to at most 5 "
+                         "benchmark score columns")
+    return names
+
+
 def score_eval_set(ctx: ProcessorContext, ec: EvalConfig):
     """Read + normalize + ensemble-score one eval set. Returns
     (scores dict, tags, weights)."""
@@ -78,8 +90,9 @@ def score_eval_set(ctx: ProcessorContext, ec: EvalConfig):
     # tags for the eval set come from its own pos/neg tags
     eval_mc = copy.copy(mc)
     eval_mc.dataSet = ds
-    dset = norm_proc.load_dataset_for_columns(eval_mc, ctx.column_configs,
-                                              cols, ds_conf=ds)
+    dset = norm_proc.load_dataset_for_columns(
+        eval_mc, ctx.column_configs, cols, ds_conf=ds,
+        extra_columns=score_meta_columns(ctx, ec))
     result = norm_proc.normalize_columns(mc, cols, dset)
     scorer = Scorer.from_dir(ctx.path_finder.models_path(),
                              score_selector=ec.performanceScoreSelector,
@@ -161,6 +174,33 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
 
     perf = performance_result(final, tags, weights,
                               n_buckets=ec.performanceBucketNum)
+
+    # champion/challenger: each benchmark score column in the eval data
+    # gets its own PerformanceResult next to the challenger model's
+    # (EvalModelProcessor.java:965-1004); score_eval_set already stashed
+    # the configured columns into dset.meta
+    champions = {}
+    for col, raw in sorted(dset.meta.items()):
+        import pandas as pd
+        vals = pd.to_numeric(pd.Series(raw), errors="coerce") \
+            .to_numpy(np.float64)
+        ok = np.isfinite(vals)
+        if not ok.any():
+            log.warning("champion column %r has no numeric scores", col)
+            continue
+        cperf = performance_result(vals[ok], tags[ok], weights[ok],
+                                   n_buckets=ec.performanceBucketNum)
+        champions[col] = cperf
+        cpath = os.path.join(base, f"EvalPerformance-{col}.json")
+        with open(cpath, "w") as f:
+            json.dump(cperf, f, indent=1)
+        log.info("eval[%s] champion %s: AUC=%.4f (challenger %.4f)",
+                 ec.name, col, cperf["areaUnderRoc"],
+                 perf["areaUnderRoc"])
+    if champions:
+        perf["championAuc"] = {c: p["areaUnderRoc"]
+                               for c, p in champions.items()}
+
     with open(ctx.path_finder.eval_performance_path(ec.name), "w") as f:
         json.dump(perf, f, indent=1)
 

@@ -47,7 +47,9 @@ def selected_candidates(ccs: List[ColumnConfig]) -> List[ColumnConfig]:
 def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
                              cols: List[ColumnConfig],
                              ds_conf=None,
-                             apply_filter: bool = True) -> ColumnarDataset:
+                             apply_filter: bool = True,
+                             extra_columns: Optional[List[str]] = None
+                             ) -> ColumnarDataset:
     """Read raw data and build columnar blocks for `cols`, with
     categorical vocabularies pinned to ColumnConfig binCategory so codes
     line up with the stats phase."""
@@ -68,7 +70,17 @@ def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
                                       only_bases=bases)
     vocabs = {c.columnNum: (c.columnBinning.binCategory or [])
               for c in cols if c.is_categorical}
-    return build_columnar(mc, _restrict(ccs, cols), df, vocabs=vocabs)
+    dset = build_columnar(mc, _restrict(ccs, cols), df, vocabs=vocabs)
+    if extra_columns:
+        # raw values of ad-hoc columns (champion score columns etc.),
+        # aligned with the built rows through the same valid-tag mask
+        from shifu_tpu.data.dataset import valid_tag_mask
+        valid = valid_tag_mask(mc, df)
+        for name in extra_columns:
+            if name in df.columns:
+                dset.meta[name] = \
+                    df[name].astype(str).str.strip().to_numpy()[valid]
+    return dset
 
 
 def _restrict(ccs: List[ColumnConfig], cols: List[ColumnConfig]):

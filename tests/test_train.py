@@ -215,6 +215,46 @@ def test_full_pipeline_multiclass(tmp_path, rng, method):
                       "predicted"]
 
 
+def test_champion_challenger_eval(tmp_path, rng):
+    """Benchmark score columns in the eval data get their own
+    PerformanceResult next to the model's
+    (EvalConfig#scoreMetaColumnNameFile, EvalModelProcessor:965-1004)."""
+    import numpy as np
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1500)
+
+    # append a noisy "champion" score column to the EVAL data only
+    eval_dir = os.path.join(root, "evaldata")
+    hdr_f = os.path.join(eval_dir, ".pig_header")
+    hdr = open(hdr_f).read().strip().split("|")
+    rows = [ln.rstrip("\n").split("|")
+            for ln in open(os.path.join(eval_dir, "part-00000"))]
+    tag_ix = hdr.index("diagnosis")
+    champ = [("%.4f" % max(0.0, min(1.0, (0.8 if r[tag_ix] == "M" else 0.2)
+                                    + rng.normal(0, 0.25)))) for r in rows]
+    with open(hdr_f, "w") as f:
+        f.write("|".join(hdr + ["champ_score"]) + "\n")
+    with open(os.path.join(eval_dir, "part-00000"), "w") as f:
+        for r, c in zip(rows, champ):
+            f.write("|".join(r + [c]) + "\n")
+    meta_file = os.path.join(root, "columns", "score.meta.names")
+    with open(meta_file, "w") as f:
+        f.write("champ_score\n")
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["evals"][0]["scoreMetaColumnNameFile"] = meta_file
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+
+    ctx = run_pipeline(root)
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert "championAuc" in perf and "champ_score" in perf["championAuc"]
+    # the champion is informative but noisy — beaten by the model
+    assert 0.6 < perf["championAuc"]["champ_score"] < perf["areaUnderRoc"]
+    champ_perf = os.path.join(ctx.path_finder.eval_base_path("Eval1"),
+                              "EvalPerformance-champ_score.json")
+    assert os.path.exists(champ_perf)
+
+
 def test_grid_search_selects_best(tmp_path, rng):
     from tests.synth import make_model_set
     root = make_model_set(

@@ -81,3 +81,47 @@ def test_norm_after_varsel_uses_selection(statsed):
     data, meta = norm_proc.load_normalized(
         ctx.path_finder.normalized_data_path())
     assert data["dense"].shape[1] == 3
+
+
+def test_voted_genetic_wrapper(statsed):
+    """filterBy=V: vmapped population of masked trainings, evolved, and
+    voted (core/dvarsel wrapper). Informative columns (even num_*
+    indices carry signal; odd are noise) should dominate the vote."""
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.filterBy = "V"
+    ctx.model_config.varSelect.wrapperNum = 4
+    ctx.model_config.varSelect.params = {"population_live_size": 12,
+                                         "population_multiply_cnt": 3,
+                                         "expect_variable_cnt": 4}
+    assert varsel_proc.run(ctx) == 0
+    ccs = load_column_configs(os.path.join(statsed, "ColumnConfig.json"))
+    sel = {c.columnName for c in ccs if c.finalSelect}
+    assert len(sel) == 4
+    # num_0/2/4 are the shifted (informative) columns; the wrapper must
+    # find at least two of them
+    assert len(sel & {"num_0", "num_2", "num_4", "cat_0", "cat_1"}) >= 3
+
+
+def test_fi_filter_requires_tree(statsed):
+    ctx = ProcessorContext.load(statsed)
+    ctx.model_config.varSelect.filterBy = "FI"
+    with pytest.raises(ValueError):
+        varsel_proc.run(ctx)
+
+
+def test_fi_filter_with_gbt(tmp_path, rng):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1200, algorithm="GBT",
+                          train_params={"TreeNum": 10, "MaxDepth": 3,
+                                        "LearningRate": 0.3})
+    for proc in (init_proc, stats_proc):
+        ctx = ProcessorContext.load(root)
+        proc.run(ctx)
+    ctx = ProcessorContext.load(root)
+    ctx.model_config.varSelect.filterBy = "FI"
+    ctx.model_config.varSelect.filterNum = 4
+    assert varsel_proc.run(ctx) == 0
+    ccs = load_column_configs(os.path.join(root, "ColumnConfig.json"))
+    sel = {c.columnName for c in ccs if c.finalSelect}
+    assert len(sel) == 4
+    assert len(sel & {"num_0", "num_2", "num_4", "cat_0", "cat_1"}) >= 3
