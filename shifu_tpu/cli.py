@@ -143,6 +143,20 @@ def cmd_encode(args) -> int:
     return p.run(_ctx(args))
 
 
+def cmd_convert(args) -> int:
+    """`shifu convert` — model spec ↔ open zip bundle
+    (IndependentTreeModelUtils zip↔binary converter)."""
+    from shifu_tpu.models.spec import bundle_to_spec, spec_to_bundle
+    src, dst = args.src, args.out
+    if src.endswith(".zip"):
+        out = bundle_to_spec(src, dst)
+    else:
+        out = spec_to_bundle(src, dst if dst.endswith(".zip")
+                             else dst + ".zip")
+    log.info("convert: %s → %s", src, out)
+    return 0
+
+
 def cmd_combo(args) -> int:
     from shifu_tpu.processor import combo as p
     ctx = _ctx(args)
@@ -225,13 +239,19 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("export", help="export model/stats")
     p.add_argument("-t", "--type", default="columnstats",
                    choices=["columnstats", "correlation", "woemapping",
-                            "pmml"])
+                            "pmml", "tf"])
     p.set_defaults(fn=cmd_export)
     p = sub.add_parser("test", help="dry-run filter expressions")
     p.add_argument("-n", type=int, default=100)
     p.set_defaults(fn=cmd_test)
     sub.add_parser("encode", help="tree-leaf-path encode the dataset") \
         .set_defaults(fn=cmd_encode)
+    p = sub.add_parser("convert",
+                       help="model spec ↔ open zip bundle")
+    p.add_argument("src", help="a model spec file or a .zip bundle")
+    p.add_argument("out", help="output path (.zip for bundles)")
+    p.set_defaults(fn=cmd_convert)
+
     p = sub.add_parser("combo", help="assembled multi-algorithm models")
     p.add_argument("-new", "--new", default=None, metavar="ALG1,ALG2,...",
                    help="create ComboTrain.json (last alg = assemble model)")

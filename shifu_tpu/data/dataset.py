@@ -114,6 +114,15 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
     """
     from shifu_tpu.data.reader import simple_column_name
     missing = [str(m) for m in mc.dataSet.missingOrInvalidValues]
+
+    def _as_float(tok):
+        try:
+            return np.float32(tok)
+        except ValueError:
+            return None
+    numeric_sentinels = np.asarray(
+        [v for v in (_as_float(t) for t in missing) if v is not None],
+        np.float32)
     cc_by_name = {c.columnName: c for c in column_configs}
     # MTL flags several Target columns; the primary tag is task 0
     task_names = [simple_column_name(t)
@@ -129,6 +138,20 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
     for col in df.columns:
         cc = cc_by_name.get(col)
         if cc is None:
+            continue
+        if pd.api.types.is_float_dtype(df[col]) and not cc.is_categorical \
+                and not (cc.is_target or cc.is_weight or cc.is_meta
+                         or cc.is_force_remove):
+            # pre-parsed by the native reader: unparseable tokens are
+            # already NaN; numeric missing sentinels (e.g. "-999" in
+            # missingOrInvalidValues) still need masking
+            vals = df[col].to_numpy(np.float32)
+            if numeric_sentinels.size:
+                vals = np.where(np.isin(vals, numeric_sentinels),
+                                np.nan, vals)
+            num_names.append(col)
+            num_cols.append(cc.columnNum)
+            num_mats.append(vals)
             continue
         sv = df[col].astype(str).str.strip()
         if cc.is_target:

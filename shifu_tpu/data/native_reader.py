@@ -1,0 +1,123 @@
+"""High-level driver for the native parser: part files → mixed-dtype
+frame (float32 numeric columns, str everything else).
+
+Splits the work per file (the native library additionally pthread-splits
+within a file): numeric candidate columns are parsed straight to a
+float32 matrix (missing tokens → NaN — exactly the framework's missing
+encoding, so no token list is needed on the hot path), while
+categorical/target/weight/meta columns come back as (offset, length)
+slices that Python materializes only for those few columns.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+log = logging.getLogger("shifu_tpu")
+
+
+def _gather_strings(blob: np.ndarray, off: np.ndarray,
+                    lens: np.ndarray) -> np.ndarray:
+    """Vectorized (offset, len) slices → str array: one fancy-indexed
+    gather into an (R, maxlen) byte matrix, then a vectorized utf-8
+    decode — no per-row Python loop."""
+    r = len(off)
+    w = max(int(lens.max()) if r else 1, 1)
+    pos = np.arange(w, dtype=np.int64)[None, :]
+    idx = off[:, None] + pos
+    valid = pos < lens[:, None].astype(np.int64)
+    mat = np.where(valid, blob[np.clip(idx, 0, len(blob) - 1)],
+                   0).astype(np.uint8)
+    raw = mat.reshape(r * w).tobytes()
+    fixed = np.frombuffer(raw, dtype=f"S{w}")
+    try:
+        # ASCII fast path (~6× np.char.decode); raises on high bytes
+        return fixed.astype(f"U{w}")
+    except UnicodeDecodeError:
+        pass
+    try:
+        return np.char.decode(fixed, "utf-8")
+    except UnicodeDecodeError:
+        return np.array([b.decode("utf-8", "replace") for b in fixed],
+                        dtype=object)
+
+
+def read_files_native(files: Sequence[str], header: List[str], delim: str,
+                      numeric_columns: Sequence[str],
+                      skip_first_row_of: Optional[str] = None,
+                      n_threads: int = 8) -> Optional[pd.DataFrame]:
+    """Parse part files with the native library. Returns None when the
+    library is unavailable or any file is compressed (caller falls back
+    to pandas)."""
+    from shifu_tpu.native import get_reader_lib
+    lib = get_reader_lib()
+    if lib is None:
+        return None
+    if any(p.endswith((".gz", ".bz2", ".zip")) for p in files):
+        return None
+
+    n_cols = len(header)
+    num_set = set(numeric_columns)
+    num_names = [c for c in header if c in num_set]
+    str_names = [c for c in header if c not in num_set]
+    num_idx = np.full(n_cols, -1, np.int32)
+    str_idx = np.full(n_cols, -1, np.int32)
+    for slot, name in enumerate(num_names):
+        num_idx[header.index(name)] = slot
+    for slot, name in enumerate(str_names):
+        str_idx[header.index(name)] = slot
+
+    import ctypes
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+
+    per_file: List[Tuple[np.ndarray, Dict[str, np.ndarray]]] = []
+    for path in files:
+        skip = 1 if path == skip_first_row_of else 0
+        n_rows = int(lib.ft_count_file_rows(path.encode(), skip))
+        if n_rows < 0:
+            return None
+        if n_rows == 0:
+            continue
+        num_out = np.full((n_rows, max(len(num_names), 1)), np.nan,
+                          np.float32)
+        off = np.zeros((n_rows, max(len(str_names), 1)), np.int64)
+        lens = np.zeros((n_rows, max(len(str_names), 1)), np.int32)
+        got = int(lib.ft_parse_file(
+            path.encode(), ctypes.c_char(delim.encode()[:1]), skip, n_cols,
+            num_idx.ctypes.data_as(i32p), len(num_names),
+            num_out.ctypes.data_as(f32p),
+            str_idx.ctypes.data_as(i32p), len(str_names),
+            off.ctypes.data_as(i64p), lens.ctypes.data_as(i32p),
+            n_threads))
+        if got != n_rows:
+            log.warning("native parse row mismatch in %s (%d != %d); "
+                        "falling back to pandas", path, got, n_rows)
+            return None
+        # memmap: the gather touches only the pages holding the few
+        # string columns, not the numeric bulk the C pass already parsed
+        blob = np.memmap(path, dtype=np.uint8, mode="r")
+        str_cols: Dict[str, np.ndarray] = {}
+        for slot, name in enumerate(str_names):
+            str_cols[name] = _gather_strings(blob, off[:, slot],
+                                             lens[:, slot])
+        per_file.append((num_out[:, :len(num_names)], str_cols))
+
+    if not per_file:
+        raise FileNotFoundError(f"no rows in {list(files)!r}")
+    num_all = np.concatenate([p[0] for p in per_file], axis=0) \
+        if num_names else np.zeros((sum(len(p[1][str_names[0]])
+                                        for p in per_file), 0), np.float32)
+    data: Dict[str, object] = {}
+    for name in header:
+        if name in num_set:
+            data[name] = num_all[:, num_names.index(name)]
+        else:
+            data[name] = np.concatenate([p[1][name] for p in per_file])
+    df = pd.DataFrame(data, columns=list(header))
+    return df

@@ -80,9 +80,15 @@ def _opener_for(path: str):
 def read_raw_table(mc: ModelConfig,
                    ds: Optional[ModelSourceDataConf] = None,
                    file_shard: Optional[tuple] = None,
-                   max_rows: Optional[int] = None) -> pd.DataFrame:
-    """Read the raw dataset as an all-string DataFrame with the header's
-    column names.
+                   max_rows: Optional[int] = None,
+                   numeric_columns: Optional[Sequence[str]] = None
+                   ) -> pd.DataFrame:
+    """Read the raw dataset as a DataFrame with the header's column
+    names — all-string, except that `numeric_columns` (when the caller
+    knows the types, i.e. after init) may come back float32 via the
+    native mmap+pthread parser (shifu_tpu/native/fast_reader.c), with
+    missing/invalid tokens already NaN. Disable with
+    SHIFU_TPU_NATIVE_READER=0.
 
     `file_shard=(index, count)` reads only every count-th file starting
     at index — the multi-host ingestion split (each JAX process reads a
@@ -97,6 +103,18 @@ def read_raw_table(mc: ModelConfig,
         files = files[idx::count] or files[idx % len(files):][:1]
 
     has_header_line = not ds.headerPath  # header came from data file itself
+
+    if numeric_columns and max_rows is None and \
+            os.environ.get("SHIFU_TPU_NATIVE_READER", "1") != "0":
+        from shifu_tpu.data.native_reader import read_files_native
+        simple = [simple_column_name(c) for c in header]
+        names = simple if len(set(simple)) == len(simple) else list(header)
+        df = read_files_native(
+            files, names, ds.dataDelimiter or "|",
+            [c for c in numeric_columns if c in names],
+            skip_first_row_of=(first_file if has_header_line else None))
+        if df is not None:
+            return df
     frames = []
     rows_left = max_rows
     for path in files:

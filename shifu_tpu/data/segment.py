@@ -28,6 +28,7 @@ import os
 import re
 from typing import List, Optional
 
+import numpy as np
 import pandas as pd
 
 from shifu_tpu.config.column_config import ColumnConfig, ColumnFlag
@@ -103,7 +104,10 @@ def expand_raw_frame(df: pd.DataFrame, mc: ModelConfig, exprs: List[str],
     for k, expr in enumerate(exprs, start=1):
         mask = pd.Series(DataPurifier(expr).apply(df), index=df.index)
         for col in wanted:
-            parts[seg_name(col, k)] = df[col].where(mask, missing_token)
+            # float columns are native-reader pre-parsed: NaN IS missing
+            other = (np.nan if pd.api.types.is_float_dtype(df[col])
+                     else missing_token)
+            parts[seg_name(col, k)] = df[col].where(mask, other)
     return pd.DataFrame(parts)
 
 

@@ -88,18 +88,40 @@ def feature_subset_count(strategy: str, n_features: int) -> int:
 # Single-level histogram + split kernel
 # ---------------------------------------------------------------------------
 
+def _hist_mode() -> str:
+    """Histogram backend: "pallas" (MXU one-hot contraction kernel,
+    ops/pallas_hist.py), "xla" (scatter-add), or "auto" (pallas on TPU,
+    xla elsewhere). Override with SHIFU_TPU_HIST=pallas|xla."""
+    import os
+    mode = os.environ.get("SHIFU_TPU_HIST", "auto").lower()
+    if mode in ("pallas", "xla"):
+        return mode
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
 def _level_histograms(bins, node_of_row, grad, hess, level_offset, n_level_nodes,
                       n_bins):
-    """Scatter-add G/H histograms for one level.
+    """Per-level G/H histograms.
 
     bins: (R, C) int32 in [0, n_bins); node_of_row: (R,) global node ids
     (rows at inactive/finished nodes carry id -1 and scatter into a
     dumped slot). Returns (n_level_nodes, C, n_bins) G and H.
+
+    On TPU this dispatches to the Pallas MXU kernel (the scatter-add
+    lowers to a serialized XLA scatter; the one-hot contraction rides
+    the systolic array instead — see ops/pallas_hist.py).
     """
     r, c = bins.shape
     local = node_of_row - level_offset  # (R,)
     valid = (local >= 0) & (local < n_level_nodes)
     slot = jnp.where(valid, local, n_level_nodes)  # dump slot
+
+    if _hist_mode() == "pallas":
+        from shifu_tpu.ops.pallas_hist import level_histograms_pallas
+        return level_histograms_pallas(
+            bins, slot, grad, hess, n_level_nodes, n_bins,
+            interpret=jax.default_backend() != "tpu")
+
     col_ids = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[None, :], (r, c))
     node_ids = jnp.broadcast_to(slot[:, None], (r, c)).astype(jnp.int32)
 

@@ -1,0 +1,77 @@
+"""Native runtime pieces — compiled on demand, always with a Python
+fallback.
+
+`get_reader_lib()` builds `fast_reader.c` (mmap + pthread delimited
+parser, the JVM-ingestion replacement — see the .c header) into a
+shared object next to this file using the system compiler, then loads
+it with ctypes. Build or load failures return None and callers fall
+back to the pandas path, so the framework never hard-depends on a
+toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("shifu_tpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_reader.c")
+_SO = os.path.join(_HERE, "_fast_reader.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _compile() -> bool:
+    for cc in ("cc", "gcc", "g++", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO],
+                capture_output=True, text=True, timeout=120)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            return True
+        log.debug("fast_reader build with %s failed: %s", cc,
+                  r.stderr[-500:])
+    return False
+
+
+def get_reader_lib():
+    """ctypes handle to the native parser, or None (no compiler / build
+    failed / platform unsupported)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                if not _compile():
+                    log.info("native fast_reader unavailable; using the "
+                             "pandas reader")
+                    return None
+            lib = ctypes.CDLL(_SO)
+            i64, i32p, f32p = (ctypes.c_int64,
+                               ctypes.POINTER(ctypes.c_int32),
+                               ctypes.POINTER(ctypes.c_float))
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.ft_parse_file.restype = i64
+            lib.ft_parse_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int, ctypes.c_int,
+                i32p, ctypes.c_int, f32p,
+                i32p, ctypes.c_int, i64p, i32p, ctypes.c_int]
+            lib.ft_count_file_rows.restype = i64
+            lib.ft_count_file_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            _lib = lib
+        except Exception as e:  # pragma: no cover - defensive
+            log.info("native fast_reader load failed (%s); using the "
+                     "pandas reader", e)
+            _lib = None
+        return _lib

@@ -39,6 +39,8 @@ def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
         out = ctx.path_finder.correlation_path()
     elif et == "pmml":
         out = _export_pmml(ctx)
+    elif et == "tf":
+        out = _export_tf(ctx)
     else:
         raise ValueError(f"unknown export type {export_type!r}")
     log.info("export[%s] → %s in %.2fs", et, out, time.time() - t0)
@@ -103,4 +105,49 @@ def _export_woemapping(ctx: ProcessorContext) -> str:
                 wwoe = bn.binWeightedWoe[i] if bn.binWeightedWoe and \
                     i < len(bn.binWeightedWoe) else ""
                 f.write(f"{cc.columnName},{i},{label},{woe},{wwoe}\n")
+    return out
+
+
+def _export_tf(ctx: ProcessorContext) -> str:
+    """`export -t tf` — TensorFlow SavedModel via jax2tf, replacing the
+    reference's external shifu-tensorflow bridge
+    (TrainModelProcessor.java:472-527; GenericModel serving side).
+    Gated: raises a clear error when tensorflow is not installed (it is
+    not a framework dependency)."""
+    try:
+        import tensorflow as tf  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "export -t tf needs the optional tensorflow package for "
+            "SavedModel serialization (the JAX model itself trains and "
+            "scores without it); install tensorflow or export PMML / "
+            "the portable spec instead") from e
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import jax2tf
+
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.models.spec import list_models, load_model
+
+    paths = list_models(ctx.path_finder.models_path())
+    if not paths:
+        raise FileNotFoundError("no trained models to export; run `train`")
+    kind, meta, params = load_model(paths[0])
+    if kind not in ("nn", "lr"):
+        raise ValueError(f"export -t tf supports nn/lr specs, not {kind}")
+    sd = dict(meta["spec"])
+    sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
+    sd["activations"] = tuple(sd.get("activations", ()))
+    spec = nn_mod.MLPSpec(**sd)
+    jparams = jax.tree.map(jnp.asarray, params)
+
+    fn = jax2tf.convert(lambda x: nn_mod.forward(spec, jparams, x),
+                        polymorphic_shapes=["(b, _)"],
+                        with_gradient=False)
+    module = tf.Module()
+    module.f = tf.function(
+        fn, input_signature=[tf.TensorSpec([None, spec.input_dim],
+                                           tf.float32)])
+    out = os.path.join(ctx.path_finder.root, "tfmodel")
+    tf.saved_model.save(module, out)
     return out

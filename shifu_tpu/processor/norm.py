@@ -53,7 +53,9 @@ def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
     """Read raw data and build columnar blocks for `cols`, with
     categorical vocabularies pinned to ColumnConfig binCategory so codes
     line up with the stats phase."""
-    df = read_raw_table(mc, ds=ds_conf)
+    df = read_raw_table(mc, ds=ds_conf, numeric_columns=[
+        c.columnName for c in ccs
+        if c.is_candidate and not c.is_categorical and not c.is_segment])
     ds_conf = ds_conf or mc.dataSet
     if apply_filter and ds_conf.filterExpressions:
         keep = DataPurifier(ds_conf.filterExpressions).apply(df)
@@ -143,21 +145,33 @@ def apply_precision(dense: np.ndarray, ptype: str) -> np.ndarray:
 def save_normalized(path: str, result: NormResult, tags: np.ndarray,
                     weights: np.ndarray,
                     task_tags: Optional[np.ndarray] = None,
-                    ptype: str = "FLOAT32") -> None:
+                    ptype: str = "FLOAT32",
+                    streaming: bool = False) -> None:
+    """`streaming=True` (train#trainOnDisk) additionally lays the blocks
+    out as raw .npy files so the streaming trainer can memory-map row
+    chunks without loading the table (train/streaming.py)."""
     os.makedirs(path, exist_ok=True)
     extra = {}
     if task_tags is not None and task_tags.size:
         extra["task_tags"] = task_tags.astype(np.float32)
+    dense = apply_precision(result.dense, ptype)
     np.savez_compressed(
         os.path.join(path, "data.npz"),
-        dense=apply_precision(result.dense, ptype), index=result.index,
+        dense=dense, index=result.index,
         tags=tags.astype(np.float32), weights=weights.astype(np.float32),
         **extra)
+    if streaming:
+        np.save(os.path.join(path, "dense.npy"),
+                np.ascontiguousarray(dense))
+        np.save(os.path.join(path, "tags.npy"), tags.astype(np.float32))
+        np.save(os.path.join(path, "weights.npy"),
+                weights.astype(np.float32))
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,
                    "indexVocabSizes": result.index_vocab_sizes,
-                   "precisionType": ptype}, f, indent=1)
+                   "precisionType": ptype,
+                   "streaming": bool(streaming)}, f, indent=1)
 
 
 def load_normalized(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
@@ -179,7 +193,8 @@ def run(ctx: ProcessorContext,
     result = normalize_columns(mc, cols, dataset)
     out = ctx.path_finder.normalized_data_path()
     save_normalized(out, result, dataset.tags, dataset.weights,
-                    task_tags=dataset.task_tags, ptype=precision_type(mc))
+                    task_tags=dataset.task_tags, ptype=precision_type(mc),
+                    streaming=mc.train.trainOnDisk)
 
     # cleaned data for tree algorithms: raw numeric (NaN = missing, trees
     # route it explicitly) + category codes with missing → vocab_len slot

@@ -46,7 +46,9 @@ def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
     df = None
 
     if dataset is None:
-        df = read_raw_table(mc)
+        df = read_raw_table(mc, numeric_columns=[
+            c.columnName for c in ccs
+            if c.is_candidate and not c.is_categorical and not c.is_segment])
         keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
         if mc.stats.sampleRate < 1.0:
             rng = np.random.default_rng(seed)

@@ -57,9 +57,13 @@ def run(ctx: ProcessorContext, seed: int = 12306) -> int:
         from shifu_tpu.processor import train_mtl
         result = train_mtl.run_mtl(ctx, seed)
     elif alg is Algorithm.TENSORFLOW:
-        raise NotImplementedError(
-            "TENSORFLOW bridge: train with NN and export via jax2tf "
-            "(shifu_tpu export -tf)")
+        # the reference's TF bridge spawns distributed-TF python
+        # training (TrainModelProcessor.java:472-527); here the same
+        # network trains natively in JAX and `export -t tf` emits a
+        # SavedModel via jax2tf when tensorflow is importable
+        log.info("TENSORFLOW algorithm: training the network natively "
+                 "in JAX (use `export -t tf` for a SavedModel)")
+        result = _train_dense(ctx, seed)
     else:
         raise ValueError(f"unsupported algorithm {alg}")
     log.info("train[%s] done in %.2fs", alg.value, time.time() - t0)
@@ -98,6 +102,11 @@ def _svm_spec(params: Dict[str, Any], input_dim: int) -> nn_mod.MLPSpec:
 
 def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
     mc = ctx.model_config
+    # streaming first: loading the npz here would materialize the very
+    # table trainOnDisk exists to keep out of RAM
+    if mc.train.trainOnDisk and not mc.is_multi_classification:
+        return _train_dense_streaming(ctx, seed)
+
     data, meta = _load_dense_training_data(ctx)
     x = data["dense"].astype(np.float32)
     y = data["tags"].astype(np.float32)
@@ -264,6 +273,59 @@ def _save_dense_models(ctx: ProcessorContext, res: TrainResult,
         save_model(path, kind, spec_meta, params)
     log.info("saved %d %s model(s) under %s", len(res.params_per_bag),
              kind, ctx.path_finder.models_path())
+
+
+def _train_dense_streaming(ctx: ProcessorContext,
+                           seed: int) -> List[TrainResult]:
+    """train#trainOnDisk — >HBM datasets stream as memory-mapped row
+    chunks with double-buffered host→device transfer
+    (train/streaming.py; MemoryDiskFloatMLDataSet's disk-spill analog).
+    Grid search / k-fold are full-batch features and are ignored here."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.train.streaming import train_nn_streaming
+    mc = ctx.model_config
+    path = ctx.path_finder.normalized_data_path()
+    dense_p = os.path.join(path, "dense.npy")
+    if not os.path.exists(dense_p):
+        raise FileNotFoundError(
+            f"streaming layout not found at {path}; run `norm` with "
+            "train#trainOnDisk=true so dense.npy/tags.npy are written")
+    dense = np.load(dense_p, mmap_mode="r")
+    tags = np.load(os.path.join(path, "tags.npy"), mmap_mode="r")
+    weights = np.load(os.path.join(path, "weights.npy"), mmap_mode="r")
+    up = np.float32(mc.train.upSampleWeight)
+
+    def get_chunk(a, b):
+        x = np.asarray(dense[a:b], np.float32)
+        y = np.asarray(tags[a:b], np.float32)
+        w = np.asarray(weights[a:b], np.float32)
+        if up != 1.0:
+            w = w * np.where(y > 0.5, up, np.float32(1.0))
+        return x, y, w
+
+    alg = mc.train.algorithm
+    if alg is Algorithm.LR:
+        spec = _lr_spec(mc.train.params, dense.shape[1])
+    elif alg is Algorithm.SVM:
+        spec = _svm_spec(mc.train.params, dense.shape[1])
+    else:
+        spec = None
+    init_params, fixed = _continuous_init(
+        ctx, spec or nn_mod.MLPSpec.from_train_params(mc.train.params,
+                                                      dense.shape[1]))
+    chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
+    res = train_nn_streaming(mc.train, get_chunk, len(tags), dense.shape[1],
+                             seed=seed, spec=spec, chunk_rows=chunk_rows,
+                             init_params=(jax.tree.map(jnp.asarray,
+                                                       init_params)
+                                          if init_params is not None
+                                          else None),
+                             fixed_layers=fixed)
+    _save_dense_models(ctx, res, alg)
+    _write_val_errors(ctx, res)
+    return [res]
 
 
 def _train_dense_ovr(ctx: ProcessorContext, x: np.ndarray, y: np.ndarray,

@@ -1,0 +1,168 @@
+"""Streaming (>HBM) training — double-buffered host→HBM chunks.
+
+The reference trains full-split-in-RAM with a disk spill fallback
+(`core/dtrain/dataset/MemoryDiskFloatMLDataSet.java:27-99`: rows past
+the memory budget go to a disk file replayed every epoch). The TPU
+analog (SURVEY.md §5 long-context note): when the normalized matrix
+exceeds HBM, stream fixed-size row chunks host→device with the NEXT
+chunk's `jax.device_put` issued while the CURRENT chunk's jitted
+update runs — JAX dispatch is async, so transfer and compute overlap
+(double buffering). Training degrades gracefully from full-batch to
+chunked mini-batch SGD; the epoch loop, optimizer state, and
+early-stop live across chunks.
+
+Activated by `train#trainOnDisk` (the reference's knob for the same
+situation). `norm` then stores the matrix as raw .npy files so chunks
+memory-map from disk without loading the whole table
+(processor/norm.save_normalized streaming layout).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from shifu_tpu.config.model_config import ModelTrainConf
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.train.optimizers import optimizer_from_params
+from shifu_tpu.train.trainer import TrainResult
+
+log = logging.getLogger("shifu_tpu")
+
+
+def train_nn_streaming(train_conf: ModelTrainConf,
+                       get_chunk: Callable[[int, int], Tuple],
+                       n_rows: int,
+                       input_dim: int,
+                       seed: int = 12306,
+                       spec: Optional[nn_mod.MLPSpec] = None,
+                       chunk_rows: int = 262_144,
+                       init_params=None,
+                       fixed_layers=None) -> TrainResult:
+    """Train one NN/LR by streaming row chunks.
+
+    get_chunk(start, stop) → (x, y, w) numpy slices — typically views of
+    np.load(..., mmap_mode="r") arrays, so only the touched rows hit
+    RAM. Validation is the trailing validSetRate fraction of rows
+    (contiguous split: random per-row masks would defeat sequential
+    disk reads; the reference's disk-spill dataset is likewise
+    sequential).
+    """
+    t0 = time.time()
+    spec = spec or nn_mod.MLPSpec.from_train_params(train_conf.params,
+                                                    input_dim=input_dim)
+    n_val = int(n_rows * max(train_conf.validSetRate, 0.0))
+    n_train = n_rows - n_val
+    if n_train <= 0:
+        raise ValueError("streaming training needs at least one train row")
+    if max(train_conf.baggingNum, 1) > 1:
+        log.warning("trainOnDisk streams one model; baggingNum ignored")
+
+    optimizer = optimizer_from_params(train_conf.params)
+    key = jax.random.PRNGKey(seed)
+    params = init_params if init_params is not None \
+        else nn_mod.init_params(spec, key)
+    opt_state = optimizer.init(params)
+
+    # continuous training's frozen-layer fitting (NNMaster.java:369-379)
+    grad_mask = [
+        {k: jnp.zeros_like(v) if fixed_layers and i in fixed_layers
+         else jnp.ones_like(v) for k, v in layer.items()}
+        for i, layer in enumerate(params)]
+
+    @jax.jit
+    def update(params, opt_state, x, y, w, key):
+        dkey = key if spec.dropout_rate > 0 else None
+        loss, grads = jax.value_and_grad(
+            lambda p: nn_mod.loss_fn(spec, p, x, y, w, dkey))(params)
+        grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def val_chunk_err(params, x, y, w):
+        pred = nn_mod.forward(spec, params, x)
+        if spec.output_dim > 1:
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
+            per = jnp.mean(jnp.square(onehot - pred), axis=-1)
+            return jnp.sum(per * w), jnp.sum(w)
+        return jnp.sum(jnp.square(y - pred) * w), jnp.sum(w)
+
+    def chunk_bounds(lo, hi):
+        starts = list(range(lo, hi, chunk_rows))
+        return [(s, min(s + chunk_rows, hi)) for s in starts]
+
+    train_chunks = chunk_bounds(0, n_train)
+    val_chunks = chunk_bounds(n_train, n_rows)
+
+    def put(bounds):
+        a, b = bounds
+        x, y, w = get_chunk(a, b)
+        # device_put dispatches the H2D copy immediately and returns;
+        # the copy overlaps the previous chunk's compute
+        return (jax.device_put(np.ascontiguousarray(x)),
+                jax.device_put(np.ascontiguousarray(y)),
+                jax.device_put(np.ascontiguousarray(w)))
+
+    best_params, best_val = params, float("inf")
+    best_epoch, bad = 0, 0
+    window = train_conf.earlyStoppingRounds or 0
+    conv = float(train_conf.convergenceThreshold or 0.0)
+    train_errs, val_errs = [], []
+
+    for epoch in range(train_conf.numTrainEpochs):
+        key, sub = jax.random.split(key)
+        epoch_loss, n_chunks = 0.0, 0
+        nxt = put(train_chunks[0])
+        for ci in range(len(train_chunks)):
+            cur = nxt
+            if ci + 1 < len(train_chunks):
+                nxt = put(train_chunks[ci + 1])  # prefetch while computing
+            params, opt_state, loss = update(params, opt_state, *cur, sub)
+            epoch_loss += float(loss)
+            n_chunks += 1
+        train_err = epoch_loss / max(n_chunks, 1)
+
+        if val_chunks:
+            se, sw = 0.0, 0.0
+            nxt = put(val_chunks[0])
+            for ci in range(len(val_chunks)):
+                cur = nxt
+                if ci + 1 < len(val_chunks):
+                    nxt = put(val_chunks[ci + 1])
+                e, w_ = val_chunk_err(params, *cur)
+                se += float(e)
+                sw += float(w_)
+            val_err = se / max(sw, 1e-12)
+        else:
+            val_err = train_err
+
+        train_errs.append(train_err)
+        val_errs.append(val_err)
+        if val_err < best_val:
+            best_val, best_epoch, bad = val_err, epoch, 0
+            best_params = jax.tree.map(lambda p: p, params)
+        else:
+            bad += 1
+        if (window and bad >= window) or (conv > 0 and train_err <= conv):
+            log.info("streaming train: early stop at epoch %d", epoch)
+            break
+
+    host = jax.tree.map(np.asarray, best_params)
+    res = TrainResult(
+        spec=spec, params_per_bag=[host],
+        train_errors=np.asarray([train_errs], np.float32),
+        val_errors=np.asarray([val_errs], np.float32),
+        best_val=np.asarray([best_val], np.float32),
+        best_epoch=np.asarray([best_epoch]),
+        wall_seconds=time.time() - t0)
+    log.info("streaming train: %d rows in %d chunks × %d epochs, best "
+             "val %.6f in %.2fs", n_rows, len(train_chunks),
+             len(train_errs), best_val, res.wall_seconds)
+    return res

@@ -77,3 +77,95 @@ def test_purifier_bad_expression_raises():
     df = pd.DataFrame({"a": ["1"]})
     with pytest.raises(ValueError):
         DataPurifier("a !!>> zz").apply(df)
+
+
+def test_native_reader_matches_pandas(tmp_path, rng):
+    """The mmap+pthread C parser (native/fast_reader.c) produces the
+    same columnar dataset as the pandas path: float32 numerics with
+    NaN missing, identical string columns."""
+    import os
+
+    from tests.synth import make_model_set
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import read_raw_table
+    from shifu_tpu.native import get_reader_lib
+
+    if get_reader_lib() is None:
+        import pytest
+        pytest.skip("no C toolchain available")
+
+    root = make_model_set(tmp_path, rng, n_rows=800)
+    mc = ModelConfig.load(root)
+    numeric = [f"num_{j}" for j in range(6)]
+
+    native = read_raw_table(mc, numeric_columns=numeric)
+    old = os.environ.get("SHIFU_TPU_NATIVE_READER")
+    os.environ["SHIFU_TPU_NATIVE_READER"] = "0"
+    try:
+        pandas_df = read_raw_table(mc, numeric_columns=numeric)
+    finally:
+        if old is None:
+            os.environ.pop("SHIFU_TPU_NATIVE_READER", None)
+        else:
+            os.environ["SHIFU_TPU_NATIVE_READER"] = old
+
+    assert len(native) == len(pandas_df)
+    for c in numeric:
+        assert native[c].dtype == np.float32
+        want = pd.to_numeric(pandas_df[c].replace(
+            ["", "*", "#", "?", "null", "~"], np.nan), errors="coerce") \
+            .to_numpy(np.float32)
+        got = native[c].to_numpy(np.float32)
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+        np.testing.assert_allclose(got[~np.isnan(got)],
+                                   want[~np.isnan(want)], rtol=1e-6)
+    for c in ("cat_0", "cat_1", "diagnosis", "wgt", "rowid"):
+        assert list(native[c].astype(str)) == list(pandas_df[c].astype(str))
+
+
+def test_native_reader_end_to_end_stats(tmp_path, rng):
+    """Stats through the native reader produce the same ColumnConfig
+    numbers as the pandas path."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.native import get_reader_lib
+    from shifu_tpu.processor import init as init_proc, stats as stats_proc
+    from shifu_tpu.processor.base import ProcessorContext
+
+    if get_reader_lib() is None:
+        import pytest
+        pytest.skip("no C toolchain available")
+
+    import os
+    roots = {}
+    for mode in ("1", "0"):
+        root = make_model_set(tmp_path / f"m{mode}", rng.spawn(1)[0]
+                              if hasattr(rng, "spawn") else rng, n_rows=700)
+        roots[mode] = root
+    # identical data for both modes
+    import shutil
+    shutil.rmtree(roots["0"])
+    shutil.copytree(roots["1"], roots["0"])
+
+    ccs_by_mode = {}
+    for mode, root in roots.items():
+        os.environ["SHIFU_TPU_NATIVE_READER"] = mode
+        try:
+            ctx = ProcessorContext.load(root)
+            init_proc.run(ctx)
+            ctx = ProcessorContext.load(root)
+            stats_proc.run(ctx)
+        finally:
+            os.environ.pop("SHIFU_TPU_NATIVE_READER", None)
+        ccs_by_mode[mode] = json.load(
+            open(os.path.join(root, "ColumnConfig.json")))
+    for a, b in zip(ccs_by_mode["1"], ccs_by_mode["0"]):
+        assert a["columnName"] == b["columnName"]
+        sa, sb = a["columnStats"], b["columnStats"]
+        for k in ("ks", "iv", "mean", "stdDev", "totalCount", "missingCount"):
+            va, vb = sa.get(k), sb.get(k)
+            if isinstance(va, float) and isinstance(vb, float):
+                assert abs(va - vb) < 1e-4 * (1 + abs(vb)), (k, va, vb)
+            else:
+                assert va == vb, (k, va, vb)

@@ -281,3 +281,28 @@ def test_model_spec_roundtrip(tmp_path):
     assert meta["spec"]["input_dim"] == 3
     np.testing.assert_array_equal(loaded[0]["w"], params[0]["w"])
     np.testing.assert_array_equal(loaded[1]["b"], params[1]["b"])
+
+
+def test_streaming_train_on_disk(tmp_path, rng):
+    """train#trainOnDisk: norm lays out mmap-able .npy blocks and the
+    trainer streams double-buffered chunks (>HBM path,
+    MemoryDiskFloatMLDataSet analog)."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=3000,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM",
+                                        "ChunkRows": 512})
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["train"]["trainOnDisk"] = True
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+
+    ctx = run_pipeline(root)
+    # streaming layout exists and training produced a model + eval
+    norm_dir = ctx.path_finder.normalized_data_path()
+    assert os.path.exists(os.path.join(norm_dir, "dense.npy"))
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.85
