@@ -11,6 +11,7 @@ per `gbtScoreConvertStrategy` (RAW/SIGMOID/MAXMIN_SCALE/CUTOFF) like
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -19,6 +20,8 @@ import numpy as np
 
 from shifu_tpu.models import nn as nn_mod
 from shifu_tpu.models.spec import load_model, list_models
+
+log = logging.getLogger("shifu_tpu")
 
 
 def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
@@ -133,8 +136,22 @@ class Scorer:
                         "with multi-class tags")
                 native.append(s)
                 n_classes = max(n_classes, s.shape[1])
+        if not native and not ova:
+            raise ValueError(
+                "no models loaded for multi-class scoring — check the "
+                "models directory and that training completed")
         parts = []
         if native:
+            if any(s.shape[1] < n_classes for s in native):
+                # models trained against different tag sets (or narrower
+                # than an OVA model's class id): pad with zero columns
+                # so the matrices stack
+                log.warning(
+                    "multi-class models disagree on class count "
+                    "(%s vs %d); padding narrower score matrices with "
+                    "zeros", sorted({s.shape[1] for s in native}), n_classes)
+                native = [np.pad(s, ((0, 0), (0, n_classes - s.shape[1])))
+                          if s.shape[1] < n_classes else s for s in native]
             parts.append(np.mean(np.stack(native, axis=0), axis=0))
         if ova:
             n_rows = len(next(iter(ova.values()))[0])

@@ -200,11 +200,35 @@ def run(ctx: ProcessorContext, resume: bool = False) -> int:
     df, tags, weights = _load_training_frame(mc)
     scores = _sub_scores(ctx, combo, df)
 
-    # assemble model: dense gradient model over sub-model scores
-    from shifu_tpu.models.spec import save_model
-    from shifu_tpu.train.trainer import train_nn
     asm = combo["assemble"]
     alg = Algorithm.parse(asm["algorithm"])
+    asm_dir = _sub_dir(ctx, asm["name"])
+    os.makedirs(os.path.join(asm_dir, "models"), exist_ok=True)
+
+    if alg.is_tree:
+        # tree assemble (e.g. `combo -new NN,LR,GBT`): boost/bag over the
+        # score matrix with its own tree trainer, like the reference's
+        # ComboModelProcessor trains the assemble with its configured
+        # algorithm — NOT an MLP mislabeled as a tree
+        val_err = _train_assemble_tree(ctx, asm_dir, alg, scores, tags,
+                                       weights, combo)
+    else:
+        val_err = _train_assemble_dense(ctx, asm_dir, alg, scores, tags,
+                                        weights, combo, asm)
+    log.info("combo run: %d subs + assemble (%s) in %.2fs; assemble "
+             "val err %.6f", len(combo["subModels"]), asm["algorithm"],
+             time.time() - t0, val_err)
+    return 0
+
+
+def _train_assemble_dense(ctx: ProcessorContext, asm_dir: str, alg,
+                          scores: np.ndarray, tags: np.ndarray,
+                          weights: np.ndarray, combo: Dict,
+                          asm: Dict) -> float:
+    """Assemble model as a dense gradient model over sub-model scores."""
+    from shifu_tpu.models.spec import save_model
+    from shifu_tpu.train.trainer import train_nn
+    mc = ctx.model_config
     conf = mc.train
     if alg in (Algorithm.LR, Algorithm.SVM):
         from shifu_tpu.processor.train import _lr_spec
@@ -213,8 +237,6 @@ def run(ctx: ProcessorContext, resume: bool = False) -> int:
         from shifu_tpu.models import nn as nn_mod
         spec = nn_mod.MLPSpec.from_train_params(conf.params, scores.shape[1])
     res = train_nn(conf, scores, tags, weights, seed=4001, spec=spec)
-    asm_dir = _sub_dir(ctx, asm["name"])
-    os.makedirs(os.path.join(asm_dir, "models"), exist_ok=True)
     kind = "lr" if alg in (Algorithm.LR, Algorithm.SVM) else "nn"
     meta = {
         "spec": {
@@ -230,10 +252,65 @@ def run(ctx: ProcessorContext, resume: bool = False) -> int:
     }
     save_model(os.path.join(asm_dir, "models", f"model0.{kind}"), kind,
                meta, res.params_per_bag[0])
-    log.info("combo run: %d subs + assemble (%s) in %.2fs; assemble "
-             "val err %.6f", len(combo["subModels"]), asm["algorithm"],
-             time.time() - t0, float(res.best_val.min()))
-    return 0
+    return float(res.best_val.min())
+
+
+def _train_assemble_tree(ctx: ProcessorContext, asm_dir: str, alg,
+                         scores: np.ndarray, tags: np.ndarray,
+                         weights: np.ndarray, combo: Dict) -> float:
+    """Assemble model as GBT/RF over the (R, n_subs) score matrix.
+    Scores live in [0,1], so equal-interval interior cuts bin them."""
+    import dataclasses
+
+    from shifu_tpu.models import gbdt
+    from shifu_tpu.models.spec import save_model
+    from shifu_tpu.processor.train_tree import tree_config_from_params
+    from shifu_tpu.train.trainer import split_validation
+    mc = ctx.model_config
+    n_sub = scores.shape[1]
+    n_cut_slots = 32  # score-space resolution; scores are smooth in [0,1]
+    cuts = np.tile(np.linspace(0.0, 1.0, n_cut_slots + 1)[1:-1,
+                                                          None],
+                   (1, n_sub)).astype(np.float32)
+    n_bins = cuts.shape[0] + 2  # cut slots + 1 value slot + missing
+    cfg = dataclasses.replace(tree_config_from_params(mc), n_bins=n_bins)
+    tables = gbdt.make_bin_tables(cuts, [], n_bins)
+    bins = gbdt.bin_dataset(tables, scores, None, n_bins)
+
+    # same TreeNum/subset defaults as the standalone tree trainer
+    # (run_tree) so an identically configured assemble matches it
+    n_trees = int(mc.train.get_param(
+        "TreeNum", 10 if alg is Algorithm.RF else 100) or 10)
+    if alg is Algorithm.DT:
+        n_trees = 1
+    subset = str(mc.train.get_param("FeatureSubsetStrategy", "ALL") or "ALL")
+    tr_mask, val_mask = split_validation(len(tags), mc.train.validSetRate,
+                                         4001)
+    val_err = float("nan")
+    if alg is Algorithm.GBT:
+        trees, val_errs = gbdt.build_gbt(
+            cfg, bins[tr_mask], tags[tr_mask], weights[tr_mask], n_trees,
+            val_data=((bins[val_mask], tags[val_mask])
+                      if val_mask.any() else None))
+        kind = "gbt"
+        if val_errs:
+            val_err = val_errs[-1]
+    else:
+        trees = gbdt.build_rf(cfg, bins[tr_mask], tags[tr_mask],
+                              weights[tr_mask], n_trees, subset,
+                              mc.train.baggingSampleRate, 4001)
+        kind = "rf"
+    meta = {
+        "kind": kind,
+        "treeConfig": {"max_depth": cfg.max_depth, "n_bins": cfg.n_bins,
+                       "learning_rate": cfg.learning_rate, "loss": cfg.loss},
+        "denseNames": [s["name"] for s in combo["subModels"]],
+        "indexNames": [], "modelSetName": mc.model_set_name,
+        "nTrees": n_trees, "normType": "SCORE",
+    }
+    save_model(os.path.join(asm_dir, "models", f"model0.{kind}"), kind,
+               meta, {"trees": trees, "tables": tables})
+    return val_err
 
 
 def evaluate(ctx: ProcessorContext,
@@ -256,13 +333,22 @@ def evaluate(ctx: ProcessorContext,
     mc = ctx.model_config
     combo = _load_combo(ctx)
     asm = combo["assemble"]
+    asm_alg = Algorithm.parse(asm["algorithm"])
+    ext = {"LR": "lr", "SVM": "lr", "GBT": "gbt", "RF": "rf",
+           "DT": "rf"}.get(asm_alg.value, "nn")
     kind, meta, params = load_model(
-        os.path.join(_sub_dir(ctx, asm["name"]), "models",
-                     f"model0.{'lr' if asm['algorithm'] in ('LR', 'SVM') else 'nn'}"))
-    sd = dict(meta["spec"])
-    sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
-    sd["activations"] = tuple(sd.get("activations", ()))
-    spec = nn_mod.MLPSpec(**sd)
+        os.path.join(_sub_dir(ctx, asm["name"]), "models", f"model0.{ext}"))
+    if asm_alg.is_tree:
+        from shifu_tpu.models import gbdt
+        score_asm = lambda s: gbdt.predict(meta, params, s, None)  # noqa: E731
+    else:
+        sd = dict(meta["spec"])
+        sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
+        sd["activations"] = tuple(sd.get("activations", ()))
+        spec = nn_mod.MLPSpec(**sd)
+        jparams = jax.tree.map(jnp.asarray, params)
+        score_asm = lambda s: np.asarray(  # noqa: E731
+            nn_mod.forward(spec, jparams, jnp.asarray(s)))
 
     for ec in mc.evals:
         if eval_name is not None and ec.name != eval_name:
@@ -287,8 +373,7 @@ def evaluate(ctx: ProcessorContext,
         else:
             weights = np.ones(len(tags), np.float32)
         scores = _sub_scores(ctx, combo, df)
-        final = np.asarray(nn_mod.forward(
-            spec, jax.tree.map(jnp.asarray, params), jnp.asarray(scores)))
+        final = score_asm(scores)
         perf = performance_result(final, tags, weights,
                                   n_buckets=ec.performanceBucketNum)
         out_dir = os.path.join(ctx.path_finder.root, "evals",

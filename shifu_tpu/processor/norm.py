@@ -137,8 +137,10 @@ def apply_precision(dense: np.ndarray, ptype: str) -> np.ndarray:
         return dense.astype(np.float16).astype(np.float32)
     if ptype == "DOUBLE64":
         return dense.astype(np.float64)
-    if ptype == "FLOAT7":  # 7 fraction digits (PrecisionType DECIMAL_FORMAT)
-        return np.round(dense.astype(np.float32), 7)
+    if ptype == "FLOAT7":
+        # PrecisionType.FLOAT7 formats with DecimalFormat "#.######" —
+        # 6 fraction digits, despite the name
+        return np.round(dense.astype(np.float32), 6)
     return dense.astype(np.float32)
 
 
@@ -172,6 +174,13 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
                    "indexVocabSizes": result.index_vocab_sizes,
                    "precisionType": ptype,
                    "streaming": bool(streaming)}, f, indent=1)
+
+
+def load_normalized_meta(path: str) -> Dict:
+    """Read only meta.json (denseNames/indexNames) — the streaming train
+    path must not decompress data.npz back into host RAM."""
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)
 
 
 def load_normalized(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:

@@ -242,7 +242,10 @@ def _dense_spec_meta(ctx: ProcessorContext, spec: nn_mod.MLPSpec,
                      meta: Optional[Dict] = None) -> Dict:
     mc = ctx.model_config
     if meta is None:
-        _, meta = _load_dense_training_data(ctx)
+        # meta.json alone carries denseNames — never reload data.npz
+        # here (the streaming path exists to keep it out of host RAM)
+        meta = norm_proc.load_normalized_meta(
+            ctx.path_finder.normalized_data_path())
     out = {
         "spec": {
             "input_dim": spec.input_dim,

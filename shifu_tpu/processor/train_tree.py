@@ -160,4 +160,9 @@ def _continuous_trees(ctx: ProcessorContext, mc: ModelConfig, bag: int):
     _, _, params = load_model(path)
     import jax.numpy as jnp
     import jax
-    return jax.tree.map(jnp.asarray, params["trees"])
+    trees = dict(params["trees"])
+    if "gain" not in trees:
+        # checkpoints saved before gain tracking lack the key; backfill
+        # zeros so the resumed pytree structure matches fresh trees
+        trees["gain"] = np.zeros_like(np.asarray(trees["leaf_value"]))
+    return jax.tree.map(jnp.asarray, trees)

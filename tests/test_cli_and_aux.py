@@ -197,6 +197,40 @@ def test_combo_requires_three_algorithms(model_set):
         combo_proc.new(ctx, "NN,LR")
 
 
+def test_combo_tree_assemble(tmp_path, rng):
+    """`combo -new NN,LR,GBT` trains the assemble model with its OWN
+    algorithm (a GBT over the score matrix), not an MLP mislabeled as
+    a tree (ComboModelProcessor trains assemble per its algorithm)."""
+    import json
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import combo as combo_proc
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=900,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM",
+                                        "TreeNum": 15, "MaxDepth": 3})
+    ctx = ProcessorContext.load(root)
+    assert combo_proc.new(ctx, "NN,LR,GBT") == 0
+    combo = json.load(open(os.path.join(root, "ComboTrain.json")))
+    assert combo_proc.init(ctx) == 0
+    assert combo_proc.run(ctx) == 0
+    asm_dir = os.path.join(root, combo["assemble"]["name"])
+    # the saved assemble model is a real tree spec
+    assert os.path.exists(os.path.join(asm_dir, "models", "model0.gbt"))
+    from shifu_tpu.models.spec import load_model
+    kind, meta, params = load_model(
+        os.path.join(asm_dir, "models", "model0.gbt"))
+    assert kind == "gbt" and "trees" in params
+    assert combo_proc.evaluate(ctx) == 0
+    perf = json.load(open(os.path.join(
+        root, "evals", "Eval1_combo", "EvalPerformance.json")))
+    assert perf["areaUnderRoc"] > 0.8
+
+
 def test_convert_spec_bundle_roundtrip(tmp_path):
     """`convert`: compact npz spec ↔ open zip bundle, scores identical
     (IndependentTreeModelUtils zip/binary converter analog)."""

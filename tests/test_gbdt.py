@@ -159,6 +159,20 @@ def test_gbt_continuous_appends_trees(tmp_path, rng):
     _, _, params = load_model(ctx.path_finder.model_path(0, "gbt"))
     assert params["trees"]["feature"].shape[0] == 10
 
+    # resuming a checkpoint saved BEFORE gain tracking (no 'gain' key)
+    # must backfill zeros instead of crashing on pytree mismatch
+    from shifu_tpu.models.spec import save_model
+    kind, meta, params = load_model(ctx.path_finder.model_path(0, "gbt"))
+    legacy_trees = {k: v for k, v in params["trees"].items() if k != "gain"}
+    save_model(ctx.path_finder.model_path(0, "gbt"), kind, meta,
+               {"trees": legacy_trees, "tables": params["tables"]})
+    ctx = ProcessorContext.load(root)
+    ctx.model_config.train.isContinuous = True
+    train_proc.run(ctx)
+    _, _, params = load_model(ctx.path_finder.model_path(0, "gbt"))
+    assert params["trees"]["feature"].shape[0] == 15
+    assert "gain" in params["trees"]
+
 
 def test_pallas_histogram_matches_scatter(rng):
     """The Pallas MXU histogram kernel (ops/pallas_hist.py) matches the

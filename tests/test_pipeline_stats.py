@@ -246,7 +246,8 @@ def test_date_stats(tmp_path, rng):
         == cc.columnStats.totalCount
 
 
-@pytest.mark.parametrize("ptype,decimals", [("FLOAT16", 2), ("DOUBLE64", 9)])
+@pytest.mark.parametrize("ptype,decimals", [("FLOAT16", 2), ("DOUBLE64", 9),
+                                            ("FLOAT7", 6)])
 def test_norm_precision_types(statsed, ptype, decimals):
     """-Dshifu.precision.type quantizes normalized output
     (udf/norm/PrecisionType.java)."""
@@ -260,5 +261,9 @@ def test_norm_precision_types(statsed, ptype, decimals):
         # every value survives a half-precision round trip unchanged
         d = data["dense"]
         assert np.allclose(d, d.astype(np.float16).astype(np.float32))
+    elif ptype == "FLOAT7":
+        # FLOAT7's DecimalFormat "#.######" keeps 6 fraction digits
+        d = data["dense"]
+        assert np.allclose(d, np.round(d.astype(np.float64), 6), atol=1e-7)
     else:
         assert data["dense"].dtype == np.float64
