@@ -35,13 +35,19 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
     −1/vocab_len missing) — mirroring the reference's split where trees
     train on cleaned data (TrainModelProcessor:1547-1550)."""
     if kind in ("nn", "lr"):
+        from shifu_tpu.parallel import mesh as mesh_mod
         sd = dict(meta["spec"])
         sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
         sd["activations"] = tuple(sd.get("activations", ()))
         spec = nn_mod.MLPSpec(**sd)
+        # scoring shards rows over the data mesh (the Pig EvalScore
+        # mappers' split, EvalScoreUDF); padded rows are sliced off
+        mesh = mesh_mod.default_mesh()
+        n = dense.shape[0]
+        d_dense = mesh_mod.shard_axis(mesh, np.asarray(dense, np.float32), 0)
         out = nn_mod.forward(spec, jax.tree.map(jnp.asarray, params),
-                             jnp.asarray(dense))
-        return np.asarray(out)
+                             d_dense)
+        return np.asarray(out)[:n]
     if kind in ("gbt", "rf"):
         from shifu_tpu.models import gbdt
         rd = raw_dense if raw_dense is not None else dense

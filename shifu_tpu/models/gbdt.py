@@ -99,23 +99,10 @@ def _hist_mode() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
-def _level_histograms(bins, node_of_row, grad, hess, level_offset, n_level_nodes,
-                      n_bins):
-    """Per-level G/H histograms.
-
-    bins: (R, C) int32 in [0, n_bins); node_of_row: (R,) global node ids
-    (rows at inactive/finished nodes carry id -1 and scatter into a
-    dumped slot). Returns (n_level_nodes, C, n_bins) G and H.
-
-    On TPU this dispatches to the Pallas MXU kernel (the scatter-add
-    lowers to a serialized XLA scatter; the one-hot contraction rides
-    the systolic array instead — see ops/pallas_hist.py).
-    """
+def _local_level_histograms(bins, slot, grad, hess, n_level_nodes, n_bins):
+    """Single-shard histogram kernel (slot already computed, incl. the
+    trailing dump slot for inactive rows)."""
     r, c = bins.shape
-    local = node_of_row - level_offset  # (R,)
-    valid = (local >= 0) & (local < n_level_nodes)
-    slot = jnp.where(valid, local, n_level_nodes)  # dump slot
-
     if _hist_mode() == "pallas":
         from shifu_tpu.ops.pallas_hist import level_histograms_pallas
         return level_histograms_pallas(
@@ -130,6 +117,43 @@ def _level_histograms(bins, node_of_row, grad, hess, level_offset, n_level_nodes
         return z.at[node_ids, col_ids, bins].add(v[:, None])[:n_level_nodes]
 
     return scatter(grad), scatter(hess)
+
+
+def _level_histograms(bins, node_of_row, grad, hess, level_offset, n_level_nodes,
+                      n_bins, mesh=None):
+    """Per-level G/H histograms.
+
+    bins: (R, C) int32 in [0, n_bins); node_of_row: (R,) global node ids
+    (rows at inactive/finished nodes carry id -1 and scatter into a
+    dumped slot). Returns (n_level_nodes, C, n_bins) G and H.
+
+    With a multi-device `mesh`, rows shard over the 'data' axis and each
+    device builds its local histogram which a psum reduces — exactly the
+    DTWorker per-split accumulation + DTMaster aggregation
+    (`dt/DTWorker.java:914-944`, `dt/DTMaster.java:276`), explicit via
+    shard_map so no silent all-gather of the row-sharded bin matrix can
+    slip in. On TPU the local kernel is the Pallas MXU one-hot
+    contraction (ops/pallas_hist.py); elsewhere an XLA scatter-add.
+    """
+    local = node_of_row - level_offset  # (R,)
+    valid = (local >= 0) & (local < n_level_nodes)
+    slot = jnp.where(valid, local, n_level_nodes)  # dump slot
+
+    if mesh is not None and mesh.shape.get("data", 1) > 1:
+        from jax.sharding import PartitionSpec as P
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P("data", None), P("data"), P("data"), P("data")),
+                 out_specs=(P(), P()), check_vma=False)
+        def sharded(b, s, g, h):
+            gh_, hh_ = _local_level_histograms(b, s, g, h, n_level_nodes,
+                                               n_bins)
+            return (jax.lax.psum(gh_, "data"), jax.lax.psum(hh_, "data"))
+
+        return sharded(bins, slot, grad, hess)
+
+    return _local_level_histograms(bins, slot, grad, hess, n_level_nodes,
+                                   n_bins)
 
 
 def _best_splits(gh, cfg: TreeConfig, feature_mask):
@@ -180,13 +204,15 @@ def _best_splits(gh, cfg: TreeConfig, feature_mask):
             "default_left": best_dl, "g_tot": g_tot, "h_tot": h_tot}
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask):
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask, mesh=None):
     """Grow one tree level-by-level (all nodes of a level at once —
     DTMaster's todoNodes batch IS the level here).
 
     bins: (R, C) int32, missing = n_bins-1. grad/hess: (R,) float32
     (for RF: grad=label·w, hess=w → leaf = mean label).
+    `mesh`: row-shard the histogram build over its 'data' axis
+    (see _level_histograms).
     Returns flat arrays sized n_nodes: feature, bin, default_left,
     is_leaf, leaf_value.
     """
@@ -204,7 +230,8 @@ def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask):
         level_offset = 2 ** depth - 1
         n_level = 2 ** depth
         g_hist, h_hist = _level_histograms(bins, node_of_row, grad, hess,
-                                           level_offset, n_level, cfg.n_bins)
+                                           level_offset, n_level, cfg.n_bins,
+                                           mesh=mesh)
         s = _best_splits((g_hist, h_hist), cfg, feature_mask)
         can_split = (s["gain"] > cfg.min_info_gain) & \
                     jnp.isfinite(s["gain"])
@@ -236,7 +263,8 @@ def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask):
     level_offset = 2 ** cfg.max_depth - 1
     n_level = 2 ** cfg.max_depth
     g_hist, h_hist = _level_histograms(bins, node_of_row, grad, hess,
-                                       level_offset, n_level, cfg.n_bins)
+                                       level_offset, n_level, cfg.n_bins,
+                                       mesh=mesh)
     g_tot = g_hist[:, 0, :].sum(axis=1)
     h_tot = h_hist[:, 0, :].sum(axis=1)
     ids = level_offset + jnp.arange(n_level)
@@ -309,10 +337,11 @@ def gbt_gradients(y, pred_raw, weights, loss: str):
     return (pred_raw - y) * weights, jnp.ones_like(y) * weights
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _gbt_round(cfg: TreeConfig, bins, y, weights, pred_raw, feature_mask):
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _gbt_round(cfg: TreeConfig, bins, y, weights, pred_raw, feature_mask,
+               mesh=None):
     grad, hess = gbt_gradients(y, pred_raw, weights, cfg.loss)
-    tree = build_tree(cfg, bins, grad, hess, feature_mask)
+    tree = build_tree(cfg, bins, grad, hess, feature_mask, mesh=mesh)
     contrib = predict_trees(
         jax.tree.map(lambda a: a[None], tree), bins,
         cfg.max_depth, cfg.n_bins)[0]
@@ -328,14 +357,22 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     """Sequential boosting (host loop — rounds are data-dependent).
     Returns (stacked trees pytree, per-round val errors). init_trees
     resumes a previous ensemble (GBT continuous training appends
-    trees, TrainModelProcessor.java:1064-1073)."""
-    jb = jnp.asarray(bins)
-    jy = jnp.asarray(y)
-    jw = jnp.asarray(weights)
+    trees, TrainModelProcessor.java:1064-1073).
+
+    Rows shard over the default data mesh; zero-weight padding keeps
+    gradients/hessians (and hence histograms and leaf values) exact.
+    """
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.default_mesh()
+    hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
+    jb = mesh_mod.shard_axis(mesh, np.asarray(bins, np.int32), 0,
+                             pad_value=0)
+    jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
+                                 np.asarray(weights, np.float32))
     fm = jnp.asarray(feature_mask if feature_mask is not None
                      else np.ones(bins.shape[1], np.float32))
     trees: List[Any] = []
-    pred = jnp.zeros(len(y), jnp.float32)
+    pred = jnp.zeros(jb.shape[0], jnp.float32)
     if init_trees is not None:
         n_prev = init_trees["feature"].shape[0]
         trees = [jax.tree.map(lambda a, i=i: a[i], init_trees)
@@ -347,21 +384,25 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     vraw = None
     if val_data is not None:
         vb, vy = val_data
-        vb = jnp.asarray(vb)
-        vy = jnp.asarray(vy)
+        n_val = vb.shape[0]
+        vb = mesh_mod.shard_axis(mesh, np.asarray(vb, np.int32), 0)
+        vy, vw = mesh_mod.shard_rows(
+            mesh, np.asarray(vy, np.float32), np.ones(n_val, np.float32))
         vraw = jnp.zeros(vb.shape[0], jnp.float32)
         if init_trees is not None:
             vraw = cfg.learning_rate * jnp.sum(predict_trees(
                 init_trees, vb, cfg.max_depth, cfg.n_bins), axis=0)
     for t in range(n_trees):
-        tree, pred = _gbt_round(cfg, jb, jy, jw, pred, fm)
+        tree, pred = _gbt_round(cfg, jb, jy, jw, pred, fm, mesh=hist_mesh)
         trees.append(tree)
         if val_data is not None:
             vraw = vraw + cfg.learning_rate * predict_trees(
                 jax.tree.map(lambda a: a[None], tree), vb,
                 cfg.max_depth, cfg.n_bins)[0]
             vp = jax.nn.sigmoid(vraw) if cfg.loss.startswith("log") else vraw
-            err = float(jnp.mean((vp - vy) ** 2))
+            # weighted mean so zero-weight padding rows don't bias it
+            err = float(jnp.sum((vp - vy) ** 2 * vw) /
+                        jnp.maximum(jnp.sum(vw), 1e-12))
             val_errs.append(err)
             if err < best_val - 1e-9:
                 best_val, bad = err, 0
@@ -379,6 +420,7 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     """Random forest: all trees independent → ONE vmapped build with
     per-tree Poisson instance weights (DTWorker Poisson sampling) and
     Bernoulli feature-subset masks."""
+    from shifu_tpu.parallel import mesh as mesh_mod
     rng = np.random.default_rng(seed)
     r, c = bins.shape
     inst_w = rng.poisson(max(bagging_rate, 1e-6),
@@ -389,9 +431,14 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     for t in range(n_trees):
         masks[t, rng.choice(c, size=k, replace=False)] = 1.0
 
-    jb = jnp.asarray(bins)
-    jy = jnp.asarray(y)
-    jw = jnp.asarray(weights)
+    # rows sharded over the data mesh (zero-weight padding is inert);
+    # trees vmapped — the scatter partitions under GSPMD here (shard_map
+    # under vmap is avoided), reducing with a cross-device sum
+    mesh = mesh_mod.default_mesh()
+    jb = mesh_mod.shard_axis(mesh, np.asarray(bins, np.int32), 0)
+    jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
+                                 np.asarray(weights, np.float32))
+    d_inst_w = mesh_mod.shard_axis(mesh, inst_w, axis=1)
 
     @partial(jax.jit, static_argnames=())
     def one(iw, fm):
@@ -400,7 +447,7 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
         hess = jw * iw
         return build_tree(cfg, jb, grad, hess, fm)
 
-    stacked = jax.vmap(one)(jnp.asarray(inst_w), jnp.asarray(masks))
+    stacked = jax.vmap(one)(d_inst_w, jnp.asarray(masks))
     return jax.tree.map(np.asarray, stacked)
 
 
@@ -454,14 +501,19 @@ def bin_dataset(tables: Dict[str, np.ndarray], dense: np.ndarray,
 def predict(meta: Dict[str, Any], params: Any, dense: np.ndarray,
             codes: Optional[np.ndarray]) -> np.ndarray:
     """Score a saved GBT/RF spec on raw cleaned features."""
+    from shifu_tpu.parallel import mesh as mesh_mod
     cfg_meta = meta["treeConfig"]
     n_bins = int(cfg_meta["n_bins"])
     tables = {"num_cuts": np.asarray(params["tables"]["num_cuts"]),
               "cat_map": np.asarray(params["tables"]["cat_map"])}
     bins = bin_dataset(tables, dense, codes, n_bins)
+    n_rows = bins.shape[0]
     trees = jax.tree.map(jnp.asarray, params["trees"])
-    per_tree = np.asarray(predict_trees(trees, jnp.asarray(bins),
-                                        int(cfg_meta["max_depth"]), n_bins))
+    mesh = mesh_mod.default_mesh()
+    jb = mesh_mod.shard_axis(mesh, bins, 0)
+    per_tree = np.asarray(predict_trees(trees, jb,
+                                        int(cfg_meta["max_depth"]),
+                                        n_bins))[:, :n_rows]
     if meta["kind"] == "rf":
         # RF trees were built with grad=-y·w, hess=w, so leaf values are
         # already +mean(label); the forest averages them

@@ -90,34 +90,48 @@ def bin_index_numeric(values: jax.Array, cuts: jax.Array) -> jax.Array:
 
 @partial(jax.jit, static_argnames=("num_slots",))
 def bin_accumulate(bin_idx: jax.Array, tags: jax.Array, weights: jax.Array,
-                   num_slots: int) -> Dict[str, jax.Array]:
+                   num_slots: int,
+                   row_mask: jax.Array = None) -> Dict[str, jax.Array]:
     """Scatter-add pos/neg/weighted counts per (column, bin).
 
     bin_idx: (R, C) int32 in [0, num_slots); tags: (R,) 1/0;
-    weights: (R,). Returns counts dict of (C, num_slots) arrays. This
-    one fused scatter replaces the UpdateBinningInfo MR job.
+    weights: (R,). row_mask: optional (R,) 1/0 — rows with 0 contribute
+    to NO count (mesh padding rows are excluded here, in the kernel,
+    rather than by host-side corrections). Returns counts dict of
+    (C, num_slots) arrays. This one fused scatter replaces the
+    UpdateBinningInfo MR job.
     """
     r, c = bin_idx.shape
     col_ids = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[None, :], (r, c))
     pos = (tags > 0.5).astype(jnp.float32)
+    m = row_mask if row_mask is not None else jnp.ones_like(pos)
 
     def scatter(row_vals):
         z = jnp.zeros((c, num_slots), jnp.float32)
         return z.at[col_ids, bin_idx].add(row_vals[:, None])
 
     return {
-        "count_pos": scatter(pos),
-        "count_neg": scatter(1.0 - pos),
-        "weight_pos": scatter(pos * weights),
-        "weight_neg": scatter((1.0 - pos) * weights),
+        "count_pos": scatter(pos * m),
+        "count_neg": scatter((1.0 - pos) * m),
+        "weight_pos": scatter(pos * weights * m),
+        "weight_neg": scatter((1.0 - pos) * weights * m),
     }
 
 
 @jax.jit
-def moment_stats(values: jax.Array) -> Dict[str, jax.Array]:
+def moment_stats(values: jax.Array,
+                 row_mask: jax.Array = None) -> Dict[str, jax.Array]:
     """Per-column mean/std/min/max/moment sums, NaN-aware (missing
     excluded, matching `statsExcludeMissingValue` default in
-    UpdateBinningInfoReducer.java:453-454). All (C,) float32."""
+    UpdateBinningInfoReducer.java:453-454). All (C,) float32.
+    row_mask: optional (R,) 1/0 — 0 rows (mesh padding, already
+    NaN-valued so the moments ignore them) are excluded from the
+    missing count too."""
+    if row_mask is not None:
+        missing = jnp.sum(jnp.isnan(values) * row_mask[:, None],
+                          axis=0).astype(jnp.float32)
+    else:
+        missing = jnp.sum(jnp.isnan(values), axis=0).astype(jnp.float32)
     n = jnp.sum(~jnp.isnan(values), axis=0).astype(jnp.float32)
     mean = jnp.nanmean(values, axis=0)
     centered = values - mean[None, :]
@@ -133,20 +147,21 @@ def moment_stats(values: jax.Array) -> Dict[str, jax.Array]:
     return {
         "count": n, "mean": mean, "std": std,
         "min": jnp.nanmin(values, axis=0), "max": jnp.nanmax(values, axis=0),
-        "missing": jnp.sum(jnp.isnan(values), axis=0).astype(jnp.float32),
+        "missing": missing,
         "skewness": skew, "kurtosis": kurt,
     }
 
 
 @partial(jax.jit, static_argnames=("num_slots",))
 def cat_bin_accumulate(codes: jax.Array, tags: jax.Array, weights: jax.Array,
-                       vocab_lens: jax.Array, num_slots: int) -> Dict[str, jax.Array]:
+                       vocab_lens: jax.Array, num_slots: int,
+                       row_mask: jax.Array = None) -> Dict[str, jax.Array]:
     """Categorical counts: codes (R, C) int32 with -1 = missing; the
     missing bin of column c is slot vocab_lens[c] (ragged vocabularies
-    padded to num_slots)."""
+    padded to num_slots). row_mask as in bin_accumulate."""
     idx = jnp.where(codes < 0, vocab_lens[None, :], codes)
     idx = jnp.clip(idx, 0, num_slots - 1)
-    return bin_accumulate(idx, tags, weights, num_slots)
+    return bin_accumulate(idx, tags, weights, num_slots, row_mask)
 
 
 # ---------------------------------------------------------------------------

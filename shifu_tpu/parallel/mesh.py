@@ -28,6 +28,54 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_MESH_CACHE: dict = {}
+
+
+def default_mesh() -> Mesh:
+    """The process-wide data mesh every processor executes over by
+    default — the round-2 replacement for 'workers': on one chip it is
+    a 1-device mesh (the reference's LOCAL mode), on a TPU host it is
+    all chips, multi-host it is all global devices (DCN via
+    parallel/dist.initialize). SHIFU_TPU_MESH_DEVICES=N caps the
+    device count (tests use it to compare 8-device vs 1-device runs).
+    """
+    import os
+    cap = os.environ.get("SHIFU_TPU_MESH_DEVICES")
+    devs = jax.devices()
+    n = min(int(cap), len(devs)) if cap else len(devs)
+    key = (n, tuple(d.id for d in devs[:n]))
+    m = _MESH_CACHE.get(key)
+    if m is None:
+        m = make_mesh(n_data=n, n_model=1, devices=devs[:n])
+        _MESH_CACHE[key] = m
+    return m
+
+
+def shard_axis(mesh: Mesh, a: np.ndarray, axis: int = 0,
+               pad_value=0):
+    """Place one host array onto the mesh sharded along `axis`, padding
+    that axis to a multiple of the data-axis size with `pad_value`
+    (weight-0 / NaN-missing padding keeps downstream results exact —
+    callers choose the value that is inert for their kernel)."""
+    a = np.asarray(a)
+    n_data = mesh.shape["data"]
+    pad = (-a.shape[axis]) % n_data
+    if pad:
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, pad)
+        a = np.pad(a, widths, constant_values=pad_value)
+    spec = [None] * a.ndim
+    spec[axis] = "data"
+    return jax.device_put(a, NamedSharding(mesh, P(*spec)))
+
+
+def place_replicated(mesh: Mesh, tree):
+    """device_put a whole pytree fully replicated over the mesh (model
+    parameters / optimizer state — the reference's 'broadcast new
+    weights' step is this sharding)."""
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build a ("data", "model") mesh. Defaults to all devices on the
@@ -54,16 +102,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_rows(mesh: Mesh, *arrays):
     """Place row-major host arrays onto the mesh sharded by row.
-    Pads the row count to a multiple of the data-axis size (padding
-    rows carry zero weight downstream, so results are unchanged)."""
-    n_data = mesh.shape["data"]
-    out = []
-    for a in arrays:
-        r = a.shape[0]
-        pad = (-r) % n_data
-        if pad:
-            a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-        out.append(jax.device_put(a, data_sharding(mesh, a.ndim)))
+    Pads the row count to a multiple of the data-axis size with zeros
+    (padding rows carry zero weight downstream, so results are
+    unchanged)."""
+    out = [shard_axis(mesh, a, axis=0) for a in arrays]
     return out if len(out) > 1 else out[0]
 
 

@@ -70,7 +70,13 @@ def run(ctx: ProcessorContext) -> int:
         names.extend(dset.cat_names)
     x = np.concatenate(blocks, axis=1).astype(np.float32)
 
-    corr = np.asarray(pearson_matrix(jnp.asarray(x)))
+    # rows shard over the data mesh (the multithreaded CorrelationMapper
+    # splits); NaN padding is excluded by the co-valid masks, so the
+    # GEMMs reduce with a psum and stay exact
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.default_mesh()
+    corr = np.asarray(pearson_matrix(
+        mesh_mod.shard_axis(mesh, x, 0, pad_value=np.nan)))
     out = ctx.path_finder.correlation_path()
     ctx.path_finder.ensure(out)
     with open(out, "w") as f:

@@ -116,22 +116,32 @@ def compute_stats(ctx: ProcessorContext, dset: ColumnarDataset,
                   cc_map=None) -> None:
     """Fill stats into ColumnConfigs; `cc_map` redirects a dataset
     column's number to a different target config (segment copies)."""
+    from shifu_tpu.parallel import mesh as mesh_mod
     mc = ctx.model_config
     cc_by_num = cc_map or {c.columnNum: c for c in ctx.column_configs}
     tags, weights = dset.tags, dset.weights
-    jt, jw = jnp.asarray(tags), jnp.asarray(weights)
+    # rows shard over the default data mesh (the reference's per-worker
+    # HDFS splits); padding rows carry row_mask 0 so the counting
+    # kernels exclude them by construction, and NaN values so the
+    # moment/quantile kernels ignore them
+    mesh = mesh_mod.default_mesh()
+    jt = mesh_mod.shard_axis(mesh, tags, 0, pad_value=0)
+    jw = mesh_mod.shard_axis(mesh, weights, 0, pad_value=0)
+    jmask = mesh_mod.shard_axis(
+        mesh, np.ones(dset.num_rows, np.float32), 0, pad_value=0)
     max_bins = mc.stats.maxNumBin
 
     # ---------------- numeric columns ----------------
     if dset.numeric.shape[1] > 0:
-        values = jnp.asarray(dset.numeric)
+        values = mesh_mod.shard_axis(mesh, dset.numeric, 0,
+                                     pad_value=np.nan)
         binning = compute_numeric_binning(dset.numeric, tags, weights,
                                           mc.stats.binningMethod, max_bins)
         bin_idx = stats_ops.bin_index_numeric(values, jnp.asarray(binning.cuts_padded))
         counts = {k: np.asarray(v) for k, v in stats_ops.bin_accumulate(
-            bin_idx, jt, jw, max_bins + 1).items()}
+            bin_idx, jt, jw, max_bins + 1, jmask).items()}
         moments = {k: np.asarray(v) for k, v in
-                   stats_ops.moment_stats(values).items()}
+                   stats_ops.moment_stats(values, jmask).items()}
         quartiles = np.asarray(stats_ops.weighted_quantiles(
             values, jnp.ones_like(values), 3))  # p25 / median / p75
 
@@ -146,9 +156,11 @@ def compute_stats(ctx: ProcessorContext, dset: ColumnarDataset,
     if dset.cat_codes.shape[1] > 0:
         vocab_lens = np.asarray([len(v) for v in dset.vocabs], np.int32)
         slots = int(vocab_lens.max()) + 1 if len(vocab_lens) else 1
+        codes_dev = mesh_mod.shard_axis(mesh, dset.cat_codes, 0,
+                                        pad_value=-1)
         ccounts = {k: np.asarray(v) for k, v in stats_ops.cat_bin_accumulate(
-            jnp.asarray(dset.cat_codes), jt, jw, jnp.asarray(vocab_lens),
-            slots).items()}
+            codes_dev, jt, jw, jnp.asarray(vocab_lens),
+            slots, jmask).items()}
         for j, col_num in enumerate(dset.cat_column_nums):
             cc = cc_by_num[int(col_num)]
             vocab = dset.vocabs[j]

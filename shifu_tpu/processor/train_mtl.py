@@ -101,14 +101,15 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
 
     optimizer = optimizer_from_params(mc.train.params)
     ew = mc.train.earlyStoppingRounds
+    # train_bags shards rows / replicates params over the default mesh
     best_params, _, _, best_val, _ = train_bags(
         loss, metric, optimizer, mc.train.numTrainEpochs,
         ew if ew and ew > 0 else 0,
         float(mc.train.convergenceThreshold or 0.0),
-        stacked, (jnp.asarray(dense[tr_mask]), jnp.asarray(y[tr_mask])),
-        jnp.asarray(bag_w),
-        (jnp.asarray(dense[val_mask]), jnp.asarray(y[val_mask])),
-        jnp.asarray(w[val_mask]), bag_keys, grad_mask)
+        stacked, (dense[tr_mask], y[tr_mask]),
+        bag_w,
+        (dense[val_mask], y[val_mask]),
+        w[val_mask], bag_keys, grad_mask)
 
     spec_meta = {
         "kind": "mtl",

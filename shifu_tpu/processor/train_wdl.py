@@ -70,17 +70,16 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
 
     optimizer = optimizer_from_params(mc.train.params)
     ew = mc.train.earlyStoppingRounds
+    # train_bags shards rows / replicates params over the default mesh
     best_params, train_errs, val_errs, best_val, best_epoch = train_bags(
         loss, metric, optimizer, mc.train.numTrainEpochs,
         ew if ew and ew > 0 else 0,
         float(mc.train.convergenceThreshold or 0.0),
         stacked,
-        (jnp.asarray(dense[tr_mask]), jnp.asarray(idx[tr_mask]),
-         jnp.asarray(y[tr_mask])),
-        jnp.asarray(bag_w),
-        (jnp.asarray(dense[val_mask]), jnp.asarray(idx[val_mask]),
-         jnp.asarray(y[val_mask])),
-        jnp.asarray(w[val_mask]), bag_keys, grad_mask)
+        (dense[tr_mask], idx[tr_mask], y[tr_mask]),
+        bag_w,
+        (dense[val_mask], idx[val_mask], y[val_mask]),
+        w[val_mask], bag_keys, grad_mask)
 
     spec_meta = {
         "kind": "wdl",

@@ -138,16 +138,19 @@ def _filter_by_stats(ctx: ProcessorContext, candidates: List[ColumnConfig],
 
 
 @partial(jax.jit, static_argnames=("spec",))
-def _sensitivity_kernel(spec, params, x, base_score):
+def _sensitivity_kernel(spec, params, x, base_score, n_real=None):
     """(C,) mean squared score delta when column c is wiped to 0
     (normalized space ⇒ 0 is the mean / missing value), the
-    `VarSelectMapper` MSE delta — all columns at once via vmap."""
+    `VarSelectMapper` MSE delta — all columns at once via vmap.
+    `n_real` divides out mesh padding rows (all-zero rows score
+    identically wiped or not, so they add 0 to the sums)."""
     c = x.shape[1]
+    n = n_real if n_real is not None else x.shape[0]
 
     def wiped(col):
         mask = jnp.ones((c,)).at[col].set(0.0)
         s = nn_mod.forward(spec, params, x * mask[None, :])
-        return jnp.mean(jnp.square(s - base_score))
+        return jnp.sum(jnp.square(s - base_score)) / n
 
     return jax.vmap(wiped)(jnp.arange(c))
 
@@ -196,9 +199,16 @@ def _filter_by_sensitivity(ctx: ProcessorContext,
     res = train_nn(conf, x, y, w, seed=seed)
     params = jax.tree.map(jnp.asarray, res.params_per_bag[0])
 
-    jx = jnp.asarray(x)
+    # sensitivity re-scoring shards rows over the data mesh — the MR
+    # VarSelectMapper's split (VarSelectMapper.java:54); the vmapped
+    # column ablation rides on top of the row sharding
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.default_mesh()
+    n_real = x.shape[0]
+    jx = mesh_mod.shard_axis(mesh, x, 0)
     base = nn_mod.forward(res.spec, params, jx)
-    deltas = np.asarray(_sensitivity_kernel(res.spec, params, jx, base))
+    deltas = np.asarray(_sensitivity_kernel(res.spec, params, jx, base,
+                                            n_real))
 
     # map dense output columns back to source columns (onehot/index
     # families expand; sum deltas per source column)
@@ -209,7 +219,7 @@ def _filter_by_sensitivity(ctx: ProcessorContext,
         per_col[src] = per_col.get(src, 0.0) + float(d)
 
     if by == "ST":
-        var = float(np.var(np.asarray(base))) or 1.0
+        var = float(np.var(np.asarray(base)[:n_real])) or 1.0
         per_col = {k: v / var for k, v in per_col.items()}
 
     se_path = ctx.path_finder.se_path(0)

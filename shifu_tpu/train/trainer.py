@@ -37,6 +37,7 @@ import optax
 
 from shifu_tpu.config.model_config import ModelTrainConf
 from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.parallel import mesh as mesh_mod
 from shifu_tpu.train.optimizers import optimizer_from_params
 
 log = logging.getLogger("shifu_tpu")
@@ -178,7 +179,24 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
     """Non-resumable façade over train_bags_carry, with optional
     checkpointing: when checkpoint_dir is set, training runs in
     `checkpoint_interval`-epoch chunks, saving the full carry after each
-    (and restoring an existing checkpoint before starting)."""
+    (and restoring an existing checkpoint before starting).
+
+    Placement happens HERE, once, for every caller (NN/LR/WDL/MTL): row
+    tensors shard over the default data mesh — the psum XLA inserts for
+    the gradient mean over sharded rows IS the reference's master
+    aggregation (nn/NNMaster.java:248-259) — while parameters,
+    optimizer state, keys and grad masks replicate. Zero-weight row
+    padding is inert because every loss/metric normalizes by sum(w)."""
+    mesh = mesh_mod.default_mesh()
+    train_inputs = tuple(mesh_mod.shard_axis(mesh, t, 0)
+                         for t in train_inputs)
+    val_inputs = tuple(mesh_mod.shard_axis(mesh, t, 0) for t in val_inputs)
+    w_train_bags = mesh_mod.shard_axis(mesh, w_train_bags, axis=1)
+    w_val = mesh_mod.shard_axis(mesh, w_val, 0)
+    stacked_params = mesh_mod.place_replicated(mesh, stacked_params)
+    grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
+    dropout_keys = mesh_mod.place_replicated(mesh, jnp.asarray(dropout_keys))
+
     carry = init_train_carry(optimizer, stacked_params, dropout_keys)
     done = 0
     tr_chunks, va_chunks = [], []
@@ -289,8 +307,8 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
         nn_loss, nn_metric, optimizer, train_conf.numTrainEpochs,
         early_window if early_window and early_window > 0 else 0,
         float(train_conf.convergenceThreshold or 0.0),
-        stacked, (jnp.asarray(x_tr), jnp.asarray(y_tr)), jnp.asarray(bag_w),
-        (jnp.asarray(x_v), jnp.asarray(y_v)), jnp.asarray(w_v),
+        stacked, (x_tr, y_tr), bag_w,
+        (x_v, y_v), w_v,
         bag_keys[:-1], grad_mask,
         checkpoint_dir=checkpoint_dir,
         checkpoint_interval=checkpoint_interval)

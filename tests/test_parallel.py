@@ -1,0 +1,160 @@
+"""SPMD-by-default tests: the REAL pipeline over the 8-device mesh.
+
+The reference certifies its distributed loop with GuaguaMRUnitDriver
+(whole master–worker app in one JVM, SURVEY.md §4.3); here the analog
+is the real processors running over the 8-virtual-device CPU mesh and
+matching their 1-device results — plus an HLO check that the GBDT
+histogram reduction is an all-reduce (psum), not an all-gather of the
+row-sharded bin matrix (dt/DTMaster.java:276 aggregation semantics).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def single_device_env():
+    """Context: force a 1-device mesh via SHIFU_TPU_MESH_DEVICES."""
+    os.environ["SHIFU_TPU_MESH_DEVICES"] = "1"
+    yield
+    os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
+
+
+def _train_and_collect(root):
+    from shifu_tpu.processor import (init as init_proc, norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    from shifu_tpu.models.spec import load_model
+    _, meta, params = load_model(ctx.path_finder.model_path(0, "nn"))
+    with open(ctx.path_finder.val_error_path()) as f:
+        val = json.load(f)
+    return params, val, ctx
+
+
+def test_train_mesh_parity_8dev_vs_1dev(tmp_path, rng):
+    """`shifu train` over the 8-device mesh produces the same model as
+    1-device within fp tolerance (VERDICT #1 done-when)."""
+    import jax
+    from tests.synth import make_model_set
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+
+    # identical data for both runs: fresh identically-seeded rngs (the
+    # session `rng` fixture has been advanced by earlier tests)
+    params8, val8, ctx8 = _train_and_collect(
+        make_model_set(tmp_path / "m8", np.random.default_rng(777),
+                       n_rows=1500))
+    try:
+        os.environ["SHIFU_TPU_MESH_DEVICES"] = "1"
+        params1, val1, ctx1 = _train_and_collect(
+            make_model_set(tmp_path / "m1",
+                           np.random.default_rng(777), n_rows=1500))
+    finally:
+        os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
+
+    # same data (same rng seed), same seeds → same model up to collective
+    # reduction order
+    for l8, l1 in zip(params8, params1):
+        for k in l8:
+            np.testing.assert_allclose(np.asarray(l8[k]), np.asarray(l1[k]),
+                                       rtol=2e-3, atol=2e-4)
+    assert abs(val8["bestValError"][0] - val1["bestValError"][0]) < 1e-3
+
+
+def test_stats_mesh_pad_correction(tmp_path, rng):
+    """Stats over the 8-device mesh with a row count NOT divisible by 8:
+    missing counts and bin counts must not absorb the padding rows."""
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import init as init_proc, stats as stats_proc
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=1003)  # 1003 % 8 != 0
+    for proc in (init_proc, stats_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    total_rows = None
+    for cc in ctx.column_configs:
+        if not cc.is_candidate or cc.columnBinning.binCountPos is None:
+            continue
+        st = cc.columnStats
+        bn = cc.columnBinning
+        n = int(np.sum(bn.binCountPos) + np.sum(bn.binCountNeg))
+        # every row lands in exactly one bin (incl. missing): counts sum
+        # to the real row count, not the padded one
+        assert n == st.totalCount, (cc.columnName, n, st.totalCount)
+        assert st.missingCount >= 0
+        total_rows = st.totalCount
+    assert total_rows is not None and total_rows <= 1003
+
+
+def test_gbdt_sharded_histogram_matches_single_device(rng):
+    """A tree built on the 8-device mesh with row-sharded bins picks the
+    SAME splits as single-device (VERDICT #5)."""
+    import jax
+    from shifu_tpu.models import gbdt
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    r, c, b = 1000, 6, 16
+    bins = rng.integers(0, b - 1, (r, c)).astype(np.int32)
+    y = (rng.random(r) < 0.4).astype(np.float32)
+    w = np.ones(r, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=4, n_bins=b, loss="log")
+
+    trees8, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=5)
+    try:
+        os.environ["SHIFU_TPU_MESH_DEVICES"] = "1"
+        trees1, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=5)
+    finally:
+        os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
+
+    np.testing.assert_array_equal(trees8["feature"], trees1["feature"])
+    np.testing.assert_array_equal(trees8["bin"], trees1["bin"])
+    np.testing.assert_allclose(trees8["leaf_value"], trees1["leaf_value"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gbdt_histogram_reduction_is_psum_not_gather(rng):
+    """HLO check: the sharded level-histogram reduces with all-reduce
+    (psum) and never all-gathers the row-sharded (R, C) bin matrix —
+    the silent-gather failure mode VERDICT #5 warns about."""
+    import jax
+    import jax.numpy as jnp
+    from shifu_tpu.models.gbdt import _level_histograms
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.default_mesh()
+    assert mesh.shape["data"] == 8
+
+    r, c, b, s = 1024, 4, 8, 4
+    bins = mesh_mod.shard_axis(mesh, rng.integers(0, b, (r, c)).astype(np.int32), 0)
+    node = mesh_mod.shard_axis(mesh, rng.integers(0, s, r).astype(np.int32), 0)
+    grad = mesh_mod.shard_axis(mesh, rng.normal(0, 1, r).astype(np.float32), 0)
+    hess = mesh_mod.shard_axis(mesh, np.ones(r, np.float32), 0)
+
+    def hist(bins, node, grad, hess):
+        return _level_histograms(bins, node, grad, hess, 0, s, b, mesh=mesh)
+
+    lowered = jax.jit(hist).lower(bins, node, grad, hess)
+    hlo = lowered.compile().as_text()
+    assert "all-reduce" in hlo, "histogram reduction should be a psum"
+    assert "all-gather" not in hlo, \
+        "row-sharded operands must not be all-gathered"
+
+    # and the result matches the unsharded computation
+    g, h = jax.jit(hist)(bins, node, grad, hess)
+    bins_h = np.asarray(bins)
+    node_h = np.asarray(node)
+    grad_h = np.asarray(grad)
+    g_ref = np.zeros((s, c, b), np.float32)
+    for i in range(r):
+        if node_h[i] < s:
+            for j in range(c):
+                g_ref[node_h[i], j, bins_h[i, j]] += grad_h[i]
+    np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-5, atol=1e-4)
