@@ -1,15 +1,34 @@
-"""Streaming (>HBM) training — double-buffered host→HBM chunks.
+"""Streaming (>HBM) training — double-buffered host→HBM chunks, SPMD.
 
 The reference trains full-split-in-RAM with a disk spill fallback
 (`core/dtrain/dataset/MemoryDiskFloatMLDataSet.java:27-99`: rows past
 the memory budget go to a disk file replayed every epoch). The TPU
 analog (SURVEY.md §5 long-context note): when the normalized matrix
 exceeds HBM, stream fixed-size row chunks host→device with the NEXT
-chunk's `jax.device_put` issued while the CURRENT chunk's jitted
-update runs — JAX dispatch is async, so transfer and compute overlap
-(double buffering). Training degrades gracefully from full-batch to
-chunked mini-batch SGD; the epoch loop, optimizer state, and
-early-stop live across chunks.
+chunk's transfer issued while the CURRENT chunk's jitted update runs —
+JAX dispatch is async, so transfer and compute overlap (double
+buffering). Training degrades gracefully from full-batch to chunked
+mini-batch SGD; the epoch loop, optimizer state, and early-stop live
+across chunks.
+
+Round-2 upgrades over the single-device round-1 loop:
+- every chunk is placed row-sharded over the default device mesh
+  (params replicated), so streaming scales over all chips exactly like
+  the resident trainer — the gradient mean over sharded rows compiles
+  to a psum (nn/NNMaster.java:248-259 aggregation);
+- multi-host: each process feeds only its row slice of every chunk and
+  `jax.make_array_from_process_local_data` assembles the global
+  chunk (parallel/dist.global_row_array) — the DCN analog of each
+  Guagua worker reading its own HDFS split;
+- chunk order reshuffles every epoch (seeded), replacing the
+  reference's one-time MapReduceShuffle resharding
+  (`core/shuffle/MapReduceShuffle.java:44`): chunked SGD sees a
+  different data order each epoch;
+- bagging streams too: the update is vmapped over a bag axis with
+  per-(bag, row) Poisson/Bernoulli multiplicities generated
+  deterministically per chunk (counter-based, so epoch replays see the
+  same bag membership — AbstractNNWorker's Poisson bagging without
+  materializing a (bags, N) matrix).
 
 Activated by `train#trainOnDisk` (the reference's knob for the same
 situation). `norm` then stores the matrix as raw .npy files so chunks
@@ -30,10 +49,38 @@ import optax
 
 from shifu_tpu.config.model_config import ModelTrainConf
 from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.parallel import mesh as mesh_mod
 from shifu_tpu.train.optimizers import optimizer_from_params
 from shifu_tpu.train.trainer import TrainResult
 
 log = logging.getLogger("shifu_tpu")
+
+
+def _chunk_bag_weights(n_bags: int, sample_rate: float,
+                       with_replacement: bool, seed: int,
+                       start: int, stop: int) -> np.ndarray:
+    """(bags, stop-start) bagging multiplicities for a row range,
+    counter-based on the GLOBAL row index so every epoch (and every
+    resume) sees identical bag membership.
+
+    A bag that draws nothing in some chunk simply contributes a
+    zero-weight chunk: loss_fn clamps its weight denominator, so the
+    data gradient is exactly zero for that chunk — no per-chunk rescue
+    (which would wrongly re-admit excluded rows)."""
+    if n_bags == 1 and sample_rate >= 1.0 and not with_replacement:
+        return np.ones((1, stop - start), np.float32)
+    rows = stop - start
+    out = np.empty((n_bags, rows), np.float32)
+    for b in range(n_bags):
+        # Philox is counter-based: jumping to `start` is O(1)-ish and
+        # guarantees row r always draws the same variate for bag b
+        bit = np.random.Generator(np.random.Philox(key=seed + 7919 * b,
+                                                   counter=start))
+        if with_replacement:
+            out[b] = bit.poisson(sample_rate, rows).astype(np.float32)
+        else:
+            out[b] = (bit.random(rows) < sample_rate).astype(np.float32)
+    return out
 
 
 def train_nn_streaming(train_conf: ModelTrainConf,
@@ -45,11 +92,13 @@ def train_nn_streaming(train_conf: ModelTrainConf,
                        chunk_rows: int = 262_144,
                        init_params=None,
                        fixed_layers=None) -> TrainResult:
-    """Train one NN/LR by streaming row chunks.
+    """Train `baggingNum` NN/LR models by streaming row chunks.
 
     get_chunk(start, stop) → (x, y, w) numpy slices — typically views of
     np.load(..., mmap_mode="r") arrays, so only the touched rows hit
-    RAM. Validation is the trailing validSetRate fraction of rows
+    RAM. In a multi-host run every process must be able to serve any
+    [start, stop) range; it is asked only for its own slice of each
+    chunk. Validation is the trailing validSetRate fraction of rows
     (contiguous split: random per-row masks would defeat sequential
     disk reads; the reference's disk-spill dataset is likewise
     sequential).
@@ -61,38 +110,60 @@ def train_nn_streaming(train_conf: ModelTrainConf,
     n_train = n_rows - n_val
     if n_train <= 0:
         raise ValueError("streaming training needs at least one train row")
-    if max(train_conf.baggingNum, 1) > 1:
-        log.warning("trainOnDisk streams one model; baggingNum ignored")
+    n_bags = max(train_conf.baggingNum, 1)
+
+    mesh = mesh_mod.default_mesh()
+    n_proc = jax.process_count()
+    proc = jax.process_index()
 
     optimizer = optimizer_from_params(train_conf.params)
     key = jax.random.PRNGKey(seed)
-    params = init_params if init_params is not None \
-        else nn_mod.init_params(spec, key)
-    opt_state = optimizer.init(params)
+    if init_params is not None:
+        stacked = jax.tree.map(
+            lambda p: jnp.broadcast_to(jnp.asarray(p), (n_bags,) + p.shape),
+            init_params)
+    else:
+        bag_keys = jax.random.split(key, n_bags)
+        stacked = jax.vmap(lambda k: nn_mod.init_params(spec, k))(bag_keys)
+    stacked = mesh_mod.place_replicated(mesh, stacked)
+    opt_state = mesh_mod.place_replicated(
+        mesh, jax.vmap(optimizer.init)(stacked))
 
     # continuous training's frozen-layer fitting (NNMaster.java:369-379)
     grad_mask = [
         {k: jnp.zeros_like(v) if fixed_layers and i in fixed_layers
          else jnp.ones_like(v) for k, v in layer.items()}
-        for i, layer in enumerate(params)]
+        for i, layer in enumerate(jax.tree.map(lambda p: p[0], stacked))]
+    grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
 
     @jax.jit
-    def update(params, opt_state, x, y, w, key):
-        dkey = key if spec.dropout_rate > 0 else None
-        loss, grads = jax.value_and_grad(
-            lambda p: nn_mod.loss_fn(spec, p, x, y, w, dkey))(params)
-        grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    def update(stacked, opt_state, x, y, w_bags, key):
+        """One chunk's SGD step for every bag at once (vmap over the
+        bag axis = the reference's ≤5 parallel bagging jobs)."""
+
+        def one(params, o_state, w):
+            dkey = key if spec.dropout_rate > 0 else None
+            loss, grads = jax.value_and_grad(
+                lambda p: nn_mod.loss_fn(spec, p, x, y, w, dkey))(params)
+            grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
+            updates, o2 = optimizer.update(grads, o_state, params)
+            # per-bag chunk weight: the epoch loss must weight chunks
+            # by their sample mass, not average them equally (unequal
+            # tail chunks / zero-draw bag chunks would bias it)
+            return optax.apply_updates(params, updates), o2, loss, jnp.sum(w)
+
+        return jax.vmap(one)(stacked, opt_state, w_bags)
 
     @jax.jit
-    def val_chunk_err(params, x, y, w):
-        pred = nn_mod.forward(spec, params, x)
-        if spec.output_dim > 1:
-            onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
-            per = jnp.mean(jnp.square(onehot - pred), axis=-1)
-            return jnp.sum(per * w), jnp.sum(w)
-        return jnp.sum(jnp.square(y - pred) * w), jnp.sum(w)
+    def val_chunk_err(stacked, x, y, w):
+        def one(params):
+            pred = nn_mod.forward(spec, params, x)
+            if spec.output_dim > 1:
+                onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
+                per = jnp.mean(jnp.square(onehot - pred), axis=-1)
+                return jnp.sum(per * w)
+            return jnp.sum(jnp.square(y - pred) * w)
+        return jax.vmap(one)(stacked), jnp.sum(w)
 
     def chunk_bounds(lo, hi):
         starts = list(range(lo, hi, chunk_rows))
@@ -101,68 +172,150 @@ def train_nn_streaming(train_conf: ModelTrainConf,
     train_chunks = chunk_bounds(0, n_train)
     val_chunks = chunk_bounds(n_train, n_rows)
 
-    def put(bounds):
-        a, b = bounds
-        x, y, w = get_chunk(a, b)
-        # device_put dispatches the H2D copy immediately and returns;
-        # the copy overlaps the previous chunk's compute
-        return (jax.device_put(np.ascontiguousarray(x)),
-                jax.device_put(np.ascontiguousarray(y)),
-                jax.device_put(np.ascontiguousarray(w)))
+    def chunk_bags(a, b):
+        """Bag weights for global chunk [a, b) — generated over the
+        WHOLE chunk so membership is invariant to process count."""
+        return _chunk_bag_weights(n_bags, train_conf.baggingSampleRate,
+                                  train_conf.baggingWithReplacement,
+                                  seed, a, b)
 
-    best_params, best_val = params, float("inf")
-    best_epoch, bad = 0, 0
+    def put(bounds, with_bags: bool):
+        """Fetch this process's slice of the chunk and place it
+        row-sharded on the mesh; device transfer is dispatched
+        immediately so it overlaps the previous chunk's compute."""
+        a, b = bounds
+        rows = b - a
+        if n_proc > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # every process contributes an identical-shape block (the
+            # assembled global array needs equal per-process slices,
+            # each divisible over that process's local devices); the
+            # tail pads with zero-weight rows, which every loss/metric
+            # ignores
+            ld = jax.local_device_count()
+            per = -(-rows // n_proc)
+            per = -(-per // ld) * ld
+            lo = min(a + proc * per, b)
+            hi = min(lo + per, b)
+            x, y, w = get_chunk(lo, hi)
+            pad = per - (hi - lo)
+            if pad:
+                x = np.pad(np.ascontiguousarray(x), ((0, pad), (0, 0)))
+                y = np.pad(np.ascontiguousarray(y), (0, pad))
+                w = np.pad(np.ascontiguousarray(w), (0, pad))
+            else:
+                x = np.ascontiguousarray(x)
+                y = np.ascontiguousarray(y)
+                w = np.ascontiguousarray(w)
+
+            def assemble(arr, spec):
+                return jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, spec), arr)
+
+            dx = assemble(x, P("data", None))
+            dy = assemble(y, P("data"))
+            if with_bags:
+                bw = chunk_bags(a, b)[:, lo - a:hi - a]
+                bw = np.pad(bw, ((0, 0), (0, pad))) * w[None, :]
+                return dx, dy, assemble(bw, P(None, "data"))
+            return dx, dy, assemble(w, P("data"))
+        x, y, w = get_chunk(a, b)
+        x = np.ascontiguousarray(x)
+        y = np.ascontiguousarray(y)
+        w = np.ascontiguousarray(w)
+        if with_bags:
+            bw = chunk_bags(a, b) * w[None, :]
+            return (mesh_mod.shard_axis(mesh, x, 0),
+                    mesh_mod.shard_axis(mesh, y, 0),
+                    mesh_mod.shard_axis(mesh, bw, axis=1))
+        return (mesh_mod.shard_axis(mesh, x, 0),
+                mesh_mod.shard_axis(mesh, y, 0),
+                mesh_mod.shard_axis(mesh, w, 0))
+
+    best = jax.tree.map(lambda p: p, stacked)
+    best_val = np.full(n_bags, np.inf, np.float32)
+    best_epoch = np.zeros(n_bags, np.int64)
+    bad = np.zeros(n_bags, np.int32)
+    stopped = np.zeros(n_bags, bool)
     window = train_conf.earlyStoppingRounds or 0
     conv = float(train_conf.convergenceThreshold or 0.0)
     train_errs, val_errs = [], []
+    order_rng = np.random.default_rng(seed ^ 0x5EED)
 
     for epoch in range(train_conf.numTrainEpochs):
         key, sub = jax.random.split(key)
-        epoch_loss, n_chunks = 0.0, 0
-        nxt = put(train_chunks[0])
-        for ci in range(len(train_chunks)):
+        # per-epoch chunk-order reshuffle: chunked SGD sees a new data
+        # order every epoch (the shuffle the reference runs as a
+        # one-time MR job, done for free at the access layer)
+        order = order_rng.permutation(len(train_chunks))
+        epoch_loss = np.zeros(n_bags, np.float64)
+        epoch_w = np.zeros(n_bags, np.float64)
+        nxt = put(train_chunks[order[0]], True)
+        prev_stacked = jax.tree.map(lambda p: p, stacked) \
+            if stopped.any() else None
+        for ci in range(len(order)):
             cur = nxt
-            if ci + 1 < len(train_chunks):
-                nxt = put(train_chunks[ci + 1])  # prefetch while computing
-            params, opt_state, loss = update(params, opt_state, *cur, sub)
-            epoch_loss += float(loss)
-            n_chunks += 1
-        train_err = epoch_loss / max(n_chunks, 1)
+            if ci + 1 < len(order):
+                nxt = put(train_chunks[order[ci + 1]], True)  # prefetch
+            stacked, opt_state, loss, sw = update(stacked, opt_state, *cur,
+                                                  sub)
+            sw = np.asarray(sw, np.float64)
+            epoch_loss += np.asarray(loss, np.float64) * sw
+            epoch_w += sw
+        if prev_stacked is not None:
+            # stopped bags freeze: restore their params after the epoch
+            keep = jnp.asarray(stopped)
+            stacked = jax.tree.map(
+                lambda new, old: jnp.where(
+                    keep.reshape((-1,) + (1,) * (new.ndim - 1)), old, new),
+                stacked, prev_stacked)
+        train_err = epoch_loss / np.maximum(epoch_w, 1e-12)
 
         if val_chunks:
-            se, sw = 0.0, 0.0
-            nxt = put(val_chunks[0])
+            se = np.zeros(n_bags, np.float64)
+            sw = 0.0
+            nxt = put(val_chunks[0], False)
             for ci in range(len(val_chunks)):
                 cur = nxt
                 if ci + 1 < len(val_chunks):
-                    nxt = put(val_chunks[ci + 1])
-                e, w_ = val_chunk_err(params, *cur)
-                se += float(e)
+                    nxt = put(val_chunks[ci + 1], False)
+                e, w_ = val_chunk_err(stacked, *cur)
+                se += np.asarray(e, np.float64)
                 sw += float(w_)
             val_err = se / max(sw, 1e-12)
         else:
             val_err = train_err
 
-        train_errs.append(train_err)
-        val_errs.append(val_err)
-        if val_err < best_val:
-            best_val, best_epoch, bad = val_err, epoch, 0
-            best_params = jax.tree.map(lambda p: p, params)
-        else:
-            bad += 1
-        if (window and bad >= window) or (conv > 0 and train_err <= conv):
-            log.info("streaming train: early stop at epoch %d", epoch)
+        train_errs.append(train_err.astype(np.float32))
+        val_errs.append(val_err.astype(np.float32))
+        improved = (val_err < best_val) & ~stopped
+        if improved.any():
+            imp = jnp.asarray(improved)
+            best = jax.tree.map(
+                lambda b, p: jnp.where(
+                    imp.reshape((-1,) + (1,) * (p.ndim - 1)), p, b),
+                best, stacked)
+            best_val = np.where(improved, val_err, best_val).astype(np.float32)
+            best_epoch = np.where(improved, epoch, best_epoch)
+        bad = np.where(stopped, bad, np.where(improved, 0, bad + 1))
+        stopped |= (window > 0) & (bad >= window)
+        stopped |= (conv > 0) & (train_err <= conv)
+        if stopped.all():
+            log.info("streaming train: all bags stopped at epoch %d", epoch)
             break
 
-    host = jax.tree.map(np.asarray, best_params)
+    host = [jax.tree.map(lambda p, i=i: np.asarray(p[i]), best)
+            for i in range(n_bags)]
     res = TrainResult(
-        spec=spec, params_per_bag=[host],
-        train_errors=np.asarray([train_errs], np.float32),
-        val_errors=np.asarray([val_errs], np.float32),
-        best_val=np.asarray([best_val], np.float32),
-        best_epoch=np.asarray([best_epoch]),
+        spec=spec, params_per_bag=host,
+        train_errors=np.stack(train_errs, axis=1),
+        val_errors=np.stack(val_errs, axis=1),
+        best_val=best_val,
+        best_epoch=best_epoch,
         wall_seconds=time.time() - t0)
-    log.info("streaming train: %d rows in %d chunks × %d epochs, best "
-             "val %.6f in %.2fs", n_rows, len(train_chunks),
-             len(train_errs), best_val, res.wall_seconds)
+    log.info("streaming train: %d rows in %d chunks × %d epochs × %d "
+             "bag(s) on %d device(s), best val %s in %.2fs",
+             n_rows, len(train_chunks), len(train_errs), n_bags,
+             mesh.devices.size, np.round(best_val, 6).tolist(),
+             res.wall_seconds)
     return res

@@ -89,14 +89,15 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
 
 
 @partial(jax.jit, static_argnames=("loss_fn", "metric_fn", "optimizer",
-                                   "n_epochs", "early_stop_window"))
+                                   "n_epochs", "early_stop_window",
+                                   "n_batches"))
 def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
                      early_stop_window: int, convergence_threshold: float,
                      carry_in, train_inputs, w_train_bags,
-                     val_inputs, w_val, grad_mask):
-    """Generic vmapped-over-bags, scanned-over-epochs full-batch trainer
-    (shared by NN/LR/WDL/MTL), resumable: takes and returns the full
-    per-bag training carry (see init_train_carry) so callers can run in
+                     val_inputs, w_val, grad_mask, n_batches: int = 1):
+    """Generic vmapped-over-bags, scanned-over-epochs trainer (shared by
+    NN/LR/WDL/MTL), resumable: takes and returns the full per-bag
+    training carry (see init_train_carry) so callers can run in
     checkpointed chunks.
 
     loss_fn(params, inputs_tuple, w, key) → scalar training loss;
@@ -104,6 +105,14 @@ def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
     w_train_bags: (B, Nt) per-bag sample weights (bagging multiplicity ×
     row weight). grad_mask: pytree of {0,1} masking fixed layers
     (continuous training's frozen-layer fitting, NNMaster.java:369-379).
+
+    n_batches > 1 switches one full-batch update per epoch to an inner
+    scan of mini-batch updates (train#params MiniBatchRows): every row
+    tensor arrives pre-reshaped to (n_batches, rows/batch, ...) and
+    w_train_bags to (B, n_batches, rows/batch); batch order reshuffles
+    per epoch via the carried PRNG key. This is what keeps bagging /
+    grid search / k-fold usable when bags × activations no longer fit
+    HBM full-batch.
     """
 
     def one_bag(carry_in, w_train):
@@ -114,11 +123,30 @@ def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
                 best["params"], best["val"], stop_state["bad"],
                 stop_state["stopped"])
             key, sub = jax.random.split(key)
-            train_err, grads = jax.value_and_grad(loss_fn)(
-                params, train_inputs, w_train, sub)
-            grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
-            updates, new_opt_state = optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            if n_batches > 1:
+                def batch_step(bc, bi):
+                    p, o, k = bc
+                    k, bkey = jax.random.split(k)
+                    inp_b = jax.tree.map(lambda t: t[bi], train_inputs)
+                    loss_b, grads_b = jax.value_and_grad(loss_fn)(
+                        p, inp_b, w_train[bi], bkey)
+                    grads_b = jax.tree.map(lambda g, m: g * m, grads_b,
+                                           grad_mask)
+                    upd, o2 = optimizer.update(grads_b, o, p)
+                    return (optax.apply_updates(p, upd), o2, k), loss_b
+
+                key, pkey = jax.random.split(key)
+                perm = jax.random.permutation(pkey, n_batches)
+                (new_params, new_opt_state, key), losses = jax.lax.scan(
+                    batch_step, (params, opt_state, key), perm)
+                train_err = jnp.mean(losses)
+            else:
+                train_err, grads = jax.value_and_grad(loss_fn)(
+                    params, train_inputs, w_train, sub)
+                grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
+                updates, new_opt_state = optimizer.update(grads, opt_state,
+                                                          params)
+                new_params = optax.apply_updates(params, updates)
             # freeze when stopped (scan must run to fixed length)
             keep = lambda new, old: jax.tree.map(  # noqa: E731
                 lambda a, b: jnp.where(stopped, b, a), new, old)
@@ -150,10 +178,6 @@ def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
     return jax.vmap(one_bag)(carry_in, w_train_bags)
 
 
-# keep the jit cache keyed on the callables/optimizer/epoch-count
-train_bags_carry = partial(jax.jit, static_argnames=(
-    "loss_fn", "metric_fn", "optimizer", "n_epochs",
-    "early_stop_window"))(train_bags_carry)
 
 
 def init_train_carry(optimizer, stacked_params, keys):
@@ -175,7 +199,8 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
                stacked_params, train_inputs, w_train_bags,
                val_inputs, w_val, dropout_keys, grad_mask,
                checkpoint_dir: Optional[str] = None,
-               checkpoint_interval: int = 0):
+               checkpoint_interval: int = 0,
+               batch_rows: int = 0):
     """Non-resumable façade over train_bags_carry, with optional
     checkpointing: when checkpoint_dir is set, training runs in
     `checkpoint_interval`-epoch chunks, saving the full carry after each
@@ -186,12 +211,47 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
     the gradient mean over sharded rows IS the reference's master
     aggregation (nn/NNMaster.java:248-259) — while parameters,
     optimizer state, keys and grad masks replicate. Zero-weight row
-    padding is inert because every loss/metric normalizes by sum(w)."""
+    padding is inert because every loss/metric normalizes by sum(w).
+
+    batch_rows > 0 enables mini-batch SGD: rows reshape to
+    (n_batches, batch_rows) on the host, the within-batch row axis
+    shards over the mesh, and the epoch becomes an in-graph scan over
+    shuffled batches (see train_bags_carry) — activation memory scales
+    with batch_rows × bags instead of rows × bags."""
     mesh = mesh_mod.default_mesh()
-    train_inputs = tuple(mesh_mod.shard_axis(mesh, t, 0)
-                         for t in train_inputs)
+    n_rows = int(np.asarray(train_inputs[0]).shape[0])
+    n_batches = 1
+    if batch_rows and 0 < batch_rows < n_rows:
+        n_batches = -(-n_rows // batch_rows)
+        # break any on-disk row ordering (sorted/grouped data would
+        # otherwise make every mini-batch class-homogeneous): rows are
+        # permuted once here, and the in-graph scan additionally
+        # shuffles BATCH order every epoch
+        perm = np.random.default_rng(0xB47C4).permutation(n_rows)
+        train_inputs = tuple(np.asarray(t)[perm] for t in train_inputs)
+        w_train_bags = np.asarray(w_train_bags)[:, perm]
+
+        def to_batches(a, axis_rows=0):
+            a = np.asarray(a)
+            pad = n_batches * batch_rows - a.shape[axis_rows]
+            if pad:
+                widths = [(0, 0)] * a.ndim
+                widths[axis_rows] = (0, pad)
+                a = np.pad(a, widths)  # zero weight ⇒ padding is inert
+            shape = (a.shape[:axis_rows] + (n_batches, batch_rows)
+                     + a.shape[axis_rows + 1:])
+            return a.reshape(shape)
+
+        train_inputs = tuple(to_batches(t) for t in train_inputs)
+        w_train_bags = to_batches(w_train_bags, axis_rows=1)
+        train_inputs = tuple(mesh_mod.shard_axis(mesh, t, 1)
+                             for t in train_inputs)
+        w_train_bags = mesh_mod.shard_axis(mesh, w_train_bags, axis=2)
+    else:
+        train_inputs = tuple(mesh_mod.shard_axis(mesh, t, 0)
+                             for t in train_inputs)
+        w_train_bags = mesh_mod.shard_axis(mesh, w_train_bags, axis=1)
     val_inputs = tuple(mesh_mod.shard_axis(mesh, t, 0) for t in val_inputs)
-    w_train_bags = mesh_mod.shard_axis(mesh, w_train_bags, axis=1)
     w_val = mesh_mod.shard_axis(mesh, w_val, 0)
     stacked_params = mesh_mod.place_replicated(mesh, stacked_params)
     grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
@@ -214,7 +274,7 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
             carry, tr, va = train_bags_carry(
                 loss_fn, metric_fn, optimizer, chunk, early_stop_window,
                 convergence_threshold, carry, train_inputs, w_train_bags,
-                val_inputs, w_val, grad_mask)
+                val_inputs, w_val, grad_mask, n_batches)
             tr_chunks.append(np.asarray(tr))
             va_chunks.append(np.asarray(va))
             done += chunk
@@ -230,7 +290,7 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
         carry, train_errs, val_errs = train_bags_carry(
             loss_fn, metric_fn, optimizer, n_epochs, early_stop_window,
             convergence_threshold, carry, train_inputs, w_train_bags,
-            val_inputs, w_val, grad_mask)
+            val_inputs, w_val, grad_mask, n_batches)
         train_errs = np.asarray(train_errs)
         val_errs = np.asarray(val_errs)
     best = carry[2]
@@ -303,6 +363,10 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
         x_, y_ = inputs
         return nn_mod.mse(spec, params, x_, y_, w)
 
+    # train#params MiniBatchRows: mini-batch SGD for data whose
+    # bags × activations exceed HBM full-batch (0 = full batch)
+    batch_rows = int(train_conf.get_param("MiniBatchRows", 0) or 0)
+
     best_params, train_errs, val_errs, best_val, best_epoch = train_bags(
         nn_loss, nn_metric, optimizer, train_conf.numTrainEpochs,
         early_window if early_window and early_window > 0 else 0,
@@ -311,7 +375,8 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
         (x_v, y_v), w_v,
         bag_keys[:-1], grad_mask,
         checkpoint_dir=checkpoint_dir,
-        checkpoint_interval=checkpoint_interval)
+        checkpoint_interval=checkpoint_interval,
+        batch_rows=batch_rows)
 
     params_per_bag = [
         jax.tree.map(lambda p, i=i: np.asarray(p[i]), best_params)

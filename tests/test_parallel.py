@@ -12,15 +12,6 @@ import json
 import os
 
 import numpy as np
-import pytest
-
-
-@pytest.fixture()
-def single_device_env():
-    """Context: force a 1-device mesh via SHIFU_TPU_MESH_DEVICES."""
-    os.environ["SHIFU_TPU_MESH_DEVICES"] = "1"
-    yield
-    os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
 
 
 def _train_and_collect(root):

@@ -306,3 +306,58 @@ def test_streaming_train_on_disk(tmp_path, rng):
     with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
         perf = json.load(f)
     assert perf["areaUnderRoc"] > 0.85
+
+
+def test_streaming_bagging(tmp_path, rng):
+    """Streaming trains every bag at once (vmapped update over the bag
+    axis, per-chunk Philox bag weights) — round 1 dropped bagging on
+    the trainOnDisk path; round 2 must not."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=3000,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM",
+                                        "ChunkRows": 700})
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["train"]["trainOnDisk"] = True
+    mc["train"]["baggingNum"] = 2
+    mc["train"]["baggingSampleRate"] = 0.8
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+
+    ctx = run_pipeline(root)
+    models = sorted(os.listdir(ctx.path_finder.models_path()))
+    assert models == ["model0.nn", "model1.nn"]
+    from shifu_tpu.models.spec import load_model
+    _, _, p0 = load_model(ctx.path_finder.model_path(0, "nn"))
+    _, _, p1 = load_model(ctx.path_finder.model_path(1, "nn"))
+    # different bag samples ⇒ different weights
+    assert np.abs(p0[0]["w"] - p1[0]["w"]).max() > 0
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.85
+
+
+def test_minibatch_mode(tmp_path, rng):
+    """train#params MiniBatchRows: the main trainer runs an in-graph
+    scan over shuffled mini-batches (bagging preserved) instead of one
+    full-batch update per epoch."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=3000,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.05,
+                                        "Propagation": "ADAM",
+                                        "MiniBatchRows": 512})
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["train"]["baggingNum"] = 2
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+
+    ctx = run_pipeline(root)
+    models = sorted(os.listdir(ctx.path_finder.models_path()))
+    assert models == ["model0.nn", "model1.nn"]
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.85
