@@ -45,6 +45,13 @@ HIST_BINS = 64
 HIST_SLOTS = 64
 HIST_REPS = 10
 
+# HIGGS-shape GBT end-to-end train (BASELINE.md ladder step 3:
+# 11M rows × 28 features)
+GBT_ROWS = 11_000_000
+GBT_COLS = 28
+GBT_TREES = 20
+GBT_DEPTH = 6
+
 # v5e bf16 MXU peak; f32 runs at half rate. Used only for a utilization
 # *estimate* in extra.
 TPU_PEAK_FLOPS_BF16 = 394e12
@@ -160,6 +167,50 @@ def task_hist(mode):
                       "wall_s": wall, "checksum": checksum}))
 
 
+def task_gbt():
+    """HIGGS-scale GBT training end-to-end (the BASELINE.md 11M-row
+    ladder step): full boosting loop on synthetic separable data.
+
+    All data is generated ON DEVICE (jax.random) — the tunneled TPU's
+    host→device path cannot move a GB-scale bin matrix (measured: a
+    1.2 GB transfer wedges the tunnel), and the thing under test is
+    the training loop, not the transport."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import gbdt
+    from shifu_tpu.ops.metrics import auc
+
+    n_bins = 64
+    key = jax.random.PRNGKey(0)
+    kb, kbeta, kn = jax.random.split(key, 3)
+    binsT = jax.random.randint(kb, (GBT_COLS, GBT_ROWS), 0, n_bins - 1,
+                               dtype=jnp.int32)
+    beta = jax.random.normal(kbeta, (GBT_COLS,))
+    margin = (beta @ binsT.astype(jnp.float32)) / np.sqrt(GBT_COLS)
+    noise = jax.random.normal(kn, (GBT_ROWS,)) * jnp.std(margin) * 0.5
+    y = (margin + noise > jnp.median(margin)).astype(jnp.float32)
+    w = jnp.ones(GBT_ROWS, jnp.float32)
+    y = jax.block_until_ready(y)
+    cfg = gbdt.TreeConfig(max_depth=GBT_DEPTH, n_bins=n_bins,
+                          learning_rate=0.2, loss="log")
+
+    t0 = time.time()
+    trees, _ = gbdt.build_gbt(cfg, binsT, y, w, n_trees=GBT_TREES)
+    wall = time.time() - t0       # build_gbt ends with np.asarray = sync
+    scores = np.asarray(gbdt.predict_trees(
+        jax.tree.map(jnp.asarray, trees), binsT[:, :500_000],
+        cfg.max_depth, cfg.n_bins)).sum(axis=0)
+    a = float(auc(jnp.asarray(scores), y[:500_000]))
+    print(json.dumps({
+        "row_trees_per_sec": GBT_ROWS * GBT_TREES / wall,
+        "wall_s": wall, "auc": a,
+        "rows": GBT_ROWS, "trees": GBT_TREES, "depth": GBT_DEPTH,
+    }))
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -219,6 +270,8 @@ def main():
         return task_nn()
     if args.task in ("hist_pallas", "hist_xla"):
         return task_hist(args.task.split("_", 1)[1])
+    if args.task == "gbt":
+        return task_gbt()
 
     diags = []
     extra = {}
@@ -266,6 +319,17 @@ def main():
                         hp["cells_per_sec"] / hx["cells_per_sec"], 2)
             else:
                 diags.append("hist_pallas failed: " +
+                             (err.splitlines()[-1] if err else "?"))
+            _log(f"running GBT end-to-end train bench "
+                 f"({GBT_ROWS}x{GBT_COLS}, {GBT_TREES} trees)...")
+            gb, err = _run_task("gbt", env_extra=env_extra)
+            if gb:
+                extra["gbt_train_Mrow_trees_per_s"] = round(
+                    gb["row_trees_per_sec"] / 1e6, 3)
+                extra["gbt_train_wall_s"] = round(gb["wall_s"], 2)
+                extra["gbt_auc"] = round(gb["auc"], 4)
+            else:
+                diags.append("gbt failed: " +
                              (err.splitlines()[-1] if err else "?"))
     except Exception as e:  # noqa: BLE001 — never crash the driver
         diags.append(f"{type(e).__name__}: {e}")

@@ -116,6 +116,9 @@ def cmd_eval(args) -> int:
     from shifu_tpu.processor import eval as p
     if args.norm:
         return p.run_norm(_ctx(args), eval_name=args.run)
+    if args.audit:
+        return p.run_audit(_ctx(args), eval_name=args.run,
+                           n_records=args.n)
     return p.run(_ctx(args), eval_name=args.run)
 
 
@@ -201,6 +204,9 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="key=value",
                     help="environment overrides (ShifuCLI -D)")
     ap.add_argument("--dir", default=".", help="model-set directory")
+    ap.add_argument("--profile", action="store_true",
+                    help="capture a jax.profiler trace for this command "
+                         "under tmp/profile/ (open in TensorBoard)")
     sub = ap.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("new", help="create a model set")
@@ -235,6 +241,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-run", "--run", default=None, metavar="EVAL_NAME")
     p.add_argument("-norm", "--norm", action="store_true",
                    help="export normalized eval data instead of scoring")
+    p.add_argument("-audit", "--audit", action="store_true",
+                   help="score and write an audit sample with raw "
+                        "variable values (eval -audit)")
+    p.add_argument("-n", "--n", type=int, default=100,
+                   help="audit record count (eval -audit -n N)")
     p.set_defaults(fn=cmd_eval)
     p = sub.add_parser("export", help="export model/stats")
     p.add_argument("-t", "--type", default="columnstats",
@@ -302,8 +313,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             os.environ[k.strip()] = v.strip()
     _honor_jax_platforms()
     t0 = time.time()
+    # every command emits one structured metrics record (and a
+    # jax.profiler trace under --profile) — SURVEY §5's replacement for
+    # master iteration logs / Hadoop counters / TailThread
+    from shifu_tpu.profiling import maybe_profile, step_metrics
+    root = getattr(args, "dir", ".") or "."
     try:
-        rc = args.fn(args)
+        with step_metrics(root, args.command) as rec, \
+                maybe_profile(root, args.command,
+                              getattr(args, "profile", False)):
+            rc = args.fn(args)
+            rec["rc"] = int(rc or 0)
     except (FileNotFoundError, ValueError, NotImplementedError) as e:
         log.error("%s", e)
         return 1

@@ -11,6 +11,7 @@ call over them, sharded over the row axis on a device mesh.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -19,6 +20,8 @@ import pandas as pd
 
 from shifu_tpu.config.column_config import ColumnConfig
 from shifu_tpu.config.model_config import ModelConfig
+
+log = logging.getLogger("shifu_tpu")
 
 MISSING_CODE = -1  # categorical missing sentinel
 
@@ -222,5 +225,17 @@ def build_columnar(mc: ModelConfig, column_configs: List[ColumnConfig],
     # drop rows with unknown tags (reference skips invalid-tag records)
     valid = ~np.isnan(tags)
     if not valid.all():
+        if not valid.any() and tag_col is not None:
+            # fail fast with the observed tag values instead of letting
+            # an empty matrix blow up inside a kernel (ModelInspector
+            # tag-cardinality semantics)
+            observed = sorted(set(np.asarray(tag_col, str)))[:10]
+            raise ValueError(
+                f"no row's {mc.dataSet.targetColumnName!r} value matches "
+                f"posTags {mc.pos_tags} / negTags {mc.neg_tags}; observed "
+                f"tag values include {observed} — fix dataSet#posTags/"
+                "negTags (or configure >2 tags for multi-class)")
+        log.warning("dropping %d/%d rows whose tag matches neither "
+                    "posTags nor negTags", int((~valid).sum()), n_rows)
         dset = dset.select(valid)
     return dset

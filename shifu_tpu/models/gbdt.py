@@ -99,33 +99,36 @@ def _hist_mode() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
-def _local_level_histograms(bins, slot, grad, hess, n_level_nodes, n_bins):
+def _local_level_histograms(binsT, slot, grad, hess, n_level_nodes, n_bins):
     """Single-shard histogram kernel (slot already computed, incl. the
-    trailing dump slot for inactive rows)."""
-    r, c = bins.shape
+    trailing dump slot for inactive rows). binsT is TRANSPOSED (C, R) —
+    rows on the lane axis, so narrow feature matrices don't pay the
+    TPU's 128-lane minor-dim padding."""
+    c, r = binsT.shape
     if _hist_mode() == "pallas":
         from shifu_tpu.ops.pallas_hist import level_histograms_pallas
         return level_histograms_pallas(
-            bins, slot, grad, hess, n_level_nodes, n_bins,
+            binsT, slot, grad, hess, n_level_nodes, n_bins,
             interpret=jax.default_backend() != "tpu")
 
-    col_ids = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[None, :], (r, c))
-    node_ids = jnp.broadcast_to(slot[:, None], (r, c)).astype(jnp.int32)
+    col_ids = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[:, None], (c, r))
+    node_ids = jnp.broadcast_to(slot[None, :], (c, r)).astype(jnp.int32)
 
     def scatter(v):
         z = jnp.zeros((n_level_nodes + 1, c, n_bins), jnp.float32)
-        return z.at[node_ids, col_ids, bins].add(v[:, None])[:n_level_nodes]
+        return z.at[node_ids, col_ids, binsT].add(v[None, :])[:n_level_nodes]
 
     return scatter(grad), scatter(hess)
 
 
-def _level_histograms(bins, node_of_row, grad, hess, level_offset, n_level_nodes,
-                      n_bins, mesh=None):
+def _level_histograms(binsT, node_of_row, grad, hess, level_offset,
+                      n_level_nodes, n_bins, mesh=None):
     """Per-level G/H histograms.
 
-    bins: (R, C) int32 in [0, n_bins); node_of_row: (R,) global node ids
-    (rows at inactive/finished nodes carry id -1 and scatter into a
-    dumped slot). Returns (n_level_nodes, C, n_bins) G and H.
+    binsT: (C, R) int32 in [0, n_bins), transposed; node_of_row: (R,)
+    global node ids (rows at inactive/finished nodes carry id -1 and
+    scatter into a dumped slot). Returns (n_level_nodes, C, n_bins) G
+    and H.
 
     With a multi-device `mesh`, rows shard over the 'data' axis and each
     device builds its local histogram which a psum reduces — exactly the
@@ -143,16 +146,16 @@ def _level_histograms(bins, node_of_row, grad, hess, level_offset, n_level_nodes
         from jax.sharding import PartitionSpec as P
 
         @partial(jax.shard_map, mesh=mesh,
-                 in_specs=(P("data", None), P("data"), P("data"), P("data")),
+                 in_specs=(P(None, "data"), P("data"), P("data"), P("data")),
                  out_specs=(P(), P()), check_vma=False)
         def sharded(b, s, g, h):
             gh_, hh_ = _local_level_histograms(b, s, g, h, n_level_nodes,
                                                n_bins)
             return (jax.lax.psum(gh_, "data"), jax.lax.psum(hh_, "data"))
 
-        return sharded(bins, slot, grad, hess)
+        return sharded(binsT, slot, grad, hess)
 
-    return _local_level_histograms(bins, slot, grad, hess, n_level_nodes,
+    return _local_level_histograms(binsT, slot, grad, hess, n_level_nodes,
                                    n_bins)
 
 
@@ -204,85 +207,113 @@ def _best_splits(gh, cfg: TreeConfig, feature_mask):
             "default_left": best_dl, "g_tot": g_tot, "h_tot": h_tot}
 
 
+def _empty_tree(cfg: TreeConfig):
+    n_nodes = cfg.n_nodes
+    return {"feature": jnp.full(n_nodes, -1, jnp.int32),
+            "bin": jnp.zeros(n_nodes, jnp.int32),
+            "default_left": jnp.zeros(n_nodes, bool),
+            "is_leaf": jnp.zeros(n_nodes, bool),
+            "leaf_value": jnp.zeros(n_nodes, jnp.float32),
+            "gain": jnp.zeros(n_nodes, jnp.float32)}
+
+
+def _apply_level(cfg: TreeConfig, tree, g_hist, h_hist, feature_mask,
+                 depth: int):
+    """Fold one level's histograms into the tree state: pick best
+    splits, turn no-gain nodes into leaves (value -G/(H+λ)). Shared by
+    the resident builder and the out-of-core chunked builder."""
+    level_offset = 2 ** depth - 1
+    n_level = 2 ** depth
+    s = _best_splits((g_hist, h_hist), cfg, feature_mask)
+    can_split = (s["gain"] > cfg.min_info_gain) & jnp.isfinite(s["gain"])
+    ids = level_offset + jnp.arange(n_level)
+    tree = dict(tree)
+    tree["feature"] = tree["feature"].at[ids].set(
+        jnp.where(can_split, s["feature"], -1))
+    tree["bin"] = tree["bin"].at[ids].set(s["bin"])
+    tree["default_left"] = tree["default_left"].at[ids].set(
+        s["default_left"])
+    tree["gain"] = tree["gain"].at[ids].set(
+        jnp.where(can_split, s["gain"], 0.0))
+    # g_tot/h_tot are identical across features — take feature 0
+    val = -s["g_tot"][:, 0] / (s["h_tot"][:, 0] + cfg.reg_lambda)
+    tree["is_leaf"] = tree["is_leaf"].at[ids].set(~can_split)
+    tree["leaf_value"] = tree["leaf_value"].at[ids].set(
+        jnp.where(can_split, 0.0, val))
+    return tree
+
+
+def _final_leaves(cfg: TreeConfig, tree, g_hist, h_hist):
+    """Everything alive at the last level becomes a leaf."""
+    level_offset = 2 ** cfg.max_depth - 1
+    n_level = 2 ** cfg.max_depth
+    g_tot = g_hist[:, 0, :].sum(axis=1)
+    h_tot = h_hist[:, 0, :].sum(axis=1)
+    ids = level_offset + jnp.arange(n_level)
+    tree = dict(tree)
+    tree["is_leaf"] = tree["is_leaf"].at[ids].set(True)
+    tree["leaf_value"] = tree["leaf_value"].at[ids].set(
+        -g_tot / (h_tot + cfg.reg_lambda))
+    return tree
+
+
+def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
+    """Advance rows one level: bin <= split_bin → left child (2i+1);
+    missing uses the node's default direction. binsT: (C, R)."""
+    level_offset = 2 ** depth - 1
+    n_level = 2 ** depth
+    node_feat = tree["feature"][node_of_row]               # (R,)
+    node_bin = tree["bin"][node_of_row]
+    node_dl = tree["default_left"][node_of_row]
+    row_bin = jnp.take_along_axis(
+        binsT, jnp.maximum(node_feat, 0)[None, :], axis=0)[0]
+    miss = row_bin == (cfg.n_bins - 1)
+    go_left = jnp.where(miss, node_dl, row_bin <= node_bin)
+    active = (node_feat >= 0) & (node_of_row >= level_offset) & \
+             (node_of_row < level_offset + n_level)
+    return jnp.where(
+        active, 2 * node_of_row + jnp.where(go_left, 1, 2), node_of_row)
+
+
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
-def build_tree(cfg: TreeConfig, bins, grad, hess, feature_mask, mesh=None):
+def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None):
     """Grow one tree level-by-level (all nodes of a level at once —
     DTMaster's todoNodes batch IS the level here).
 
-    bins: (R, C) int32, missing = n_bins-1. grad/hess: (R,) float32
+    binsT: (C, R) int32 TRANSPOSED bin matrix, missing = n_bins-1 (rows
+    ride the lane axis — a row-major (R, C) array with C < 128 would
+    waste up to 128/C × HBM to lane padding). grad/hess: (R,) float32
     (for RF: grad=label·w, hess=w → leaf = mean label).
     `mesh`: row-shard the histogram build over its 'data' axis
     (see _level_histograms).
     Returns flat arrays sized n_nodes: feature, bin, default_left,
     is_leaf, leaf_value.
     """
-    r, c = bins.shape
-    n_nodes = cfg.n_nodes
-    feature = jnp.full(n_nodes, -1, jnp.int32)
-    split_bin = jnp.zeros(n_nodes, jnp.int32)
-    default_left = jnp.zeros(n_nodes, bool)
-    is_leaf = jnp.zeros(n_nodes, bool)
-    leaf_value = jnp.zeros(n_nodes, jnp.float32)
-    node_gain = jnp.zeros(n_nodes, jnp.float32)  # for feature importance
+    c, r = binsT.shape
+    tree = _empty_tree(cfg)
     node_of_row = jnp.zeros(r, jnp.int32)  # all rows at root
 
     for depth in range(cfg.max_depth):
         level_offset = 2 ** depth - 1
         n_level = 2 ** depth
-        g_hist, h_hist = _level_histograms(bins, node_of_row, grad, hess,
+        g_hist, h_hist = _level_histograms(binsT, node_of_row, grad, hess,
                                            level_offset, n_level, cfg.n_bins,
                                            mesh=mesh)
-        s = _best_splits((g_hist, h_hist), cfg, feature_mask)
-        can_split = (s["gain"] > cfg.min_info_gain) & \
-                    jnp.isfinite(s["gain"])
-        ids = level_offset + jnp.arange(n_level)
-        feature = feature.at[ids].set(jnp.where(can_split, s["feature"], -1))
-        split_bin = split_bin.at[ids].set(s["bin"])
-        default_left = default_left.at[ids].set(s["default_left"])
-        node_gain = node_gain.at[ids].set(jnp.where(can_split, s["gain"], 0.0))
-        # nodes that don't split become leaves with value -G/(H+λ);
-        # g_tot/h_tot are identical across features — take feature 0
-        val = -s["g_tot"][:, 0] / (s["h_tot"][:, 0] + cfg.reg_lambda)
-        is_leaf = is_leaf.at[ids].set(~can_split)
-        leaf_value = leaf_value.at[ids].set(jnp.where(can_split, 0.0, val))
+        tree = _apply_level(cfg, tree, g_hist, h_hist, feature_mask, depth)
+        node_of_row = _route_level(cfg, tree, binsT, node_of_row, depth)
 
-        # route rows: bin <= split_bin → left child; missing uses default
-        node_feat = feature[node_of_row]                       # (R,)
-        node_bin = split_bin[node_of_row]
-        node_dl = default_left[node_of_row]
-        row_bin = jnp.take_along_axis(
-            bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
-        miss = row_bin == (cfg.n_bins - 1)
-        go_left = jnp.where(miss, node_dl, row_bin <= node_bin)
-        active = (node_feat >= 0) & (node_of_row >= level_offset) & \
-                 (node_of_row < level_offset + n_level)
-        node_of_row = jnp.where(
-            active, 2 * node_of_row + jnp.where(go_left, 1, 2), node_of_row)
-
-    # final level: everything still active becomes a leaf
-    level_offset = 2 ** cfg.max_depth - 1
-    n_level = 2 ** cfg.max_depth
-    g_hist, h_hist = _level_histograms(bins, node_of_row, grad, hess,
-                                       level_offset, n_level, cfg.n_bins,
+    g_hist, h_hist = _level_histograms(binsT, node_of_row, grad, hess,
+                                       2 ** cfg.max_depth - 1,
+                                       2 ** cfg.max_depth, cfg.n_bins,
                                        mesh=mesh)
-    g_tot = g_hist[:, 0, :].sum(axis=1)
-    h_tot = h_hist[:, 0, :].sum(axis=1)
-    ids = level_offset + jnp.arange(n_level)
-    is_leaf = is_leaf.at[ids].set(True)
-    leaf_value = leaf_value.at[ids].set(-g_tot / (h_tot + cfg.reg_lambda))
-    return {"feature": feature, "bin": split_bin,
-            "default_left": default_left, "is_leaf": is_leaf,
-            "leaf_value": leaf_value, "gain": node_gain}
+    return _final_leaves(cfg, tree, g_hist, h_hist)
 
 
-@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
-def predict_trees(trees, bins, max_depth: int, n_bins: int):
-    """Sum of per-tree leaf values. trees: pytree of (T, n_nodes)
-    arrays; bins: (R, C). Returns (T, R) raw scores (caller averages for
-    RF / shrinks+offsets for GBT)."""
+def _walk_trees(trees, binsT, max_depth: int, n_bins: int):
+    """Per-tree landing node of every row. binsT: (C, R)."""
 
     def one_tree(tree):
-        r = bins.shape[0]
+        r = binsT.shape[1]
         node = jnp.zeros(r, jnp.int32)
         for _ in range(max_depth):
             feat = tree["feature"][node]
@@ -290,32 +321,7 @@ def predict_trees(trees, bins, max_depth: int, n_bins: int):
             dl = tree["default_left"][node]
             leaf = tree["is_leaf"][node]
             row_bin = jnp.take_along_axis(
-                bins, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-            miss = row_bin == (n_bins - 1)
-            go_left = jnp.where(miss, dl, row_bin <= sbin)
-            nxt = 2 * node + jnp.where(go_left, 1, 2)
-            node = jnp.where(leaf | (feat < 0), node, nxt)
-        return tree["leaf_value"][node]
-
-    return jax.vmap(one_tree)(trees)
-
-
-@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
-def leaf_indices(trees, bins, max_depth: int, n_bins: int):
-    """Per-tree landing leaf id for every row — the tree-path encoding
-    of `udf/EncodeDataUDF.java` (each record becomes one categorical
-    value per tree). Returns (T, R) int32 node ids."""
-
-    def one_tree(tree):
-        r = bins.shape[0]
-        node = jnp.zeros(r, jnp.int32)
-        for _ in range(max_depth):
-            feat = tree["feature"][node]
-            sbin = tree["bin"][node]
-            dl = tree["default_left"][node]
-            leaf = tree["is_leaf"][node]
-            row_bin = jnp.take_along_axis(
-                bins, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
+                binsT, jnp.maximum(feat, 0)[None, :], axis=0)[0]
             miss = row_bin == (n_bins - 1)
             go_left = jnp.where(miss, dl, row_bin <= sbin)
             nxt = 2 * node + jnp.where(go_left, 1, 2)
@@ -323,6 +329,23 @@ def leaf_indices(trees, bins, max_depth: int, n_bins: int):
         return node
 
     return jax.vmap(one_tree)(trees)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+def predict_trees(trees, binsT, max_depth: int, n_bins: int):
+    """Sum of per-tree leaf values. trees: pytree of (T, n_nodes)
+    arrays; binsT: (C, R) transposed. Returns (T, R) raw scores (caller
+    averages for RF / shrinks+offsets for GBT)."""
+    nodes = _walk_trees(trees, binsT, max_depth, n_bins)
+    return jax.vmap(lambda tree, n: tree["leaf_value"][n])(trees, nodes)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+def leaf_indices(trees, binsT, max_depth: int, n_bins: int):
+    """Per-tree landing leaf id for every row — the tree-path encoding
+    of `udf/EncodeDataUDF.java` (each record becomes one categorical
+    value per tree). binsT: (C, R). Returns (T, R) int32 node ids."""
+    return _walk_trees(trees, binsT, max_depth, n_bins)
 
 
 # ---------------------------------------------------------------------------
@@ -338,12 +361,12 @@ def gbt_gradients(y, pred_raw, weights, loss: str):
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
-def _gbt_round(cfg: TreeConfig, bins, y, weights, pred_raw, feature_mask,
+def _gbt_round(cfg: TreeConfig, binsT, y, weights, pred_raw, feature_mask,
                mesh=None):
     grad, hess = gbt_gradients(y, pred_raw, weights, cfg.loss)
-    tree = build_tree(cfg, bins, grad, hess, feature_mask, mesh=mesh)
+    tree = build_tree(cfg, binsT, grad, hess, feature_mask, mesh=mesh)
     contrib = predict_trees(
-        jax.tree.map(lambda a: a[None], tree), bins,
+        jax.tree.map(lambda a: a[None], tree), binsT,
         cfg.max_depth, cfg.n_bins)[0]
     return tree, pred_raw + cfg.learning_rate * contrib
 
@@ -365,14 +388,23 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     from shifu_tpu.parallel import mesh as mesh_mod
     mesh = mesh_mod.default_mesh()
     hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
-    jb = mesh_mod.shard_axis(mesh, np.asarray(bins, np.int32), 0,
-                             pad_value=0)
-    jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
-                                 np.asarray(weights, np.float32))
+    # device bins are TRANSPOSED (C, R): rows on the lane axis, so a
+    # narrow feature matrix doesn't lane-pad to 128 columns in HBM.
+    # jax.Array inputs are taken as ALREADY transposed + placed (lets
+    # device-resident data skip the host round-trip entirely).
+    if isinstance(bins, jax.Array):
+        jb, jy, jw = bins, jnp.asarray(y), jnp.asarray(weights)
+    else:
+        jb = mesh_mod.shard_axis(
+            mesh, np.ascontiguousarray(np.asarray(bins, np.int32).T), 1,
+            pad_value=0)
+        jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
+                                     np.asarray(weights, np.float32))
+    # feature count: axis 0 of the (C, R) device layout, axis 1 row-major
     fm = jnp.asarray(feature_mask if feature_mask is not None
-                     else np.ones(bins.shape[1], np.float32))
+                     else np.ones(int(jb.shape[0]), np.float32))
     trees: List[Any] = []
-    pred = jnp.zeros(jb.shape[0], jnp.float32)
+    pred = jnp.zeros(jb.shape[1], jnp.float32)
     if init_trees is not None:
         n_prev = init_trees["feature"].shape[0]
         trees = [jax.tree.map(lambda a, i=i: a[i], init_trees)
@@ -385,10 +417,11 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     if val_data is not None:
         vb, vy = val_data
         n_val = vb.shape[0]
-        vb = mesh_mod.shard_axis(mesh, np.asarray(vb, np.int32), 0)
+        vb = mesh_mod.shard_axis(
+            mesh, np.ascontiguousarray(np.asarray(vb, np.int32).T), 1)
         vy, vw = mesh_mod.shard_rows(
             mesh, np.asarray(vy, np.float32), np.ones(n_val, np.float32))
-        vraw = jnp.zeros(vb.shape[0], jnp.float32)
+        vraw = jnp.zeros(vb.shape[1], jnp.float32)
         if init_trees is not None:
             vraw = cfg.learning_rate * jnp.sum(predict_trees(
                 init_trees, vb, cfg.max_depth, cfg.n_bins), axis=0)
@@ -435,7 +468,8 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     # trees vmapped — the scatter partitions under GSPMD here (shard_map
     # under vmap is avoided), reducing with a cross-device sum
     mesh = mesh_mod.default_mesh()
-    jb = mesh_mod.shard_axis(mesh, np.asarray(bins, np.int32), 0)
+    jb = mesh_mod.shard_axis(
+        mesh, np.ascontiguousarray(np.asarray(bins, np.int32).T), 1)
     jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
                                  np.asarray(weights, np.float32))
     d_inst_w = mesh_mod.shard_axis(mesh, inst_w, axis=1)
@@ -448,6 +482,234 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
         return build_tree(cfg, jb, grad, hess, fm)
 
     stacked = jax.vmap(one)(d_inst_w, jnp.asarray(masks))
+    return jax.tree.map(np.asarray, stacked)
+
+
+# ---------------------------------------------------------------------------
+# Out-of-core (>HBM) builders — chunked histogram accumulation
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "depth", "mesh"))
+def _stream_level_chunk(cfg: TreeConfig, tree, binsT_c, node_c, grad_c,
+                        hess_c, depth: int, mesh=None):
+    """One chunk's work for one level: lazily route the chunk's rows
+    through the PREVIOUS level's just-decided splits, then build this
+    level's partial histograms — histograms are additive over row
+    chunks, so the level's G/H are the sum of these partials (the same
+    associativity Guagua exploits to combine DTWorkerParams across
+    workers, dt/DTWorker.java:914-944). Fusing route+hist keeps disk
+    IO at one bins pass per level. binsT_c: (C, chunk) transposed."""
+    binsT_c = binsT_c.astype(jnp.int32)
+    if depth > 0:
+        node_c = _route_level(cfg, tree, binsT_c, node_c, depth - 1)
+    g, h = _level_histograms(binsT_c, node_c, grad_c, hess_c,
+                             2 ** depth - 1, 2 ** depth, cfg.n_bins,
+                             mesh=mesh)
+    return node_c, g, h
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _leaf_contrib_chunk(cfg: TreeConfig, tree, node_c):
+    return tree["leaf_value"][node_c]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _predict_chunk(cfg: TreeConfig, tree, binsT_c):
+    return predict_trees(jax.tree.map(lambda a: a[None], tree),
+                         binsT_c.astype(jnp.int32),
+                         cfg.max_depth, cfg.n_bins)[0]
+
+
+def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
+                          node_host: np.ndarray, chunk_rows: int,
+                          feature_mask, mesh, hist_mesh):
+    """Grow one tree over a bins matrix that never fully enters HBM.
+
+    bins_mm: (R, C) memory-mapped int matrix; grad_of_chunk(a, b) →
+    host (grad, hess) float32 slices; node_host: (R,) int32 scratch the
+    caller owns (reset to 0 per tree), updated in place to the landing
+    node of every row. One bins pass per level, chunks double-buffered
+    host→HBM like train/streaming.py."""
+    from shifu_tpu.parallel import mesh as mesh_mod
+    r = bins_mm.shape[0]
+    bounds = [(s, min(s + chunk_rows, r)) for s in range(0, r, chunk_rows)]
+    tree = _empty_tree(cfg)
+    fm = jnp.asarray(feature_mask)
+
+    def put(b_):
+        a, b = b_
+        pad = chunk_rows - (b - a)
+        binsT_c = np.ascontiguousarray(bins_mm[a:b].T)   # (C, chunk)
+        node_c = node_host[a:b]
+        grad_c, hess_c = grad_of_chunk(a, b)
+        if pad:  # fixed chunk shape → one compile; padding is inert
+            binsT_c = np.pad(binsT_c, ((0, 0), (0, pad)))
+            node_c = np.pad(node_c, (0, pad), constant_values=-1)
+            grad_c = np.pad(grad_c, (0, pad))
+            hess_c = np.pad(hess_c, (0, pad))
+        return (mesh_mod.shard_axis(mesh, binsT_c, 1),
+                mesh_mod.shard_axis(mesh, node_c, 0, pad_value=-1),
+                mesh_mod.shard_axis(mesh, grad_c, 0),
+                mesh_mod.shard_axis(mesh, hess_c, 0))
+
+    for depth in range(cfg.max_depth + 1):
+        g_acc = h_acc = None
+        cur = put(bounds[0])
+        for ci, (a, b) in enumerate(bounds):
+            # dispatch the current chunk FIRST (jax dispatch is async),
+            # THEN prepare the next one so host-side transpose/pad/put
+            # overlaps device compute, THEN sync on the routed nodes
+            node_c, g, h = _stream_level_chunk(
+                cfg, tree, *cur, depth=depth, mesh=hist_mesh)
+            if ci + 1 < len(bounds):
+                cur = put(bounds[ci + 1])
+            node_host[a:b] = np.asarray(node_c)[:b - a]
+            g_acc = g if g_acc is None else g_acc + g
+            h_acc = h if h_acc is None else h_acc + h
+        if depth < cfg.max_depth:
+            tree = _apply_level(cfg, tree, g_acc, h_acc, fm, depth)
+        else:
+            tree = _final_leaves(cfg, tree, g_acc, h_acc)
+    return tree
+
+
+def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
+                        valid_rate: float = 0.0,
+                        chunk_rows: int = 1 << 20,
+                        feature_mask: Optional[np.ndarray] = None,
+                        init_trees: Optional[Any] = None,
+                        early_stop_window: int = 0):
+    """Out-of-core boosting: the bin matrix streams from disk chunk by
+    chunk (max_depth+1 passes per tree), per-row state (node, raw
+    prediction) lives on the host at 8 bytes/row. The resident
+    build_gbt path covers data that fits HBM; this is the TPU answer
+    to the reference's disk-spill dataset feeding DTWorker
+    (MemoryDiskFloatMLDataSet + dt/DTWorker.java:578). Validation is
+    the trailing valid_rate fraction (sequential-read split, like
+    train/streaming.py)."""
+    from shifu_tpu.parallel import mesh as mesh_mod
+    r, c = bins_mm.shape
+    n_val = int(r * max(valid_rate, 0.0))
+    n_train = r - n_val
+    if n_train <= 0:
+        raise ValueError("streaming GBT needs at least one training row")
+    mesh = mesh_mod.default_mesh()
+    hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
+    fm = feature_mask if feature_mask is not None \
+        else np.ones(c, np.float32)
+
+    pred = np.zeros(n_train, np.float32)
+    vraw = np.zeros(n_val, np.float32)
+    node_host = np.zeros(n_train, np.int32)
+    trees: List[Any] = []
+    if init_trees is not None:
+        n_prev = init_trees["feature"].shape[0]
+        prev = [jax.tree.map(lambda a, i=i: jnp.asarray(a[i]), init_trees)
+                for i in range(n_prev)]
+        trees.extend(prev)
+        for tree in prev:       # warm predictions from the resumed trees
+            _accumulate_pred(cfg, tree, bins_mm, pred, vraw, n_train,
+                             chunk_rows, mesh)
+
+    def grad_of_chunk(a, b):
+        y_c = np.asarray(y_mm[a:b], np.float32)
+        w_c = np.asarray(w_mm[a:b], np.float32)
+        if cfg.loss.startswith("log"):
+            p = 1.0 / (1.0 + np.exp(-pred[a:b]))
+            return (p - y_c) * w_c, p * (1 - p) * w_c
+        return (pred[a:b] - y_c) * w_c, np.ones_like(y_c) * w_c
+
+    val_errs: List[float] = []
+    best_val, bad = np.inf, 0
+    for t in range(n_trees):
+        node_host[:] = 0
+        tree = _build_tree_streaming(
+            cfg, bins_mm[:n_train], grad_of_chunk, node_host, chunk_rows,
+            fm, mesh, hist_mesh)
+        trees.append(tree)
+        # prediction update needs only node_host + leaf values (no IO)
+        for a in range(0, n_train, chunk_rows):
+            b = min(a + chunk_rows, n_train)
+            contrib = _leaf_contrib_chunk(
+                cfg, tree, jnp.asarray(node_host[a:b]))
+            pred[a:b] += cfg.learning_rate * np.asarray(contrib)
+        if n_val:
+            for a in range(n_train, r, chunk_rows):
+                b = min(a + chunk_rows, r)
+                contrib = _predict_chunk(
+                    cfg, tree, jnp.asarray(np.ascontiguousarray(
+                        bins_mm[a:b].T)))
+                vraw[a - n_train:b - n_train] += \
+                    cfg.learning_rate * np.asarray(contrib)
+            vy = np.asarray(y_mm[n_train:r], np.float32)
+            # unit val weights — parity with build_gbt (and keeps any
+            # caller-side bagging weight view out of the val metric)
+            vw = np.ones_like(vy)
+            vp = 1.0 / (1.0 + np.exp(-vraw)) if cfg.loss.startswith("log") \
+                else vraw
+            err = float(np.sum((vp - vy) ** 2 * vw) /
+                        max(np.sum(vw), 1e-12))
+            val_errs.append(err)
+            if err < best_val - 1e-9:
+                best_val, bad = err, 0
+            else:
+                bad += 1
+                if early_stop_window and bad >= early_stop_window:
+                    break
+    stacked = jax.tree.map(lambda *a: jnp.stack(a), *trees)
+    return jax.tree.map(np.asarray, stacked), val_errs
+
+
+def _accumulate_pred(cfg, tree, bins_mm, pred, vraw, n_train, chunk_rows,
+                     mesh):
+    """Add one tree's shrunk contribution to train+val raw scores by
+    streaming the bin matrix (used when resuming from init_trees)."""
+    r = bins_mm.shape[0]
+    for a in range(0, r, chunk_rows):
+        b = min(a + chunk_rows, r)
+        contrib = cfg.learning_rate * np.asarray(_predict_chunk(
+            cfg, tree, jnp.asarray(np.ascontiguousarray(bins_mm[a:b].T))))
+        if a < n_train:
+            hi = min(b, n_train)
+            pred[a:hi] += contrib[:hi - a]
+        if b > n_train:
+            lo = max(a, n_train)
+            vraw[lo - n_train:b - n_train] += contrib[lo - a:]
+
+
+def build_rf_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
+                       subset_strategy: str, bagging_rate: float,
+                       seed: int, chunk_rows: int = 1 << 20):
+    """Out-of-core random forest: trees build sequentially (the
+    resident path vmaps them — that needs the whole matrix in HBM),
+    each with counter-based Poisson instance weights and a Bernoulli
+    feature subset, streaming the bin matrix like build_gbt_streaming."""
+    from shifu_tpu.parallel import mesh as mesh_mod
+    r, c = bins_mm.shape
+    rng = np.random.default_rng(seed)
+    k = feature_subset_count(subset_strategy, c)
+    mesh = mesh_mod.default_mesh()
+    hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
+    node_host = np.zeros(r, np.int32)
+    trees = []
+    for t in range(n_trees):
+        mask = np.zeros(c, np.float32)
+        mask[rng.choice(c, size=k, replace=False)] = 1.0
+
+        def grad_of_chunk(a, b, t=t):
+            y_c = np.asarray(y_mm[a:b], np.float32)
+            w_c = np.asarray(w_mm[a:b], np.float32)
+            gen = np.random.Generator(np.random.Philox(
+                key=seed + 104729 * t, counter=a))
+            iw = gen.poisson(max(bagging_rate, 1e-6),
+                             b - a).astype(np.float32)
+            return -(y_c * w_c * iw), w_c * iw
+
+        node_host[:] = 0
+        trees.append(_build_tree_streaming(
+            cfg, bins_mm, grad_of_chunk, node_host, chunk_rows,
+            mask, mesh, hist_mesh))
+    stacked = jax.tree.map(lambda *a: jnp.stack(a), *trees)
     return jax.tree.map(np.asarray, stacked)
 
 
@@ -510,7 +772,7 @@ def predict(meta: Dict[str, Any], params: Any, dense: np.ndarray,
     n_rows = bins.shape[0]
     trees = jax.tree.map(jnp.asarray, params["trees"])
     mesh = mesh_mod.default_mesh()
-    jb = mesh_mod.shard_axis(mesh, bins, 0)
+    jb = mesh_mod.shard_axis(mesh, np.ascontiguousarray(bins.T), 1)
     per_tree = np.asarray(predict_trees(trees, jb,
                                         int(cfg_meta["max_depth"]),
                                         n_bins))[:, :n_rows]

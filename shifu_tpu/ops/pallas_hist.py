@@ -8,26 +8,29 @@ bound by how the scatter-add is expressed. XLA lowers
 ~10 s for 2M×128 at depth 6 on v5e); this kernel reformulates the
 histogram as an MXU contraction instead:
 
-    hist[n, c, b] = Σ_r onehot_node[r, n] · g[r] · onehot_bin[r, c, b]
-                  = (onehot_node · g)ᵀ  @  onehot_bins2d
+    hist[n, c, b] = Σ_r onehot_node[n, r] · g[r] · onehot_bin[c, b, r]
 
-Everything stays 2D inside the kernel — Mosaic's vector layouts cannot
-collapse a (TR, TC, B) one-hot whose minor dim B is smaller than the
-128 lane width ("infer-vector-layout: unsupported shape cast", hit on
-hardware in round 2). Instead the bin one-hot is built directly in a
-bin-major lane layout, lane l = b·TC + c:
+Layout is everything on TPU: arrays pad their minor dim to the 128
+lane width and the second-minor to 8 sublanes, so a row-major
+(R, C) bin matrix with few features (HIGGS: C=28) or an (R, 1) column
+vector wastes 4–128× HBM. Every per-row operand therefore arrives
+TRANSPOSED — rows on the LANE axis:
 
-    onehot2d[r, l] = (bins[r, l mod TC] == l div TC)
+- `binsT`: (C, R) int — negligible padding for any feature count;
+- `packed`: (8, R) f32 carrying [slot, grad, hess] in its first three
+  sublane rows (slot as exact-integer float).
 
-via `jnp.tile` along lanes (a broadcast + lane-aligned collapse Mosaic
-accepts when TC is the 128-lane width) and an iota division. Each grid
-step contracts a (row_tile × S) gradient-weighted node one-hot with the
-(row_tile × TC·B) bin one-hot on the MXU and accumulates the (S, TC·B)
-output block across row tiles (TPU grids iterate sequentially, so `+=`
-into the same output block is the standard reduction pattern). The
-(S, C, B) histogram is reassembled from the bin-major blocks by cheap
-XLA reshape/transpose outside the kernel. Both G and H histograms come
-out of one pass.
+Per grid step the kernel expands a (TC, TR) bins tile to its bin
+one-hot in a bin-major sublane layout (sublane l = b·TC + c, built
+with the dedicated `tpu.repeat` op — no 128-alignment constraint on
+TC, verified on v5e at TC=28), builds the (S, TR) gradient-weighted
+node one-hot by comparing the slot lane-vector against a sublane
+iota, and contracts the two on the MXU with an NT matmul
+((S, TR) × (L, TR)ᵀ). The (S, L) output block accumulates across row
+tiles (TPU grids iterate sequentially, so `+=` into the same output
+block is the standard reduction pattern); the (S, C, B) histogram is
+reassembled by cheap XLA reshape/transpose outside the kernel. Both G
+and H histograms come out of one pass.
 
 `interpret=True` runs the same kernel on CPU for tests (conftest's
 8-device CPU mesh), keeping kernel parity checkable without a chip.
@@ -44,41 +47,45 @@ from jax.experimental import pallas as pl
 __all__ = ["level_histograms_pallas"]
 
 
-def _hist_kernel(bins_ref, slot_ref, grad_ref, hess_ref,
-                 out_g_ref, out_h_ref, *, n_slots: int, n_bins: int,
-                 precision):
+def _hist_kernel(binsT_ref, pk_ref, out_g_ref, out_h_ref, *,
+                 n_slots: int, n_bins: int, precision, interpret: bool):
     # grid = (col_tiles, row_tiles): the ROW (reduction) dimension is
     # innermost, so each output block's revisits are consecutive grid
     # steps — required for the += accumulation pattern on TPU (the
     # output VMEM buffer is flushed between non-consecutive revisits)
     i = pl.program_id(1)
 
-    bins = bins_ref[:, :]                       # (TR, TC) int32
-    slot = slot_ref[:, 0]                       # (TR,) int32
-    grad = grad_ref[:, 0]                       # (TR,) f32
-    hess = hess_ref[:, 0]
+    binsT = binsT_ref[:, :]                     # (TC, TR) int32
+    pk = pk_ref[:, :]                           # (8, TR) f32
+    slot = pk[0:1, :].astype(jnp.int32)         # (1, TR)
+    grad = pk[1:2, :]
+    hess = pk[2:3, :]
 
-    tr, tc = bins.shape
-    lanes = tc * n_bins
-    # bin one-hot in bin-major lane layout (lane l = b·TC + c):
-    # tile keeps the collapse lane-aligned (minor dim = TC = 128)
-    bins_rep = jnp.tile(bins, (1, n_bins))          # (TR, B·TC), l % TC
-    lane_bin = jax.lax.broadcasted_iota(jnp.int32, (tr, lanes), 1) // tc
-    onehot_bins = (bins_rep == lane_bin).astype(jnp.float32)
+    tc, tr = binsT.shape
+    # bin one-hot, transposed + bin-major (sublane l = b·TC + c):
+    # tpu.repeat stacks B copies of the (TC, TR) tile along sublanes
+    if interpret:
+        rep = jnp.tile(binsT, (n_bins, 1))      # rows l % TC
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+        rep = pltpu.repeat(binsT, n_bins, axis=0)
+    lane_bin = jax.lax.broadcasted_iota(
+        jnp.int32, (tc * n_bins, tr), 0) // tc
+    onehot_bins = (rep == lane_bin).astype(jnp.float32)   # (B·TC, TR)
 
-    # node one-hot weighted by grad/hess: (TR, S) — slot==n_slots is the
-    # dump slot for rows not in this level and is simply not emitted
-    slot_iota = jax.lax.broadcasted_iota(jnp.int32, (tr, n_slots), 1)
-    node_onehot = (slot[:, None] == slot_iota).astype(jnp.float32)
-    gw = node_onehot * grad[:, None]            # (TR, S)
-    hw = node_onehot * hess[:, None]
+    # node one-hot weighted by grad/hess: (S, TR) — slot==n_slots is
+    # the dump slot for rows not in this level and matches no sublane
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, (n_slots, tr), 0)
+    node_onehot = (slot == slot_iota).astype(jnp.float32)
+    gw = node_onehot * grad                     # (S, TR)
+    hw = node_onehot * hess
 
-    # MXU contraction over rows: (S, TR) @ (TR, B·TC) → (S, B·TC)
+    # MXU NT contraction over rows: (S, TR) · (B·TC, TR)ᵀ → (S, B·TC)
     part_g = jax.lax.dot_general(
-        gw, onehot_bins, (((0,), (0,)), ((), ())),
+        gw, onehot_bins, (((1,), (1,)), ((), ())),
         precision=precision, preferred_element_type=jnp.float32)
     part_h = jax.lax.dot_general(
-        hw, onehot_bins, (((0,), (0,)), ((), ())),
+        hw, onehot_bins, (((1,), (1,)), ((), ())),
         precision=precision, preferred_element_type=jnp.float32)
 
     @pl.when(i == 0)
@@ -92,14 +99,15 @@ def _hist_kernel(bins_ref, slot_ref, grad_ref, hess_ref,
         out_h_ref[:, :] += part_h
 
 
-def level_histograms_pallas(bins: jax.Array, slot: jax.Array,
+def level_histograms_pallas(binsT: jax.Array, slot: jax.Array,
                             grad: jax.Array, hess: jax.Array,
                             n_slots: int, n_bins: int,
                             row_tile: int = 512, col_tile: int = 128,
                             interpret: bool = False):
-    """(R, C) bins + (R,) slot/grad/hess → two (n_slots, C, n_bins)
-    histograms. `slot` values outside [0, n_slots) are ignored (rows
-    belonging to finished nodes / padding).
+    """(C, R) transposed bins + (R,) slot/grad/hess → two
+    (n_slots, C, n_bins) histograms. `slot` values outside
+    [0, n_slots) are ignored (rows belonging to finished nodes /
+    padding).
 
     Precision: the MXU multiplies in bf16 by default — the one-hot
     side is exact, so only grad/hess values truncate (~0.3% relative
@@ -113,7 +121,7 @@ def level_histograms_pallas(bins: jax.Array, slot: jax.Array,
                              "").lower() == "highest"
     if highest:
         row_tile = min(row_tile, 64)
-    return _level_histograms_pallas(bins, slot, grad, hess, n_slots,
+    return _level_histograms_pallas(binsT, slot, grad, hess, n_slots,
                                     n_bins, row_tile, col_tile, interpret,
                                     highest)
 
@@ -121,46 +129,46 @@ def level_histograms_pallas(bins: jax.Array, slot: jax.Array,
 @functools.partial(jax.jit, static_argnames=("n_slots", "n_bins",
                                              "row_tile", "col_tile",
                                              "interpret", "highest"))
-def _level_histograms_pallas(bins, slot, grad, hess,
+def _level_histograms_pallas(binsT, slot, grad, hess,
                              n_slots: int, n_bins: int,
                              row_tile: int, col_tile: int,
                              interpret: bool, highest: bool):
     precision = jax.lax.Precision.HIGHEST if highest \
         else jax.lax.Precision.DEFAULT
-    r, c = bins.shape
+    c, r = binsT.shape
     row_tile = min(row_tile, max(8, r))
-    # col_tile stays the 128-lane width: the kernel's lane-layout math
-    # (and Mosaic's tile collapse) relies on it; narrow matrices pad
+    col_tile = min(col_tile, max(1, c))
     pad_r = (-r) % row_tile
     pad_c = (-c) % col_tile
-    # out-of-level rows → a slot id that matches no one-hot lane
+    # out-of-level rows → a slot id that matches no one-hot sublane
     slot = jnp.where((slot >= 0) & (slot < n_slots), slot, n_slots)
+    # pack the per-row vectors into one (8, R) block: a bare (R,) or
+    # (R, 1) operand would lane-pad to 128× its size in HBM
+    packed = jnp.zeros((8, r + pad_r), jnp.float32)
+    packed = packed.at[0, :r].set(slot.astype(jnp.float32))
+    packed = packed.at[1, :r].set(grad.astype(jnp.float32))
+    packed = packed.at[2, :r].set(hess.astype(jnp.float32))
     if pad_r:
-        bins = jnp.pad(bins, ((0, pad_r), (0, 0)))
-        slot = jnp.pad(slot, (0, pad_r), constant_values=n_slots)
-        grad = jnp.pad(grad, (0, pad_r))
-        hess = jnp.pad(hess, (0, pad_r))
+        packed = packed.at[0, r:].set(float(n_slots))  # dump slot
+        binsT = jnp.pad(binsT, ((0, 0), (0, pad_r)))
     if pad_c:
-        bins = jnp.pad(bins, ((0, 0), (0, pad_c)))
-    rp, cp = bins.shape
+        binsT = jnp.pad(binsT, ((0, pad_c), (0, 0)))
+    cp, rp = binsT.shape
     n_ct = cp // col_tile
     # (col_tiles, row_tiles) — rows innermost; see _hist_kernel
     grid = (n_ct, rp // row_tile)
 
     kern = functools.partial(_hist_kernel, n_slots=n_slots, n_bins=n_bins,
-                             precision=precision)
+                             precision=precision, interpret=interpret)
     lanes = col_tile * n_bins
     out_shape = jax.ShapeDtypeStruct((n_slots, n_ct * lanes), jnp.float32)
-    col2d = lambda arr: arr.reshape(-1, 1)  # noqa: E731
 
     g, h = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((row_tile, col_tile), lambda j, i: (i, j)),
-            pl.BlockSpec((row_tile, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((col_tile, row_tile), lambda j, i: (j, i)),
+            pl.BlockSpec((8, row_tile), lambda j, i: (0, i)),
         ],
         out_specs=[
             pl.BlockSpec((n_slots, lanes), lambda j, i: (0, j)),
@@ -168,11 +176,11 @@ def _level_histograms_pallas(bins, slot, grad, hess,
         ],
         out_shape=[out_shape, out_shape],
         interpret=interpret,
-    )(bins.astype(jnp.int32), col2d(slot.astype(jnp.int32)),
-      col2d(grad.astype(jnp.float32)), col2d(hess.astype(jnp.float32)))
+    )(binsT.astype(jnp.int32), packed)
 
     def reassemble(a):
-        # blocks are (S, [tile j][bin b][col c]) bin-major → (S, C, B)
+        # out lanes are (S, [tile j][bin b][col c]) col-major-in-bin →
+        # (S, C, B); cheap XLA reshape/transpose on the small output
         a = a.reshape(n_slots, n_ct, n_bins, col_tile)
         a = a.transpose(0, 1, 3, 2).reshape(n_slots, cp, n_bins)
         return a[:, :c, :]

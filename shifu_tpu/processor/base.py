@@ -42,6 +42,8 @@ class ProcessorContext:
 
     def validate(self, step: ModelStep) -> None:
         res = probe(self.model_config, step)
+        for w in res.warnings:
+            log.warning("config: %s", w)
         if not res.status:
             raise ValueError(
                 f"ModelConfig validation failed for step {step.value}: "

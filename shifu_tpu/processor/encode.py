@@ -57,7 +57,8 @@ def run(ctx: ProcessorContext, out_dir: Optional[str] = None) -> int:
               "cat_map": np.asarray(params["tables"]["cat_map"])}
     bins = gbdt.bin_dataset(tables, dset.numeric, codes, n_bins)
     leaves = np.asarray(gbdt.leaf_indices(
-        jax.tree.map(jnp.asarray, params["trees"]), jnp.asarray(bins),
+        jax.tree.map(jnp.asarray, params["trees"]),
+        jnp.asarray(np.ascontiguousarray(bins.T)),
         int(cfg_meta["max_depth"]), n_bins)).T  # (R, T)
 
     out_dir = out_dir or os.path.join(ctx.path_finder.root, "encoded")

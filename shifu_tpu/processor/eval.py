@@ -149,6 +149,52 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
     return 0
 
 
+def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
+              n_records: int = 100) -> int:
+    """`shifu eval -audit [-n N]` — score the eval set and write the
+    first N records WITH every final-select variable's raw value, the
+    meta columns, and the model scores: a human-reviewable sample
+    (`EvalModelProcessor.doGenAuditData:1296-1356`, which re-runs the
+    score job with all finalSelect vars added to the meta list and
+    heads the output into tmp/<set>_<eval>_audit.data)."""
+    mc = ctx.model_config
+    ctx.require_columns()
+    evals = [e for e in mc.evals if eval_name is None or e.name == eval_name]
+    if not evals:
+        raise ValueError(f"no eval set named {eval_name!r}; have "
+                         f"{[e.name for e in mc.evals]}")
+    for ec in evals:
+        scores, tags, weights, dset = score_eval_set(ctx, ec)
+        if mc.is_multi_classification:
+            score_cols = sorted(k for k in scores if k.startswith("class"))
+        else:
+            score_cols = sorted(k for k in scores if k.startswith("model"))
+
+        n = min(n_records, len(tags))
+        tmp_dir = os.path.join(ctx.path_finder.root, "tmp")
+        os.makedirs(tmp_dir, exist_ok=True)
+        out = os.path.join(tmp_dir,
+                           f"{mc.model_set_name}_{ec.name}_audit.data")
+        var_names = list(dset.num_names) + list(dset.cat_names)
+        meta_names = sorted(dset.meta.keys())
+        with open(out, "w") as f:
+            f.write("|".join(["tag", "weight"] + var_names + meta_names
+                             + score_cols + ["finalScore"]) + "\n")
+            for i in range(n):
+                row = [str(dset.tags[i]), f"{weights[i]:.6g}"]
+                row += [f"{v:.6g}" for v in dset.numeric[i]]
+                row += [str(dset.vocabs[j][dset.cat_codes[i, j]])
+                        if 0 <= dset.cat_codes[i, j] < len(dset.vocabs[j])
+                        else "" for j in range(dset.cat_codes.shape[1])]
+                row += [str(dset.meta[m][i]) for m in meta_names]
+                row += [f"{float(scores[c][i]):.6f}" for c in score_cols]
+                row.append(f"{float(scores['final'][i]):.6f}")
+                f.write("|".join(row) + "\n")
+        log.info("eval[%s] -audit → %s (%d records, %d variables)",
+                 ec.name, out, n, len(var_names))
+    return 0
+
+
 def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     t0 = time.time()
     mc = ctx.model_config
@@ -174,6 +220,23 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
 
     perf = performance_result(final, tags, weights,
                               n_buckets=ec.performanceBucketNum)
+
+    # dynamic score capture — the reference harvests these from Pig/
+    # Hadoop counters + a max-min side file during the scoring job
+    # (`EvalModelProcessor.java:473,1114-1165` ScoreStatus); here the
+    # scores are in memory, so it is a reduction. maxScore/minScore
+    # matter for raw-score models (GBT RAW): downstream consumers use
+    # them to scale into display units.
+    pos = tags > 0.5
+    perf["scoreStatus"] = {
+        "records": int(len(final)),
+        "posCount": int(pos.sum()),
+        "negCount": int((~pos).sum()),
+        "weightedPos": float(weights[pos].sum()),
+        "weightedNeg": float(weights[~pos].sum()),
+        "maxScore": float(np.max(final)) if len(final) else 0.0,
+        "minScore": float(np.min(final)) if len(final) else 0.0,
+    }
 
     # champion/challenger: each benchmark score column in the eval data
     # gets its own PerformanceResult next to the challenger model's

@@ -168,6 +168,10 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
         np.save(os.path.join(path, "tags.npy"), tags.astype(np.float32))
         np.save(os.path.join(path, "weights.npy"),
                 weights.astype(np.float32))
+        if result.index.size:
+            # tree trainers also stream the categorical code block
+            np.save(os.path.join(path, "index.npy"),
+                    np.ascontiguousarray(result.index.astype(np.int32)))
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,
@@ -219,7 +223,8 @@ def run(ctx: ProcessorContext,
         index_vocab_sizes=[len(v) + 1 for v in dataset.vocabs])
     save_normalized(ctx.path_finder.cleaned_data_path(), clean,
                     dataset.tags, dataset.weights,
-                    task_tags=dataset.task_tags)
+                    task_tags=dataset.task_tags,
+                    streaming=mc.train.trainOnDisk)
     log.info("norm: %d rows → dense %s, index %s in %.2fs", dataset.num_rows,
              result.dense.shape, result.index.shape, time.time() - t0)
     return 0

@@ -63,26 +63,10 @@ def build_tables(ccs_num: List[ColumnConfig], ccs_cat: List[ColumnConfig],
     return cuts, cat_orders
 
 
-def run_tree(ctx: ProcessorContext, seed: int = 12306):
-    t0 = time.time()
+def _tables_and_cfg(ctx: ProcessorContext, meta):
+    """Binning tables + TreeConfig from ColumnConfig stats (shared by
+    the resident and streaming tree paths)."""
     mc = ctx.model_config
-    alg = mc.train.algorithm
-
-    clean_path = ctx.path_finder.cleaned_data_path()
-    if not os.path.exists(os.path.join(clean_path, "data.npz")):
-        raise FileNotFoundError(
-            f"cleaned data not found at {clean_path}; run `norm` first")
-    data, meta = norm_proc.load_normalized(clean_path)
-    dense = data["dense"].astype(np.float32)
-    codes = data["index"].astype(np.int32)
-    y = data["tags"].astype(np.float32)
-    w = data["weights"].astype(np.float32)
-
-    if mc.train.upSampleWeight != 1.0:
-        # duplicate-positive rebalance expressed as weight upsampling
-        # (core/shuffle rebalance + train#upSampleWeight)
-        w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
-
     cols = norm_proc.selected_candidates(ctx.column_configs)
     by_name = {c.columnName: c for c in cols}
     ccs_num = [by_name[n] for n in meta["denseNames"] if n in by_name]
@@ -98,8 +82,32 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     n_bins = value_slots + 1
     import dataclasses
     cfg = dataclasses.replace(tree_config_from_params(mc), n_bins=n_bins)
+    return cfg, gbdt.make_bin_tables(cuts, cat_orders, n_bins), n_bins
 
-    tables = gbdt.make_bin_tables(cuts, cat_orders, n_bins)
+
+def run_tree(ctx: ProcessorContext, seed: int = 12306):
+    t0 = time.time()
+    mc = ctx.model_config
+    alg = mc.train.algorithm
+
+    clean_path = ctx.path_finder.cleaned_data_path()
+    if mc.train.trainOnDisk and not mc.is_multi_classification:
+        return _run_tree_streaming(ctx, seed)
+    if not os.path.exists(os.path.join(clean_path, "data.npz")):
+        raise FileNotFoundError(
+            f"cleaned data not found at {clean_path}; run `norm` first")
+    data, meta = norm_proc.load_normalized(clean_path)
+    dense = data["dense"].astype(np.float32)
+    codes = data["index"].astype(np.int32)
+    y = data["tags"].astype(np.float32)
+    w = data["weights"].astype(np.float32)
+
+    if mc.train.upSampleWeight != 1.0:
+        # duplicate-positive rebalance expressed as weight upsampling
+        # (core/shuffle rebalance + train#upSampleWeight)
+        w = w * np.where(y > 0.5, np.float32(mc.train.upSampleWeight), 1.0)
+
+    cfg, tables, n_bins = _tables_and_cfg(ctx, meta)
     bins = gbdt.bin_dataset(tables, dense, codes, n_bins)
 
     n_trees = int(mc.train.get_param("TreeNum", 10 if alg is Algorithm.RF
@@ -119,12 +127,19 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     }
 
     n_bags = max(mc.train.baggingNum, 1) if alg is Algorithm.GBT else 1
+    # per-bag instance resampling — without it every GBT bag would
+    # train the identical model (reference bagging jobs each sample
+    # their own instances, TrainModelProcessor.runDistributedBagging)
+    from shifu_tpu.train.trainer import bagging_weights
+    bag_w = bagging_weights(int(tr_mask.sum()), n_bags,
+                            mc.train.baggingSampleRate,
+                            mc.train.baggingWithReplacement, seed)
     for bag in range(n_bags):
         if alg is Algorithm.GBT:
             init_trees = _continuous_trees(ctx, mc, bag)
             trees, val_errs = gbdt.build_gbt(
-                cfg, bins[tr_mask], y[tr_mask], w[tr_mask], n_trees,
-                init_trees=init_trees,
+                cfg, bins[tr_mask], y[tr_mask], w[tr_mask] * bag_w[bag],
+                n_trees, init_trees=init_trees,
                 val_data=(bins[val_mask], y[val_mask]) if val_mask.any() else None,
                 early_stop_window=int(mc.train.get_param(
                     "EnableEarlyStop", 0) and 10),
@@ -146,6 +161,133 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     log.info("train[%s]: %d bag(s) × %d trees, depth %d, %d bins in %.2fs",
              alg.value, n_bags, n_trees, cfg.max_depth, n_bins,
              time.time() - t0)
+    return None
+
+
+class _BaggedWeights:
+    """Sliceable view multiplying a weight view by counter-based
+    Poisson/Bernoulli bag multiplicities (same Philox scheme as
+    train/streaming._chunk_bag_weights: global row counter ⇒ identical
+    membership every pass)."""
+
+    def __init__(self, base, rate: float, with_replacement: bool, key: int):
+        self._base, self._rate = base, rate
+        self._repl, self._key = with_replacement, key
+
+    def __getitem__(self, sl):
+        w = np.asarray(self._base[sl], np.float32)
+        gen = np.random.Generator(np.random.Philox(
+            key=self._key, counter=sl.start or 0))
+        if self._repl:
+            m = gen.poisson(self._rate, len(w)).astype(np.float32)
+        else:
+            m = (gen.random(len(w)) < self._rate).astype(np.float32)
+        return w * m
+
+
+class _UpsampledWeights:
+    """Sliceable view applying train#upSampleWeight to a weight memmap
+    without materializing the adjusted array."""
+
+    def __init__(self, w_mm, y_mm, up: float):
+        self._w, self._y, self._up = w_mm, y_mm, np.float32(up)
+
+    def __getitem__(self, sl):
+        w = np.asarray(self._w[sl], np.float32)
+        if self._up == 1.0:
+            return w
+        y = np.asarray(self._y[sl], np.float32)
+        return w * np.where(y > 0.5, self._up, np.float32(1.0))
+
+
+def _run_tree_streaming(ctx: ProcessorContext, seed: int):
+    """train#trainOnDisk for GBT/RF: the cleaned matrix memory-maps
+    from disk, bins materialize once into a compact on-disk matrix
+    (uint8 when bins fit), and trees build by chunked histogram
+    accumulation (gbdt.build_gbt_streaming — one bins pass per level,
+    the disk-spill analog of MemoryDiskFloatMLDataSet feeding
+    DTWorker). Validation is the trailing validSetRate fraction."""
+    t0 = time.time()
+    mc = ctx.model_config
+    alg = mc.train.algorithm
+    clean_path = ctx.path_finder.cleaned_data_path()
+    dense_p = os.path.join(clean_path, "dense.npy")
+    if not os.path.exists(dense_p):
+        raise FileNotFoundError(
+            f"streaming layout not found at {clean_path}; run `norm` "
+            "with train#trainOnDisk=true so dense/index .npy blocks are "
+            "written")
+    meta = norm_proc.load_normalized_meta(clean_path)
+    dense = np.load(dense_p, mmap_mode="r")
+    idx_p = os.path.join(clean_path, "index.npy")
+    codes = np.load(idx_p, mmap_mode="r") if os.path.exists(idx_p) else None
+    y = np.load(os.path.join(clean_path, "tags.npy"), mmap_mode="r")
+    w_raw = np.load(os.path.join(clean_path, "weights.npy"), mmap_mode="r")
+    w = _UpsampledWeights(w_raw, y, mc.train.upSampleWeight)
+
+    cfg, tables, n_bins = _tables_and_cfg(ctx, meta)
+    n_rows = dense.shape[0] if dense.ndim == 2 and dense.shape[1] \
+        else len(y)
+    chunk_rows = int(mc.train.get_param("ChunkRows", 1 << 20) or (1 << 20))
+
+    # one-time chunked binning pass → compact on-disk bin matrix
+    n_cols = (dense.shape[1] if dense.ndim == 2 else 0) + \
+        (codes.shape[1] if codes is not None else 0)
+    dtype = np.uint8 if n_bins <= 256 else np.int16
+    bins_path = os.path.join(clean_path, "bins.npy")
+    bins_mm = np.lib.format.open_memmap(
+        bins_path, mode="w+", dtype=dtype, shape=(n_rows, n_cols))
+    for a in range(0, n_rows, chunk_rows):
+        b = min(a + chunk_rows, n_rows)
+        d_c = np.asarray(dense[a:b], np.float32) if dense.ndim == 2 else None
+        c_c = np.asarray(codes[a:b], np.int32) if codes is not None else None
+        bins_mm[a:b] = gbdt.bin_dataset(tables, d_c, c_c,
+                                        n_bins).astype(dtype)
+    bins_mm.flush()
+
+    n_trees = int(mc.train.get_param("TreeNum", 10 if alg is Algorithm.RF
+                                     else 100) or 10)
+    if alg is Algorithm.DT:
+        n_trees = 1
+    subset = str(mc.train.get_param("FeatureSubsetStrategy", "ALL") or "ALL")
+    spec_meta = {
+        "kind": alg.value.lower() if alg is not Algorithm.DT else "rf",
+        "treeConfig": {"max_depth": cfg.max_depth, "n_bins": cfg.n_bins,
+                       "learning_rate": cfg.learning_rate, "loss": cfg.loss},
+        "denseNames": meta["denseNames"], "indexNames": meta["indexNames"],
+        "modelSetName": mc.model_set_name, "nTrees": n_trees,
+    }
+
+    n_bags = max(mc.train.baggingNum, 1) if alg is Algorithm.GBT else 1
+    for bag in range(n_bags):
+        if alg is Algorithm.GBT:
+            init_trees = _continuous_trees(ctx, mc, bag)
+            w_bag = w if n_bags == 1 else _BaggedWeights(
+                w, mc.train.baggingSampleRate,
+                mc.train.baggingWithReplacement, seed + 7919 * bag)
+            trees, val_errs = gbdt.build_gbt_streaming(
+                cfg, bins_mm, y, w_bag, n_trees,
+                valid_rate=mc.train.validSetRate,
+                chunk_rows=chunk_rows, init_trees=init_trees,
+                early_stop_window=int(mc.train.get_param(
+                    "EnableEarlyStop", 0) and 10))
+            kind = "gbt"
+        else:
+            trees = gbdt.build_rf_streaming(
+                cfg, bins_mm, y, w, n_trees, subset,
+                mc.train.baggingSampleRate, seed + bag,
+                chunk_rows=chunk_rows)
+            val_errs = []
+            kind = "rf"
+        path = ctx.path_finder.model_path(bag, kind)
+        ctx.path_finder.ensure(path)
+        save_model(path, kind, spec_meta, {"trees": trees, "tables": tables})
+        if val_errs:
+            log.info("tree bag %d: %d trees, final val err %.6f", bag,
+                     trees["feature"].shape[0], val_errs[-1])
+    log.info("train[%s] streaming: %d bag(s) × %d trees, depth %d, "
+             "%d bins, %d rows in %.2fs", alg.value, n_bags, n_trees,
+             cfg.max_depth, n_bins, n_rows, time.time() - t0)
     return None
 
 

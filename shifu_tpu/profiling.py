@@ -1,0 +1,91 @@
+"""Step metrics + profiler traces.
+
+The reference's observability is per-iteration master log lines
+(`NNMaster.doCompute:309`), Hadoop/Pig counters
+(`EvalModelProcessor.java:473,1114-1165`), and a progress file tailed
+to the console (`TrainModelProcessor.java:1468-1489` TailThread).
+SURVEY.md §5 prescribes the TPU replacement: structured per-step
+metrics plus `jax.profiler` traces.
+
+- every CLI command (= every processor run) appends one JSON line to
+  `tmp/metrics/steps.jsonl`: step, wall seconds, rc, backend, device
+  count, and device memory stats (peak HBM bytes when the backend
+  reports them);
+- `shifu --profile <cmd>` additionally captures a `jax.profiler` trace
+  under `tmp/profile/<step>-<timestamp>/` — openable in TensorBoard /
+  Perfetto for op-level TPU timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("shifu_tpu")
+
+
+def device_stats() -> Dict:
+    """Backend + device count + memory stats (peak HBM) when the
+    runtime exposes them (TPU does; CPU returns none)."""
+    out: Dict = {}
+    try:
+        import jax
+        devs = jax.devices()
+        out["backend"] = jax.default_backend()
+        out["deviceCount"] = len(devs)
+        st = devs[0].memory_stats() if hasattr(devs[0],
+                                               "memory_stats") else None
+        if st:
+            for src, dst in (("peak_bytes_in_use", "peakBytesInUse"),
+                             ("bytes_in_use", "bytesInUse"),
+                             ("bytes_limit", "bytesLimit")):
+                if src in st:
+                    out[dst] = int(st[src])
+    except Exception as e:  # noqa: BLE001 — metrics must never fail a run
+        out["error"] = str(e)
+    return out
+
+
+@contextlib.contextmanager
+def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
+    """Record one step's structured metrics to tmp/metrics/steps.jsonl.
+    Yields a dict the caller may enrich (e.g. rows=, rc=)."""
+    rec: Dict = {"step": step, "startedAt": round(time.time(), 3)}
+    if extra:
+        rec.update(extra)
+    t0 = time.time()
+    try:
+        yield rec
+    finally:
+        rec["wallSeconds"] = round(time.time() - t0, 3)
+        rec.update(device_stats())
+        try:
+            mdir = os.path.join(root, "tmp", "metrics")
+            os.makedirs(mdir, exist_ok=True)
+            with open(os.path.join(mdir, "steps.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            log.warning("metrics: could not write steps.jsonl: %s", e)
+
+
+@contextlib.contextmanager
+def maybe_profile(root: str, step: str, enabled: bool):
+    """jax.profiler trace around a step when --profile is set."""
+    if not enabled:
+        yield None
+        return
+    import jax
+    out = os.path.join(root, "tmp", "profile",
+                       f"{step}-{int(time.time())}")
+    os.makedirs(out, exist_ok=True)
+    jax.profiler.start_trace(out)
+    try:
+        yield out
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s (open with TensorBoard "
+                 "or ui.perfetto.dev)", out)

@@ -30,6 +30,30 @@ def test_cli_full_pipeline(model_set):
     assert os.path.exists(os.path.join(model_set, "featureimportance.csv"))
 
 
+def test_eval_audit_and_score_status(model_set):
+    """`eval -audit` writes a raw-variable sample; EvalPerformance
+    carries the dynamic score capture (ScoreStatus parity:
+    EvalModelProcessor.java:473,1114-1165 counters + max/min file)."""
+    for cmd in (["init"], ["stats"], ["norm"], ["train"], ["eval"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    assert cli_main(["--dir", model_set, "eval", "-audit", "-n", "37"]) == 0
+    ctx = ProcessorContext.load(model_set)
+    mc = ctx.model_config
+    audit = os.path.join(model_set, "tmp",
+                         f"{mc.model_set_name}_Eval1_audit.data")
+    assert os.path.exists(audit)
+    lines = open(audit).read().strip().splitlines()
+    assert len(lines) == 38  # header + 37 records
+    header = lines[0].split("|")
+    assert header[0] == "tag" and header[-1] == "finalScore"
+    assert len(header) > 4  # raw variables present
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    ss = perf["scoreStatus"]
+    assert ss["records"] == ss["posCount"] + ss["negCount"]
+    assert 0.0 <= ss["minScore"] <= ss["maxScore"] <= 1.0
+
+
 def test_cli_new_scaffold(tmp_path):
     rc = cli_main(["--dir", str(tmp_path), "new", "MyModel"])
     assert rc == 0
@@ -314,3 +338,23 @@ def test_tf_export_savedmodel(model_set):
     want = np.asarray(nn_mod.forward(spec, params, jnp.asarray(x)))
     got = mod.f(tf.constant(x)).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_step_metrics_and_profile(model_set):
+    """Every command appends a structured metrics record; --profile
+    captures a jax.profiler trace (SURVEY §5 observability)."""
+    assert cli_main(["--dir", model_set, "init"]) == 0
+    assert cli_main(["--dir", model_set, "--profile", "stats"]) == 0
+    mpath = os.path.join(model_set, "tmp", "metrics", "steps.jsonl")
+    assert os.path.exists(mpath)
+    recs = [json.loads(l) for l in open(mpath)]
+    assert [r["step"] for r in recs] == ["init", "stats"]
+    for r in recs:
+        assert r["rc"] == 0 and r["wallSeconds"] >= 0
+        assert r["backend"] and r["deviceCount"] >= 1
+    pdir = os.path.join(model_set, "tmp", "profile")
+    traces = []
+    for dirpath, _, files in os.walk(pdir):
+        traces += [f for f in files if "trace" in f or f.endswith(".pb")
+                   or f.endswith(".json.gz")]
+    assert traces, f"no profiler trace files under {pdir}"

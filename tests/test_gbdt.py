@@ -36,7 +36,7 @@ def test_single_tree_finds_informative_split(rng):
     cfg = TreeConfig(max_depth=3, n_bins=17)
     grad = -(y)  # RF-style: leaf = mean(y)
     hess = np.ones_like(y)
-    tree = gbdt.build_tree(cfg, jnp.asarray(bins), jnp.asarray(grad),
+    tree = gbdt.build_tree(cfg, jnp.asarray(bins.T), jnp.asarray(grad),
                            jnp.asarray(hess),
                            jnp.ones(bins.shape[1], jnp.float32))
     # root must split on feature 0 near the middle bin
@@ -47,11 +47,11 @@ def test_single_tree_finds_informative_split(rng):
 def test_tree_predict_partitions(rng):
     bins, y = _binned(rng)
     cfg = TreeConfig(max_depth=4, n_bins=17)
-    tree = gbdt.build_tree(cfg, jnp.asarray(bins), jnp.asarray(-(y)),
+    tree = gbdt.build_tree(cfg, jnp.asarray(bins.T), jnp.asarray(-(y)),
                            jnp.asarray(np.ones_like(y)),
                            jnp.ones(bins.shape[1], jnp.float32))
     pred = np.asarray(gbdt.predict_trees(
-        jax.tree.map(lambda a: a[None], tree), jnp.asarray(bins), 4, 17))[0]
+        jax.tree.map(lambda a: a[None], tree), jnp.asarray(bins.T), 4, 17))[0]
     # leaf means approximate P(y|leaf): high AUC
     from shifu_tpu.ops.metrics import auc
     a = float(auc(jnp.asarray(pred), jnp.asarray(y)))
@@ -84,7 +84,7 @@ def test_gbt_missing_direction(rng):
                                           "learning_rate": 0.5, "loss": "log"}}
     # score missing rows directly on bin matrix
     pred = np.asarray(gbdt.predict_trees(
-        jax.tree.map(jnp.asarray, trees), jnp.asarray(bins), 2, n_bins))
+        jax.tree.map(jnp.asarray, trees), jnp.asarray(bins.T), 2, n_bins))
     raw = 0.5 * pred.sum(axis=0)
     p = 1 / (1 + np.exp(-raw))
     assert p[miss].mean() > 0.8  # learned that missing → positive
@@ -97,7 +97,7 @@ def test_rf_vmapped_forest(rng):
                           subset_strategy="SQRT", bagging_rate=1.0, seed=7)
     assert trees["feature"].shape == (8, cfg.n_nodes)
     pred = np.asarray(gbdt.predict_trees(
-        jax.tree.map(jnp.asarray, trees), jnp.asarray(bins), 4, 17)).mean(axis=0)
+        jax.tree.map(jnp.asarray, trees), jnp.asarray(bins.T), 4, 17)).mean(axis=0)
     from shifu_tpu.ops.metrics import auc
     assert float(auc(jnp.asarray(pred), jnp.asarray(y))) > 0.85
     assert pred.min() >= -1e-5 and pred.max() <= 1 + 1e-5  # mean-label leaves
@@ -106,7 +106,7 @@ def test_rf_vmapped_forest(rng):
 def test_min_instances_respected(rng):
     bins, y = _binned(rng, n=50)
     cfg = TreeConfig(max_depth=6, n_bins=17, min_instances_per_node=20)
-    tree = gbdt.build_tree(cfg, jnp.asarray(bins), jnp.asarray(-(y)),
+    tree = gbdt.build_tree(cfg, jnp.asarray(bins.T), jnp.asarray(-(y)),
                            jnp.asarray(np.ones_like(y)),
                            jnp.ones(bins.shape[1], jnp.float32))
     # with 50 rows and min 20 per side, depth ≥ 2 splits are impossible
@@ -193,9 +193,9 @@ def test_pallas_histogram_matches_scatter(rng):
     old = os.environ.get("SHIFU_TPU_HIST")
     try:
         os.environ["SHIFU_TPU_HIST"] = "xla"
-        g0, h0 = _level_histograms(bins, node, grad, hess, 0, S, B)
+        g0, h0 = _level_histograms(bins.T, node, grad, hess, 0, S, B)
         slot = jnp.where((node >= 0) & (node < S), node, S)
-        g1, h1 = level_histograms_pallas(bins, slot, grad, hess, S, B,
+        g1, h1 = level_histograms_pallas(bins.T, slot, grad, hess, S, B,
                                          row_tile=128, col_tile=5,
                                          interpret=True)
     finally:
@@ -239,3 +239,84 @@ def test_gbt_trains_through_pallas_kernel(tmp_path, rng):
     import json
     perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
     assert perf["areaUnderRoc"] > 0.85
+
+
+def test_streaming_gbt_matches_resident(rng):
+    """Chunked histogram accumulation (build_gbt_streaming) grows the
+    same ensemble as the resident builder: histograms are additive over
+    row chunks, so splits must agree (dt/DTWorker.java:914-944
+    Combinable merge semantics, here chunk partial sums)."""
+    from shifu_tpu.models import gbdt
+
+    r, c, n_bins = 700, 6, 10
+    bins = rng.integers(0, n_bins - 1, (r, c)).astype(np.int32)
+    beta = rng.normal(0, 1, c)
+    y = ((bins @ beta) > np.median(bins @ beta)).astype(np.float32)
+    w = np.ones(r, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=3, n_bins=n_bins, learning_rate=0.3,
+                          loss="log")
+    resident, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=5)
+    streaming, _ = gbdt.build_gbt_streaming(cfg, bins, y, w, n_trees=5,
+                                            chunk_rows=150)
+    np.testing.assert_array_equal(resident["feature"],
+                                  streaming["feature"])
+    np.testing.assert_array_equal(resident["is_leaf"],
+                                  streaming["is_leaf"])
+    np.testing.assert_allclose(resident["leaf_value"],
+                               streaming["leaf_value"], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_streaming_tree_pipeline(tmp_path, rng):
+    """trainOnDisk routes GBT through the out-of-core path: bins
+    materialize to a uint8 on-disk matrix and the model evaluates."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (eval as eval_proc, init as init_proc,
+                                     norm as norm_proc, stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=1200, algorithm="GBT",
+                          train_params={"TreeNum": 8, "MaxDepth": 3,
+                                        "LearningRate": 0.3,
+                                        "ChunkRows": 300})
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["train"]["trainOnDisk"] = True
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    assert eval_proc.run(ctx) == 0
+    bins_path = os.path.join(ctx.path_finder.cleaned_data_path(),
+                             "bins.npy")
+    assert os.path.exists(bins_path)
+    assert np.load(bins_path, mmap_mode="r").dtype == np.uint8
+    perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
+    assert perf["areaUnderRoc"] > 0.85
+
+
+def test_streaming_rf_smoke(rng):
+    """Out-of-core RF: sequential per-tree builds with Philox Poisson
+    weights produce a working ensemble."""
+    from shifu_tpu.models import gbdt
+
+    r, c, n_bins = 600, 5, 8
+    bins = rng.integers(0, n_bins - 1, (r, c)).astype(np.int32)
+    beta = rng.normal(0, 1, c)
+    y = ((bins @ beta) > np.median(bins @ beta)).astype(np.float32)
+    w = np.ones(r, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=3, n_bins=n_bins)
+    trees = gbdt.build_rf_streaming(cfg, bins, y, w, n_trees=4,
+                                    subset_strategy="ALL",
+                                    bagging_rate=1.0, seed=3,
+                                    chunk_rows=200)
+    assert trees["feature"].shape[0] == 4
+    import jax.numpy as jnp
+    scores = np.mean(np.asarray(gbdt.predict_trees(
+        jax.tree.map(jnp.asarray, trees), jnp.asarray(bins.T),
+        cfg.max_depth, cfg.n_bins)), axis=0)
+    from shifu_tpu.ops.metrics import auc
+    assert float(auc(jnp.asarray(scores), jnp.asarray(y))) > 0.8

@@ -1,0 +1,271 @@
+"""Misconfiguration battery: every bad config fails at probe time with
+a clean, step-specific message — never as a shape error inside a jitted
+kernel (reference: `core/validator/ModelInspector.java:92+` +
+`container/meta/*` meta-spec validation)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config.inspector import ModelStep, probe
+from shifu_tpu.config.model_config import ModelConfig
+
+
+@pytest.fixture()
+def ms(tmp_path, rng):
+    from tests.synth import make_model_set
+    return make_model_set(tmp_path, rng, n_rows=200)
+
+
+def _mc(root, **edits):
+    """Load the model set's config and apply {'section.key': value}."""
+    path = os.path.join(root, "ModelConfig.json")
+    raw = json.load(open(path))
+    for dotted, v in edits.items():
+        cur = raw
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            cur = cur[p]
+        cur[parts[-1]] = v
+    json.dump(raw, open(path, "w"))
+    return ModelConfig.load(root)
+
+
+def _causes(mc, step):
+    r = probe(mc, step)
+    return "" if r.status else "; ".join(r.causes)
+
+
+# ---- meta-spec range/enum checks ------------------------------------------
+
+def test_empty_name_fails(ms):
+    assert "basic#name" in _causes(_mc(ms, **{"basic.name": ""}),
+                                   ModelStep.INIT)
+
+
+def test_bad_max_num_bin(ms):
+    assert "maxNumBin" in _causes(_mc(ms, **{"stats.maxNumBin": 1}),
+                                  ModelStep.STATS)
+
+
+def test_huge_max_num_bin(ms):
+    assert "maxNumBin" in _causes(_mc(ms, **{"stats.maxNumBin": 99999}),
+                                  ModelStep.STATS)
+
+
+def test_bad_sample_rate(ms):
+    assert "sampleRate" in _causes(_mc(ms, **{"stats.sampleRate": 0.0}),
+                                   ModelStep.STATS)
+
+
+def test_bad_std_dev_cutoff(ms):
+    assert "stdDevCutOff" in _causes(
+        _mc(ms, **{"normalize.stdDevCutOff": -1.0}), ModelStep.NORMALIZE)
+
+
+def test_bad_precision_type(ms):
+    assert "precisionType" in _causes(
+        _mc(ms, **{"normalize.precisionType": "FLOAT99"}),
+        ModelStep.NORMALIZE)
+
+
+def test_bad_bagging_num(ms):
+    assert "baggingNum" in _causes(_mc(ms, **{"train.baggingNum": 0}),
+                                   ModelStep.TRAIN)
+
+
+def test_bad_valid_set_rate(ms):
+    assert "validSetRate" in _causes(
+        _mc(ms, **{"train.validSetRate": 1.5}), ModelStep.TRAIN)
+
+
+def test_bad_epochs(ms):
+    assert "numTrainEpochs" in _causes(
+        _mc(ms, **{"train.numTrainEpochs": 0}), ModelStep.TRAIN)
+
+
+def test_bad_upsample_weight(ms):
+    assert "upSampleWeight" in _causes(
+        _mc(ms, **{"train.upSampleWeight": 0.5}), ModelStep.TRAIN)
+
+
+def test_bad_learning_rate_param(ms):
+    mc = _mc(ms)
+    mc.train.params["LearningRate"] = -0.1
+    assert "LearningRate" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_bad_grid_learning_rate_element(ms):
+    mc = _mc(ms)
+    mc.train.params["LearningRate"] = [0.1, -0.5]
+    assert "LearningRate" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_bad_max_depth_param(ms):
+    mc = _mc(ms)
+    mc.train.params["MaxDepth"] = 99
+    assert "MaxDepth" in _causes(mc, ModelStep.TRAIN)
+
+
+# ---- semantic / cross-field checks ----------------------------------------
+
+def test_missing_data_path(ms):
+    assert "dataPath" in _causes(_mc(ms, **{"dataSet.dataPath": ""}),
+                                 ModelStep.INIT)
+
+
+def test_nonexistent_data_path(ms):
+    c = _causes(_mc(ms, **{"dataSet.dataPath": "no/such/file.psv"}),
+                ModelStep.INIT)
+    assert "does not exist" in c
+
+
+def test_weight_equals_target(ms):
+    mc = _mc(ms)
+    mc.dataSet.weightColumnName = mc.dataSet.targetColumnName
+    assert "weight column cannot be the target" in _causes(
+        mc, ModelStep.INIT)
+
+
+def test_overlapping_tags(ms):
+    mc = _mc(ms)
+    mc.dataSet.negTags = list(mc.dataSet.posTags)
+    assert "overlap" in _causes(mc, ModelStep.INIT)
+
+
+def test_empty_pos_tags(ms):
+    assert "posTags" in _causes(_mc(ms, **{"dataSet.posTags": []}),
+                                ModelStep.INIT)
+
+
+def test_unknown_activation(ms):
+    mc = _mc(ms)
+    mc.train.params["NumHiddenLayers"] = 1
+    mc.train.params["NumHiddenNodes"] = [8]
+    mc.train.params["ActivationFunc"] = ["warpdrive"]
+    assert "warpdrive" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_unknown_propagation(ms):
+    mc = _mc(ms)
+    mc.train.params["Propagation"] = "WARP"
+    assert "Propagation" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_hidden_layer_mismatch(ms):
+    mc = _mc(ms)
+    mc.train.params["NumHiddenLayers"] = 2
+    mc.train.params["NumHiddenNodes"] = [8]
+    mc.train.params["ActivationFunc"] = ["tanh", "tanh"]
+    assert "NumHiddenNodes" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_bad_tree_loss(ms):
+    mc = _mc(ms, **{"train.algorithm": "GBT"})
+    mc.train.params["Loss"] = "hinge9"
+    assert "Loss" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_bad_subset_strategy(ms):
+    mc = _mc(ms, **{"train.algorithm": "RF"})
+    mc.train.params["FeatureSubsetStrategy"] = "MOST"
+    assert "FeatureSubsetStrategy" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_fixed_layers_without_continuous(ms):
+    mc = _mc(ms)
+    mc.train.params["FixedLayers"] = [0]
+    assert "isContinuous" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_kfold_with_continuous(ms):
+    mc = _mc(ms, **{"train.numKFold": 5, "train.isContinuous": True})
+    assert "k-fold" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_grid_search_with_continuous(ms):
+    mc = _mc(ms, **{"train.isContinuous": True})
+    mc.train.params["LearningRate"] = [0.1, 0.2]
+    assert "grid search" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_missing_grid_config_file(ms):
+    mc = _mc(ms, **{"train.gridConfigFile": "grid/nope.txt"})
+    assert "gridConfigFile" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_wdl_requires_index_norm(ms):
+    mc = _mc(ms, **{"train.algorithm": "WDL",
+                    "normalize.normType": "ZSCALE"})
+    assert "INDEX" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_eval_duplicate_names(ms):
+    mc = _mc(ms)
+    mc.evals.append(mc.evals[0])
+    assert "duplicate" in _causes(mc, ModelStep.EVAL)
+
+
+def test_eval_bad_bucket_num(ms):
+    mc = _mc(ms)
+    mc.evals[0].performanceBucketNum = 1
+    assert "performanceBucketNum" in _causes(mc, ModelStep.EVAL)
+
+
+def test_eval_bad_selector(ms):
+    mc = _mc(ms)
+    mc.evals[0].performanceScoreSelector = "loudest"
+    assert "performanceScoreSelector" in _causes(mc, ModelStep.EVAL)
+
+
+def test_eval_bad_gbt_convert(ms):
+    mc = _mc(ms)
+    mc.evals[0].gbtScoreConvertStrategy = "SQUARE"
+    assert "gbtScoreConvertStrategy" in _causes(mc, ModelStep.EVAL)
+
+
+def test_eval_missing_data_path(ms):
+    mc = _mc(ms)
+    mc.evals[0].dataSet.dataPath = ""
+    assert "dataPath" in _causes(mc, ModelStep.EVAL)
+
+
+# ---- typo warnings ---------------------------------------------------------
+
+def test_unknown_key_suggestion(ms):
+    path = os.path.join(ms, "ModelConfig.json")
+    raw = json.load(open(path))
+    raw["train"]["baggingNums"] = 3        # typo of baggingNum
+    json.dump(raw, open(path, "w"))
+    mc = ModelConfig.load(ms)
+    r = probe(mc, ModelStep.TRAIN)
+    assert r.status  # warning, not failure (keys are preserved)
+    assert any("baggingNums" in w and "baggingNum" in w
+               for w in r.warnings)
+
+
+def test_probe_fails_before_kernel(ms):
+    """End-to-end: the processor raises the probe message, not a shape
+    error from inside a kernel."""
+    from shifu_tpu.processor import init as init_proc
+    from shifu_tpu.processor.base import ProcessorContext
+    _mc(ms, **{"dataSet.dataPath": "no/such/file.psv"})
+    ctx = ProcessorContext.load(ms)
+    with pytest.raises(ValueError, match="does not exist"):
+        ctx.validate(ModelStep.INIT)
+
+
+def test_all_tags_invalid_fails_cleanly(ms):
+    """Data-dependent check: a target column whose values never match
+    posTags/negTags fails with the observed values, not a kernel shape
+    error (VERDICT Weak #7 tag-cardinality example)."""
+    from shifu_tpu.processor import init as init_proc, stats as stats_proc
+    from shifu_tpu.processor.base import ProcessorContext
+    _mc(ms, **{"dataSet.posTags": ["yes"], "dataSet.negTags": ["no"]})
+    ctx = ProcessorContext.load(ms)
+    assert init_proc.run(ctx) == 0
+    ctx = ProcessorContext.load(ms)
+    with pytest.raises(ValueError, match="posTags"):
+        stats_proc.run(ctx)

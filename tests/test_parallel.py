@@ -124,23 +124,24 @@ def test_gbdt_histogram_reduction_is_psum_not_gather(rng):
     assert mesh.shape["data"] == 8
 
     r, c, b, s = 1024, 4, 8, 4
-    bins = mesh_mod.shard_axis(mesh, rng.integers(0, b, (r, c)).astype(np.int32), 0)
+    binsT = mesh_mod.shard_axis(
+        mesh, np.ascontiguousarray(rng.integers(0, b, (r, c)).astype(np.int32).T), 1)
     node = mesh_mod.shard_axis(mesh, rng.integers(0, s, r).astype(np.int32), 0)
     grad = mesh_mod.shard_axis(mesh, rng.normal(0, 1, r).astype(np.float32), 0)
     hess = mesh_mod.shard_axis(mesh, np.ones(r, np.float32), 0)
 
-    def hist(bins, node, grad, hess):
-        return _level_histograms(bins, node, grad, hess, 0, s, b, mesh=mesh)
+    def hist(binsT, node, grad, hess):
+        return _level_histograms(binsT, node, grad, hess, 0, s, b, mesh=mesh)
 
-    lowered = jax.jit(hist).lower(bins, node, grad, hess)
+    lowered = jax.jit(hist).lower(binsT, node, grad, hess)
     hlo = lowered.compile().as_text()
     assert "all-reduce" in hlo, "histogram reduction should be a psum"
     assert "all-gather" not in hlo, \
         "row-sharded operands must not be all-gathered"
 
     # and the result matches the unsharded computation
-    g, h = jax.jit(hist)(bins, node, grad, hess)
-    bins_h = np.asarray(bins)
+    g, h = jax.jit(hist)(binsT, node, grad, hess)
+    bins_h = np.asarray(binsT).T
     node_h = np.asarray(node)
     grad_h = np.asarray(grad)
     g_ref = np.zeros((s, c, b), np.float32)
