@@ -95,6 +95,15 @@ def _file_should_exist(mc: ModelConfig, p: str, label: str,
     if not p:
         return
     rp = mc.resolve_path(p)
+    from shifu_tpu.data import fs as fs_mod
+    if fs_mod.has_scheme(rp):
+        try:
+            ok = fs_mod.exists(rp)
+        except RuntimeError:
+            return  # backend not installed here — defer to read time
+        if not ok:
+            r.fail(f"{label} points to {p!r}, which does not exist")
+        return
     if not os.path.exists(rp):
         r.fail(f"{label} points to {p!r}, which does not exist "
                f"(resolved {rp})")

@@ -696,8 +696,13 @@ class ModelConfig:
         return [str(t) for t in self.dataSet.negTags]
 
     def resolve_path(self, p: str) -> str:
-        """Resolve a config-relative path against the model-set dir."""
+        """Resolve a config-relative path against the model-set dir.
+        Scheme'd remote paths (hdfs://, s3://, gs://, memory://) pass
+        through untouched (fs/ShifuFileUtils SourceType dispatch)."""
         if not p:
+            return p
+        from shifu_tpu.data.fs import has_scheme
+        if has_scheme(p):
             return p
         if os.path.isabs(p):
             return p

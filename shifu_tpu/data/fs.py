@@ -1,0 +1,81 @@
+"""Multi-scheme filesystem dispatch.
+
+The reference routes every FS operation through
+`fs/ShifuFileUtils.java`, which dispatches on SourceType
+(LOCAL/HDFS/S3/GS resolved from the path scheme) to a Hadoop
+FileSystem. Here the analog is scheme-driven dispatch to fsspec:
+plain paths stay on the fast local code path (including the native C
+reader), while `hdfs://`, `s3://`, `s3a://`, `gs://`, `memory://`, …
+paths go through `fsspec` (bundled; backends for a specific scheme may
+need their extra package — s3fs/gcsfs — and a clear error names what
+is missing). `memory://` is fsspec's in-process filesystem, used by
+tests to exercise the remote path without a cluster
+(`fs/ShifuFileUtils.java` + `util/HDFSUtils` analog).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+_SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.\-]*://")
+
+
+def has_scheme(path: str) -> bool:
+    """True for URL-style paths (hdfs://, s3://, gs://, memory://...).
+    Windows drive letters don't occur here; plain/relative paths and
+    file-less strings are local."""
+    return bool(path) and bool(_SCHEME_RE.match(path))
+
+
+def _fs_and_path(path: str):
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec ships in-image
+        raise RuntimeError(
+            f"path {path!r} needs fsspec for remote filesystems; "
+            "pip install fsspec (+ the scheme's backend, e.g. s3fs/gcsfs)"
+        ) from e
+    try:
+        return fsspec.core.url_to_fs(path)
+    except (ImportError, ValueError) as e:
+        raise RuntimeError(
+            f"no filesystem backend for {path!r}: {e} — install the "
+            "scheme's fsspec backend (s3fs for s3://, gcsfs for gs://, "
+            "pyarrow for hdfs://)") from e
+
+
+def open_text(path: str, mode: str = "rt"):
+    """Open a (possibly remote, possibly compressed) file for reading."""
+    import fsspec
+    return fsspec.open(path, mode, compression="infer").open()
+
+
+def exists(path: str) -> bool:
+    fs, p = _fs_and_path(path)
+    return fs.exists(p)
+
+
+def list_data_files(path: str, skip_basenames, strip_url=False) -> List[str]:
+    """File / directory-of-part-files / glob expansion for a remote
+    path — the scheme-side twin of reader.expand_data_files. Returns
+    full URLs (scheme preserved) so downstream opens dispatch right."""
+    fs, p = _fs_and_path(path)
+    proto = fs.protocol if isinstance(fs.protocol, str) else fs.protocol[0]
+
+    def url(q: str) -> str:
+        return q if has_scheme(q) else f"{proto}://{q.lstrip('/') if proto == 'memory' else q}"
+
+    if fs.isdir(p):
+        names = sorted(fs.ls(p, detail=False))
+        out = []
+        for q in names:
+            base = q.rstrip("/").rsplit("/", 1)[-1]
+            if base in skip_basenames or base.startswith((".", "_")):
+                continue
+            if fs.isfile(q):
+                out.append(url(q))
+        return out
+    if fs.isfile(p):
+        return [url(p)]
+    return [url(q) for q in sorted(fs.glob(p)) if fs.isfile(q)]

@@ -19,14 +19,22 @@ import numpy as np
 import pandas as pd
 
 from shifu_tpu.config.model_config import ModelConfig, ModelSourceDataConf
+from shifu_tpu.data import fs as fs_mod
 
 _SKIP_BASENAMES = {"_SUCCESS", ".pig_header", ".pig_schema"}
 
 
 def expand_data_files(data_path: str) -> List[str]:
     """A dataPath may be a file, a glob, or a directory of part files
-    (Hadoop layout). Hidden/marker files are skipped like the
+    (Hadoop layout) — local or on a scheme'd remote filesystem
+    (hdfs://, s3://, gs://, memory://; `fs/ShifuFileUtils.java`
+    SourceType dispatch). Hidden/marker files are skipped like the
     reference's part-file scanners."""
+    if fs_mod.has_scheme(data_path):
+        files = fs_mod.list_data_files(data_path, _SKIP_BASENAMES)
+        if not files:
+            raise FileNotFoundError(f"no data files under {data_path!r}")
+        return files
     if os.path.isdir(data_path):
         files = sorted(
             p for p in glob.glob(os.path.join(data_path, "*"))
@@ -50,7 +58,9 @@ def read_header(ds: ModelSourceDataConf, base_resolver=None) -> List[str]:
     resolve = base_resolver or (lambda p: p)
     if ds.headerPath:
         hp = resolve(ds.headerPath)
-        with open(hp) as f:
+        opener = fs_mod.open_text if fs_mod.has_scheme(hp) \
+            else (lambda p: open(p))
+        with opener(hp) as f:
             line = f.readline().rstrip("\r\n")
         delim = ds.headerDelimiter or "|"
     else:
@@ -68,6 +78,8 @@ def simple_column_name(name: str) -> str:
 
 
 def _opener_for(path: str):
+    if fs_mod.has_scheme(path):
+        return fs_mod.open_text
     if path.endswith(".gz"):
         import gzip
         return lambda p: gzip.open(p, "rt")
@@ -105,6 +117,7 @@ def read_raw_table(mc: ModelConfig,
     has_header_line = not ds.headerPath  # header came from data file itself
 
     if numeric_columns and max_rows is None and \
+            not any(fs_mod.has_scheme(p) for p in files) and \
             os.environ.get("SHIFU_TPU_NATIVE_READER", "1") != "0":
         from shifu_tpu.data.native_reader import read_files_native
         simple = [simple_column_name(c) for c in header]

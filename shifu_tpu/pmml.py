@@ -25,6 +25,7 @@ native scorer.
 
 from __future__ import annotations
 
+import copy
 import xml.etree.ElementTree as ET
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -453,6 +454,192 @@ def build_pmml(mc: ModelConfig, ccs: List[ColumnConfig], kind: str,
 def to_string(root: ET.Element) -> str:
     ET.indent(root)
     return ET.tostring(root, encoding="unicode", xml_declaration=True)
+
+
+# ---------------------------------------------------------------------------
+# Structural conformance validation
+# ---------------------------------------------------------------------------
+
+# PMML 4.2 child-order (subset this module emits). The reference
+# validates via the jpmml evaluator (`PMMLTranslatorTest.java`); with
+# no external consumer installable here, this enforces the schema
+# rules a consumer would trip on: namespace/version, element order,
+# count attributes, and that every reference (field, neuron id,
+# output neuron) resolves.
+_MODEL_TAGS = ("NeuralNetwork", "RegressionModel", "MiningModel",
+               "TreeModel")
+_PREDICATES = ("True", "False", "SimplePredicate", "SimpleSetPredicate",
+               "CompoundPredicate")
+
+
+def validate_structure(root: ET.Element) -> List[str]:
+    """PMML 4.2 structural conformance errors ([] = conformant)."""
+    errs: List[str] = []
+    root = _strip_ns(copy.deepcopy(root))
+    if root.tag != "PMML":
+        return [f"root element is {root.tag}, not PMML"]
+    if root.get("version") != "4.2":
+        errs.append(f"PMML version {root.get('version')!r}, expected 4.2")
+
+    kids = list(root)
+    if not kids or kids[0].tag != "Header":
+        errs.append("first PMML child must be Header")
+    dd = root.find("DataDictionary")
+    if dd is None:
+        return errs + ["DataDictionary missing"]
+    if kids[1].tag != "DataDictionary":
+        errs.append("DataDictionary must directly follow Header")
+    fields = {f.get("name") for f in dd.findall("DataField")}
+    n_decl = dd.get("numberOfFields")
+    if n_decl is not None and int(n_decl) != len(fields):
+        errs.append(f"DataDictionary numberOfFields={n_decl} but has "
+                    f"{len(fields)} DataField elements")
+
+    models = [e for e in root if e.tag in _MODEL_TAGS]
+    if not models:
+        errs.append("no model element (NeuralNetwork/RegressionModel/"
+                    "MiningModel/TreeModel)")
+    for m in models:
+        errs.extend(_validate_model(m, fields))
+    return errs
+
+
+def _validate_model(m: ET.Element, fields) -> List[str]:
+    errs: List[str] = []
+    kids = list(m)
+    if not kids or kids[0].tag != "MiningSchema":
+        errs.append(f"{m.tag}: first child must be MiningSchema")
+        return errs
+    for mf in kids[0].findall("MiningField"):
+        if mf.get("name") not in fields:
+            errs.append(f"{m.tag}: MiningField {mf.get('name')!r} not in "
+                        "DataDictionary")
+    # fields visible to the model = data fields + derived fields
+    visible = set(fields)
+    lt = m.find("LocalTransformations")
+    if lt is not None:
+        for df in lt.findall("DerivedField"):
+            for ref in df.iter("FieldRef"):
+                if ref.get("field") not in visible:
+                    errs.append(f"{m.tag}: DerivedField "
+                                f"{df.get('name')!r} references undefined "
+                                f"field {ref.get('field')!r}")
+            for nc in df.iter("NormContinuous"):
+                if nc.get("field") not in visible:
+                    errs.append(f"{m.tag}: NormContinuous field "
+                                f"{nc.get('field')!r} undefined")
+            visible.add(df.get("name"))
+
+    if m.tag == "NeuralNetwork":
+        errs.extend(_validate_nn(m, visible))
+    elif m.tag == "RegressionModel":
+        tables = m.findall("RegressionTable")
+        if not tables:
+            errs.append("RegressionModel: no RegressionTable")
+        for t in tables:
+            for np_ in t.findall("NumericPredictor"):
+                if np_.get("name") not in visible:
+                    errs.append(f"RegressionModel: NumericPredictor "
+                                f"{np_.get('name')!r} undefined")
+    elif m.tag == "MiningModel":
+        seg = m.find("Segmentation")
+        if seg is None:
+            errs.append("MiningModel: Segmentation missing")
+        else:
+            if seg.get("multipleModelMethod") not in (
+                    "sum", "average", "majorityVote", "weightedAverage",
+                    "max", "selectFirst", "modelChain"):
+                errs.append("MiningModel: bad multipleModelMethod "
+                            f"{seg.get('multipleModelMethod')!r}")
+            for s in seg.findall("Segment"):
+                kids = list(s)
+                if len(kids) < 2 or kids[0].tag not in _PREDICATES:
+                    errs.append(f"Segment {s.get('id')}: must be "
+                                "(predicate, model)")
+                    continue
+                if kids[1].tag == "TreeModel":
+                    errs.extend(_validate_tree(kids[1], visible,
+                                               s.get("id")))
+    elif m.tag == "TreeModel":
+        errs.extend(_validate_tree(m, visible, "-"))
+    return errs
+
+
+def _validate_nn(m: ET.Element, visible) -> List[str]:
+    errs: List[str] = []
+    order = [e.tag for e in m
+             if e.tag in ("NeuralInputs", "NeuralLayer", "NeuralOutputs")]
+    if not order or order[0] != "NeuralInputs" \
+            or order[-1] != "NeuralOutputs" \
+            or "NeuralLayer" not in order:
+        errs.append("NeuralNetwork: children must be NeuralInputs, "
+                    "NeuralLayer+, NeuralOutputs in order")
+        return errs
+    ids = set()
+    ni = m.find("NeuralInputs")
+    for e in ni.findall("NeuralInput"):
+        ids.add(e.get("id"))
+        fr = e.find("DerivedField/FieldRef")
+        if fr is None or fr.get("field") not in visible:
+            errs.append(f"NeuralInput {e.get('id')}: FieldRef must name a "
+                        "defined field")
+    n_decl = ni.get("numberOfInputs")
+    if n_decl is not None and int(n_decl) != len(ids):
+        errs.append(f"NeuralInputs numberOfInputs={n_decl} ≠ {len(ids)}")
+    for layer in m.findall("NeuralLayer"):
+        if layer.get("activationFunction") is None:
+            errs.append("NeuralLayer without activationFunction")
+        new_ids = set()
+        for neuron in layer.findall("Neuron"):
+            nid = neuron.get("id")
+            if nid in ids or nid in new_ids:
+                errs.append(f"duplicate Neuron id {nid}")
+            new_ids.add(nid)
+            for con in neuron.findall("Con"):
+                if con.get("from") not in ids:
+                    errs.append(f"Neuron {nid}: Con from "
+                                f"{con.get('from')!r} does not resolve to "
+                                "an earlier neuron/input")
+        n_decl = layer.get("numberOfNeurons")
+        if n_decl is not None and int(n_decl) != len(new_ids):
+            errs.append(f"NeuralLayer numberOfNeurons={n_decl} ≠ "
+                        f"{len(new_ids)}")
+        ids |= new_ids
+    for no in m.find("NeuralOutputs").findall("NeuralOutput"):
+        if no.get("outputNeuron") not in ids:
+            errs.append(f"NeuralOutput outputNeuron "
+                        f"{no.get('outputNeuron')!r} does not resolve")
+    return errs
+
+
+def _validate_tree(tm: ET.Element, visible, seg_id) -> List[str]:
+    errs: List[str] = []
+    root_node = tm.find("Node")
+    if root_node is None:
+        return [f"TreeModel (segment {seg_id}): no root Node"]
+
+    def walk(node):
+        kids = list(node)
+        if not kids or kids[0].tag not in _PREDICATES:
+            errs.append(f"TreeModel (segment {seg_id}) Node "
+                        f"{node.get('id')}: first child must be a "
+                        "predicate")
+            return
+        for p in kids[0].iter():
+            f = p.get("field")
+            if p.tag in ("SimplePredicate", "SimpleSetPredicate") and \
+                    f not in visible:
+                errs.append(f"TreeModel (segment {seg_id}): predicate "
+                            f"field {f!r} undefined")
+        children = [k for k in kids if k.tag == "Node"]
+        if not children and node.get("score") is None:
+            errs.append(f"TreeModel (segment {seg_id}) leaf Node "
+                        f"{node.get('id')}: missing score")
+        for ch in children:
+            walk(ch)
+
+    walk(root_node)
+    return errs
 
 
 # ---------------------------------------------------------------------------

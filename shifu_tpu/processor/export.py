@@ -80,6 +80,12 @@ def _export_pmml(ctx: ProcessorContext) -> str:
         kind, meta, params = load_model(p)
         root = pmml_mod.build_pmml(ctx.model_config, ctx.column_configs,
                                    kind, meta, params)
+        # structural conformance gate (jpmml-validation analog,
+        # PMMLTranslatorTest.java): never emit a nonconforming document
+        problems = pmml_mod.validate_structure(root)
+        if problems:
+            raise ValueError(f"PMML for {os.path.basename(p)} failed "
+                             f"conformance: " + "; ".join(problems))
         out = ctx.path_finder.pmml_path(i)
         ctx.path_finder.ensure(out)
         out_dir = os.path.dirname(out)
