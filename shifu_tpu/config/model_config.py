@@ -459,7 +459,7 @@ class ModelNormalizeConf:
 class ModelTrainConf:
     """`container/obj/ModelTrainConf.java:74-191`."""
     baggingNum: int = 1
-    baggingWithReplacement: bool = True
+    baggingWithReplacement: bool = False  # ModelTrainConf.java:80 default FALSE
     baggingSampleRate: float = 1.0
     validSetRate: float = 0.2
     numTrainEpochs: int = 100
@@ -491,7 +491,7 @@ class ModelTrainConf:
         d = d or {}
         o = cls(
             baggingNum=int(d.get("baggingNum", 1)),
-            baggingWithReplacement=bool(d.get("baggingWithReplacement", True)),
+            baggingWithReplacement=bool(d.get("baggingWithReplacement", False)),
             baggingSampleRate=float(d.get("baggingSampleRate", 1.0)),
             validSetRate=float(d.get("validSetRate", 0.2)),
             numTrainEpochs=int(d.get("numTrainEpochs", 100)),

@@ -159,6 +159,78 @@ def _level_histograms(binsT, node_of_row, grad, hess, level_offset,
                                    n_bins)
 
 
+def _forest_level_histograms(binsT, node_T, grad_T, hess_T, level_offset,
+                             n_level_nodes, n_bins, mesh=None):
+    """Per-level G/H histograms for T trees grown in LOCKSTEP.
+
+    binsT: (C, R) shared bin matrix; node_T/grad_T/hess_T: (T, R)
+    per-tree row state. Returns (T, n_level_nodes, C, n_bins) G and H.
+
+    Same explicit shard_map + psum structure as _level_histograms —
+    rows shard over 'data', each device builds local histograms for
+    ALL trees (vmap over the tree axis), one psum reduces. RF used to
+    rely on GSPMD partitioning a vmapped scatter here; that both risks
+    a silent all-gather of the row-sharded bins AND compiles
+    pathologically slowly (>9 min for a toy shape on the 8-device CPU
+    mesh), so the forest path now shares the GBT path's collective.
+    """
+    local = node_T - level_offset                       # (T, R)
+    valid = (local >= 0) & (local < n_level_nodes)
+    slot_T = jnp.where(valid, local, n_level_nodes)
+
+    def local_hists(b, s, g, h):
+        return jax.vmap(lambda s_, g_, h_: _local_level_histograms(
+            b, s_, g_, h_, n_level_nodes, n_bins))(s, g, h)
+
+    if mesh is not None and mesh.shape.get("data", 1) > 1:
+        from jax.sharding import PartitionSpec as P
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(None, "data"), P(None, "data"),
+                           P(None, "data"), P(None, "data")),
+                 out_specs=(P(), P()), check_vma=False)
+        def sharded(b, s, g, h):
+            gh_, hh_ = local_hists(b, s, g, h)
+            return (jax.lax.psum(gh_, "data"), jax.lax.psum(hh_, "data"))
+
+        return sharded(binsT, slot_T, grad_T, hess_T)
+
+    return local_hists(binsT, slot_T, grad_T, hess_T)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
+                 mesh=None):
+    """Grow T independent trees level-by-level in lockstep (the RF
+    analog of build_tree; one histogram collective per level covers
+    every tree). grad_T/hess_T: (T, R); feature_masks: (T, C).
+    Returns a stacked (T, n_nodes) tree pytree."""
+    c, r = binsT.shape
+    n_trees = grad_T.shape[0]
+    trees = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_trees,) + a.shape),
+        _empty_tree(cfg))
+    node_T = jnp.zeros((n_trees, r), jnp.int32)
+
+    for depth in range(cfg.max_depth):
+        g, h = _forest_level_histograms(binsT, node_T, grad_T, hess_T,
+                                        2 ** depth - 1, 2 ** depth,
+                                        cfg.n_bins, mesh=mesh)
+        trees = jax.vmap(
+            lambda t, gh, hh, fm: _apply_level(cfg, t, gh, hh, fm, depth)
+        )(trees, g, h, feature_masks)
+        node_T = jax.vmap(
+            lambda t, n: _route_level(cfg, t, binsT, n, depth)
+        )(trees, node_T)
+
+    g, h = _forest_level_histograms(binsT, node_T, grad_T, hess_T,
+                                    2 ** cfg.max_depth - 1,
+                                    2 ** cfg.max_depth, cfg.n_bins,
+                                    mesh=mesh)
+    return jax.vmap(lambda t, gh, hh: _final_leaves(cfg, t, gh, hh)
+                    )(trees, g, h)
+
+
 def _best_splits(gh, cfg: TreeConfig, feature_mask):
     """Pick the best (feature, bin, missing-direction) per node.
 
@@ -450,9 +522,12 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
 def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
              weights: np.ndarray, n_trees: int, subset_strategy: str,
              bagging_rate: float, seed: int):
-    """Random forest: all trees independent → ONE vmapped build with
-    per-tree Poisson instance weights (DTWorker Poisson sampling) and
-    Bernoulli feature-subset masks."""
+    """Random forest: all trees independent → ONE lockstep build
+    (build_forest) with per-tree Poisson instance weights (DTWorker
+    Poisson sampling) and Bernoulli feature-subset masks. The
+    histograms go through the same explicit shard_map + psum collective
+    as GBT — no GSPMD-partitioned scatter (silent-gather risk +
+    pathological compile time)."""
     from shifu_tpu.parallel import mesh as mesh_mod
     rng = np.random.default_rng(seed)
     r, c = bins.shape
@@ -464,24 +539,19 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     for t in range(n_trees):
         masks[t, rng.choice(c, size=k, replace=False)] = 1.0
 
-    # rows sharded over the data mesh (zero-weight padding is inert);
-    # trees vmapped — the scatter partitions under GSPMD here (shard_map
-    # under vmap is avoided), reducing with a cross-device sum
     mesh = mesh_mod.default_mesh()
+    hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
     jb = mesh_mod.shard_axis(
         mesh, np.ascontiguousarray(np.asarray(bins, np.int32).T), 1)
     jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
                                  np.asarray(weights, np.float32))
     d_inst_w = mesh_mod.shard_axis(mesh, inst_w, axis=1)
 
-    @partial(jax.jit, static_argnames=())
-    def one(iw, fm):
-        # leaf value = weighted mean label: grad = -y·w, hess = w
-        grad = -(jy * jw * iw)
-        hess = jw * iw
-        return build_tree(cfg, jb, grad, hess, fm)
-
-    stacked = jax.vmap(one)(d_inst_w, jnp.asarray(masks))
+    # leaf value = weighted mean label: grad = -y·w·iw, hess = w·iw
+    grad_T = -(jy * jw * d_inst_w)
+    hess_T = jw * d_inst_w
+    stacked = build_forest(cfg, jb, grad_T, hess_T, jnp.asarray(masks),
+                           mesh=hist_mesh)
     return jax.tree.map(np.asarray, stacked)
 
 

@@ -131,14 +131,17 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     # train the identical model (reference bagging jobs each sample
     # their own instances, TrainModelProcessor.runDistributedBagging)
     from shifu_tpu.train.trainer import bagging_weights
-    bag_w = bagging_weights(int(tr_mask.sum()), n_bags,
-                            mc.train.baggingSampleRate,
-                            mc.train.baggingWithReplacement, seed)
+    # single-bag runs train on the full data — only multi-bag runs
+    # resample per bag (mirrors _run_tree_streaming's n_bags==1 skip)
+    bag_w = None if n_bags == 1 else bagging_weights(
+        int(tr_mask.sum()), n_bags, mc.train.baggingSampleRate,
+        mc.train.baggingWithReplacement, seed)
     for bag in range(n_bags):
         if alg is Algorithm.GBT:
             init_trees = _continuous_trees(ctx, mc, bag)
+            w_tr = w[tr_mask] if bag_w is None else w[tr_mask] * bag_w[bag]
             trees, val_errs = gbdt.build_gbt(
-                cfg, bins[tr_mask], y[tr_mask], w[tr_mask] * bag_w[bag],
+                cfg, bins[tr_mask], y[tr_mask], w_tr,
                 n_trees, init_trees=init_trees,
                 val_data=(bins[val_mask], y[val_mask]) if val_mask.any() else None,
                 early_stop_window=int(mc.train.get_param(
@@ -172,7 +175,11 @@ class _BaggedWeights:
 
     def __init__(self, base, rate: float, with_replacement: bool, key: int):
         self._base, self._rate = base, rate
-        self._repl, self._key = with_replacement, key
+        # rate>=1 without replacement would make every bag identical —
+        # degrade to Poisson like trainer.bagging_weights (callers only
+        # construct this view for multi-bag runs)
+        self._repl = with_replacement or rate >= 1.0
+        self._key = key
 
     def __getitem__(self, sl):
         w = np.asarray(self._base[sl], np.float32)

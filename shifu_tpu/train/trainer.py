@@ -78,6 +78,13 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
     rng = np.random.default_rng(seed)
     if n_bags == 1 and sample_rate >= 1.0 and not with_replacement:
         return np.ones((1, n), np.float32)
+    if n_bags > 1 and sample_rate >= 1.0 and not with_replacement:
+        # "100% sample without replacement" per bag would give every
+        # bag the identical full dataset — N identical models at N×
+        # cost. Degrade to Poisson(rate) resampling, which is what the
+        # reference's per-bag worker actually does (AbstractNNWorker
+        # Poisson bagging runs regardless of the replacement flag).
+        with_replacement = True
     if with_replacement:
         w = rng.poisson(sample_rate, size=(n_bags, n)).astype(np.float32)
     else:
@@ -133,13 +140,20 @@ def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
                     grads_b = jax.tree.map(lambda g, m: g * m, grads_b,
                                            grad_mask)
                     upd, o2 = optimizer.update(grads_b, o, p)
-                    return (optax.apply_updates(p, upd), o2, k), loss_b
+                    return (optax.apply_updates(p, upd), o2, k), \
+                        (loss_b, jnp.sum(w_train[bi]))
 
                 key, pkey = jax.random.split(key)
                 perm = jax.random.permutation(pkey, n_batches)
-                (new_params, new_opt_state, key), losses = jax.lax.scan(
-                    batch_step, (params, opt_state, key), perm)
-                train_err = jnp.mean(losses)
+                (new_params, new_opt_state, key), (losses, wsums) = \
+                    jax.lax.scan(batch_step, (params, opt_state, key), perm)
+                # per-batch losses are already weight-normalized within
+                # the batch; weight by batch mass so the zero-weight
+                # padded tail (and weight-skewed batches) don't bias the
+                # epoch error feeding convergenceThreshold (the
+                # streaming trainer does the same per chunk)
+                train_err = jnp.sum(losses * wsums) / \
+                    jnp.maximum(jnp.sum(wsums), 1e-12)
             else:
                 train_err, grads = jax.value_and_grad(loss_fn)(
                     params, train_inputs, w_train, sub)
@@ -200,7 +214,7 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
                val_inputs, w_val, dropout_keys, grad_mask,
                checkpoint_dir: Optional[str] = None,
                checkpoint_interval: int = 0,
-               batch_rows: int = 0):
+               batch_rows: int = 0, perm_seed: int = 0):
     """Non-resumable façade over train_bags_carry, with optional
     checkpointing: when checkpoint_dir is set, training runs in
     `checkpoint_interval`-epoch chunks, saving the full carry after each
@@ -226,21 +240,29 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
         # break any on-disk row ordering (sorted/grouped data would
         # otherwise make every mini-batch class-homogeneous): rows are
         # permuted once here, and the in-graph scan additionally
-        # shuffles BATCH order every epoch
-        perm = np.random.default_rng(0xB47C4).permutation(n_rows)
-        train_inputs = tuple(np.asarray(t)[perm] for t in train_inputs)
-        w_train_bags = np.asarray(w_train_bags)[:, perm]
+        # shuffles BATCH order every epoch. The seed derives from the
+        # caller's train seed so bags/runs don't all share one order.
+        perm = np.random.default_rng(
+            np.uint64(0xB47C4) ^ np.uint64(perm_seed)).permutation(n_rows)
 
         def to_batches(a, axis_rows=0):
+            # permute + pad + reshape in ONE allocation (a permuted
+            # intermediate copy would double host RAM exactly when
+            # MiniBatchRows is in use for memory reasons)
             a = np.asarray(a)
-            pad = n_batches * batch_rows - a.shape[axis_rows]
-            if pad:
-                widths = [(0, 0)] * a.ndim
-                widths[axis_rows] = (0, pad)
-                a = np.pad(a, widths)  # zero weight ⇒ padding is inert
+            padded = a.shape[:axis_rows] + (n_batches * batch_rows,) \
+                + a.shape[axis_rows + 1:]
+            out = np.zeros(padded, a.dtype)  # zero weight ⇒ pad is inert
+            sel = [slice(None)] * a.ndim
+            sel[axis_rows] = slice(0, a.shape[axis_rows])
+            # mode='clip' (a no-op: perm is a permutation) lets take
+            # write straight into the out view — the default
+            # mode='raise' always buffers a full temporary copy
+            np.take(a, perm, axis=axis_rows, out=out[tuple(sel)],
+                    mode="clip")
             shape = (a.shape[:axis_rows] + (n_batches, batch_rows)
                      + a.shape[axis_rows + 1:])
-            return a.reshape(shape)
+            return out.reshape(shape)
 
         train_inputs = tuple(to_batches(t) for t in train_inputs)
         w_train_bags = to_batches(w_train_bags, axis_rows=1)
@@ -376,7 +398,7 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
         bag_keys[:-1], grad_mask,
         checkpoint_dir=checkpoint_dir,
         checkpoint_interval=checkpoint_interval,
-        batch_rows=batch_rows)
+        batch_rows=batch_rows, perm_seed=seed)
 
     params_per_bag = [
         jax.tree.map(lambda p, i=i: np.asarray(p[i]), best_params)

@@ -1,0 +1,74 @@
+"""Smoke-test the bench.py task functions at tiny shapes on CPU.
+
+Round-2 advisor finding: bench.task_hist silently drifted out of sync
+with _level_histograms' transposed (C, R) API and the orchestrator
+swallowed the shape error into diagnostics — the advertised evidence
+never got measured. These tests call the task functions directly (the
+same code the TPU bench runs, shapes patched down) so any API drift
+fails the suite loudly instead of failing silently at capture time.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _patch_small(monkeypatch):
+    monkeypatch.setattr(bench, "N_ROWS", 20_000)
+    monkeypatch.setattr(bench, "N_FEATURES", 16)
+    monkeypatch.setattr(bench, "HIDDEN", 16)
+    monkeypatch.setattr(bench, "BENCH_EPOCHS", 15)
+    monkeypatch.setattr(bench, "HIST_ROWS", 5_000)
+    monkeypatch.setattr(bench, "HIST_COLS", 8)
+    monkeypatch.setattr(bench, "HIST_BINS", 8)
+    monkeypatch.setattr(bench, "HIST_SLOTS", 8)
+    monkeypatch.setattr(bench, "HIST_REPS", 1)
+    monkeypatch.setattr(bench, "GBT_ROWS", 20_000)
+    monkeypatch.setattr(bench, "GBT_COLS", 8)
+    monkeypatch.setattr(bench, "GBT_TREES", 3)
+    monkeypatch.setattr(bench, "GBT_DEPTH", 3)
+
+
+def _last_json(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_task_nn(monkeypatch, capsys):
+    _patch_small(monkeypatch)
+    bench.task_nn()  # asserts AUC > 0.75 internally
+    rec = _last_json(capsys)
+    assert rec["row_epochs_per_sec"] > 0
+    assert rec["auc"] > 0.75
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas"])
+def test_task_hist(monkeypatch, capsys, mode):
+    _patch_small(monkeypatch)
+    monkeypatch.setenv("SHIFU_TPU_HIST", mode)
+    bench.task_hist(mode)
+    rec = _last_json(capsys)
+    assert rec["cells_per_sec"] > 0
+    assert rec["checksum"] > 0
+
+
+def test_hist_modes_agree(monkeypatch, capsys):
+    """XLA scatter and Pallas (interpret) kernels must produce the same
+    histogram — the checksum printed by each task is comparable."""
+    _patch_small(monkeypatch)
+    sums = {}
+    for mode in ("xla", "pallas"):
+        monkeypatch.setenv("SHIFU_TPU_HIST", mode)
+        bench.task_hist(mode)
+        sums[mode] = _last_json(capsys)["checksum"]
+    assert sums["xla"] == pytest.approx(sums["pallas"], rel=1e-5)
+
+
+def test_task_gbt(monkeypatch, capsys):
+    _patch_small(monkeypatch)
+    bench.task_gbt()
+    rec = _last_json(capsys)
+    assert rec["row_trees_per_sec"] > 0
+    assert rec["auc"] > 0.6

@@ -111,6 +111,77 @@ def test_gbdt_sharded_histogram_matches_single_device(rng):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_rf_sharded_matches_single_device(rng):
+    """build_rf over the 8-device mesh grows the SAME forest (splits,
+    leaves) as 1-device — VERDICT r2 #3: RF correctness under SPMD."""
+    from shifu_tpu.models import gbdt
+
+    r, c, b = 1000, 6, 16
+    bins = rng.integers(0, b - 1, (r, c)).astype(np.int32)
+    y = (rng.random(r) < 0.4).astype(np.float32)
+    w = np.ones(r, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=4, n_bins=b)
+
+    trees8 = gbdt.build_rf(cfg, bins, y, w, n_trees=4,
+                           subset_strategy="ALL", bagging_rate=1.0, seed=42)
+    try:
+        os.environ["SHIFU_TPU_MESH_DEVICES"] = "1"
+        trees1 = gbdt.build_rf(cfg, bins, y, w, n_trees=4,
+                               subset_strategy="ALL", bagging_rate=1.0,
+                               seed=42)
+    finally:
+        os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
+
+    np.testing.assert_array_equal(trees8["feature"], trees1["feature"])
+    np.testing.assert_array_equal(trees8["bin"], trees1["bin"])
+    np.testing.assert_allclose(trees8["leaf_value"], trees1["leaf_value"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_forest_histogram_reduction_is_psum_not_gather(rng):
+    """HLO check for the lockstep forest histogram: all-reduce (psum),
+    never an all-gather of the row-sharded bins — the RF analog of the
+    GBT assertion below."""
+    import jax
+    from shifu_tpu.models.gbdt import _forest_level_histograms
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.default_mesh()
+    assert mesh.shape["data"] == 8
+
+    r, c, b, s, t = 1024, 4, 8, 4, 3
+    binsT = mesh_mod.shard_axis(
+        mesh, np.ascontiguousarray(
+            rng.integers(0, b, (r, c)).astype(np.int32).T), 1)
+    node = mesh_mod.shard_axis(
+        mesh, rng.integers(0, s, (t, r)).astype(np.int32), 1)
+    grad = mesh_mod.shard_axis(
+        mesh, rng.normal(0, 1, (t, r)).astype(np.float32), 1)
+    hess = mesh_mod.shard_axis(mesh, np.ones((t, r), np.float32), 1)
+
+    def hist(binsT, node, grad, hess):
+        return _forest_level_histograms(binsT, node, grad, hess, 0, s, b,
+                                        mesh=mesh)
+
+    hlo = jax.jit(hist).lower(binsT, node, grad, hess).compile().as_text()
+    assert "all-reduce" in hlo, "forest histogram should reduce via psum"
+    assert "all-gather" not in hlo, \
+        "row-sharded operands must not be all-gathered"
+
+    # numerics: matches a per-tree host loop
+    g, _ = jax.jit(hist)(binsT, node, grad, hess)
+    bins_h = np.asarray(binsT).T
+    node_h = np.asarray(node)
+    grad_h = np.asarray(grad)
+    g_ref = np.zeros((t, s, c, b), np.float32)
+    for ti in range(t):
+        for i in range(r):
+            if node_h[ti, i] < s:
+                for j in range(c):
+                    g_ref[ti, node_h[ti, i], j, bins_h[i, j]] += grad_h[ti, i]
+    np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-5, atol=1e-4)
+
+
 def test_gbdt_histogram_reduction_is_psum_not_gather(rng):
     """HLO check: the sharded level-histogram reduces with all-reduce
     (psum) and never all-gathers the row-sharded (R, C) bin matrix —

@@ -126,8 +126,19 @@ def test_train_nn_window_early_stop_on_overfit():
         {**base, "earlyStoppingRounds": 5}), x, y, w, seed=4)
     free = train_nn(ModelTrainConf.from_dict(base), x, y, w, seed=4)
     v_stop, v_free = stop.val_errors[0], free.val_errors[0]
+
+    def first_const(v):
+        """First epoch from which the val error never changes again."""
+        i = len(v) - 1
+        while i > 0 and v[i - 1] == v[-1]:
+            i -= 1
+        return i
+
     assert np.all(v_stop[-50:] == v_stop[-1])     # frozen
-    assert not np.all(v_free[-50:] == v_free[-1])  # still training
+    # the window froze the stopped run far earlier than the free run's
+    # natural saturation (the free run may ALSO go exactly constant
+    # once tanh saturates — order, not non-constancy, is the signal)
+    assert first_const(v_stop) + 20 < first_const(v_free)
 
 
 def test_bagging_vmap_trains_distinct_models(rng):
