@@ -102,8 +102,6 @@ def cmd_varselect(args) -> int:
 
 def cmd_train(args) -> int:
     from shifu_tpu.processor import train as p
-    from shifu_tpu.parallel import dist
-    dist.initialize()
     return p.run(_ctx(args))
 
 
@@ -250,7 +248,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("export", help="export model/stats")
     p.add_argument("-t", "--type", default="columnstats",
                    choices=["columnstats", "correlation", "woemapping",
-                            "pmml", "tf"])
+                            "pmml", "tf", "bagging", "baggingpmml",
+                            "woe", "ume", "baggingume", "normume"])
     p.set_defaults(fn=cmd_export)
     p = sub.add_parser("test", help="dry-run filter expressions")
     p.add_argument("-n", type=int, default=100)
@@ -306,12 +305,26 @@ def _honor_jax_platforms() -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    # -D overrides → environment (ShifuCLI.cleanArgs:468-492)
+    # global-defaults tier first ($SHIFU_HOME/conf/shifuconfig chain,
+    # util/Environment.java:95-111) ...
+    from shifu_tpu.config.environment import load_shifuconfig
+    load_shifuconfig()
+    # ... then -D overrides → environment (ShifuCLI.cleanArgs:468-492)
     for kv in args.defines:
         if "=" in kv:
             k, v = kv.split("=", 1)
             os.environ[k.strip()] = v.strip()
     _honor_jax_platforms()
+    # multi-host runtime comes up for every DEVICE-USING command
+    # (stats/norm/eval shard over the same global mesh as train) — a
+    # no-op single-process. Pure file-ops commands (new/save/switch/
+    # show/convert/test/version) must not block on the coordinator
+    # barrier just to copy files.
+    if args.command in ("init", "stats", "norm", "normalize", "varsel",
+                        "varselect", "train", "posttrain", "eval",
+                        "export", "encode", "combo"):
+        from shifu_tpu.parallel import dist
+        dist.initialize()
     t0 = time.time()
     # every command emits one structured metrics record (and a
     # jax.profiler trace under --profile) — SURVEY §5's replacement for

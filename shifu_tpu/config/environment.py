@@ -1,0 +1,82 @@
+"""Global key-value config tier: `$SHIFU_HOME/conf/shifuconfig`.
+
+The reference loads a properties file chain into a process-global
+`Environment` at JVM start (`util/Environment.java:95-111`): in order
+`$SHIFU_HOME/conf/shifuconfig`, `$SHIFU_HOME/conf/shifu.config`,
+`$SHIFU_HOME/shifu.config`, `/etc/shifuconfig`, `~/.shifuconfig` —
+each later file overriding earlier ones — and CLI `-Dkey=value`
+overrides the lot (`ShifuCLI.cleanArgs:468-492`).
+
+Here the same tiers land in `os.environ`, which is what every knob in
+this codebase already reads. Layering, lowest to highest precedence:
+
+    shifuconfig file chain  <  pre-existing process environment  <  -D
+
+(The process environment outranks the files so that
+`SHIFU_TPU_HIST=xla shifu_tpu train ...` keeps working regardless of
+what a site-wide /etc/shifuconfig says; `-D` is applied by the CLI
+*after* this loader and clobbers unconditionally, matching the
+reference's override order.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+def _parse_properties(path: str) -> Dict[str, str]:
+    """Minimal java-properties reader: `k=v` / `k:v` lines, `#`/`!`
+    comments, blank lines skipped. No line continuations or unicode
+    escapes — shifuconfig files in the wild are plain `key=value`."""
+    out: Dict[str, str] = {}
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line[0] in "#!":
+                continue
+            # java.util.Properties: the FIRST '=' or ':' terminates the
+            # key (so 'opts: -Ddir=/tmp' keys on 'opts', not the '=')
+            cuts = [i for i in (line.find("="), line.find(":")) if i >= 0]
+            if cuts:
+                i = min(cuts)
+                out[line[:i].strip()] = line[i + 1:].strip()
+            else:
+                log.warning("shifuconfig %s: ignoring malformed line %r",
+                            path, line)
+    return out
+
+
+def config_file_chain(shifu_home: Optional[str] = None) -> List[str]:
+    """The reference's file precedence chain, earliest-loaded first
+    (later files override earlier ones, `Environment.loadShifuConfig`)."""
+    home = shifu_home if shifu_home is not None \
+        else os.environ.get("SHIFU_HOME", "")
+    chain = []
+    if home:
+        chain += [os.path.join(home, "conf", "shifuconfig"),
+                  os.path.join(home, "conf", "shifu.config"),
+                  os.path.join(home, "shifu.config")]
+    chain.append(os.path.join(os.sep, "etc", "shifuconfig"))
+    chain.append(os.path.join(os.path.expanduser("~"), ".shifuconfig"))
+    return chain
+
+
+def load_shifuconfig(shifu_home: Optional[str] = None) -> Dict[str, str]:
+    """Merge the shifuconfig tier into `os.environ` (without clobbering
+    keys the environment already defines) and return the merged
+    file-level key-values. Called once at CLI start, before `-D`
+    overrides are applied."""
+    merged: Dict[str, str] = {}
+    for path in config_file_chain(shifu_home):
+        try:
+            if os.path.isfile(path):
+                merged.update(_parse_properties(path))
+        except OSError as e:
+            log.warning("could not read shifuconfig %s: %s", path, e)
+    for k, v in merged.items():
+        os.environ.setdefault(k, v)
+    return merged

@@ -182,11 +182,16 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
         # MTL consumes the dense block and takes any normType.
         r.fail(f"{alg.value} requires an *_INDEX normType for embeddings, "
                f"got {norm.value}")
-    if alg is Algorithm.NN:
+    if alg in (Algorithm.NN, Algorithm.WDL, Algorithm.MTL):
+        # arch lists feed MLPSpec for all three families
+        # (nn.parse_arch_params; WDL/MTL reuse it with
+        # honor_num_layers=False, so the count-vs-NumHiddenLayers
+        # check is NN-only)
         nh = t.get_param("NumHiddenLayers")
         nodes = t.get_param("NumHiddenNodes")
         acts = t.get_param("ActivationFunc")
-        if nh is not None and nodes is not None and not isinstance(nodes, dict):
+        if alg is Algorithm.NN and nh is not None and nodes is not None \
+                and not isinstance(nodes, dict):
             n_layers = int(nh)
             if isinstance(nodes, list) and not _grid_list(nodes) and \
                     len(nodes) != n_layers:
@@ -212,6 +217,13 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
             if not isinstance(n, (int, float)) or int(n) <= 0:
                 r.fail(f"NumHiddenNodes entries must be positive ints, "
                        f"got {n!r}")
+    if alg is Algorithm.WDL:
+        wide = t.get_param("WideEnable")
+        deep = t.get_param("DeepEnable")
+        if wide is not None and deep is not None \
+                and not bool(wide) and not bool(deep):
+            r.fail("WDL with WideEnable=false and DeepEnable=false has "
+                   "no model branches (WideAndDeep.java:78-249)")
     prop = t.get_param("Propagation")
     if prop is not None:
         props = prop if isinstance(prop, list) else [prop]
@@ -228,14 +240,17 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
                     r.fail(f"Loss {lo!r} unknown for trees; supported: "
                            f"{_LOSSES}")
         fss = t.get_param("FeatureSubsetStrategy")
-        if fss is not None and not isinstance(fss, list):
-            s = str(fss).upper()
-            if s not in _SUBSET_STRATEGIES:
-                try:
-                    int(s)
-                except ValueError:
-                    r.fail(f"FeatureSubsetStrategy {fss!r} unknown; "
-                           f"supported: {_SUBSET_STRATEGIES} or an int")
+        if fss is not None:
+            # grid-search lists check element-wise (the round-2 gap:
+            # a list-valued FSS skipped validation entirely)
+            for s0 in (fss if isinstance(fss, list) else [fss]):
+                s = str(s0).upper()
+                if s not in _SUBSET_STRATEGIES:
+                    try:
+                        int(s)
+                    except ValueError:
+                        r.fail(f"FeatureSubsetStrategy {s0!r} unknown; "
+                               f"supported: {_SUBSET_STRATEGIES} or an int")
     fixed = t.get_param("FixedLayers")
     if fixed is not None:
         if not isinstance(fixed, list) or \
@@ -289,6 +304,12 @@ def _check_evals(mc: ModelConfig, r: ValidateResult) -> None:
             r.fail(f"eval {e.name}: gbtScoreConvertStrategy "
                    f"{e.gbtScoreConvertStrategy!r} unknown; supported: "
                    f"{_GBT_CONVERT}")
+        _file_should_exist(mc, e.scoreMetaColumnNameFile,
+                           f"eval {e.name}: scoreMetaColumnNameFile", r)
+        overlap = set(e.dataSet.posTags) & set(e.dataSet.negTags)
+        if overlap:
+            r.fail(f"eval {e.name}: posTags and negTags overlap: "
+                   f"{sorted(overlap)}")
 
 
 def _grid_list(v) -> bool:

@@ -65,6 +65,8 @@ FIELD_METAS: List[FieldMeta] = [
     FieldMeta("train.workerThreadCount", "int", lo=1),
     FieldMeta("train.upSampleWeight", "float", lo=1),
     FieldMeta("train.convergenceThreshold", "float", lo=0),
+    # k-fold: -1 = disabled (reference default); DTrain caps folds at 20
+    FieldMeta("train.numKFold", "int", lo=-1, hi=20),
 ]
 
 # train#params entries: (name, kind, lo, hi, lo_open); values may also
@@ -81,6 +83,10 @@ PARAM_METAS = {
     "ChunkRows": ("int", 1, None, False),
     "CheckpointInterval": ("int", 0, None, False),
     "DropoutRate": ("float", 0, 0.999999, False),
+    # WDL/MTL architecture params (wdl.WDLSpec.from_train_params /
+    # mtl.MTLSpec.from_train_params; reference WideAndDeep.java:78-249)
+    "EmbedSize": ("int", 1, 4096, False),
+    "RegularizedConstant": ("float", 0, None, False),
 }
 
 

@@ -106,22 +106,14 @@ def read_raw_table(mc: ModelConfig,
     at index — the multi-host ingestion split (each JAX process reads a
     disjoint file subset; replaces per-worker HDFS splits).
     """
-    ds = ds or mc.dataSet
-    header = read_header(ds, mc.resolve_path)
-    files = expand_data_files(mc.resolve_path(ds.dataPath))
-    first_file = files[0]  # the one holding the in-file header line, if any
-    if file_shard is not None:
-        idx, count = file_shard
-        files = files[idx::count] or files[idx % len(files):][:1]
-
-    has_header_line = not ds.headerPath  # header came from data file itself
+    ds, header, files, first_file, has_header_line, simple = \
+        _table_layout(mc, ds, file_shard)
 
     if numeric_columns and max_rows is None and \
             not any(fs_mod.has_scheme(p) for p in files) and \
             os.environ.get("SHIFU_TPU_NATIVE_READER", "1") != "0":
         from shifu_tpu.data.native_reader import read_files_native
-        simple = [simple_column_name(c) for c in header]
-        names = simple if len(set(simple)) == len(simple) else list(header)
+        names = simple if simple is not None else list(header)
         df = read_files_native(
             files, names, ds.dataDelimiter or "|",
             [c for c in numeric_columns if c in names],
@@ -146,10 +138,51 @@ def read_raw_table(mc: ModelConfig,
     # NSColumn semantics: downstream matching is by simple name
     # ('namespace::col' → 'col'), so expose simple names as the frame's
     # columns (only when unambiguous).
-    simple = [simple_column_name(c) for c in header]
-    if len(set(simple)) == len(simple):
+    if simple is not None:
         out.columns = simple
     return out
+
+
+def _table_layout(mc: ModelConfig, ds: Optional[ModelSourceDataConf],
+                  file_shard: Optional[tuple]):
+    """Shared read prologue for read_raw_table / iter_raw_table:
+    (ds, header, files, first_file, has_header_line, simple_names)
+    where simple_names is None when NSColumn simple names collide."""
+    ds = ds or mc.dataSet
+    header = read_header(ds, mc.resolve_path)
+    files = expand_data_files(mc.resolve_path(ds.dataPath))
+    first_file = files[0]  # the one holding the in-file header line, if any
+    if file_shard is not None:
+        idx, count = file_shard
+        files = files[idx::count] or files[idx % len(files):][:1]
+    has_header_line = not ds.headerPath  # header came from data file itself
+    simple = [simple_column_name(c) for c in header]
+    if len(set(simple)) != len(simple):
+        simple = None
+    return ds, header, files, first_file, has_header_line, simple
+
+
+def iter_raw_table(mc: ModelConfig,
+                   ds: Optional[ModelSourceDataConf] = None,
+                   chunk_rows: int = 2_000_000,
+                   file_shard: Optional[tuple] = None):
+    """Yield DataFrames of ≤ chunk_rows rows spanning all part files —
+    the bounded-memory reader behind streaming eval (and any consumer
+    that must not materialize the table). Column naming matches
+    read_raw_table (simple NSColumn names when unambiguous)."""
+    ds, header, files, first_file, has_header_line, simple = \
+        _table_layout(mc, ds, file_shard)
+    for path in files:
+        skip = 1 if (has_header_line and path == first_file) else 0
+        reader = pd.read_csv(
+            path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
+            names=header, skiprows=skip, na_filter=False,
+            engine="c", compression="infer", quoting=3,
+            chunksize=chunk_rows)
+        for df in reader:
+            if simple is not None:
+                df.columns = simple
+            yield df.reset_index(drop=True)
 
 
 def missing_mask(values: np.ndarray, missing_values: Sequence[str]) -> np.ndarray:

@@ -655,7 +655,8 @@ def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
     build_gbt path covers data that fits HBM; this is the TPU answer
     to the reference's disk-spill dataset feeding DTWorker
     (MemoryDiskFloatMLDataSet + dt/DTWorker.java:578). Validation is
-    the trailing valid_rate fraction (sequential-read split, like
+    the trailing valid_rate fraction — ≈ random because `norm` writes
+    the streaming layout in seeded-shuffled row order (like
     train/streaming.py)."""
     from shifu_tpu.parallel import mesh as mesh_mod
     r, c = bins_mm.shape

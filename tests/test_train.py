@@ -372,3 +372,48 @@ def test_minibatch_mode(tmp_path, rng):
     with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
         perf = json.load(f)
     assert perf["areaUnderRoc"] > 0.85
+
+
+def test_streaming_split_unbiased_on_label_sorted_input(tmp_path, rng):
+    """Label-sorted input must not yield a single-class trailing
+    validation split: `norm` writes the streaming layout in
+    seeded-shuffled row order, so the trailing validSetRate block is
+    ≈ a random split (the streaming analog of AbstractNNWorker.init:387
+    random train/val assignment). VERDICT r2 Weak #4 / Next #6."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=3000,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM",
+                                        "ChunkRows": 512})
+    # adversarial row order: sort the raw data file by label so the
+    # trailing fraction of the FILE is single-class
+    data_file = os.path.join(root, "data", "part-00000")
+    with open(data_file) as f:
+        lines = f.readlines()
+    lines.sort(key=lambda ln: ln.rsplit("|", 1)[-1])
+    with open(data_file, "w") as f:
+        f.writelines(lines)
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    mc["train"]["trainOnDisk"] = True
+    mc["train"]["validSetRate"] = 0.2
+    mc["train"]["numTrainEpochs"] = 30
+    mc["train"]["earlyStoppingRounds"] = 5
+    json.dump(mc, open(os.path.join(root, "ModelConfig.json"), "w"))
+
+    ctx = run_pipeline(root)
+    # the streaming layout's trailing 20% holds BOTH classes at ≈ the
+    # population rate (label-sorted writes would make it single-class)
+    tags = np.load(os.path.join(ctx.path_finder.normalized_data_path(),
+                                "tags.npy"))
+    n_val = int(len(tags) * 0.2)
+    val_pos_rate = float(tags[-n_val:].mean())
+    pop_pos_rate = float(tags.mean())
+    assert 0.5 * pop_pos_rate < val_pos_rate < 1.5 * pop_pos_rate, \
+        f"validation split is biased: {val_pos_rate} vs {pop_pos_rate}"
+    # and early-stop against that split still produces a real model
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    assert perf["areaUnderRoc"] > 0.85
