@@ -56,6 +56,16 @@ def exists(path: str) -> bool:
     return fs.exists(p)
 
 
+def size(path: str) -> int:
+    """On-storage byte size of one (possibly remote) file; 0 when the
+    backend cannot stat it."""
+    fs, p = _fs_and_path(path)
+    try:
+        return int(fs.size(p) or 0)
+    except (OSError, FileNotFoundError):
+        return 0
+
+
 def list_data_files(path: str, skip_basenames, strip_url=False) -> List[str]:
     """File / directory-of-part-files / glob expansion for a remote
     path — the scheme-side twin of reader.expand_data_files. Returns

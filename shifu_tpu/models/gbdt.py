@@ -198,9 +198,9 @@ def _forest_level_histograms(binsT, node_T, grad_T, hess_T, level_offset,
     return local_hists(binsT, slot_T, grad_T, hess_T)
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract"))
 def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
-                 mesh=None):
+                 mesh=None, subtract=None):
     """Grow T independent trees level-by-level in lockstep (the RF
     analog of build_tree; one histogram collective per level covers
     every tree). grad_T/hess_T: (T, R); feature_masks: (T, C).
@@ -212,23 +212,57 @@ def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
         _empty_tree(cfg))
     node_T = jnp.zeros((n_trees, r), jnp.int32)
 
+    prev_g = prev_h = None
     for depth in range(cfg.max_depth):
-        g, h = _forest_level_histograms(binsT, node_T, grad_T, hess_T,
-                                        2 ** depth - 1, 2 ** depth,
-                                        cfg.n_bins, mesh=mesh)
+        g, h = _forest_child_histograms(cfg, binsT, node_T, grad_T,
+                                        hess_T, depth, prev_g, prev_h,
+                                        trees, mesh, subtract)
         trees = jax.vmap(
             lambda t, gh, hh, fm: _apply_level(cfg, t, gh, hh, fm, depth)
         )(trees, g, h, feature_masks)
         node_T = jax.vmap(
             lambda t, n: _route_level(cfg, t, binsT, n, depth)
         )(trees, node_T)
+        prev_g, prev_h = g, h
 
-    g, h = _forest_level_histograms(binsT, node_T, grad_T, hess_T,
-                                    2 ** cfg.max_depth - 1,
-                                    2 ** cfg.max_depth, cfg.n_bins,
-                                    mesh=mesh)
+    g, h = _forest_child_histograms(cfg, binsT, node_T, grad_T, hess_T,
+                                    cfg.max_depth, prev_g, prev_h,
+                                    trees, mesh, subtract)
     return jax.vmap(lambda t, gh, hh: _final_leaves(cfg, t, gh, hh)
                     )(trees, g, h)
+
+
+def _forest_child_histograms(cfg: TreeConfig, binsT, node_T, grad_T,
+                             hess_T, depth: int, prev_g, prev_h, trees,
+                             mesh, subtract=None):
+    """Sibling-subtraction for the lockstep forest build (see
+    _child_level_histograms): left children through the kernel, right
+    children by parent − left, per tree."""
+    level_offset = 2 ** depth - 1
+    n_level = 2 ** depth
+    use = _use_hist_subtract() if subtract is None else subtract
+    if depth == 0 or prev_g is None or not use:
+        return _forest_level_histograms(binsT, node_T, grad_T, hess_T,
+                                        level_offset, n_level,
+                                        cfg.n_bins, mesh=mesh)
+    local = node_T - level_offset                        # (T, R)
+    left = (local >= 0) & (local < n_level) & (local % 2 == 0)
+    half_node = jnp.where(left, level_offset + local // 2, -1)
+    gl, hl = _forest_level_histograms(binsT, half_node, grad_T, hess_T,
+                                      level_offset, n_level // 2,
+                                      cfg.n_bins, mesh=mesh)
+    parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(n_level // 2)
+    split = (~trees["is_leaf"][:, parent_ids]) & \
+        (trees["feature"][:, parent_ids] >= 0)           # (T, P)
+    m = split[:, :, None, None]
+    gl = jnp.where(m, gl, 0.0)
+    hl = jnp.where(m, hl, 0.0)
+    gr = jnp.where(m, prev_g - gl, 0.0)
+    hr = jnp.where(m, prev_h - hl, 0.0)
+    t, p, c, b = gl.shape
+    g = jnp.stack([gl, gr], axis=2).reshape(t, n_level, c, b)
+    h = jnp.stack([hl, hr], axis=2).reshape(t, n_level, c, b)
+    return g, h
 
 
 def _best_splits(gh, cfg: TreeConfig, feature_mask):
@@ -347,8 +381,9 @@ def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
         active, 2 * node_of_row + jnp.where(go_left, 1, 2), node_of_row)
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"))
-def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None):
+@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract"))
+def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None,
+               subtract=None):
     """Grow one tree level-by-level (all nodes of a level at once —
     DTMaster's todoNodes batch IS the level here).
 
@@ -365,20 +400,64 @@ def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None):
     tree = _empty_tree(cfg)
     node_of_row = jnp.zeros(r, jnp.int32)  # all rows at root
 
+    prev_g = prev_h = None
     for depth in range(cfg.max_depth):
-        level_offset = 2 ** depth - 1
-        n_level = 2 ** depth
-        g_hist, h_hist = _level_histograms(binsT, node_of_row, grad, hess,
-                                           level_offset, n_level, cfg.n_bins,
-                                           mesh=mesh)
+        g_hist, h_hist = _child_level_histograms(
+            cfg, binsT, node_of_row, grad, hess, depth, prev_g, prev_h,
+            tree["is_leaf"], tree["feature"], mesh, subtract)
         tree = _apply_level(cfg, tree, g_hist, h_hist, feature_mask, depth)
         node_of_row = _route_level(cfg, tree, binsT, node_of_row, depth)
+        prev_g, prev_h = g_hist, h_hist
 
-    g_hist, h_hist = _level_histograms(binsT, node_of_row, grad, hess,
-                                       2 ** cfg.max_depth - 1,
-                                       2 ** cfg.max_depth, cfg.n_bins,
-                                       mesh=mesh)
+    g_hist, h_hist = _child_level_histograms(
+        cfg, binsT, node_of_row, grad, hess, cfg.max_depth, prev_g,
+        prev_h, tree["is_leaf"], tree["feature"], mesh, subtract)
     return _final_leaves(cfg, tree, g_hist, h_hist)
+
+
+def _use_hist_subtract() -> bool:
+    import os
+    return os.environ.get("SHIFU_TPU_HIST_SUBTRACT", "1") != "0"
+
+
+def _child_level_histograms(cfg: TreeConfig, binsT, node_of_row, grad,
+                            hess, depth: int, prev_g, prev_h,
+                            is_leaf, feature, mesh, subtract=None):
+    """Level histograms with the sibling-subtraction trick: at depth
+    d ≥ 1 only LEFT children (even level-local slots — children of
+    parent k land at local 2k/2k+1) go through the histogram kernel,
+    and right = parent − left from the previous level's histograms.
+    Kernel work per level halves (Σ 2^d slot-levels → Σ 2^(d-1)), the
+    standard GBDT histogram-subtraction optimization; children of
+    leaf parents are masked to zero (the subtraction would otherwise
+    resurrect the parent's rows as a phantom right child).
+    Disable with SHIFU_TPU_HIST_SUBTRACT=0."""
+    level_offset = 2 ** depth - 1
+    n_level = 2 ** depth
+    use = _use_hist_subtract() if subtract is None else subtract
+    if depth == 0 or prev_g is None or not use:
+        return _level_histograms(binsT, node_of_row, grad, hess,
+                                 level_offset, n_level, cfg.n_bins,
+                                 mesh=mesh)
+    local = node_of_row - level_offset
+    in_level = (local >= 0) & (local < n_level)
+    left = in_level & (local % 2 == 0)
+    half_node = jnp.where(left, level_offset + local // 2, -1)
+    gl, hl = _level_histograms(binsT, half_node, grad, hess,
+                               level_offset, n_level // 2, cfg.n_bins,
+                               mesh=mesh)
+    parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(n_level // 2)
+    split = (~is_leaf[parent_ids]) & (feature[parent_ids] >= 0)
+    m = split[:, None, None]
+    gl = jnp.where(m, gl, 0.0)
+    hl = jnp.where(m, hl, 0.0)
+    gr = jnp.where(m, prev_g - gl, 0.0)
+    hr = jnp.where(m, prev_h - hl, 0.0)
+    g = jnp.stack([gl, gr], axis=1).reshape(n_level, gl.shape[1],
+                                            cfg.n_bins)
+    h = jnp.stack([hl, hr], axis=1).reshape(n_level, hl.shape[1],
+                                            cfg.n_bins)
+    return g, h
 
 
 def _walk_trees(trees, binsT, max_depth: int, n_bins: int):
@@ -432,11 +511,12 @@ def gbt_gradients(y, pred_raw, weights, loss: str):
     return (pred_raw - y) * weights, jnp.ones_like(y) * weights
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract"))
 def _gbt_round(cfg: TreeConfig, binsT, y, weights, pred_raw, feature_mask,
-               mesh=None):
+               mesh=None, subtract=None):
     grad, hess = gbt_gradients(y, pred_raw, weights, cfg.loss)
-    tree = build_tree(cfg, binsT, grad, hess, feature_mask, mesh=mesh)
+    tree = build_tree(cfg, binsT, grad, hess, feature_mask, mesh=mesh,
+                      subtract=subtract)
     contrib = predict_trees(
         jax.tree.map(lambda a: a[None], tree), binsT,
         cfg.max_depth, cfg.n_bins)[0]

@@ -151,15 +151,24 @@ def eval_chunk_rows(ctx: ProcessorContext, ec: EvalConfig) -> int:
                 f"eval {ec.name}: chunkRows must be an integer, "
                 f"got {v!r}")
     try:
+        from shifu_tpu.data import fs as fs_mod
         from shifu_tpu.data.reader import expand_data_files
         ds = effective_dataset_conf(ctx.model_config, ec)
         files = expand_data_files(ctx.model_config.resolve_path(ds.dataPath))
+
+        def _size(p: str) -> int:
+            # remote (hdfs/s3/gs) parts size via fsspec — os.path would
+            # silently report 0 and default huge remote sets to the
+            # resident path
+            if fs_mod.has_scheme(p):
+                return int(fs_mod.size(p))
+            return os.path.getsize(p) if os.path.exists(p) else 0
+
         # the limit guards decompressed (RAM) size: count compressed
         # parts at a conservative ~6× text expansion ratio
-        total = sum(os.path.getsize(p) * (6 if p.endswith((".gz", ".bz2"))
-                                          else 1)
-                    for p in files if os.path.exists(p))
-    except (OSError, FileNotFoundError, ValueError):
+        total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
+                    for p in files)
+    except (OSError, FileNotFoundError, ValueError, RuntimeError):
         return 0
     limit = int(os.environ.get("SHIFU_TPU_EVAL_STREAM_BYTES",
                                2 * 1024 ** 3))
@@ -459,13 +468,21 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
     def _hist_from_dump(path: str):
         """ScoreHistogram over a (score, tag, w) f32 dump, or None when
         the dump holds no finite scores (champion column that never
-        parsed — the resident path warns and skips it too)."""
+        parsed — the resident path warns and skips it too). Both the
+        min/max scan and the accumulation run chunked so the path's
+        memory stays bounded at the billion-row scale it exists for."""
         mm = np.memmap(path, np.float32).reshape(-1, 3)
-        ok = np.isfinite(mm[:, 0])
-        if not ok.any():
-            return None
-        h = ScoreHistogram(float(mm[ok, 0].min()), float(mm[ok, 0].max()))
         step = 16_000_000
+        lo, hi = np.inf, -np.inf
+        for a in range(0, len(mm), step):
+            s = mm[a:a + step, 0]
+            s = s[np.isfinite(s)]
+            if s.size:
+                lo = min(lo, float(s.min()))
+                hi = max(hi, float(s.max()))
+        if not np.isfinite(lo):
+            return None
+        h = ScoreHistogram(lo, hi)
         for a in range(0, len(mm), step):
             blk = mm[a:a + step]
             m = np.isfinite(blk[:, 0])

@@ -434,3 +434,53 @@ def test_streaming_bins_cache_reused(tmp_path, rng):
     assert os.stat(bins_path).st_mtime_ns != mtime1
     key2 = json.load(open(meta_path))["key"]
     assert key2
+
+
+def test_hist_subtraction_matches_direct(rng, monkeypatch):
+    """Sibling-subtraction histograms (left via kernel, right =
+    parent − left) grow the same trees as direct per-level histograms
+    — the 2× histogram-work GBDT optimization must not change
+    results."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import gbdt
+
+    R, C, B = 3000, 6, 16
+    bins = rng.integers(0, B - 1, (R, C)).astype(np.int32)
+    binsT = jnp.asarray(bins.T)
+    beta = rng.normal(0, 1, C)
+    y = ((bins @ beta) / np.sqrt(C) + rng.normal(0, 2, R) >
+         np.median(bins @ beta) / np.sqrt(C)).astype(np.float32)
+    w = np.ones(R, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=4, n_bins=B, learning_rate=0.3,
+                          loss="log")
+
+    # subtract is a STATIC jit arg on the tree builders (an env flip
+    # after first compile would silently hit the cached trace)
+    fm = jnp.ones(C, jnp.float32)
+    t_direct = gbdt.build_tree(cfg, binsT, jnp.asarray(y * w),
+                               jnp.asarray(w), fm, subtract=False)
+    t_sub = gbdt.build_tree(cfg, binsT, jnp.asarray(y * w),
+                            jnp.asarray(w), fm, subtract=True)
+    t_direct = {k: np.asarray(v) for k, v in t_direct.items()}
+    t_sub = {k: np.asarray(v) for k, v in t_sub.items()}
+
+    np.testing.assert_array_equal(t_direct["feature"], t_sub["feature"])
+    np.testing.assert_array_equal(t_direct["bin"], t_sub["bin"])
+    np.testing.assert_array_equal(t_direct["is_leaf"], t_sub["is_leaf"])
+    np.testing.assert_allclose(t_direct["leaf_value"],
+                               t_sub["leaf_value"], rtol=1e-4, atol=1e-5)
+
+    # RF lockstep build too
+    gT = jnp.asarray(np.stack([y * w, y * w * 0.5]))
+    hT = jnp.asarray(np.stack([w, w * 0.5]))
+    fm2 = jnp.ones((2, C), jnp.float32)
+    f_direct = gbdt.build_forest(gbdt.TreeConfig(max_depth=3, n_bins=B),
+                                 binsT, gT, hT, fm2, subtract=False)
+    f_sub = gbdt.build_forest(gbdt.TreeConfig(max_depth=3, n_bins=B),
+                              binsT, gT, hT, fm2, subtract=True)
+    np.testing.assert_array_equal(np.asarray(f_direct["feature"]),
+                                  np.asarray(f_sub["feature"]))
+    np.testing.assert_allclose(np.asarray(f_direct["leaf_value"]),
+                               np.asarray(f_sub["leaf_value"]),
+                               rtol=1e-4, atol=1e-5)
