@@ -31,11 +31,16 @@ import time
 
 REFERENCE_WORKER_ROW_EPOCHS_PER_SEC = 2.0e6  # see module docstring
 
-# flagship NN shape (BASELINE.md ladder step 1 scaled up to chip size)
+# flagship NN shape (BASELINE.md ladder step 1 scaled up to chip size).
+# Two epoch lengths: throughput comes from wall(long) − wall(short) so
+# the one-time 256 MB host→device transfer (seconds of tunnel time that
+# round 2 baked into the headline) cancels out of the number.
 N_ROWS = 2_000_000
 N_FEATURES = 32
 HIDDEN = 64
-BENCH_EPOCHS = 30
+BENCH_EPOCHS_SHORT = 2
+BENCH_EPOCHS = 32
+VALID_RATE = 0.05
 
 # wide NN: reference-realistic fraud-model width (600 candidate
 # features, two hidden layers). The narrow flagship measures HBM/
@@ -146,27 +151,45 @@ def task_nn():
     y = (logits > 0).astype(np.float32)
     w = np.ones(N_ROWS, np.float32)
 
-    conf = ModelTrainConf()
-    conf.params = {"NumHiddenLayers": 1, "NumHiddenNodes": [HIDDEN],
-                   "ActivationFunc": ["tanh"], "Propagation": "ADAM",
-                   "LearningRate": 0.05}
-    conf.numTrainEpochs = BENCH_EPOCHS
-    conf.baggingNum = 1
-    conf.validSetRate = 0.05
-    conf.earlyStoppingRounds = 0     # fixed-length scan for clean timing
-    conf.convergenceThreshold = 0.0
+    def conf_for(epochs):
+        conf = ModelTrainConf()
+        conf.params = {"NumHiddenLayers": 1, "NumHiddenNodes": [HIDDEN],
+                       "ActivationFunc": ["tanh"], "Propagation": "ADAM",
+                       "LearningRate": 0.05}
+        conf.numTrainEpochs = epochs
+        conf.baggingNum = 1
+        conf.validSetRate = VALID_RATE
+        conf.earlyStoppingRounds = 0  # fixed-length scan for clean timing
+        conf.convergenceThreshold = 0.0
+        return conf
 
-    # first call compiles (same shapes — a smaller warmup would
-    # recompile); second call measures the steady path. train_nn's
-    # np.asarray on results is a real device sync (NB block_until_ready
-    # is NOT reliable under the axon TPU tunnel — returns early).
-    trainer.train_nn(conf, x, y, w, seed=1)
-    t0 = time.time()
-    res = trainer.train_nn(conf, x, y, w, seed=1)
-    wall = time.time() - t0
+    # per length: first call compiles (scan length is part of the
+    # shape), second measures. train_nn's np.asarray on results is a
+    # real device sync (NB block_until_ready is NOT reliable under the
+    # axon TPU tunnel — returns early). Throughput = the delta between
+    # the two measured walls, so per-call transfer cost cancels.
+    walls = {}
+    res = None
+    for attempt in range(2):
+        for epochs in (BENCH_EPOCHS_SHORT, BENCH_EPOCHS):
+            conf = conf_for(epochs)
+            trainer.train_nn(conf, x, y, w, seed=1)
+            t0 = time.time()
+            res = trainer.train_nn(conf, x, y, w, seed=1)
+            walls[epochs] = time.time() - t0
+        if walls[BENCH_EPOCHS] > walls[BENCH_EPOCHS_SHORT]:
+            break   # sane sample; else re-measure once (tunnel jitter)
 
-    n_train = int(N_ROWS * (1 - conf.validSetRate))
-    row_epochs_per_sec = n_train * BENCH_EPOCHS / wall
+    d_epochs = BENCH_EPOCHS - BENCH_EPOCHS_SHORT
+    wall = walls[BENCH_EPOCHS] - walls[BENCH_EPOCHS_SHORT]
+    # a timing inversion surviving the retry must fail the sample
+    # loudly, not clamp into an absurd headline in BENCH_LOCAL.jsonl
+    assert wall > 0, (f"timing inversion: {BENCH_EPOCHS} epochs took "
+                      f"{walls[BENCH_EPOCHS]:.2f}s vs "
+                      f"{walls[BENCH_EPOCHS_SHORT]:.2f}s for "
+                      f"{BENCH_EPOCHS_SHORT}")
+    n_train = int(N_ROWS * (1 - VALID_RATE))
+    row_epochs_per_sec = n_train * d_epochs / wall
 
     scores = nn_mod.forward(res.spec, res.params_per_bag[0],
                             jax.numpy.asarray(x[:200_000]))
@@ -174,10 +197,11 @@ def task_nn():
     assert a > 0.75, f"model failed to learn (AUC {a})"
 
     # fwd ≈ 2·N·(F·H + H) FLOPs; training ≈ 3× fwd (bwd 2×)
-    flops = 3 * 2 * n_train * (N_FEATURES * HIDDEN + HIDDEN) * BENCH_EPOCHS
+    flops = 3 * 2 * n_train * (N_FEATURES * HIDDEN + HIDDEN) * d_epochs
     print(json.dumps({
         "row_epochs_per_sec": row_epochs_per_sec,
-        "wall_s": wall, "auc": a,
+        "wall_s": wall, "wall_short_s": walls[BENCH_EPOCHS_SHORT],
+        "wall_long_s": walls[BENCH_EPOCHS], "auc": a,
         "mxu_util_est": flops / wall / TPU_PEAK_FLOPS_BF16,
     }))
 
@@ -226,15 +250,20 @@ def task_nn_wide():
 
     walls = {}
     res = None
-    for epochs in (WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG):
-        conf = conf_for(epochs)
-        trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
-        t0 = time.time()
-        res = trainer.train_nn(conf, x, y, w, seed=1)
-        walls[epochs] = time.time() - t0
+    for attempt in range(2):
+        for epochs in (WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG):
+            conf = conf_for(epochs)
+            trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
+            t0 = time.time()
+            res = trainer.train_nn(conf, x, y, w, seed=1)
+            walls[epochs] = time.time() - t0
+        if walls[WIDE_EPOCHS_LONG] > walls[WIDE_EPOCHS_SHORT]:
+            break   # sane sample; else re-measure once (tunnel jitter)
 
     d_epochs = WIDE_EPOCHS_LONG - WIDE_EPOCHS_SHORT
-    d_wall = max(walls[WIDE_EPOCHS_LONG] - walls[WIDE_EPOCHS_SHORT], 1e-9)
+    d_wall = walls[WIDE_EPOCHS_LONG] - walls[WIDE_EPOCHS_SHORT]
+    assert d_wall > 0, (f"timing inversion: {walls[WIDE_EPOCHS_LONG]:.2f}s "
+                        f"long vs {walls[WIDE_EPOCHS_SHORT]:.2f}s short")
     n_train = int(WIDE_ROWS * 0.95)
     row_epochs_per_sec = n_train * d_epochs / d_wall
     scores = nn_mod.forward(res.spec, res.params_per_bag[0],

@@ -254,15 +254,7 @@ def _forest_child_histograms(cfg: TreeConfig, binsT, node_T, grad_T,
     parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(n_level // 2)
     split = (~trees["is_leaf"][:, parent_ids]) & \
         (trees["feature"][:, parent_ids] >= 0)           # (T, P)
-    m = split[:, :, None, None]
-    gl = jnp.where(m, gl, 0.0)
-    hl = jnp.where(m, hl, 0.0)
-    gr = jnp.where(m, prev_g - gl, 0.0)
-    hr = jnp.where(m, prev_h - hl, 0.0)
-    t, p, c, b = gl.shape
-    g = jnp.stack([gl, gr], axis=2).reshape(t, n_level, c, b)
-    h = jnp.stack([hl, hr], axis=2).reshape(t, n_level, c, b)
-    return g, h
+    return _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level)
 
 
 def _best_splits(gh, cfg: TreeConfig, feature_mask):
@@ -448,15 +440,23 @@ def _child_level_histograms(cfg: TreeConfig, binsT, node_of_row, grad,
                                mesh=mesh)
     parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(n_level // 2)
     split = (~is_leaf[parent_ids]) & (feature[parent_ids] >= 0)
-    m = split[:, None, None]
+    return _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level)
+
+
+def _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level):
+    """Shared sibling-subtraction core (single tree (P, C, B) or
+    lockstep forest (T, P, C, B) — `split` carries the matching leading
+    dims): mask leaf parents, derive right = parent − left, interleave
+    (left0, right0, left1, ...) back into a full level."""
+    m = split[..., None, None]
     gl = jnp.where(m, gl, 0.0)
     hl = jnp.where(m, hl, 0.0)
     gr = jnp.where(m, prev_g - gl, 0.0)
     hr = jnp.where(m, prev_h - hl, 0.0)
-    g = jnp.stack([gl, gr], axis=1).reshape(n_level, gl.shape[1],
-                                            cfg.n_bins)
-    h = jnp.stack([hl, hr], axis=1).reshape(n_level, hl.shape[1],
-                                            cfg.n_bins)
+    lead = gl.shape[:-3]
+    c, b = gl.shape[-2], gl.shape[-1]
+    g = jnp.stack([gl, gr], axis=-3).reshape(lead + (n_level, c, b))
+    h = jnp.stack([hl, hr], axis=-3).reshape(lead + (n_level, c, b))
     return g, h
 
 
@@ -555,6 +555,10 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     # feature count: axis 0 of the (C, R) device layout, axis 1 row-major
     fm = jnp.asarray(feature_mask if feature_mask is not None
                      else np.ones(int(jb.shape[0]), np.float32))
+    # env resolved HERE, outside jit: subtract is a static jit arg, so
+    # an env flip after first compile must produce a fresh trace, not a
+    # silent cache hit on whatever was compiled first
+    subtract = _use_hist_subtract()
     trees: List[Any] = []
     pred = jnp.zeros(jb.shape[1], jnp.float32)
     if init_trees is not None:
@@ -578,7 +582,8 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
             vraw = cfg.learning_rate * jnp.sum(predict_trees(
                 init_trees, vb, cfg.max_depth, cfg.n_bins), axis=0)
     for t in range(n_trees):
-        tree, pred = _gbt_round(cfg, jb, jy, jw, pred, fm, mesh=hist_mesh)
+        tree, pred = _gbt_round(cfg, jb, jy, jw, pred, fm, mesh=hist_mesh,
+                                subtract=subtract)
         trees.append(tree)
         if val_data is not None:
             vraw = vraw + cfg.learning_rate * predict_trees(
@@ -631,6 +636,7 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     grad_T = -(jy * jw * d_inst_w)
     hess_T = jw * d_inst_w
     stacked = build_forest(cfg, jb, grad_T, hess_T, jnp.asarray(masks),
+                           subtract=_use_hist_subtract(),
                            mesh=hist_mesh)
     return jax.tree.map(np.asarray, stacked)
 

@@ -19,7 +19,8 @@ def _patch_small(monkeypatch):
     monkeypatch.setattr(bench, "N_ROWS", 20_000)
     monkeypatch.setattr(bench, "N_FEATURES", 16)
     monkeypatch.setattr(bench, "HIDDEN", 16)
-    monkeypatch.setattr(bench, "BENCH_EPOCHS", 15)
+    monkeypatch.setattr(bench, "BENCH_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "BENCH_EPOCHS", 40)
     monkeypatch.setattr(bench, "HIST_ROWS", 5_000)
     monkeypatch.setattr(bench, "HIST_COLS", 8)
     monkeypatch.setattr(bench, "HIST_BINS", 8)
@@ -79,7 +80,7 @@ def test_task_nn_wide(monkeypatch, capsys):
     monkeypatch.setattr(bench, "WIDE_FEATURES", 24)
     monkeypatch.setattr(bench, "WIDE_HIDDEN", (16, 8))
     monkeypatch.setattr(bench, "WIDE_EPOCHS_SHORT", 2)
-    monkeypatch.setattr(bench, "WIDE_EPOCHS_LONG", 6)
+    monkeypatch.setattr(bench, "WIDE_EPOCHS_LONG", 40)
     bench.task_nn_wide()
     rec = _last_json(capsys)
     assert rec["row_epochs_per_sec"] > 0
