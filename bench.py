@@ -182,12 +182,14 @@ def task_nn():
 
     d_epochs = BENCH_EPOCHS - BENCH_EPOCHS_SHORT
     wall = walls[BENCH_EPOCHS] - walls[BENCH_EPOCHS_SHORT]
-    # a timing inversion surviving the retry must fail the sample
-    # loudly, not clamp into an absurd headline in BENCH_LOCAL.jsonl
-    assert wall > 0, (f"timing inversion: {BENCH_EPOCHS} epochs took "
-                      f"{walls[BENCH_EPOCHS]:.2f}s vs "
-                      f"{walls[BENCH_EPOCHS_SHORT]:.2f}s for "
-                      f"{BENCH_EPOCHS_SHORT}")
+    if wall <= 0:
+        # a timing inversion surviving the retry must fail the sample
+        # loudly (not an assert — python -O would compile it out and
+        # emit an absurd headline into BENCH_LOCAL.jsonl)
+        raise ValueError(f"timing inversion: {BENCH_EPOCHS} epochs took "
+                         f"{walls[BENCH_EPOCHS]:.2f}s vs "
+                         f"{walls[BENCH_EPOCHS_SHORT]:.2f}s for "
+                         f"{BENCH_EPOCHS_SHORT}")
     n_train = int(N_ROWS * (1 - VALID_RATE))
     row_epochs_per_sec = n_train * d_epochs / wall
 
@@ -262,8 +264,9 @@ def task_nn_wide():
 
     d_epochs = WIDE_EPOCHS_LONG - WIDE_EPOCHS_SHORT
     d_wall = walls[WIDE_EPOCHS_LONG] - walls[WIDE_EPOCHS_SHORT]
-    assert d_wall > 0, (f"timing inversion: {walls[WIDE_EPOCHS_LONG]:.2f}s "
-                        f"long vs {walls[WIDE_EPOCHS_SHORT]:.2f}s short")
+    if d_wall <= 0:
+        raise ValueError(f"timing inversion: {walls[WIDE_EPOCHS_LONG]:.2f}s "
+                         f"long vs {walls[WIDE_EPOCHS_SHORT]:.2f}s short")
     n_train = int(WIDE_ROWS * 0.95)
     row_epochs_per_sec = n_train * d_epochs / d_wall
     scores = nn_mod.forward(res.spec, res.params_per_bag[0],

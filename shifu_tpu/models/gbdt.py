@@ -245,15 +245,12 @@ def _forest_child_histograms(cfg: TreeConfig, binsT, node_T, grad_T,
         return _forest_level_histograms(binsT, node_T, grad_T, hess_T,
                                         level_offset, n_level,
                                         cfg.n_bins, mesh=mesh)
-    local = node_T - level_offset                        # (T, R)
-    left = (local >= 0) & (local < n_level) & (local % 2 == 0)
-    half_node = jnp.where(left, level_offset + local // 2, -1)
+    half_node = _left_half_nodes(node_T, level_offset, n_level)  # (T, R)
     gl, hl = _forest_level_histograms(binsT, half_node, grad_T, hess_T,
                                       level_offset, n_level // 2,
                                       cfg.n_bins, mesh=mesh)
-    parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(n_level // 2)
-    split = (~trees["is_leaf"][:, parent_ids]) & \
-        (trees["feature"][:, parent_ids] >= 0)           # (T, P)
+    split = _parent_split_mask(trees["is_leaf"], trees["feature"],
+                               depth)                    # (T, P)
     return _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level)
 
 
@@ -431,16 +428,30 @@ def _child_level_histograms(cfg: TreeConfig, binsT, node_of_row, grad,
         return _level_histograms(binsT, node_of_row, grad, hess,
                                  level_offset, n_level, cfg.n_bins,
                                  mesh=mesh)
-    local = node_of_row - level_offset
-    in_level = (local >= 0) & (local < n_level)
-    left = in_level & (local % 2 == 0)
-    half_node = jnp.where(left, level_offset + local // 2, -1)
+    half_node = _left_half_nodes(node_of_row, level_offset, n_level)
     gl, hl = _level_histograms(binsT, half_node, grad, hess,
                                level_offset, n_level // 2, cfg.n_bins,
                                mesh=mesh)
-    parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(n_level // 2)
-    split = (~is_leaf[parent_ids]) & (feature[parent_ids] >= 0)
+    split = _parent_split_mask(is_leaf, feature, depth)
     return _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level)
+
+
+def _left_half_nodes(node, level_offset, n_level):
+    """Map rows at LEFT children (even level-local slots) to their
+    parent's slot id for the half-width kernel; everything else → -1
+    (dumped). Shared by all three subtraction call sites so child
+    ordering can never desynchronize between them."""
+    local = node - level_offset
+    left = (local >= 0) & (local < n_level) & (local % 2 == 0)
+    return jnp.where(left, level_offset + local // 2, -1)
+
+
+def _parent_split_mask(is_leaf, feature, depth):
+    """(... , P) bool: which previous-level parents actually split
+    (their children exist). is_leaf/feature index node arrays with an
+    optional leading tree axis."""
+    parent_ids = (2 ** (depth - 1) - 1) + jnp.arange(2 ** (depth - 1))
+    return (~is_leaf[..., parent_ids]) & (feature[..., parent_ids] >= 0)
 
 
 def _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level):
@@ -645,21 +656,32 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
 # Out-of-core (>HBM) builders — chunked histogram accumulation
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg", "depth", "mesh"))
+@partial(jax.jit, static_argnames=("cfg", "depth", "mesh", "half"))
 def _stream_level_chunk(cfg: TreeConfig, tree, binsT_c, node_c, grad_c,
-                        hess_c, depth: int, mesh=None):
+                        hess_c, depth: int, mesh=None, half=False):
     """One chunk's work for one level: lazily route the chunk's rows
     through the PREVIOUS level's just-decided splits, then build this
     level's partial histograms — histograms are additive over row
     chunks, so the level's G/H are the sum of these partials (the same
     associativity Guagua exploits to combine DTWorkerParams across
     workers, dt/DTWorker.java:914-944). Fusing route+hist keeps disk
-    IO at one bins pass per level. binsT_c: (C, chunk) transposed."""
+    IO at one bins pass per level. binsT_c: (C, chunk) transposed.
+
+    half=True: sibling-subtraction mode — only LEFT children (even
+    level-local slots) through the kernel at parent-slot positions;
+    the caller reconstructs right siblings from the previous level's
+    accumulated histograms (_subtract_siblings)."""
     binsT_c = binsT_c.astype(jnp.int32)
     if depth > 0:
         node_c = _route_level(cfg, tree, binsT_c, node_c, depth - 1)
-    g, h = _level_histograms(binsT_c, node_c, grad_c, hess_c,
-                             2 ** depth - 1, 2 ** depth, cfg.n_bins,
+    level_offset = 2 ** depth - 1
+    n_level = 2 ** depth
+    hist_node = node_c
+    if half:
+        hist_node = _left_half_nodes(node_c, level_offset, n_level)
+        n_level //= 2
+    g, h = _level_histograms(binsT_c, hist_node, grad_c, hess_c,
+                             level_offset, n_level, cfg.n_bins,
                              mesh=mesh)
     return node_c, g, h
 
@@ -708,7 +730,10 @@ def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
                 mesh_mod.shard_axis(mesh, grad_c, 0),
                 mesh_mod.shard_axis(mesh, hess_c, 0))
 
+    prev_g = prev_h = None
+    subtract = _use_hist_subtract()
     for depth in range(cfg.max_depth + 1):
+        half = subtract and depth > 0 and prev_g is not None
         g_acc = h_acc = None
         cur = put(bounds[0])
         for ci, (a, b) in enumerate(bounds):
@@ -716,12 +741,19 @@ def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
             # THEN prepare the next one so host-side transpose/pad/put
             # overlaps device compute, THEN sync on the routed nodes
             node_c, g, h = _stream_level_chunk(
-                cfg, tree, *cur, depth=depth, mesh=hist_mesh)
+                cfg, tree, *cur, depth=depth, mesh=hist_mesh, half=half)
             if ci + 1 < len(bounds):
                 cur = put(bounds[ci + 1])
             node_host[a:b] = np.asarray(node_c)[:b - a]
             g_acc = g if g_acc is None else g_acc + g
             h_acc = h if h_acc is None else h_acc + h
+        if half:
+            # right siblings from the previous level's full histograms
+            split = _parent_split_mask(tree["is_leaf"], tree["feature"],
+                                       depth)
+            g_acc, h_acc = _subtract_siblings(prev_g, prev_h, g_acc,
+                                              h_acc, split, 2 ** depth)
+        prev_g, prev_h = g_acc, h_acc
         if depth < cfg.max_depth:
             tree = _apply_level(cfg, tree, g_acc, h_acc, fm, depth)
         else:
