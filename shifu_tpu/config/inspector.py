@@ -291,8 +291,15 @@ def _check_evals(mc: ModelConfig, r: ValidateResult) -> None:
         elif e.dataSet.source is SourceType.LOCAL:
             _file_should_exist(mc, e.dataSet.dataPath,
                                f"eval {e.name}: dataSet#dataPath", r)
-        if not e.dataSet.targetColumnName:
-            r.fail(f"eval {e.name}: dataSet#targetColumnName is empty")
+        if not e.dataSet.targetColumnName and \
+                not mc.dataSet.targetColumnName:
+            # eval sets inherit the model target when unset
+            # (EvalConfig falls back to ModelConfig's dataSet —
+            # processor/eval.effective_dataset_conf; the reference's
+            # bundled model sets leave this empty)
+            r.fail(f"eval {e.name}: dataSet#targetColumnName is empty "
+                   "and the model-level dataSet#targetColumnName (the "
+                   "inherited fallback) is empty too")
         if e.performanceBucketNum < 2:
             r.fail(f"eval {e.name}: performanceBucketNum must be >= 2, "
                    f"got {e.performanceBucketNum}")

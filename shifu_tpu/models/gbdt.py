@@ -753,7 +753,10 @@ def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
                                        depth)
             g_acc, h_acc = _subtract_siblings(prev_g, prev_h, g_acc,
                                               h_acc, split, 2 ** depth)
-        prev_g, prev_h = g_acc, h_acc
+        # only the subtraction mode needs last level's histograms; with
+        # it disabled, holding them would pin extra HBM on exactly the
+        # memory-scarce path this builder exists for
+        prev_g, prev_h = (g_acc, h_acc) if subtract else (None, None)
         if depth < cfg.max_depth:
             tree = _apply_level(cfg, tree, g_acc, h_acc, fm, depth)
         else:

@@ -1,0 +1,139 @@
+"""End-to-end on the reference's OWN tutorial model set
+(cancer-judgement, the fixture `ShifuCLITest.java:94-336` drives
+through createNewModel → ... → exportModel). The reference ModelConfig
+loads UNCHANGED — only the data paths are repointed at the mounted
+copy — proving on-disk config compatibility plus full-pipeline quality
+on real Shifu data. Skipped when the reference checkout is absent
+(end-user machines)."""
+
+import json
+import os
+
+import pytest
+
+REF = "/root/reference/src/test/resources/example/cancer-judgement"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted")
+
+
+@pytest.fixture()
+def cancer_set(tmp_path):
+    """The reference ModelSet1 config with dataPath repointed (the
+    reference stores paths relative to its repo root)."""
+    root = tmp_path / "cancer-judgement"
+    root.mkdir()
+    raw = json.load(open(os.path.join(REF, "ModelStore", "ModelSet1",
+                                      "ModelConfig.json")))
+    raw["dataSet"]["dataPath"] = os.path.join(REF, "DataStore", "DataSet1")
+    raw["dataSet"]["headerPath"] = os.path.join(
+        REF, "DataStore", "DataSet1", ".pig_header")
+    # eval set: the bundled EvalSet1 split
+    for ev in raw.get("evals") or []:
+        ev["dataSet"]["dataPath"] = os.path.join(REF, "DataStore",
+                                                 "EvalSet1")
+        ev["dataSet"]["headerPath"] = os.path.join(
+            REF, "DataStore", "EvalSet1", ".pig_header")
+    # keep runtime sane for CI: the reference trains 5 bags × 100
+    # epochs of a 45×45 sigmoid net; 2 bags × 40 epochs shows the same
+    # pipeline with the same architecture
+    raw["train"]["baggingNum"] = 2
+    raw["train"]["numTrainEpochs"] = 40
+    json.dump(raw, open(root / "ModelConfig.json", "w"), indent=1)
+    return str(root)
+
+
+def test_reference_modelconfig_loads_verbatim():
+    """The untouched Jackson-written config parses with every section
+    intact (round-trip safety is covered by config tests; this pins
+    the REAL file)."""
+    from shifu_tpu.config.model_config import Algorithm, ModelConfig
+    mc = ModelConfig.load(os.path.join(REF, "ModelStore", "ModelSet1"))
+    assert mc.basic.name == "cancer-judgement"
+    assert mc.train.algorithm is Algorithm.NN
+    assert mc.train.baggingNum == 5
+    assert mc.dataSet.posTags == ["M"]
+    assert mc.train.get_param("NumHiddenNodes") == [45, 45]
+    assert [a.lower() for a in mc.train.get_param("ActivationFunc")] == \
+        ["sigmoid", "sigmoid"]
+
+
+def test_cancer_judgement_end_to_end(cancer_set):
+    """init → stats → norm → train → eval on the real dataset: the
+    north-star acceptance is matched AUC, and this separable dataset
+    must score ≥0.95 eval AUC (the reference wiki reports ~0.99 for
+    its NN on this data)."""
+    from shifu_tpu.processor import (eval as eval_proc, init as init_proc,
+                                     norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    for proc in (init_proc, stats_proc, norm_proc, train_proc, eval_proc):
+        ctx = ProcessorContext.load(cancer_set)
+        assert proc.run(ctx) == 0
+
+    ccs = json.load(open(os.path.join(cancer_set, "ColumnConfig.json")))
+    target = [c for c in ccs if c["columnName"] == "diagnosis"]
+    assert target and target[0]["columnType"] is not None
+    # weight column flagged, stats filled on a real numeric column
+    num = [c for c in ccs if c["columnName"] == "column_4"][0]
+    assert num["columnStats"]["ks"] > 0
+
+    perf_path = ProcessorContext.load(cancer_set) \
+        .path_finder.eval_performance_path("EvalA")
+    if not os.path.exists(perf_path):
+        # eval-set name from the reference config
+        mc = json.load(open(os.path.join(cancer_set, "ModelConfig.json")))
+        name = (mc.get("evals") or [{}])[0].get("name", "Eval1")
+        perf_path = ProcessorContext.load(cancer_set) \
+            .path_finder.eval_performance_path(name)
+    perf = json.load(open(perf_path))
+    assert perf["areaUnderRoc"] > 0.95, perf["areaUnderRoc"]
+    models = os.listdir(os.path.join(cancer_set, "models"))
+    assert sorted(models) == ["model0.nn", "model1.nn"]
+
+
+@pytest.mark.parametrize("ms,norm", [("ModelSet2", "WOE"),
+                                     ("ModelSet3", "WOE_ZSCORE")])
+def test_reference_woe_modelsets_end_to_end(tmp_path, ms, norm):
+    """The WOE / WOE_ZSCORE variants of the bundled model sets run the
+    full pipeline too (NormalizerTest's norm families against real
+    configs)."""
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor import (eval as eval_proc, init as init_proc,
+                                     norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    raw = json.load(open(os.path.join(REF, "ModelStore", ms,
+                                      "ModelConfig.json")))
+    assert raw["normalize"]["normType"].upper() == norm
+    root = tmp_path / ms
+    root.mkdir()
+    raw["dataSet"]["dataPath"] = os.path.join(REF, "DataStore", "DataSet1")
+    raw["dataSet"]["headerPath"] = os.path.join(
+        REF, "DataStore", "DataSet1", ".pig_header")
+    for ev in raw.get("evals") or []:
+        ev["dataSet"]["dataPath"] = os.path.join(REF, "DataStore",
+                                                 "EvalSet1")
+        ev["dataSet"]["headerPath"] = os.path.join(
+            REF, "DataStore", "EvalSet1", ".pig_header")
+    raw["train"]["baggingNum"] = 1
+    raw["train"]["numTrainEpochs"] = 30
+    json.dump(raw, open(root / "ModelConfig.json", "w"), indent=1)
+    # the reference workflow scaffolds these via `shifu new`
+    # (CreateModelProcessor); the fixture config references them
+    (root / "columns").mkdir()
+    for name in ("meta.column.names", "categorical.column.names"):
+        (root / "columns" / name).write_text("")
+
+    for proc in (init_proc, stats_proc, norm_proc, train_proc, eval_proc):
+        ctx = ProcessorContext.load(str(root))
+        assert proc.run(ctx) == 0
+    mc = ModelConfig.load(str(root))
+    name = mc.evals[0].name
+    perf = json.load(open(ProcessorContext.load(str(root))
+                          .path_finder.eval_performance_path(name)))
+    assert perf["areaUnderRoc"] > 0.95, (ms, perf["areaUnderRoc"])
