@@ -55,6 +55,20 @@ WIDE_HIDDEN = (512, 256)
 WIDE_EPOCHS_SHORT = 2
 WIDE_EPOCHS_LONG = 102
 
+# WDL (wide-and-deep): the Criteo ladder-step analog (BASELINE.md step
+# 4) — 13 dense + 26 categorical features through embedding gathers +
+# wide tables + deep MLP, the reference's WDLWorker/WideAndDeep path.
+# Perf profile differs from the MLP benches: embedding gather/scatter
+# (HBM random access) instead of big GEMMs.
+WDL_ROWS = 500_000
+WDL_DENSE = 13
+WDL_CAT = 26
+WDL_VOCAB = 10_000
+WDL_EMBED = 16
+WDL_HIDDEN = (256, 128)
+WDL_EPOCHS_SHORT = 2
+WDL_EPOCHS_LONG = 22
+
 # v5e HBM bandwidth (GB/s) for the roofline estimate in extra
 TPU_HBM_GBPS = 819.0
 
@@ -132,6 +146,29 @@ def task_probe():
                       "n_devices": jax.local_device_count()}))
 
 
+def _delta_timed(measure, short_epochs: int, long_epochs: int):
+    """Shared two-length delta-timing protocol: run `measure(epochs)`
+    (compile + timed run, returning the run's result) for both lengths;
+    re-measure once on a timing inversion (tunnel jitter); raise if the
+    inversion survives — a bad sample must fail loudly, not print an
+    absurd headline into BENCH_LOCAL.jsonl. Returns
+    (result_of_long_run, walls dict, d_wall)."""
+    walls = {}
+    res = None
+    for attempt in range(2):
+        for epochs in (short_epochs, long_epochs):
+            t0, res = measure(epochs)
+            walls[epochs] = time.time() - t0
+        if walls[long_epochs] > walls[short_epochs]:
+            break
+    d_wall = walls[long_epochs] - walls[short_epochs]
+    if d_wall <= 0:
+        raise ValueError(f"timing inversion: {long_epochs} epochs took "
+                         f"{walls[long_epochs]:.2f}s vs "
+                         f"{walls[short_epochs]:.2f}s for {short_epochs}")
+    return res, walls, d_wall
+
+
 def task_nn():
     """Flagship: the REAL train_bags path (vmapped bags, scanned epochs,
     in-graph early stop + best-val tracking), 1 bag, full batch."""
@@ -168,28 +205,15 @@ def task_nn():
     # real device sync (NB block_until_ready is NOT reliable under the
     # axon TPU tunnel — returns early). Throughput = the delta between
     # the two measured walls, so per-call transfer cost cancels.
-    walls = {}
-    res = None
-    for attempt in range(2):
-        for epochs in (BENCH_EPOCHS_SHORT, BENCH_EPOCHS):
-            conf = conf_for(epochs)
-            trainer.train_nn(conf, x, y, w, seed=1)
-            t0 = time.time()
-            res = trainer.train_nn(conf, x, y, w, seed=1)
-            walls[epochs] = time.time() - t0
-        if walls[BENCH_EPOCHS] > walls[BENCH_EPOCHS_SHORT]:
-            break   # sane sample; else re-measure once (tunnel jitter)
+    def measure(epochs):
+        conf = conf_for(epochs)
+        trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
+        t0 = time.time()
+        return t0, trainer.train_nn(conf, x, y, w, seed=1)
 
+    res, walls, wall = _delta_timed(measure, BENCH_EPOCHS_SHORT,
+                                    BENCH_EPOCHS)
     d_epochs = BENCH_EPOCHS - BENCH_EPOCHS_SHORT
-    wall = walls[BENCH_EPOCHS] - walls[BENCH_EPOCHS_SHORT]
-    if wall <= 0:
-        # a timing inversion surviving the retry must fail the sample
-        # loudly (not an assert — python -O would compile it out and
-        # emit an absurd headline into BENCH_LOCAL.jsonl)
-        raise ValueError(f"timing inversion: {BENCH_EPOCHS} epochs took "
-                         f"{walls[BENCH_EPOCHS]:.2f}s vs "
-                         f"{walls[BENCH_EPOCHS_SHORT]:.2f}s for "
-                         f"{BENCH_EPOCHS_SHORT}")
     n_train = int(N_ROWS * (1 - VALID_RATE))
     row_epochs_per_sec = n_train * d_epochs / wall
 
@@ -251,23 +275,15 @@ def task_nn_wide():
         conf.convergenceThreshold = 0.0
         return conf
 
-    walls = {}
-    res = None
-    for attempt in range(2):
-        for epochs in (WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG):
-            conf = conf_for(epochs)
-            trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
-            t0 = time.time()
-            res = trainer.train_nn(conf, x, y, w, seed=1)
-            walls[epochs] = time.time() - t0
-        if walls[WIDE_EPOCHS_LONG] > walls[WIDE_EPOCHS_SHORT]:
-            break   # sane sample; else re-measure once (tunnel jitter)
+    def measure(epochs):
+        conf = conf_for(epochs)
+        trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
+        t0 = time.time()
+        return t0, trainer.train_nn(conf, x, y, w, seed=1)
 
+    res, walls, d_wall = _delta_timed(measure, WIDE_EPOCHS_SHORT,
+                                      WIDE_EPOCHS_LONG)
     d_epochs = WIDE_EPOCHS_LONG - WIDE_EPOCHS_SHORT
-    d_wall = walls[WIDE_EPOCHS_LONG] - walls[WIDE_EPOCHS_SHORT]
-    if d_wall <= 0:
-        raise ValueError(f"timing inversion: {walls[WIDE_EPOCHS_LONG]:.2f}s "
-                         f"long vs {walls[WIDE_EPOCHS_SHORT]:.2f}s short")
     n_train = int(WIDE_ROWS * 0.95)
     row_epochs_per_sec = n_train * d_epochs / d_wall
     scores = nn_mod.forward(res.spec, res.params_per_bag[0],
@@ -290,6 +306,81 @@ def task_nn_wide():
         "mxu_util": achieved / TPU_PEAK_FLOPS_BF16,
         "hbm_gbps_est": hbm_bytes / d_wall / 1e9,
         "hbm_util_est": hbm_bytes / d_wall / 1e9 / TPU_HBM_GBPS,
+    }))
+
+
+def task_wdl():
+    """Criteo-like WDL training throughput: the real train_bags path
+    with embedding + wide tables + deep MLP (models/wdl.py, the
+    WDLWorker/WideAndDeep replacement). Delta timing like the MLP
+    benches so the one-time transfer cancels."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import wdl
+    from shifu_tpu.ops.metrics import auc
+    from shifu_tpu.train.optimizers import optimizer_from_params
+    from shifu_tpu.train.trainer import split_validation, train_bags
+
+    rng = np.random.default_rng(0)
+    dense = rng.normal(0, 1, (WDL_ROWS, WDL_DENSE)).astype(np.float32)
+    idx = rng.integers(0, WDL_VOCAB, (WDL_ROWS, WDL_CAT)).astype(np.int32)
+    # informative signal: a few embedding ids + dense margin
+    eff = rng.normal(0, 1, WDL_VOCAB).astype(np.float32)
+    margin = dense[:, 0] * 0.8 + eff[idx[:, 0]] + eff[idx[:, 1]] * 0.5
+    y = (margin + rng.normal(0, 1, WDL_ROWS) > 0).astype(np.float32)
+    w = np.ones(WDL_ROWS, np.float32)
+
+    spec = wdl.WDLSpec(dense_dim=WDL_DENSE, n_cat=WDL_CAT,
+                       vocab_size=WDL_VOCAB, embed_size=WDL_EMBED,
+                       hidden_dims=WDL_HIDDEN,
+                       activations=("relu",) * len(WDL_HIDDEN))
+    tr_mask, val_mask = split_validation(WDL_ROWS, 0.05, 7)
+    n_train = int(tr_mask.sum())
+    optimizer = optimizer_from_params({"Propagation": "ADAM",
+                                       "LearningRate": 0.02})
+
+    def loss(params, inputs, w_, key_):
+        d_, i_, y_ = inputs
+        return wdl.loss_fn(spec, params, d_, i_, y_, w_)
+
+    def metric(params, inputs, w_):
+        d_, i_, y_ = inputs
+        return wdl.mse(spec, params, d_, i_, y_, w_)
+
+    key = jax.random.PRNGKey(1)
+    bag_keys = jax.random.split(key, 1)
+
+    def measure(epochs):
+        stacked = jax.vmap(lambda k: wdl.init_params(spec, k))(bag_keys)
+        grad_mask = jax.tree.map(lambda l: jnp.ones_like(l[0]), stacked)
+        args = (loss, metric, optimizer, epochs, 0, 0.0, stacked,
+                (dense[tr_mask], idx[tr_mask], y[tr_mask]),
+                w[tr_mask][None, :],
+                (dense[val_mask], idx[val_mask], y[val_mask]),
+                w[val_mask], bag_keys, grad_mask)
+        train_bags(*args)   # compile this scan length
+        t0 = time.time()
+        return t0, train_bags(*args)
+
+    out, walls, d_wall = _delta_timed(measure, WDL_EPOCHS_SHORT,
+                                      WDL_EPOCHS_LONG)
+    res_params = jax.tree.map(lambda p: p[0], out[0])
+    d_epochs = WDL_EPOCHS_LONG - WDL_EPOCHS_SHORT
+    scores = wdl.forward(spec, res_params,
+                         jnp.asarray(dense[:200_000]),
+                         jnp.asarray(idx[:200_000]))
+    a = float(auc(scores, jnp.asarray(y[:200_000])))
+    if a <= 0.7:
+        raise ValueError(f"WDL failed to learn (AUC {a})")
+    # embedding traffic LOWER bound per epoch: fwd gather + bwd scatter
+    emb_bytes = 2 * n_train * WDL_CAT * WDL_EMBED * 4 * d_epochs
+    print(json.dumps({
+        "row_epochs_per_sec": n_train * d_epochs / d_wall,
+        "wall_s": d_wall, "auc": a,
+        "embed_gather_gbps_est": emb_bytes / d_wall / 1e9,
     }))
 
 
@@ -441,6 +532,8 @@ def main():
         return task_nn()
     if args.task == "nn_wide":
         return task_nn_wide()
+    if args.task == "wdl":
+        return task_wdl()
     if args.task in ("hist_pallas", "hist_xla"):
         return task_hist(args.task.split("_", 1)[1])
     if args.task == "gbt":
@@ -503,6 +596,19 @@ def main():
                     f"({100 * nw['hbm_util_est']:.1f}% of HBM)")
             else:
                 diags.append("nn_wide failed: " +
+                             (err.splitlines()[-1] if err else "?"))
+            _log(f"running WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
+                 f"vocab {WDL_VOCAB})...")
+            wd, err = _run_task("wdl", env_extra=env_extra)
+            if wd:
+                _persist("wdl", backend, wd)
+                extra["wdl_Mrow_epochs_per_s"] = round(
+                    wd["row_epochs_per_sec"] / 1e6, 3)
+                extra["wdl_auc"] = round(wd["auc"], 4)
+                extra["wdl_embed_gather_gbps_est"] = round(
+                    wd["embed_gather_gbps_est"], 1)
+            else:
+                diags.append("wdl failed: " +
                              (err.splitlines()[-1] if err else "?"))
             # Pallas interpret mode on CPU is not a perf path; only
             # measure the kernel where it actually runs.

@@ -223,7 +223,35 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
         raise ValueError(f"no eval set named {eval_name!r}; have "
                          f"{[e.name for e in mc.evals]}")
     for ec in evals:
-        scores, tags, weights, dset = score_eval_set(ctx, ec)
+        # the audit wants N records, not the whole set: read chunks
+        # until N scorable rows survive the filter/tag mask, then score
+        # just those (the reference heads the full score job's output;
+        # at 1B-row scale that is hours of work for a 100-row sample)
+        from shifu_tpu.data.dataset import valid_tag_mask
+        from shifu_tpu.data.purifier import DataPurifier
+        from shifu_tpu.data.reader import iter_raw_table
+        import pandas as pd
+        ds = effective_dataset_conf(mc, ec)
+        purifier = DataPurifier(ds.filterExpressions) \
+            if ds.filterExpressions else None
+        eval_mc = copy.copy(mc)
+        eval_mc.dataSet = ds
+        frames, have = [], 0
+        for df in iter_raw_table(mc, ds=ds,
+                                 chunk_rows=max(4 * n_records, 4096)):
+            if purifier is not None:
+                df = df[purifier.apply(df)].reset_index(drop=True)
+            frames.append(df)
+            # count rows that will actually survive the build (valid
+            # tags), so a heavily-filtered set keeps reading CHUNKS —
+            # never regressing to a full resident read for a sample
+            have += int(valid_tag_mask(eval_mc, df).sum())
+            if have >= n_records:
+                break
+        head_df = pd.concat(frames, ignore_index=True) if frames else None
+        dset, norm_cols = _build_eval_dataset(ctx, ec, df=head_df)
+        scores = _score_dataset(mc, _make_scorer(ctx, ec), dset, norm_cols)
+        tags, weights = dset.tags, dset.weights
         if mc.is_multi_classification:
             score_cols = sorted(k for k in scores if k.startswith("class"))
         else:

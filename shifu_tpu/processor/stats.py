@@ -46,6 +46,23 @@ def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
     df = None
 
     if dataset is None:
+        from shifu_tpu.processor import stats_streaming
+        chunk = stats_streaming.stats_chunk_rows(ctx)
+        if chunk and not stats_streaming.explicitly_requested():
+            # an auto (size-based) trigger must not break configs the
+            # resident path supports: segments re-filter the frame per
+            # expression and DateStats needs the raw date column
+            from shifu_tpu.data import segment as seg_mod
+            from shifu_tpu.processor import datestat
+            if seg_mod.segment_expressions(mc) or                     datestat.date_column_name(mc):
+                log.warning(
+                    "stats: dataset exceeds the streaming threshold but "
+                    "segment expansion / DateStats need the resident "
+                    "path — running resident (set "
+                    "SHIFU_TPU_STATS_CHUNK_ROWS to force streaming)")
+                chunk = 0
+        if chunk:
+            return stats_streaming.run_streaming(ctx, chunk, seed=seed)
         df = read_raw_table(mc, numeric_columns=[
             c.columnName for c in ccs
             if c.is_candidate and not c.is_categorical and not c.is_segment])
@@ -171,8 +188,7 @@ def compute_stats(ctx: ProcessorContext, dset: ColumnarDataset,
                 tot = ccounts["count_pos"][j] + ccounts["count_neg"][j]
                 kept = cap_categories(vocab, tot[:len(vocab)], cap)
             _fill_categorical(cc, vocab, kept, j, ccounts, int(vocab_lens[j]),
-                              dset.num_rows, dset.cat_codes[:, j], tags,
-                              weights)
+                              dset.num_rows)
 
 
 def _fill_numeric(cc: ColumnConfig, bounds: np.ndarray, k: int, j: int,
@@ -226,8 +242,7 @@ def _fill_numeric(cc: ColumnConfig, bounds: np.ndarray, k: int, j: int,
 
 
 def _fill_categorical(cc: ColumnConfig, orig_vocab, vocab, j: int, counts,
-                      vocab_len: int, n_rows: int, codes: np.ndarray,
-                      tags: np.ndarray, weights: np.ndarray) -> None:
+                      vocab_len: int, n_rows: int) -> None:
     """Write categorical binning + stats into one ColumnConfig.
 
     When `vocab` is the full original vocabulary, the device-accumulated
@@ -275,7 +290,9 @@ def _fill_categorical(cc: ColumnConfig, orig_vocab, vocab, j: int, counts,
 
     st = cc.columnStats
     st.totalCount = int(n_rows)
-    st.missingCount = int((codes < 0).sum())
+    # the counts arrays already carry the missing slot (mask-consistent
+    # with the codes) — no per-column host row scan needed
+    st.missingCount = int(round(row_p[vocab_len] + row_n[vocab_len]))
     st.missingPercentage = float(st.missingCount / max(n_rows, 1))
     st.distinctCount = len(vocab)
     # categorical mean/std over posrate-encoded values (parseRawValue

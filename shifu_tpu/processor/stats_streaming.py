@@ -1,0 +1,391 @@
+"""Streaming (>RAM) column stats — chunked two-pass sketch.
+
+The resident stats path (`processor/stats.py`) materializes the whole
+table; the reference never does — its stats run as Pig jobs whose
+binning is a streaming SKETCH (SPDT equal-population /
+Munro–Patterson quantiles, `core/binning/EqualPopulationBinning.java`,
+SURVEY §3.3). This module is the TPU-native analog for datasets that
+don't fit host RAM:
+
+- **Pass A** (one chunked read): per numeric column, float64 power
+  sums s1..s4 + min/max + missing counts (exact moments); per
+  categorical column, a value → (posCount, negCount, posWeight,
+  negWeight) dict merge (exact — the same associative merge as
+  `BinningDataMergeUDF`).
+- **Pass B** (second chunked read): per numeric column, a fixed-width
+  K=8192-bin histogram over [min, max] with all four weight kinds.
+  Every BinningMethod's quantile cuts derive from the appropriate
+  weight's cumulative histogram, boundaries land on fine-bin edges,
+  and the final per-bin pos/neg counts AGGREGATE EXACTLY from the fine
+  histogram — so KS/IV/WOE are exact for the chosen boundaries, and
+  the boundaries themselves are within 1/K of the exact quantiles
+  (tighter than the reference's sketches at default sizes).
+
+Row order cannot bias anything: all accumulations are associative.
+Activated by SHIFU_TPU_STATS_CHUNK_ROWS / -Dshifu.stats.chunkRows or
+automatically when the raw files exceed SHIFU_TPU_STATS_STREAM_BYTES
+(default 2 GB). Segment expansion and date-stats require the resident
+path (they re-filter the frame per expression) and raise/skip clearly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.config.model_config import BinningMethod
+from shifu_tpu.data.dataset import build_columnar
+from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.reader import expand_data_files, iter_raw_table
+from shifu_tpu.ops import stats as stats_ops
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+FINE_BINS = 8192
+
+
+def explicitly_requested() -> bool:
+    """True when the operator forced streaming via env / -D (an AUTO
+    size trigger falls back to resident for configs streaming cannot
+    serve — segments, DateStats)."""
+    return bool(os.environ.get("shifu.stats.chunkRows")
+                or os.environ.get("SHIFU_TPU_STATS_CHUNK_ROWS"))
+
+
+def stats_chunk_rows(ctx: ProcessorContext) -> int:
+    """0 = resident. Same trigger pattern as streaming eval."""
+    v = os.environ.get("shifu.stats.chunkRows") \
+        or os.environ.get("SHIFU_TPU_STATS_CHUNK_ROWS")
+    if v is not None and str(v).strip() != "":
+        try:
+            return max(int(float(v)), 0)
+        except (TypeError, ValueError):
+            raise ValueError(f"stats chunkRows must be an integer, got {v!r}")
+    try:
+        from shifu_tpu.data import fs as fs_mod
+        files = expand_data_files(
+            ctx.model_config.resolve_path(ctx.model_config.dataSet.dataPath))
+
+        def _size(p):
+            if fs_mod.has_scheme(p):
+                return int(fs_mod.size(p))
+            return os.path.getsize(p) if os.path.exists(p) else 0
+
+        total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
+                    for p in files)
+    except (OSError, FileNotFoundError, ValueError, RuntimeError):
+        return 0
+    limit = int(os.environ.get("SHIFU_TPU_STATS_STREAM_BYTES",
+                               2 * 1024 ** 3))
+    return 2_000_000 if total > limit else 0
+
+
+def _sample_mask(rng_seed: int, start: int, n: int, rate: float,
+                 keep_pos: Optional[np.ndarray]) -> np.ndarray:
+    """Stateless per-GLOBAL-row-index sampling (splitmix64 hash →
+    uniform): the sampled set is identical for ANY chunking of the
+    rows — a Philox counter stream would misalign at chunk boundaries
+    because its counter advances in blocks, not single draws."""
+    if rate >= 1.0:
+        return np.ones(n, bool)
+    idx = np.arange(start, start + n, dtype=np.uint64)
+    z = idx + np.uint64(rng_seed) * np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    u = z.astype(np.float64) / float(2 ** 64)
+    m = u < rate
+    if keep_pos is not None:
+        m |= keep_pos
+    return m
+
+
+def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
+                    seed: int):
+    """Yield per-chunk ColumnarDatasets with filter + sampling applied
+    (build_columnar drops invalid-tag rows itself)."""
+    mc = ctx.model_config
+    purifier = DataPurifier(mc.dataSet.filterExpressions) \
+        if mc.dataSet.filterExpressions else None
+    global_row = 0
+    from shifu_tpu.data.reader import simple_column_name
+    tgt_col = simple_column_name(
+        mc.dataSet.targetColumnName.split("|")[0])
+    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+        start = global_row
+        global_row += len(df)
+        if purifier is not None:
+            df = df[purifier.apply(df)].reset_index(drop=True)
+        if mc.stats.sampleRate < 1.0 and len(df):
+            keep_pos = None
+            if mc.stats.sampleNegOnly and tgt_col in df.columns:
+                tgt = df[tgt_col].astype(str).str.strip()
+                keep_pos = tgt.isin(mc.pos_tags).to_numpy()
+            df = df[_sample_mask(seed, start, len(df),
+                                 mc.stats.sampleRate,
+                                 keep_pos)].reset_index(drop=True)
+        if not len(df):
+            continue
+        try:
+            dset = build_columnar(mc, [c for c in ccs if not c.is_segment],
+                                  df)
+        except ValueError:
+            continue   # chunk with zero valid-tag rows — skip
+        if dset.num_rows:
+            yield dset
+
+
+def run_streaming(ctx: ProcessorContext, chunk_rows: int,
+                  seed: int = 12306) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.validate(ModelStep.STATS)
+    ctx.require_columns()
+    ccs = ctx.column_configs
+    from shifu_tpu.data import segment
+    if segment.segment_expressions(mc):
+        raise ValueError(
+            "segment expansion needs the resident stats path — drop "
+            "shifu.stats.chunkRows / SHIFU_TPU_STATS_CHUNK_ROWS or raise "
+            "SHIFU_TPU_STATS_STREAM_BYTES for this model set")
+
+    # ---- Pass A: moments + categorical value counts -------------------
+    num_names: List[str] = []
+    num_nums: Optional[np.ndarray] = None
+    cat_names: List[str] = []
+    cat_nums: Optional[np.ndarray] = None
+    A: Dict[str, np.ndarray] = {}
+    cat_counts: List[Dict[str, np.ndarray]] = []
+    cat_missing: Optional[np.ndarray] = None   # (Cc, 4)
+    n_rows = 0
+
+    for dset in _chunk_datasets(ctx, ccs, chunk_rows, seed):
+        if num_nums is None:
+            num_names, num_nums = dset.num_names, dset.num_column_nums
+            cat_names, cat_nums = dset.cat_names, dset.cat_column_nums
+            cn = len(num_names)
+            A = {k: np.zeros(cn, np.float64) for k in
+                 ("n", "miss", "s1", "s2", "s3", "s4",
+                  "miss_pos_n", "miss_neg_n", "miss_pos_w",
+                  "miss_neg_w")}
+            A["min"] = np.full(cn, np.inf)
+            A["max"] = np.full(cn, -np.inf)
+            cat_counts = [dict() for _ in cat_names]
+            cat_missing = np.zeros((len(cat_names), 4), np.float64)
+        n_rows += dset.num_rows
+        v = dset.numeric.astype(np.float64)
+        ok = ~np.isnan(v)
+        A["n"] += ok.sum(axis=0)
+        A["miss"] += (~ok).sum(axis=0)
+        pos_rows = (dset.tags > 0.5)[:, None]
+        wcol = dset.weights.astype(np.float64)[:, None]
+        A["miss_pos_n"] += (~ok & pos_rows).sum(axis=0)
+        A["miss_neg_n"] += (~ok & ~pos_rows).sum(axis=0)
+        A["miss_pos_w"] += np.where(~ok & pos_rows, wcol, 0.0).sum(axis=0)
+        A["miss_neg_w"] += np.where(~ok & ~pos_rows, wcol, 0.0).sum(axis=0)
+        vz = np.where(ok, v, 0.0)
+        A["s1"] += vz.sum(axis=0)
+        A["s2"] += (vz ** 2).sum(axis=0)
+        A["s3"] += (vz ** 3).sum(axis=0)
+        A["s4"] += (vz ** 4).sum(axis=0)
+        with np.errstate(all="ignore"):
+            A["min"] = np.minimum(A["min"], np.nanmin(
+                np.where(ok, v, np.inf), axis=0))
+            A["max"] = np.maximum(A["max"], np.nanmax(
+                np.where(ok, v, -np.inf), axis=0))
+        pos = dset.tags > 0.5
+        w = dset.weights.astype(np.float64)
+        for j in range(len(cat_names)):
+            codes = dset.cat_codes[:, j]
+            vocab = dset.vocabs[j]
+            miss = codes < 0
+            cat_missing[j] += (float((pos & miss).sum()),
+                               float((~pos & miss).sum()),
+                               float(w[pos & miss].sum()),
+                               float(w[~pos & miss].sum()))
+            d = cat_counts[j]
+            for arr, k in ((pos & ~miss, 0), (~pos & ~miss, 1)):
+                if not arr.any():
+                    continue
+                cnt = np.bincount(codes[arr], minlength=len(vocab))
+                wcnt = np.bincount(codes[arr], weights=w[arr],
+                                   minlength=len(vocab))
+                for ci in np.nonzero(cnt)[0]:
+                    row = d.get(vocab[ci])
+                    if row is None:
+                        row = d[vocab[ci]] = np.zeros(4)
+                    row[k] += cnt[ci]
+                    row[2 + k] += wcnt[ci]
+
+    if n_rows == 0:
+        raise ValueError(
+            f"no row's {mc.dataSet.targetColumnName!r} value matches "
+            f"posTags {mc.pos_tags} / negTags {mc.neg_tags} in any chunk")
+
+    cn = len(num_names)
+    span = np.where(A["max"] > A["min"], A["max"] - A["min"], 1.0)
+
+    # ---- Pass B: fine histograms for numeric columns ------------------
+    fine = np.zeros((4, cn, FINE_BINS), np.float64)  # pos_n/neg_n/pos_w/neg_w
+    for dset in _chunk_datasets(ctx, ccs, chunk_rows, seed):
+        v = dset.numeric.astype(np.float64)
+        ok = ~np.isnan(v)
+        idx = np.clip(((v - A["min"][None, :]) / span[None, :]
+                       * FINE_BINS).astype(np.int64), 0, FINE_BINS - 1)
+        pos = dset.tags > 0.5
+        w = dset.weights.astype(np.float64)
+        flat = (idx + np.arange(cn)[None, :] * FINE_BINS)
+        for k, (rows, wv) in enumerate((
+                (pos, None), (~pos, None), (pos, w), (~pos, w))):
+            sel = ok & rows[:, None]
+            f = flat[sel]
+            wts = None if wv is None else \
+                np.broadcast_to(wv[:, None], sel.shape)[sel]
+            fine[k] += np.bincount(f, weights=wts,
+                                   minlength=cn * FINE_BINS) \
+                .reshape(cn, FINE_BINS)
+
+    _fill_from_sketch(ctx, mc, num_names, num_nums, A, fine, n_rows)
+    _fill_cats_from_dicts(ctx, mc, cat_names, cat_nums, cat_counts,
+                          cat_missing, n_rows)
+    ctx.save_column_configs()
+    from shifu_tpu.processor import datestat
+    if datestat.date_column_name(mc):
+        log.warning("streaming stats: per-date stats need the resident "
+                    "path; DateStats skipped")
+    log.info("streaming stats: %d rows in 2 chunked passes, %d num + "
+             "%d cat columns in %.2fs", n_rows, cn, len(cat_names),
+             time.time() - t0)
+    return 0
+
+
+def _quantile_weights_hist(method: BinningMethod, fine: np.ndarray):
+    """(C, K) per-fine-bin quantile mass for the configured
+    BinningMethod (ops/binning.quantile_weights_for_method analog)."""
+    pos_n, neg_n, pos_w, neg_w = fine
+    m = method
+    if m in (BinningMethod.EqualPositive,):
+        return pos_n
+    if m in (BinningMethod.EqualNegative,):
+        return neg_n
+    if m in (BinningMethod.WeightEqualPositive,):
+        return pos_w
+    if m in (BinningMethod.WeightEqualNegative,):
+        return neg_w
+    if m in (BinningMethod.WeightEqualTotal,):
+        return pos_w + neg_w
+    return pos_n + neg_n    # EqualTotal default
+
+
+def _fill_from_sketch(ctx, mc, num_names, num_nums, A, fine,
+                      n_rows: int) -> None:
+    from shifu_tpu.processor.stats import _fill_numeric
+    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
+    max_bins = mc.stats.maxNumBin
+    cn = len(num_names)
+    if cn == 0:
+        return
+    K = FINE_BINS
+    edges = A["min"][:, None] + (np.arange(K + 1)[None, :] / K) \
+        * (np.where(A["max"] > A["min"], A["max"] - A["min"], 1.0))[:, None]
+
+    # moments from power sums
+    n = np.maximum(A["n"], 1.0)
+    mean = A["s1"] / n
+    var = np.maximum(A["s2"] / n - mean ** 2, 0.0)
+    std = np.sqrt(var * n / np.maximum(n - 1, 1.0))
+    m3 = A["s3"] / n - 3 * mean * A["s2"] / n + 2 * mean ** 3
+    m4 = A["s4"] / n - 4 * mean * A["s3"] / n + 6 * mean ** 2 \
+        * A["s2"] / n - 3 * mean ** 4
+    with np.errstate(all="ignore"):
+        skew = np.where(var > 0, m3 / var ** 1.5, 0.0)
+        kurt = np.where(var > 0, m4 / var ** 2 - 3.0, 0.0)
+    moments = {"mean": mean, "std": std, "min": A["min"], "max": A["max"],
+               "missing": A["miss"], "skewness": skew, "kurtosis": kurt}
+
+    # quartiles from the unit-count fine histogram
+    tot_hist = fine[0] + fine[1]
+    cum = np.cumsum(tot_hist, axis=1)
+    quartiles = np.zeros((3, cn))
+    for qi, q in enumerate((0.25, 0.5, 0.75)):
+        tgt = q * np.maximum(cum[:, -1], 1e-12)
+        pos_idx = np.minimum((cum < tgt[:, None]).sum(axis=1), K - 1)
+        quartiles[qi] = edges[np.arange(cn), pos_idx + 1]
+
+    if mc.stats.binningMethod in (BinningMethod.EqualInterval,
+                                  BinningMethod.WeightEqualInterval):
+        cut_edges = [np.arange(1, max_bins) * K // max_bins
+                     for _ in range(cn)]
+    else:
+        qw = _quantile_weights_hist(mc.stats.binningMethod, fine)
+        qcum = np.cumsum(qw, axis=1)
+        cut_edges = []
+        for j in range(cn):
+            tot = qcum[j, -1]
+            if tot <= 0:
+                cut_edges.append(np.asarray([], np.int64))
+                continue
+            tgts = np.arange(1, max_bins) / max_bins * tot
+            # fine-bin index whose RIGHT edge is the cut
+            ce = np.searchsorted(qcum[j], tgts, side="left")
+            cut_edges.append(np.unique(np.clip(ce, 0, K - 2)))
+
+    counts = {k: np.zeros((cn, max_bins + 1)) for k in
+              ("count_pos", "count_neg", "weight_pos", "weight_neg")}
+    keys = ("count_pos", "count_neg", "weight_pos", "weight_neg")
+    for j in range(cn):
+        ce = cut_edges[j]
+        bounds = np.concatenate(([-np.inf], edges[j, ce + 1]))
+        # aggregate fine bins into final bins: fine bin f belongs to
+        # final bin = #cuts with cut_fine_index < f
+        assign = np.searchsorted(ce, np.arange(K), side="left")
+        for k in range(4):
+            binned = np.bincount(assign, weights=fine[k, j],
+                                 minlength=max_bins)[:max_bins]
+            counts[keys[k]][j, :len(binned)] = binned
+        # missing slot broken out by class like the resident kernels
+        counts["count_pos"][j, max_bins] = A["miss_pos_n"][j]
+        counts["count_neg"][j, max_bins] = A["miss_neg_n"][j]
+        counts["weight_pos"][j, max_bins] = A["miss_pos_w"][j]
+        counts["weight_neg"][j, max_bins] = A["miss_neg_w"][j]
+        cc = cc_by_num[int(num_nums[j])]
+        _fill_numeric(cc, bounds, len(bounds), j, counts, moments,
+                      quartiles, max_bins, n_rows)
+
+
+def _fill_cats_from_dicts(ctx, mc, cat_names, cat_nums, cat_counts,
+                          cat_missing, n_rows: int) -> None:
+    from shifu_tpu.ops.binning import cap_categories
+    from shifu_tpu.processor.stats import _fill_categorical
+    if not cat_names:
+        return
+    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
+    for j, name in enumerate(cat_names):
+        d = cat_counts[j]
+        vocab = sorted(d.keys())
+        vl = len(vocab)
+        counts = {k: np.zeros((1, vl + 1)) for k in
+                  ("count_pos", "count_neg", "weight_pos", "weight_neg")}
+        for ci, val in enumerate(vocab):
+            row = d[val]
+            counts["count_pos"][0, ci] = row[0]
+            counts["count_neg"][0, ci] = row[1]
+            counts["weight_pos"][0, ci] = row[2]
+            counts["weight_neg"][0, ci] = row[3]
+        counts["count_pos"][0, vl] = cat_missing[j, 0]
+        counts["count_neg"][0, vl] = cat_missing[j, 1]
+        counts["weight_pos"][0, vl] = cat_missing[j, 2]
+        counts["weight_neg"][0, vl] = cat_missing[j, 3]
+        kept = vocab
+        cap = mc.stats.cateMaxNumBin
+        if cap > 0 and vl > cap:
+            tot = counts["count_pos"][0, :vl] + counts["count_neg"][0, :vl]
+            kept = cap_categories(vocab, tot, cap)
+        cc = cc_by_num[int(cat_nums[j])]
+        _fill_categorical(cc, vocab, kept, 0, counts, vl, n_rows)

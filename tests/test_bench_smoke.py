@@ -86,3 +86,18 @@ def test_task_nn_wide(monkeypatch, capsys):
     assert rec["row_epochs_per_sec"] > 0
     assert rec["achieved_tflops"] > 0
     assert rec["wall_long_s"] >= 0
+
+
+def test_task_wdl(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "WDL_ROWS", 6_000)
+    monkeypatch.setattr(bench, "WDL_DENSE", 5)
+    monkeypatch.setattr(bench, "WDL_CAT", 3)
+    monkeypatch.setattr(bench, "WDL_VOCAB", 50)
+    monkeypatch.setattr(bench, "WDL_EMBED", 4)
+    monkeypatch.setattr(bench, "WDL_HIDDEN", (8,))
+    monkeypatch.setattr(bench, "WDL_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "WDL_EPOCHS_LONG", 30)
+    bench.task_wdl()
+    rec = _last_json(capsys)
+    assert rec["row_epochs_per_sec"] > 0
+    assert rec["auc"] > 0.7

@@ -1,0 +1,144 @@
+"""Streaming (>RAM) stats parity: the chunked two-pass sketch must
+reproduce the resident stats within fine-histogram resolution, be
+invariant to row order (all accumulations associative), and plug into
+the same downstream pipeline (norm/train read only ColumnConfig)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.processor import init as init_proc, stats as stats_proc
+from shifu_tpu.processor.base import ProcessorContext
+
+
+def _stats_of(root):
+    ccs = json.load(open(os.path.join(root, "ColumnConfig.json")))
+    return {c["columnName"]: c for c in ccs}
+
+
+def _run_init_stats(root, monkeypatch, chunk=None):
+    if chunk is None:
+        monkeypatch.delenv("SHIFU_TPU_STATS_CHUNK_ROWS", raising=False)
+    else:
+        monkeypatch.setenv("SHIFU_TPU_STATS_CHUNK_ROWS", str(chunk))
+    ctx = ProcessorContext.load(root)
+    assert init_proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    assert stats_proc.run(ctx) == 0
+    return _stats_of(root)
+
+
+def test_streaming_stats_matches_resident(tmp_path, rng, monkeypatch):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=4000)
+    resident = _run_init_stats(root, monkeypatch)
+    streamed = _run_init_stats(root, monkeypatch, chunk=512)
+
+    for name, res in resident.items():
+        st_r, st_s = res["columnStats"], streamed[name]["columnStats"]
+        bn_r, bn_s = res["columnBinning"], streamed[name]["columnBinning"]
+        if not bn_r.get("binCountPos"):
+            continue
+        # exact-ish: moments + counts
+        for k in ("totalCount", "missingCount"):
+            assert st_r[k] == st_s[k], (name, k, st_r[k], st_s[k])
+        for k in ("mean", "stdDev", "min", "max"):
+            if st_r.get(k) is not None and st_s.get(k) is not None:
+                assert abs(st_r[k] - st_s[k]) < 1e-3 * (1 + abs(st_r[k])), \
+                    (name, k, st_r[k], st_s[k])
+        # sketch-resolution: KS/IV close in relative terms (KS is on
+        # the reference's 0-100-ish scale; boundary drift of 1/8192 of
+        # the population shifts weak columns' KS by a few percent)
+        for k in ("ks", "iv", "weightedKs", "weightedIv"):
+            assert abs(st_r[k] - st_s[k]) < 0.2 + 0.1 * abs(st_r[k]), \
+                (name, k, st_r[k], st_s[k])
+        if bn_r.get("binCategory") is not None:
+            # categorical: exact dict merge — vocab and counts equal
+            assert bn_r["binCategory"] == bn_s["binCategory"], name
+            assert bn_r["binCountPos"] == bn_s["binCountPos"], name
+            assert bn_r["binCountNeg"] == bn_s["binCountNeg"], name
+        else:
+            b_r = np.asarray(bn_r["binBoundary"][1:], float)
+            b_s = np.asarray(bn_s["binBoundary"][1:], float)
+            vspan = max(st_r["max"] - st_r["min"], 1e-9)
+            if len(b_r) == len(b_s):
+                assert np.all(np.abs(b_r - b_s) < 0.01 * vspan + 1e-6), \
+                    (name, b_r, b_s)
+            # totals conserved across bins regardless of cut drift
+            assert sum(bn_r["binCountPos"]) == sum(bn_s["binCountPos"]), name
+            assert sum(bn_r["binCountNeg"]) == sum(bn_s["binCountNeg"]), name
+
+
+def test_streaming_stats_order_invariant(tmp_path, rng, monkeypatch):
+    """Label-sorted input produces identical streaming stats to the
+    original order (associative accumulation — no order bias)."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=3000)
+    a = _run_init_stats(root, monkeypatch, chunk=700)
+    data_file = os.path.join(root, "data", "part-00000")
+    with open(data_file) as f:
+        lines = f.readlines()
+    lines.sort(key=lambda ln: ln.rsplit("|", 1)[-1])
+    with open(data_file, "w") as f:
+        f.writelines(lines)
+    b = _run_init_stats(root, monkeypatch, chunk=700)
+    for name in a:
+        sa, sb = a[name]["columnStats"], b[name]["columnStats"]
+        for k in ("ks", "iv", "mean", "stdDev", "totalCount"):
+            va, vb = sa.get(k), sb.get(k)
+            if isinstance(va, float):
+                assert abs(va - vb) < 1e-9 * (1 + abs(vb)), (name, k)
+            else:
+                assert va == vb, (name, k)
+
+
+def test_streaming_stats_feeds_norm_and_train(tmp_path, rng, monkeypatch):
+    """ColumnConfig from streaming stats drives norm → train → eval
+    end-to-end (downstream reads only the configs)."""
+    import json as _json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (eval as eval_proc,
+                                     norm as norm_proc,
+                                     train as train_proc)
+    root = make_model_set(tmp_path, rng, n_rows=3000)
+    _run_init_stats(root, monkeypatch, chunk=512)
+    monkeypatch.delenv("SHIFU_TPU_STATS_CHUNK_ROWS", raising=False)
+    for proc in (norm_proc, train_proc, eval_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    perf = _json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
+    assert perf["areaUnderRoc"] > 0.85
+
+
+def test_streaming_stats_sampling_and_filter(tmp_path, rng, monkeypatch):
+    """sampleRate applies counter-based on the global row index:
+    chunk size cannot change which rows are sampled."""
+    import json as _json
+
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=3000)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = _json.load(open(mcp))
+    mc["stats"]["sampleRate"] = 0.5
+    _json.dump(mc, open(mcp, "w"))
+    a = _run_init_stats(root, monkeypatch, chunk=300)
+    b = _run_init_stats(root, monkeypatch, chunk=1100)
+    for name in a:
+        assert a[name]["columnStats"]["totalCount"] == \
+            b[name]["columnStats"]["totalCount"], name
+
+
+def test_streaming_stats_segment_rejected(tmp_path, rng, monkeypatch):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=500,
+                          seg_expressions=["num_0 > 0"])
+    monkeypatch.setenv("SHIFU_TPU_STATS_CHUNK_ROWS", "200")
+    ctx = ProcessorContext.load(root)
+    assert init_proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    with pytest.raises(ValueError, match="resident stats"):
+        stats_proc.run(ctx)
