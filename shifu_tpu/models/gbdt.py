@@ -769,7 +769,8 @@ def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
                         chunk_rows: int = 1 << 20,
                         feature_mask: Optional[np.ndarray] = None,
                         init_trees: Optional[Any] = None,
-                        early_stop_window: int = 0):
+                        early_stop_window: int = 0,
+                        n_val: Optional[int] = None):
     """Out-of-core boosting: the bin matrix streams from disk chunk by
     chunk (max_depth+1 passes per tree), per-row state (node, raw
     prediction) lives on the host at 8 bytes/row. The resident
@@ -781,7 +782,11 @@ def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
     train/streaming.py)."""
     from shifu_tpu.parallel import mesh as mesh_mod
     r, c = bins_mm.shape
-    n_val = int(r * max(valid_rate, 0.0))
+    if n_val is None:
+        # streaming norm records the EXACT trailing-region size; when
+        # the caller passes it, the split matches the written layout
+        # row-for-row instead of round-tripping through a float rate
+        n_val = int(r * max(valid_rate, 0.0))
     n_train = r - n_val
     if n_train <= 0:
         raise ValueError("streaming GBT needs at least one training row")

@@ -1,0 +1,62 @@
+"""Shared plumbing for the streaming (>RAM) processor paths
+(stats_streaming / norm_streaming / eval): the chunk-size trigger and
+the stateless per-row hash.
+
+One definition so the trigger semantics (env parse, fsspec-aware
+sizes, compressed-size expansion ratio, default threshold) cannot
+drift between the three streaming steps.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
+                   label: str, default_rows: int = 2_000_000) -> int:
+    """0 = resident. Explicit via any of `env_keys` (first set wins;
+    '0' forces resident); automatic when the raw files' estimated
+    decompressed size exceeds the `byte_env` threshold (default 2 GB).
+    Compressed parts count at a conservative ~6× text expansion."""
+    v = None
+    for k in env_keys:
+        v = os.environ.get(k)
+        if v is not None:
+            break
+    if v is not None and str(v).strip() != "":
+        try:
+            return max(int(float(v)), 0)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{label} chunkRows must be an integer, got {v!r}")
+    try:
+        from shifu_tpu.data import fs as fs_mod
+        from shifu_tpu.data.reader import expand_data_files
+        files = expand_data_files(ctx.model_config.resolve_path(data_path))
+
+        def _size(p):
+            if fs_mod.has_scheme(p):
+                return int(fs_mod.size(p))
+            return os.path.getsize(p) if os.path.exists(p) else 0
+
+        total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
+                    for p in files)
+    except (OSError, FileNotFoundError, ValueError, RuntimeError):
+        return 0
+    limit = int(os.environ.get(byte_env, 2 * 1024 ** 3))
+    return default_rows if total > limit else 0
+
+
+def splitmix64_uniform(start: int, n: int, seed: int) -> np.ndarray:
+    """(n,) uniforms in [0, 1) from a stateless splitmix64 hash of the
+    global row indices start..start+n — identical for ANY chunking of
+    the rows (a counter-based Generator stream would misalign at chunk
+    boundaries because its counter advances in blocks)."""
+    idx = np.arange(start, start + n, dtype=np.uint64)
+    z = idx + np.uint64(seed | 1) * np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return z.astype(np.float64) / float(2 ** 64)

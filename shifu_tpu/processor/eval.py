@@ -105,9 +105,12 @@ def _score_dataset(mc: ModelConfig, scorer: Scorer, dset, cols):
 
 
 def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,
-                        df=None):
+                        df=None, apply_filter: bool = True):
     """Build the (chunk of the) eval set as a ColumnarDataset; returns
-    (dataset, selected-candidate cols) for _score_dataset."""
+    (dataset, selected-candidate cols) for _score_dataset.
+    `apply_filter=False` for callers that already ran the purifier on
+    `df` (the audit head-read) — re-filtering is idempotent but wasted
+    work."""
     mc = ctx.model_config
     ds = effective_dataset_conf(mc, ec)
     cols = norm_proc.selected_candidates(ctx.column_configs)
@@ -115,7 +118,8 @@ def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,
     eval_mc.dataSet = ds
     dset = norm_proc.load_dataset_for_columns(
         eval_mc, ctx.column_configs, cols, ds_conf=ds,
-        extra_columns=score_meta_columns(ctx, ec), df=df)
+        extra_columns=score_meta_columns(ctx, ec), df=df,
+        apply_filter=apply_filter)
     return dset, cols
 
 
@@ -139,40 +143,22 @@ def eval_chunk_rows(ctx: ProcessorContext, ec: EvalConfig) -> int:
     Explicit via -Dshifu.eval.chunkRows / SHIFU_TPU_EVAL_CHUNK_ROWS or
     the eval section's `chunkRows`; automatic when the eval files
     exceed SHIFU_TPU_EVAL_STREAM_BYTES (default 2 GB) on disk."""
-    v = os.environ.get("shifu.eval.chunkRows") \
-        or os.environ.get("SHIFU_TPU_EVAL_CHUNK_ROWS")
-    if v is None:
-        v = ec._extras.get("chunkRows")
-    if v is not None and str(v).strip() != "":
+    from shifu_tpu.processor.chunking import chunk_rows_for
+    v = ec._extras.get("chunkRows")
+    if v is not None and str(v).strip() != "" \
+            and not os.environ.get("shifu.eval.chunkRows") \
+            and not os.environ.get("SHIFU_TPU_EVAL_CHUNK_ROWS"):
         try:
             return max(int(float(v)), 0)   # explicit 0 = resident mode
         except (TypeError, ValueError):
             raise ValueError(
                 f"eval {ec.name}: chunkRows must be an integer, "
                 f"got {v!r}")
-    try:
-        from shifu_tpu.data import fs as fs_mod
-        from shifu_tpu.data.reader import expand_data_files
-        ds = effective_dataset_conf(ctx.model_config, ec)
-        files = expand_data_files(ctx.model_config.resolve_path(ds.dataPath))
-
-        def _size(p: str) -> int:
-            # remote (hdfs/s3/gs) parts size via fsspec — os.path would
-            # silently report 0 and default huge remote sets to the
-            # resident path
-            if fs_mod.has_scheme(p):
-                return int(fs_mod.size(p))
-            return os.path.getsize(p) if os.path.exists(p) else 0
-
-        # the limit guards decompressed (RAM) size: count compressed
-        # parts at a conservative ~6× text expansion ratio
-        total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
-                    for p in files)
-    except (OSError, FileNotFoundError, ValueError, RuntimeError):
-        return 0
-    limit = int(os.environ.get("SHIFU_TPU_EVAL_STREAM_BYTES",
-                               2 * 1024 ** 3))
-    return 2_000_000 if total > limit else 0
+    ds = effective_dataset_conf(ctx.model_config, ec)
+    return chunk_rows_for(ctx, ("shifu.eval.chunkRows",
+                                "SHIFU_TPU_EVAL_CHUNK_ROWS"),
+                          "SHIFU_TPU_EVAL_STREAM_BYTES",
+                          ds.dataPath, f"eval {ec.name}")
 
 
 def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
@@ -249,7 +235,8 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
             if have >= n_records:
                 break
         head_df = pd.concat(frames, ignore_index=True) if frames else None
-        dset, norm_cols = _build_eval_dataset(ctx, ec, df=head_df)
+        dset, norm_cols = _build_eval_dataset(ctx, ec, df=head_df,
+                                              apply_filter=False)
         scores = _score_dataset(mc, _make_scorer(ctx, ec), dset, norm_cols)
         tags, weights = dset.tags, dset.weights
         if mc.is_multi_classification:

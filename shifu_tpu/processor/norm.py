@@ -230,6 +230,10 @@ def run(ctx: ProcessorContext,
     ctx.require_columns()
     cols = selected_candidates(ctx.column_configs)
     if dataset is None:
+        from shifu_tpu.processor import norm_streaming
+        chunk = norm_streaming.norm_chunk_rows(ctx)
+        if chunk:
+            return norm_streaming.run_streaming(ctx, chunk)
         dataset = load_dataset_for_columns(mc, ctx.column_configs, cols)
     result = normalize_columns(mc, cols, dataset)
     out = ctx.path_finder.normalized_data_path()

@@ -1,0 +1,230 @@
+"""Streaming (>RAM) normalization — chunked two-pass mmap writer.
+
+Completes the >RAM pipeline (streaming stats → THIS → trainOnDisk
+streaming train → streaming eval): the resident norm materializes the
+whole table and its outputs; here chunks read → normalize (row-local,
+all tables come from ColumnConfig) → write straight into pre-allocated
+.npy memmaps, so host memory stays bounded at one chunk.
+
+Validation-split de-biasing without a global shuffle: a stateless
+splitmix64 hash of each RAW global row index assigns rows to the
+train region [0, n_train) or the TRAILING val region [n_train, R) of
+the on-disk layout, both written sequentially. The streaming trainers'
+"trailing validSetRate fraction" split therefore IS an exact
+uniform-random split — stronger than the resident path's shuffle, with
+zero scatter IO. meta.json records validSplit so the trainers use the
+exact written fraction.
+
+The compressed data.npz (resident trainers' input) is NOT written —
+a dataset that needs streaming norm must train with
+`train#trainOnDisk` (the resident trainer's missing-data.npz error
+already says so). Activated like streaming stats:
+-Dshifu.norm.chunkRows / SHIFU_TPU_NORM_CHUNK_ROWS or automatically by
+raw file size.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import List
+
+import numpy as np
+
+from shifu_tpu.config.inspector import ModelStep
+from shifu_tpu.data.dataset import valid_tag_mask
+from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.reader import iter_raw_table
+from shifu_tpu.processor.base import ProcessorContext
+
+log = logging.getLogger("shifu_tpu")
+
+
+def norm_chunk_rows(ctx: ProcessorContext) -> int:
+    """0 = resident. Shared trigger (processor/chunking.py)."""
+    from shifu_tpu.processor.chunking import chunk_rows_for
+    return chunk_rows_for(ctx, ("shifu.norm.chunkRows",
+                                "SHIFU_TPU_NORM_CHUNK_ROWS"),
+                          "SHIFU_TPU_NORM_STREAM_BYTES",
+                          ctx.model_config.dataSet.dataPath, "norm")
+
+
+def _val_flags(seed: int, start: int, n: int, rate: float) -> np.ndarray:
+    """Stateless per-raw-row val assignment (splitmix64 → uniform):
+    identical across passes and chunkings."""
+    if rate <= 0.0:
+        return np.zeros(n, bool)
+    from shifu_tpu.processor.chunking import splitmix64_uniform
+    return splitmix64_uniform(start, n, seed) < rate
+
+
+class _RegionWriter:
+    """Sequential writer into the train region [0, n_train) and the
+    trailing val region [n_train, R) of a set of row-aligned mmaps."""
+
+    def __init__(self, n_train: int):
+        self.cursors = [0, n_train]
+        self.arrays: List = []
+
+    def add(self, mm):
+        self.arrays.append(mm)
+        return mm
+
+    def write(self, blocks, val_mask: np.ndarray) -> None:
+        for region, sel in ((0, ~val_mask), (1, val_mask)):
+            n = int(sel.sum())
+            if not n:
+                continue
+            at = self.cursors[region]
+            for mm, blk in zip(self.arrays, blocks):
+                mm[at:at + n] = blk[sel]
+            self.cursors[region] = at + n
+
+
+def run_streaming(ctx: ProcessorContext, chunk_rows: int,
+                  seed: int = 12306) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.validate(ModelStep.NORMALIZE)
+    ctx.require_columns()
+    from shifu_tpu.processor import norm as norm_proc
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    if not mc.train.trainOnDisk:
+        log.warning("streaming norm writes only the mmap layout — set "
+                    "train#trainOnDisk=true (resident training needs "
+                    "data.npz, which a >RAM set cannot materialize)")
+    purifier = DataPurifier(mc.dataSet.filterExpressions) \
+        if mc.dataSet.filterExpressions else None
+    val_rate = max(float(mc.train.validSetRate or 0.0), 0.0)
+
+    # ---- pass 1: exact region sizes -----------------------------------
+    n_train = n_val = 0
+    raw_row = 0
+    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+        start = raw_row
+        raw_row += len(df)
+        keep = np.ones(len(df), bool)
+        if purifier is not None:
+            keep &= purifier.apply(df)
+        keep &= valid_tag_mask(mc, df)
+        vf = _val_flags(seed, start, len(df), val_rate)
+        n_val += int((keep & vf).sum())
+        n_train += int((keep & ~vf).sum())
+    n_rows = n_train + n_val
+    if n_rows == 0:
+        raise ValueError(
+            f"no row's {mc.dataSet.targetColumnName!r} value matches "
+            f"posTags {mc.pos_tags} / negTags {mc.neg_tags} in any chunk")
+
+    # ---- probe for the output schema (first chunk with valid rows) ----
+    probe = None
+    for probe_df in iter_raw_table(mc, chunk_rows=min(chunk_rows, 4096)):
+        if purifier is not None:
+            probe_df = probe_df[purifier.apply(probe_df)] \
+                .reset_index(drop=True)
+        if not len(probe_df) or not valid_tag_mask(mc, probe_df).any():
+            continue
+        probe = norm_proc.load_dataset_for_columns(
+            mc, ctx.column_configs, cols, apply_filter=False, df=probe_df)
+        break
+    assert probe is not None   # n_rows > 0 guarantees one valid chunk
+    probe_norm = norm_proc.normalize_columns(mc, cols, probe)
+    ptype = norm_proc.precision_type(mc)
+    f_dense = probe_norm.dense.shape[1]
+    k_index = probe_norm.index.shape[1] if probe_norm.index_names else 0
+    c_numeric = probe.numeric.shape[1]
+    c_codes = probe.cat_codes.shape[1]
+    vlen = np.asarray([len(v) for v in probe.vocabs], np.int32) \
+        if c_codes else np.zeros(0, np.int32)
+
+    def _layout(path, spec):
+        os.makedirs(path, exist_ok=True)
+        w = _RegionWriter(n_train)
+        for name, shape, dtype in spec:
+            w.add(np.lib.format.open_memmap(
+                os.path.join(path, name), mode="w+", dtype=dtype,
+                shape=shape))
+        return w
+
+    norm_dir = ctx.path_finder.normalized_data_path()
+    clean_dir = ctx.path_finder.cleaned_data_path()
+    dtype_dense = np.float64 if ptype == "DOUBLE64" else np.float32
+    norm_spec = [("dense.npy", (n_rows, f_dense), dtype_dense),
+                 ("tags.npy", (n_rows,), np.float32),
+                 ("weights.npy", (n_rows,), np.float32)]
+    if k_index:
+        norm_spec.append(("index.npy", (n_rows, k_index), np.int32))
+    clean_spec = [("dense.npy", (n_rows, c_numeric), np.float32),
+                  ("tags.npy", (n_rows,), np.float32),
+                  ("weights.npy", (n_rows,), np.float32)]
+    if c_codes:
+        clean_spec.append(("index.npy", (n_rows, c_codes), np.int32))
+    wn = _layout(norm_dir, norm_spec)
+    wc = _layout(clean_dir, clean_spec)
+
+    # ---- pass 2: normalize + write ------------------------------------
+    raw_row = 0
+    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+        start = raw_row
+        raw_row += len(df)
+        keep = np.ones(len(df), bool)
+        if purifier is not None:
+            keep &= purifier.apply(df)
+        vf_all = _val_flags(seed, start, len(df), val_rate)
+        df = df[keep].reset_index(drop=True)
+        vf = vf_all[keep]
+        if not len(df):
+            continue
+        # build_columnar drops invalid-tag rows — align the val flags;
+        # skip ONLY the zero-valid-rows case (any other build error
+        # must raise, not silently truncate the output)
+        tag_ok = valid_tag_mask(mc, df)
+        if not tag_ok.any():
+            continue
+        dset = norm_proc.load_dataset_for_columns(
+            mc, ctx.column_configs, cols, apply_filter=False, df=df)
+        vf = vf[tag_ok]
+        result = norm_proc.normalize_columns(mc, cols, dset)
+        dense = norm_proc.apply_precision(result.dense, ptype)
+        blocks_n = [dense, dset.tags.astype(np.float32),
+                    dset.weights.astype(np.float32)]
+        if k_index:
+            blocks_n.append(result.index.astype(np.int32))
+        wn.write(blocks_n, vf)
+        if c_codes:
+            codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                             dset.cat_codes).astype(np.int32)
+        else:
+            codes = dset.cat_codes
+        blocks_c = [dset.numeric.astype(np.float32),
+                    dset.tags.astype(np.float32),
+                    dset.weights.astype(np.float32)]
+        if c_codes:
+            blocks_c.append(codes)
+        wc.write(blocks_c, vf)
+    for w in (wn, wc):
+        for mm in w.arrays:
+            mm.flush()
+    assert wn.cursors == [n_train, n_rows], wn.cursors
+
+    for path, names, vocab_sizes in (
+            (norm_dir, (probe_norm.dense_names, probe_norm.index_names,
+                        probe_norm.index_vocab_sizes), None),
+            (clean_dir, (probe.num_names, probe.cat_names,
+                         [int(v) + 1 for v in vlen]), None)):
+        dn, ixn, ivs = names
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"denseNames": list(dn), "indexNames": list(ixn),
+                       "indexVocabSizes": list(ivs),
+                       "precisionType": ptype, "streaming": True,
+                       "streamingNorm": True,
+                       # the split is EXACT: trailing n_val rows are a
+                       # uniform-random sample (splitmix64 row hash)
+                       "validSplit": {"nTrain": n_train, "nVal": n_val,
+                                      "seed": seed}}, f, indent=1)
+    log.info("streaming norm: %d rows (%d train + %d val regions) → "
+             "dense %s in 2 chunked passes, %.2fs", n_rows, n_train,
+             n_val, (n_rows, f_dense), time.time() - t0)
+    return 0

@@ -59,48 +59,22 @@ def explicitly_requested() -> bool:
 
 
 def stats_chunk_rows(ctx: ProcessorContext) -> int:
-    """0 = resident. Same trigger pattern as streaming eval."""
-    v = os.environ.get("shifu.stats.chunkRows") \
-        or os.environ.get("SHIFU_TPU_STATS_CHUNK_ROWS")
-    if v is not None and str(v).strip() != "":
-        try:
-            return max(int(float(v)), 0)
-        except (TypeError, ValueError):
-            raise ValueError(f"stats chunkRows must be an integer, got {v!r}")
-    try:
-        from shifu_tpu.data import fs as fs_mod
-        files = expand_data_files(
-            ctx.model_config.resolve_path(ctx.model_config.dataSet.dataPath))
-
-        def _size(p):
-            if fs_mod.has_scheme(p):
-                return int(fs_mod.size(p))
-            return os.path.getsize(p) if os.path.exists(p) else 0
-
-        total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
-                    for p in files)
-    except (OSError, FileNotFoundError, ValueError, RuntimeError):
-        return 0
-    limit = int(os.environ.get("SHIFU_TPU_STATS_STREAM_BYTES",
-                               2 * 1024 ** 3))
-    return 2_000_000 if total > limit else 0
+    """0 = resident. Shared trigger (processor/chunking.py)."""
+    from shifu_tpu.processor.chunking import chunk_rows_for
+    return chunk_rows_for(ctx, ("shifu.stats.chunkRows",
+                                "SHIFU_TPU_STATS_CHUNK_ROWS"),
+                          "SHIFU_TPU_STATS_STREAM_BYTES",
+                          ctx.model_config.dataSet.dataPath, "stats")
 
 
 def _sample_mask(rng_seed: int, start: int, n: int, rate: float,
                  keep_pos: Optional[np.ndarray]) -> np.ndarray:
-    """Stateless per-GLOBAL-row-index sampling (splitmix64 hash →
-    uniform): the sampled set is identical for ANY chunking of the
-    rows — a Philox counter stream would misalign at chunk boundaries
-    because its counter advances in blocks, not single draws."""
+    """Stateless per-GLOBAL-RAW-row-index sampling: identical for any
+    chunking (processor/chunking.splitmix64_uniform)."""
     if rate >= 1.0:
         return np.ones(n, bool)
-    idx = np.arange(start, start + n, dtype=np.uint64)
-    z = idx + np.uint64(rng_seed) * np.uint64(0x9E3779B97F4A7C15)
-    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    z = z ^ (z >> np.uint64(31))
-    u = z.astype(np.float64) / float(2 ** 64)
-    m = u < rate
+    from shifu_tpu.processor.chunking import splitmix64_uniform
+    m = splitmix64_uniform(start, n, rng_seed) < rate
     if keep_pos is not None:
         m |= keep_pos
     return m
@@ -117,26 +91,33 @@ def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
     from shifu_tpu.data.reader import simple_column_name
     tgt_col = simple_column_name(
         mc.dataSet.targetColumnName.split("|")[0])
+    from shifu_tpu.data.dataset import valid_tag_mask
     for df in iter_raw_table(mc, chunk_rows=chunk_rows):
         start = global_row
         global_row += len(df)
-        if purifier is not None:
-            df = df[purifier.apply(df)].reset_index(drop=True)
-        if mc.stats.sampleRate < 1.0 and len(df):
+        # sample on the RAW global row index BEFORE filtering, so the
+        # sampled set is identical for any chunking even with
+        # filterExpressions configured
+        keep = np.ones(len(df), bool)
+        if mc.stats.sampleRate < 1.0:
             keep_pos = None
             if mc.stats.sampleNegOnly and tgt_col in df.columns:
                 tgt = df[tgt_col].astype(str).str.strip()
                 keep_pos = tgt.isin(mc.pos_tags).to_numpy()
-            df = df[_sample_mask(seed, start, len(df),
-                                 mc.stats.sampleRate,
-                                 keep_pos)].reset_index(drop=True)
+            keep &= _sample_mask(seed, start, len(df),
+                                 mc.stats.sampleRate, keep_pos)
+        if purifier is not None:
+            keep &= purifier.apply(df)
+        df = df[keep].reset_index(drop=True)
         if not len(df):
             continue
-        try:
-            dset = build_columnar(mc, [c for c in ccs if not c.is_segment],
-                                  df)
-        except ValueError:
-            continue   # chunk with zero valid-tag rows — skip
+        # skip chunks with zero valid-tag rows explicitly — any OTHER
+        # build error (malformed chunk, bad column count) must raise,
+        # not silently truncate the stats
+        if not valid_tag_mask(mc, df).any():
+            continue
+        dset = build_columnar(mc, [c for c in ccs if not c.is_segment],
+                              df)
         if dset.num_rows:
             yield dset
 
@@ -236,7 +217,8 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
     for dset in _chunk_datasets(ctx, ccs, chunk_rows, seed):
         v = dset.numeric.astype(np.float64)
         ok = ~np.isnan(v)
-        idx = np.clip(((v - A["min"][None, :]) / span[None, :]
+        vq = np.where(ok, v, A["min"][None, :])   # NaN→any valid value;
+        idx = np.clip(((vq - A["min"][None, :]) / span[None, :]
                        * FINE_BINS).astype(np.int64), 0, FINE_BINS - 1)
         pos = dset.tags > 0.5
         w = dset.weights.astype(np.float64)

@@ -319,8 +319,11 @@ def _train_dense_streaming(ctx: ProcessorContext,
         ctx, spec or nn_mod.MLPSpec.from_train_params(mc.train.params,
                                                       dense.shape[1]))
     chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
+    meta = norm_proc.load_normalized_meta(path)
+    n_val = (meta.get("validSplit") or {}).get("nVal")
     res = train_nn_streaming(mc.train, get_chunk, len(tags), dense.shape[1],
                              seed=seed, spec=spec, chunk_rows=chunk_rows,
+                             n_val=n_val,
                              init_params=(jax.tree.map(jnp.asarray,
                                                        init_params)
                                           if init_params is not None

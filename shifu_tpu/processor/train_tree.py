@@ -207,6 +207,13 @@ class _UpsampledWeights:
         return w * np.where(y > 0.5, self._up, np.float32(1.0))
 
 
+def _recorded_n_val(meta) -> "Optional[int]":
+    """Streaming norm records the EXACT trailing val-region size;
+    None for shuffled resident layouts (trainer derives from the
+    configured fraction)."""
+    return (meta.get("validSplit") or {}).get("nVal")
+
+
 def _run_tree_streaming(ctx: ProcessorContext, seed: int):
     """train#trainOnDisk for GBT/RF: the cleaned matrix memory-maps
     from disk, bins materialize once into a compact on-disk matrix
@@ -317,6 +324,7 @@ def _run_tree_streaming(ctx: ProcessorContext, seed: int):
             trees, val_errs = gbdt.build_gbt_streaming(
                 cfg, bins_mm, y, w_bag, n_trees,
                 valid_rate=mc.train.validSetRate,
+                n_val=_recorded_n_val(meta),
                 chunk_rows=chunk_rows, init_trees=init_trees,
                 early_stop_window=int(mc.train.get_param(
                     "EnableEarlyStop", 0) and 10))

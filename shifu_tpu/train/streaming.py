@@ -91,7 +91,8 @@ def train_nn_streaming(train_conf: ModelTrainConf,
                        spec: Optional[nn_mod.MLPSpec] = None,
                        chunk_rows: int = 262_144,
                        init_params=None,
-                       fixed_layers=None) -> TrainResult:
+                       fixed_layers=None,
+                       n_val: Optional[int] = None) -> TrainResult:
     """Train `baggingNum` NN/LR models by streaming row chunks.
 
     get_chunk(start, stop) → (x, y, w) numpy slices — typically views of
@@ -107,7 +108,11 @@ def train_nn_streaming(train_conf: ModelTrainConf,
     t0 = time.time()
     spec = spec or nn_mod.MLPSpec.from_train_params(train_conf.params,
                                                     input_dim=input_dim)
-    n_val = int(n_rows * max(train_conf.validSetRate, 0.0))
+    if n_val is None:
+        n_val = int(n_rows * max(train_conf.validSetRate, 0.0))
+    # (streaming norm records the EXACT trailing-region size in
+    # meta.json validSplit; callers pass it so the split boundary
+    # matches the written layout row-for-row)
     n_train = n_rows - n_val
     if n_train <= 0:
         raise ValueError("streaming training needs at least one train row")

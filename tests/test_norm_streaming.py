@@ -1,0 +1,114 @@
+"""Streaming (>RAM) norm: chunked two-pass mmap writer parity with the
+resident path, exact hash-based validation split, and the fully
+streaming pipeline (stats → norm → trainOnDisk train → eval)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.processor import (init as init_proc, norm as norm_proc,
+                                 stats as stats_proc)
+from shifu_tpu.processor.base import ProcessorContext
+
+
+def _prep(tmp_path, rng, n_rows=3000, **kw):
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=n_rows, **kw)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["train"]["trainOnDisk"] = True
+    mc["train"]["validSetRate"] = 0.2
+    json.dump(mc, open(mcp, "w"))
+    for proc in (init_proc, stats_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    return root
+
+
+def test_streaming_norm_matches_resident_rows(tmp_path, rng, monkeypatch):
+    root = _prep(tmp_path, rng)
+    # resident
+    monkeypatch.delenv("SHIFU_TPU_NORM_CHUNK_ROWS", raising=False)
+    ctx = ProcessorContext.load(root)
+    assert norm_proc.run(ctx) == 0
+    nd = ctx.path_finder.normalized_data_path()
+    res_dense = np.load(os.path.join(nd, "dense.npy"))
+    res_tags = np.load(os.path.join(nd, "tags.npy"))
+    # streaming
+    monkeypatch.setenv("SHIFU_TPU_NORM_CHUNK_ROWS", "512")
+    ctx = ProcessorContext.load(root)
+    assert norm_proc.run(ctx) == 0
+    st_dense = np.load(os.path.join(nd, "dense.npy"))
+    st_tags = np.load(os.path.join(nd, "tags.npy"))
+
+    # same multiset of rows, different order: sort by a stable key
+    assert st_dense.shape == res_dense.shape
+    assert st_tags.sum() == res_tags.sum()
+    order_r = np.lexsort(res_dense.T)
+    order_s = np.lexsort(st_dense.T)
+    np.testing.assert_allclose(res_dense[order_r], st_dense[order_s],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(res_tags[order_r], st_tags[order_s])
+    meta = json.load(open(os.path.join(nd, "meta.json")))
+    vs = meta["validSplit"]
+    assert vs["nTrain"] + vs["nVal"] == len(st_tags)
+    # hash split is ~binomial around the configured rate
+    assert abs(vs["nVal"] / len(st_tags) - 0.2) < 0.04
+    # cleaned layout written too (tree path)
+    cd = ctx.path_finder.cleaned_data_path()
+    assert os.path.exists(os.path.join(cd, "dense.npy"))
+    assert json.load(open(os.path.join(cd, "meta.json")))["streamingNorm"]
+
+
+def test_streaming_norm_split_unbiased_on_sorted_input(tmp_path, rng,
+                                                       monkeypatch):
+    """Label-sorted input: the trailing val region is a uniform-random
+    sample by construction (per-row hash), so its positive rate tracks
+    the population."""
+    root = _prep(tmp_path, rng)
+    data_file = os.path.join(root, "data", "part-00000")
+    lines = open(data_file).readlines()
+    lines.sort(key=lambda ln: ln.rsplit("|", 1)[-1])
+    open(data_file, "w").writelines(lines)
+    for proc in (init_proc, stats_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    monkeypatch.setenv("SHIFU_TPU_NORM_CHUNK_ROWS", "400")
+    ctx = ProcessorContext.load(root)
+    assert norm_proc.run(ctx) == 0
+    nd = ctx.path_finder.normalized_data_path()
+    tags = np.load(os.path.join(nd, "tags.npy"))
+    vs = json.load(open(os.path.join(nd, "meta.json")))["validSplit"]
+    val_rate = float(tags[vs["nTrain"]:].mean())
+    pop_rate = float(tags.mean())
+    assert 0.6 * pop_rate < val_rate < 1.4 * pop_rate, (val_rate, pop_rate)
+
+
+def test_fully_streaming_pipeline(tmp_path, rng, monkeypatch):
+    """The complete >RAM pipeline: streaming stats → streaming norm →
+    trainOnDisk NN and GBT → streaming eval — no step materializes the
+    table."""
+    from shifu_tpu.processor import eval as eval_proc, train as train_proc
+    for alg, params in (
+            ("NN", {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                    "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                    "Propagation": "ADAM", "ChunkRows": 512}),
+            ("GBT", {"TreeNum": 6, "MaxDepth": 3, "LearningRate": 0.3,
+                     "ChunkRows": 512})):
+        monkeypatch.setenv("SHIFU_TPU_STATS_CHUNK_ROWS", "600")
+        monkeypatch.setenv("SHIFU_TPU_NORM_CHUNK_ROWS", "600")
+        monkeypatch.setenv("SHIFU_TPU_EVAL_CHUNK_ROWS", "300")
+        root = _prep(tmp_path / alg, rng, algorithm=alg,
+                     train_params=params)
+        for proc in (norm_proc, train_proc, eval_proc):
+            ctx = ProcessorContext.load(root)
+            assert proc.run(ctx) == 0
+        perf = json.load(open(ProcessorContext.load(root)
+                              .path_finder.eval_performance_path("Eval1")))
+        assert perf["areaUnderRoc"] > 0.85, (alg, perf["areaUnderRoc"])
+        assert perf["streaming"]["chunks"] > 1
+        for k in ("SHIFU_TPU_STATS_CHUNK_ROWS", "SHIFU_TPU_NORM_CHUNK_ROWS",
+                  "SHIFU_TPU_EVAL_CHUNK_ROWS"):
+            monkeypatch.delenv(k, raising=False)
