@@ -22,8 +22,9 @@ def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
     Compressed parts count at a conservative ~6× text expansion."""
     v = None
     for k in env_keys:
-        v = os.environ.get(k)
-        if v is not None:
+        cand = os.environ.get(k)
+        if cand is not None and str(cand).strip() != "":
+            v = cand
             break
     if v is not None and str(v).strip() != "":
         try:
@@ -49,13 +50,108 @@ def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
     return default_rows if total > limit else 0
 
 
-def splitmix64_uniform(start: int, n: int, seed: int) -> np.ndarray:
+def sampled_frame(mc, cap_rows: int, chunk_rows: int = 1_000_000,
+                  seed: int = 12306):
+    """A ≈cap_rows uniform sample of the raw table, read chunked so
+    host memory stays bounded — the analysis-step answer to >RAM sets
+    (varselect sensitivity / posttrain bin averages are statistically
+    stable on a capped sample; the reference runs them as full MR
+    passes instead). Row selection hashes the global row index and the
+    WHOLE file is always scanned (a rate over-estimate must not turn
+    into a file-prefix-biased early stop); an over-full sample is
+    thinned by a second independent hash, staying uniform."""
+    import pandas as pd
+
+    from shifu_tpu.data.reader import iter_raw_table
+
+    frames = []
+    rate = None
+    start = 0
+    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+        if rate is None:
+            # estimate total rows from bytes/row of the first chunk
+            # (compressed parts at the same ~6× text expansion the
+            # trigger uses)
+            try:
+                from shifu_tpu.data import fs as fs_mod
+                from shifu_tpu.data.reader import expand_data_files
+                files = expand_data_files(
+                    mc.resolve_path(mc.dataSet.dataPath))
+                total_bytes = sum(
+                    (int(fs_mod.size(p)) if fs_mod.has_scheme(p)
+                     else (os.path.getsize(p) if os.path.exists(p) else 0))
+                    * (6 if p.endswith((".gz", ".bz2")) else 1)
+                    for p in files)
+                row_bytes = max(df.memory_usage(deep=False).sum()
+                                / max(len(df), 1), 1.0)
+                est_rows = max(total_bytes / (row_bytes * 0.5), len(df))
+            except (OSError, ValueError, RuntimeError):
+                est_rows = len(df) * 10
+            rate = min(1.0, cap_rows / max(est_rows, 1.0))
+        sel = splitmix64_uniform(start, len(df), seed,
+                                 purpose="analysis-sample") < rate
+        start += len(df)
+        if sel.any():
+            frames.append(df[sel])
+    out = pd.concat(frames, ignore_index=True) if frames else None
+    if out is not None and len(out) > cap_rows:
+        # thin uniformly with an independent hash — NOT head(), which
+        # would keep only the earliest file positions
+        u = splitmix64_uniform(0, len(out), seed, purpose="thin")
+        keep = np.argsort(u)[:cap_rows]
+        out = out.iloc[np.sort(keep)].reset_index(drop=True)
+    return out
+
+
+def analysis_frame(ctx, log=None):
+    """None for resident reads; a bounded uniform sample when the raw
+    set exceeds the streaming threshold (analysis steps — sensitivity
+    varselect, posttrain bin averages — are statistically stable on a
+    capped sample; reading a >RAM table resident would OOM).
+    SHIFU_TPU_ANALYSIS_MAX_ROWS caps the sample (default 2M). The
+    sample is cached on the ProcessorContext — the recursive varselect
+    path and posttrain must not each re-scan a multi-GB table for the
+    identical deterministic sample."""
+    cached = getattr(ctx, "_analysis_frame", "unset")
+    if cached != "unset":
+        return cached
+    mc = ctx.model_config
+    chunk = chunk_rows_for(ctx, ("shifu.analysis.chunkRows",
+                                 "SHIFU_TPU_ANALYSIS_CHUNK_ROWS"),
+                           "SHIFU_TPU_ANALYSIS_STREAM_BYTES",
+                           mc.dataSet.dataPath, "analysis")
+    if not chunk:
+        ctx._analysis_frame = None
+        return None
+    cap = int(os.environ.get("SHIFU_TPU_ANALYSIS_MAX_ROWS", 2_000_000))
+    if log is not None:
+        log.warning("dataset exceeds the resident threshold — analysis "
+                    "step runs on a ~%d-row uniform sample "
+                    "(SHIFU_TPU_ANALYSIS_MAX_ROWS)", cap)
+    out = sampled_frame(mc, cap, chunk_rows=chunk)
+    ctx._analysis_frame = out
+    return out
+
+
+def splitmix64_uniform(start: int, n: int, seed: int,
+                       purpose: str = "") -> np.ndarray:
     """(n,) uniforms in [0, 1) from a stateless splitmix64 hash of the
     global row indices start..start+n — identical for ANY chunking of
     the rows (a counter-based Generator stream would misalign at chunk
-    boundaries because its counter advances in blocks)."""
+    boundaries because its counter advances in blocks).
+
+    `purpose` salts the stream: the val split, the stats sample, and
+    the analysis sample must be INDEPENDENT draws — with one shared
+    stream, thresholding makes every lower-rate selection a subset of
+    every higher-rate one (e.g. the whole analysis sample landing
+    inside the validation region — a selection/validation leak)."""
+    import zlib
+    # crc32, NOT hash(): python string hashing is randomized per
+    # process (PYTHONHASHSEED) and would desynchronize multi-host runs
+    salt = np.uint64(zlib.crc32(purpose.encode()))
     idx = np.arange(start, start + n, dtype=np.uint64)
-    z = idx + np.uint64(seed | 1) * np.uint64(0x9E3779B97F4A7C15)
+    z = idx + (np.uint64(seed | 1) + salt * np.uint64(0x9E3779B9)) \
+        * np.uint64(0x9E3779B97F4A7C15)
     z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
     z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
     z = z ^ (z >> np.uint64(31))

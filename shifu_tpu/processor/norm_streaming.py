@@ -57,7 +57,7 @@ def _val_flags(seed: int, start: int, n: int, rate: float) -> np.ndarray:
     if rate <= 0.0:
         return np.zeros(n, bool)
     from shifu_tpu.processor.chunking import splitmix64_uniform
-    return splitmix64_uniform(start, n, seed) < rate
+    return splitmix64_uniform(start, n, seed, purpose="val-split") < rate
 
 
 class _RegionWriter:
@@ -129,7 +129,9 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
         probe = norm_proc.load_dataset_for_columns(
             mc, ctx.column_configs, cols, apply_filter=False, df=probe_df)
         break
-    assert probe is not None   # n_rows > 0 guarantees one valid chunk
+    if probe is None:   # n_rows > 0 should guarantee one valid chunk
+        raise RuntimeError("streaming norm: no buildable probe chunk "
+                           "despite counted rows — inconsistent input?")
     probe_norm = norm_proc.normalize_columns(mc, cols, probe)
     ptype = norm_proc.precision_type(mc)
     f_dense = probe_norm.dense.shape[1]
@@ -207,7 +209,13 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
     for w in (wn, wc):
         for mm in w.arrays:
             mm.flush()
-    assert wn.cursors == [n_train, n_rows], wn.cursors
+    if wn.cursors != [n_train, n_rows] or wc.cursors != [n_train, n_rows]:
+        # a pass-1 / pass-2 drift would ship a corrupted layout (train
+        # rows spilling into the val region) — hard error, not assert
+        # (python -O strips asserts)
+        raise RuntimeError(
+            f"streaming norm wrote {wn.cursors}/{wc.cursors} rows but "
+            f"counted [{n_train}, {n_rows}] — pass-1/pass-2 drift")
 
     for path, names, vocab_sizes in (
             (norm_dir, (probe_norm.dense_names, probe_norm.index_names,

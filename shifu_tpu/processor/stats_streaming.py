@@ -74,7 +74,8 @@ def _sample_mask(rng_seed: int, start: int, n: int, rate: float,
     if rate >= 1.0:
         return np.ones(n, bool)
     from shifu_tpu.processor.chunking import splitmix64_uniform
-    m = splitmix64_uniform(start, n, rng_seed) < rate
+    m = splitmix64_uniform(start, n, rng_seed,
+                           purpose="stats-sample") < rate
     if keep_pos is not None:
         m |= keep_pos
     return m
@@ -217,8 +218,12 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
     for dset in _chunk_datasets(ctx, ccs, chunk_rows, seed):
         v = dset.numeric.astype(np.float64)
         ok = ~np.isnan(v)
-        vq = np.where(ok, v, A["min"][None, :])   # NaN→any valid value;
-        idx = np.clip(((vq - A["min"][None, :]) / span[None, :]
+        # all-missing columns leave A["min"] at +inf — substitute a
+        # finite base so inf-inf can't NaN into the int cast (those
+        # rows are masked out of the bincount anyway)
+        fmin = np.where(np.isfinite(A["min"]), A["min"], 0.0)
+        vq = np.where(ok, v, fmin[None, :])
+        idx = np.clip(((vq - fmin[None, :]) / span[None, :]
                        * FINE_BINS).astype(np.int64), 0, FINE_BINS - 1)
         pos = dset.tags > 0.5
         w = dset.weights.astype(np.float64)

@@ -46,6 +46,13 @@ from shifu_tpu.train.trainer import train_nn
 log = logging.getLogger("shifu_tpu")
 
 
+def analysis_frame(ctx):
+    from shifu_tpu.processor.chunking import analysis_frame as af
+    return af(ctx, log=log)
+
+
+
+
 def run(ctx: ProcessorContext, recursive: int = 0, seed: int = 12306) -> int:
     t0 = time.time()
     mc = ctx.model_config
@@ -168,7 +175,8 @@ def _filter_by_sensitivity(ctx: ProcessorContext,
     ctx.save_column_configs()
 
     cols = [c for c in candidates]
-    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols)
+    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols,
+                                              df=analysis_frame(ctx))
     # *_INDEX families route categoricals to the embedding-index block,
     # which the sensitivity MLP can't see — normalize with the dense
     # equivalent family so every candidate lands in the dense matrix
@@ -243,7 +251,8 @@ def _dense_candidate_matrix(ctx: ProcessorContext,
     for cc in candidates:
         cc.finalSelect = True
     dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs,
-                                              candidates)
+                                              candidates,
+                                              df=analysis_frame(ctx))
     import copy as _copy
     from shifu_tpu.config.model_config import NormType
     sens_mc = mc

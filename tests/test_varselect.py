@@ -125,3 +125,35 @@ def test_fi_filter_with_gbt(tmp_path, rng):
     sel = {c.columnName for c in ccs if c.finalSelect}
     assert len(sel) == 4
     assert len(sel & {"num_0", "num_2", "num_4", "cat_0", "cat_1"}) >= 3
+
+
+def test_analysis_sampling_caps_big_sets(tmp_path, rng, monkeypatch):
+    """When the raw set exceeds the analysis streaming threshold,
+    varselect runs on a bounded uniform sample instead of reading the
+    table resident (>RAM safety for the analysis steps)."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import init as init_proc, stats as stats_proc
+    from shifu_tpu.processor import varselect as vs_proc
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=2000)
+    for proc in (init_proc, stats_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    # force the analysis trigger + a small cap
+    monkeypatch.setenv("SHIFU_TPU_ANALYSIS_CHUNK_ROWS", "400")
+    monkeypatch.setenv("SHIFU_TPU_ANALYSIS_MAX_ROWS", "900")
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["varSelect"]["filterBy"] = "SE"
+    mc["varSelect"]["filterNum"] = 4
+    json.dump(mc, open(mcp, "w"))
+    ctx = ProcessorContext.load(root)
+    assert vs_proc.run(ctx) == 0
+    ccs = json.load(open(os.path.join(root, "ColumnConfig.json")))
+    assert sum(1 for c in ccs if c.get("finalSelect")) == 4
+    # the informative columns still win on the sample
+    sel = {c["columnName"] for c in ccs if c.get("finalSelect")}
+    assert "num_0" in sel or "num_2" in sel
