@@ -147,11 +147,13 @@ def splitmix64_uniform(start: int, n: int, seed: int,
     inside the validation region — a selection/validation leak)."""
     import zlib
     # crc32, NOT hash(): python string hashing is randomized per
-    # process (PYTHONHASHSEED) and would desynchronize multi-host runs
-    salt = np.uint64(zlib.crc32(purpose.encode()))
+    # process (PYTHONHASHSEED) and would desynchronize multi-host runs.
+    # Mix in python ints (arbitrary precision) and mask to 64 bits —
+    # numpy scalar uint64 arithmetic warns on the intended wraparound.
+    mixed = ((int(seed) | 1) + zlib.crc32(purpose.encode()) * 0x9E3779B9) \
+        * 0x9E3779B97F4A7C15
     idx = np.arange(start, start + n, dtype=np.uint64)
-    z = idx + (np.uint64(seed | 1) + salt * np.uint64(0x9E3779B9)) \
-        * np.uint64(0x9E3779B97F4A7C15)
+    z = idx + np.uint64(mixed & 0xFFFFFFFFFFFFFFFF)
     z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
     z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
     z = z ^ (z >> np.uint64(31))
