@@ -295,17 +295,14 @@ def _train_dense_streaming(ctx: ProcessorContext,
         raise FileNotFoundError(
             f"streaming layout not found at {path}; run `norm` with "
             "train#trainOnDisk=true so dense.npy/tags.npy are written")
-    dense = np.load(dense_p, mmap_mode="r")
-    tags = np.load(os.path.join(path, "tags.npy"), mmap_mode="r")
-    weights = np.load(os.path.join(path, "weights.npy"), mmap_mode="r")
-    up = np.float32(mc.train.upSampleWeight)
+    from shifu_tpu.train.streaming import mmap_layout, upsampled_weights
+    dense, tags, weights = mmap_layout(path, "dense", "tags", "weights")
 
     def get_chunk(a, b):
         x = np.asarray(dense[a:b], np.float32)
         y = np.asarray(tags[a:b], np.float32)
-        w = np.asarray(weights[a:b], np.float32)
-        if up != 1.0:
-            w = w * np.where(y > 0.5, up, np.float32(1.0))
+        w = upsampled_weights(y, np.asarray(weights[a:b], np.float32),
+                              mc.train.upSampleWeight)
         return x, y, w
 
     alg = mc.train.algorithm

@@ -27,6 +27,8 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
     t0 = time.time()
     mc = ctx.model_config
     path = ctx.path_finder.normalized_data_path()
+    if mc.train.trainOnDisk:
+        return _run_wdl_streaming(ctx, seed)
     if not os.path.exists(os.path.join(path, "data.npz")):
         raise FileNotFoundError(f"normalized data not found at {path}; "
                                 "run `norm` first (WDL needs an *_INDEX "
@@ -81,7 +83,19 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
         (dense[val_mask], idx[val_mask], y[val_mask]),
         w[val_mask], bag_keys, grad_mask)
 
-    spec_meta = {
+    spec_meta = _wdl_spec_meta(mc, spec, meta)
+    for i in range(n_bags):
+        p = jax.tree.map(lambda a, i=i: np.asarray(a[i]), best_params)
+        path = ctx.path_finder.model_path(i, "wdl")
+        ctx.path_finder.ensure(path)
+        save_model(path, "wdl", spec_meta, p)
+    log.info("train[WDL]: %d bag(s), best val %s in %.2fs", n_bags,
+             np.round(np.asarray(best_val), 6).tolist(), time.time() - t0)
+    return None
+
+
+def _wdl_spec_meta(mc, spec, meta):
+    return {
         "kind": "wdl",
         "spec": {"dense_dim": spec.dense_dim, "n_cat": spec.n_cat,
                  "vocab_size": spec.vocab_size,
@@ -95,11 +109,55 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
         "normType": mc.normalize.normType.value,
         "modelSetName": mc.model_set_name,
     }
-    for i in range(n_bags):
-        p = jax.tree.map(lambda a, i=i: np.asarray(a[i]), best_params)
-        path = ctx.path_finder.model_path(i, "wdl")
-        ctx.path_finder.ensure(path)
-        save_model(path, "wdl", spec_meta, p)
-    log.info("train[WDL]: %d bag(s), best val %s in %.2fs", n_bags,
-             np.round(np.asarray(best_val), 6).tolist(), time.time() - t0)
+
+
+def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
+    """train#trainOnDisk for WDL: mmap'd dense + index chunks stream
+    through the shared double-buffered core (the Criteo-scale family
+    IS the >RAM case — reference WDLWorker holds its split in RAM)."""
+    from shifu_tpu.train.streaming import train_wdl_streaming
+    t0 = time.time()
+    mc = ctx.model_config
+    path = ctx.path_finder.normalized_data_path()
+    dense_p = os.path.join(path, "dense.npy")
+    if not os.path.exists(dense_p):
+        raise FileNotFoundError(
+            f"streaming layout not found at {path}; run `norm` with "
+            "train#trainOnDisk=true so dense/index .npy blocks are "
+            "written")
+    if not os.path.exists(os.path.join(path, "index.npy")):
+        # same behavior as the resident path: deep-only model
+        log.warning("WDL without categorical index block — deep-only "
+                    "model")
+    meta = norm_proc.load_normalized_meta(path)
+    from shifu_tpu.train.streaming import mmap_layout, upsampled_weights
+    dense, idx, tags, weights = mmap_layout(path, "dense", "index",
+                                            "tags", "weights")
+
+    def get_chunk(a, b):
+        y = np.asarray(tags[a:b], np.float32)
+        w = upsampled_weights(y, np.asarray(weights[a:b], np.float32),
+                              mc.train.upSampleWeight)
+        i_blk = (np.asarray(idx[a:b], np.int32) if idx is not None
+                 else np.zeros((b - a, 0), np.int32))
+        return (np.asarray(dense[a:b], np.float32), i_blk, y, w)
+
+    vocab = max(meta["indexVocabSizes"], default=1)
+    n_cat = idx.shape[1] if idx is not None else 0
+    spec = wdl.WDLSpec.from_train_params(mc.train.params, dense.shape[1],
+                                         n_cat, vocab)
+    chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
+    n_val = (meta.get("validSplit") or {}).get("nVal")
+    res = train_wdl_streaming(mc.train, get_chunk, len(tags), spec,
+                              seed=seed, chunk_rows=chunk_rows,
+                              n_val=n_val)
+    spec_meta = _wdl_spec_meta(mc, spec, meta)
+    for i, p in enumerate(res.params_per_bag):
+        out = ctx.path_finder.model_path(i, "wdl")
+        ctx.path_finder.ensure(out)
+        save_model(out, "wdl", spec_meta, p)
+    log.info("train[WDL streaming]: %d bag(s), best val %s in %.2fs",
+             len(res.params_per_bag),
+             np.round(np.asarray(res.best_val), 6).tolist(),
+             time.time() - t0)
     return None

@@ -105,9 +105,73 @@ def train_nn_streaming(train_conf: ModelTrainConf,
     (processor/norm.save_normalized) and the trailing block is ≈ a
     random split even on label-sorted input.
     """
-    t0 = time.time()
     spec = spec or nn_mod.MLPSpec.from_train_params(train_conf.params,
                                                     input_dim=input_dim)
+
+    def loss_fn(params, inputs, w, key_):
+        x, y = inputs
+        dkey = key_ if spec.dropout_rate > 0 else None
+        return nn_mod.loss_fn(spec, params, x, y, w, dkey)
+
+    def metric_sum_fn(params, inputs, w):
+        x, y = inputs
+        pred = nn_mod.forward(spec, params, x)
+        if spec.output_dim > 1:
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
+            per = jnp.mean(jnp.square(onehot - pred), axis=-1)
+            return jnp.sum(per * w)
+        return jnp.sum(jnp.square(y - pred) * w)
+
+    def init_fn(k):
+        return nn_mod.init_params(spec, k)
+
+    return train_streaming_core(
+        train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
+        init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
+        init_params=init_params, fixed_layers=fixed_layers, n_val=n_val,
+        spec=spec)
+
+
+def mmap_layout(path: str, *names: str):
+    """Open streaming-layout .npy blocks memory-mapped (norm writes
+    them; one loader shared by the NN/WDL streaming trainers)."""
+    import os
+    out = []
+    for name in names:
+        fp = os.path.join(path, f"{name}.npy")
+        out.append(np.load(fp, mmap_mode="r") if os.path.exists(fp)
+                   else None)
+    return out
+
+
+def upsampled_weights(y: np.ndarray, w: np.ndarray, up) -> np.ndarray:
+    """train#upSampleWeight as weight multiplication (the rebalance
+    semantics every trainer shares)."""
+    up = np.float32(up)
+    if up == 1.0:
+        return w
+    return w * np.where(y > 0.5, up, np.float32(1.0))
+
+
+def train_streaming_core(train_conf: ModelTrainConf,
+                         get_chunk: Callable[[int, int], Tuple],
+                         n_rows: int,
+                         seed: int,
+                         chunk_rows: int,
+                         init_fn,
+                         loss_fn,
+                         metric_sum_fn,
+                         init_params=None,
+                         fixed_layers=None,
+                         n_val: Optional[int] = None,
+                         spec=None) -> TrainResult:
+    """Model-agnostic streaming trainer core (NN/LR/WDL/MTL wrappers
+    feed it their loss): get_chunk(a, b) → (*inputs, w) row-aligned
+    numpy blocks (any number of 1-D/2-D input arrays, weights LAST);
+    loss_fn(params, inputs_tuple, w, key) → scalar weighted-mean loss;
+    metric_sum_fn(params, inputs_tuple, w) → SUM of weighted per-row
+    errors (summed across chunks, normalized by Σw at epoch end)."""
+    t0 = time.time()
     if n_val is None:
         n_val = int(n_rows * max(train_conf.validSetRate, 0.0))
     # (streaming norm records the EXACT trailing-region size in
@@ -130,27 +194,37 @@ def train_nn_streaming(train_conf: ModelTrainConf,
             init_params)
     else:
         bag_keys = jax.random.split(key, n_bags)
-        stacked = jax.vmap(lambda k: nn_mod.init_params(spec, k))(bag_keys)
+        stacked = jax.vmap(init_fn)(bag_keys)
     stacked = mesh_mod.place_replicated(mesh, stacked)
     opt_state = mesh_mod.place_replicated(
         mesh, jax.vmap(optimizer.init)(stacked))
 
     # continuous training's frozen-layer fitting (NNMaster.java:369-379)
-    grad_mask = [
-        {k: jnp.zeros_like(v) if fixed_layers and i in fixed_layers
-         else jnp.ones_like(v) for k, v in layer.items()}
-        for i, layer in enumerate(jax.tree.map(lambda p: p[0], stacked))]
+    def _mask_layer(i, layer):
+        freeze = bool(fixed_layers and i in fixed_layers)
+        return jax.tree.map(
+            lambda v: jnp.zeros_like(v) if freeze else jnp.ones_like(v),
+            layer)
+    one_bag = jax.tree.map(lambda p: p[0], stacked)
+    if isinstance(one_bag, list):
+        grad_mask = [_mask_layer(i, layer)
+                     for i, layer in enumerate(one_bag)]
+    else:
+        # non-list param pytrees (WDL/MTL dicts) have no layer indexing
+        # — fixed_layers does not apply
+        grad_mask = jax.tree.map(jnp.ones_like, one_bag)
     grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
 
     @jax.jit
-    def update(stacked, opt_state, x, y, w_bags, key):
+    def update(stacked, opt_state, *chunk_and_key):
         """One chunk's SGD step for every bag at once (vmap over the
         bag axis = the reference's ≤5 parallel bagging jobs)."""
+        *inputs, w_bags, key_ = chunk_and_key
+        inputs = tuple(inputs)
 
         def one(params, o_state, w):
-            dkey = key if spec.dropout_rate > 0 else None
             loss, grads = jax.value_and_grad(
-                lambda p: nn_mod.loss_fn(spec, p, x, y, w, dkey))(params)
+                lambda p: loss_fn(p, inputs, w, key_))(params)
             grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
             updates, o2 = optimizer.update(grads, o_state, params)
             # per-bag chunk weight: the epoch loss must weight chunks
@@ -161,14 +235,12 @@ def train_nn_streaming(train_conf: ModelTrainConf,
         return jax.vmap(one)(stacked, opt_state, w_bags)
 
     @jax.jit
-    def val_chunk_err(stacked, x, y, w):
+    def val_chunk_err(stacked, *chunk):
+        *inputs, w = chunk
+        inputs = tuple(inputs)
+
         def one(params):
-            pred = nn_mod.forward(spec, params, x)
-            if spec.output_dim > 1:
-                onehot = jax.nn.one_hot(y.astype(jnp.int32), spec.output_dim)
-                per = jnp.mean(jnp.square(onehot - pred), axis=-1)
-                return jnp.sum(per * w)
-            return jnp.sum(jnp.square(y - pred) * w)
+            return metric_sum_fn(params, inputs, w)
         return jax.vmap(one)(stacked), jnp.sum(w)
 
     def chunk_bounds(lo, hi):
@@ -185,10 +257,19 @@ def train_nn_streaming(train_conf: ModelTrainConf,
                                   train_conf.baggingWithReplacement,
                                   seed, a, b)
 
+    def _pad_rows(arr, pad):
+        arr = np.ascontiguousarray(arr)
+        if not pad:
+            return arr
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, widths)
+
     def put(bounds, with_bags: bool):
         """Fetch this process's slice of the chunk and place it
         row-sharded on the mesh; device transfer is dispatched
-        immediately so it overlaps the previous chunk's compute."""
+        immediately so it overlaps the previous chunk's compute.
+        get_chunk returns (*inputs, w): every array row-aligned,
+        weights last."""
         a, b = bounds
         rows = b - a
         if n_proc > 1:
@@ -203,40 +284,30 @@ def train_nn_streaming(train_conf: ModelTrainConf,
             per = -(-per // ld) * ld
             lo = min(a + proc * per, b)
             hi = min(lo + per, b)
-            x, y, w = get_chunk(lo, hi)
+            *inputs, w = get_chunk(lo, hi)
             pad = per - (hi - lo)
-            if pad:
-                x = np.pad(np.ascontiguousarray(x), ((0, pad), (0, 0)))
-                y = np.pad(np.ascontiguousarray(y), (0, pad))
-                w = np.pad(np.ascontiguousarray(w), (0, pad))
-            else:
-                x = np.ascontiguousarray(x)
-                y = np.ascontiguousarray(y)
-                w = np.ascontiguousarray(w)
+            inputs = [_pad_rows(x, pad) for x in inputs]
+            w = _pad_rows(w, pad)
 
             def assemble(arr, spec):
                 return jax.make_array_from_process_local_data(
                     NamedSharding(mesh, spec), arr)
 
-            dx = assemble(x, P("data", None))
-            dy = assemble(y, P("data"))
+            placed = [assemble(x, P("data", *([None] * (x.ndim - 1))))
+                      for x in inputs]
             if with_bags:
                 bw = chunk_bags(a, b)[:, lo - a:hi - a]
                 bw = np.pad(bw, ((0, 0), (0, pad))) * w[None, :]
-                return dx, dy, assemble(bw, P(None, "data"))
-            return dx, dy, assemble(w, P("data"))
-        x, y, w = get_chunk(a, b)
-        x = np.ascontiguousarray(x)
-        y = np.ascontiguousarray(y)
+                return (*placed, assemble(bw, P(None, "data")))
+            return (*placed, assemble(w, P("data")))
+        *inputs, w = get_chunk(a, b)
+        inputs = [np.ascontiguousarray(x) for x in inputs]
         w = np.ascontiguousarray(w)
+        placed = [mesh_mod.shard_axis(mesh, x, 0) for x in inputs]
         if with_bags:
             bw = chunk_bags(a, b) * w[None, :]
-            return (mesh_mod.shard_axis(mesh, x, 0),
-                    mesh_mod.shard_axis(mesh, y, 0),
-                    mesh_mod.shard_axis(mesh, bw, axis=1))
-        return (mesh_mod.shard_axis(mesh, x, 0),
-                mesh_mod.shard_axis(mesh, y, 0),
-                mesh_mod.shard_axis(mesh, w, 0))
+            return (*placed, mesh_mod.shard_axis(mesh, bw, axis=1))
+        return (*placed, mesh_mod.shard_axis(mesh, w, 0))
 
     best = jax.tree.map(lambda p: p, stacked)
     best_val = np.full(n_bags, np.inf, np.float32)
@@ -263,8 +334,8 @@ def train_nn_streaming(train_conf: ModelTrainConf,
             cur = nxt
             if ci + 1 < len(order):
                 nxt = put(train_chunks[order[ci + 1]], True)  # prefetch
-            stacked, opt_state, loss, sw = update(stacked, opt_state, *cur,
-                                                  sub)
+            stacked, opt_state, loss, sw = update(stacked, opt_state,
+                                                  *cur, sub)
             sw = np.asarray(sw, np.float64)
             epoch_loss += np.asarray(loss, np.float64) * sw
             epoch_w += sw
@@ -325,3 +396,34 @@ def train_nn_streaming(train_conf: ModelTrainConf,
              mesh.devices.size, np.round(best_val, 6).tolist(),
              res.wall_seconds)
     return res
+
+
+def train_wdl_streaming(train_conf: ModelTrainConf,
+                        get_chunk: Callable[[int, int], Tuple],
+                        n_rows: int,
+                        spec,
+                        seed: int = 12306,
+                        chunk_rows: int = 262_144,
+                        n_val: Optional[int] = None) -> TrainResult:
+    """Streaming wide-and-deep training (the Criteo-scale family IS the
+    >RAM case): get_chunk(a, b) → (dense, idx, y, w). Same chunked
+    double-buffered core as NN — embedding/wide tables replicate,
+    row chunks shard, gradients psum."""
+    from shifu_tpu.models import wdl as wdl_mod
+
+    def loss_fn(params, inputs, w, key_):
+        dense, idx, y = inputs
+        return wdl_mod.loss_fn(spec, params, dense, idx, y, w)
+
+    def metric_sum_fn(params, inputs, w):
+        dense, idx, y = inputs
+        pred = wdl_mod.forward(spec, params, dense, idx)
+        return jnp.sum(jnp.square(y - pred) * w)
+
+    def init_fn(k):
+        return wdl_mod.init_params(spec, k)
+
+    return train_streaming_core(
+        train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
+        init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
+        n_val=n_val, spec=spec)

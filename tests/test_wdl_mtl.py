@@ -126,3 +126,37 @@ def test_full_pipeline_mtl(tmp_path, rng):
     from shifu_tpu.ops.metrics import auc
     a0 = float(auc(jnp.asarray(scores[:, 0]), jnp.asarray(data["tags"])))
     assert a0 > 0.8
+
+
+def test_wdl_streaming_train_on_disk(tmp_path, rng):
+    """train#trainOnDisk routes WDL through the chunk-streamed core
+    (mmap'd dense + embedding-index blocks; Criteo-scale analog)."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (eval as eval_proc, init as init_proc,
+                                     norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=2500, algorithm="WDL",
+                          norm_type="ZSCALE_INDEX",
+                          train_params={"NumHiddenNodes": [8],
+                                        "ActivationFunc": ["relu"],
+                                        "EmbedSize": 4,
+                                        "LearningRate": 0.05,
+                                        "Propagation": "ADAM",
+                                        "ChunkRows": 500})
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["train"]["trainOnDisk"] = True
+    mc["train"]["numTrainEpochs"] = 30
+    json.dump(mc, open(mcp, "w"))
+    for proc in (init_proc, stats_proc, norm_proc, train_proc, eval_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    models = os.listdir(ctx.path_finder.models_path())
+    assert models == ["model0.wdl"]
+    perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
+    assert perf["areaUnderRoc"] > 0.85, perf["areaUnderRoc"]
