@@ -199,6 +199,10 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
             # tree trainers also stream the categorical code block
             np.save(os.path.join(path, "index.npy"),
                     np.ascontiguousarray(index.astype(np.int32)))
+        if task_tags is not None and task_tags.size:
+            # MTL streams its (R, T) per-task tag block too
+            np.save(os.path.join(path, "task_tags.npy"),
+                    np.ascontiguousarray(task_tags.astype(np.float32)))
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,

@@ -138,6 +138,7 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
     k_index = probe_norm.index.shape[1] if probe_norm.index_names else 0
     c_numeric = probe.numeric.shape[1]
     c_codes = probe.cat_codes.shape[1]
+    n_tasks = probe.task_tags.shape[1] if probe.task_tags.size else 0
     vlen = np.asarray([len(v) for v in probe.vocabs], np.int32) \
         if c_codes else np.zeros(0, np.int32)
 
@@ -158,6 +159,9 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
                  ("weights.npy", (n_rows,), np.float32)]
     if k_index:
         norm_spec.append(("index.npy", (n_rows, k_index), np.int32))
+    if n_tasks:
+        # MTL's (R, T) per-task tag block streams too
+        norm_spec.append(("task_tags.npy", (n_rows, n_tasks), np.float32))
     clean_spec = [("dense.npy", (n_rows, c_numeric), np.float32),
                   ("tags.npy", (n_rows,), np.float32),
                   ("weights.npy", (n_rows,), np.float32)]
@@ -194,6 +198,8 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
                     dset.weights.astype(np.float32)]
         if k_index:
             blocks_n.append(result.index.astype(np.int32))
+        if n_tasks:
+            blocks_n.append(dset.task_tags.astype(np.float32))
         wn.write(blocks_n, vf)
         if c_codes:
             codes = np.where(dset.cat_codes < 0, vlen[None, :],

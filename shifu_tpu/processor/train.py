@@ -295,7 +295,9 @@ def _train_dense_streaming(ctx: ProcessorContext,
         raise FileNotFoundError(
             f"streaming layout not found at {path}; run `norm` with "
             "train#trainOnDisk=true so dense.npy/tags.npy are written")
-    from shifu_tpu.train.streaming import mmap_layout, upsampled_weights
+    from shifu_tpu.train.streaming import (mmap_layout,
+                                           streaming_train_args,
+                                           upsampled_weights)
     dense, tags, weights = mmap_layout(path, "dense", "tags", "weights")
 
     def get_chunk(a, b):
@@ -315,9 +317,8 @@ def _train_dense_streaming(ctx: ProcessorContext,
     init_params, fixed = _continuous_init(
         ctx, spec or nn_mod.MLPSpec.from_train_params(mc.train.params,
                                                       dense.shape[1]))
-    chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
     meta = norm_proc.load_normalized_meta(path)
-    n_val = (meta.get("validSplit") or {}).get("nVal")
+    chunk_rows, n_val = streaming_train_args(mc, meta)
     res = train_nn_streaming(mc.train, get_chunk, len(tags), dense.shape[1],
                              seed=seed, spec=spec, chunk_rows=chunk_rows,
                              n_val=n_val,

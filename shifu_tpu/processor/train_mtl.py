@@ -62,6 +62,8 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
     t0 = time.time()
     mc = ctx.model_config
     path = ctx.path_finder.normalized_data_path()
+    if mc.train.trainOnDisk:
+        return _run_mtl_streaming(ctx, seed)
     if not os.path.exists(os.path.join(path, "data.npz")):
         raise FileNotFoundError(f"normalized data not found at {path}; "
                                 "run `norm` first")
@@ -111,7 +113,20 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
         (dense[val_mask], y[val_mask]),
         w[val_mask], bag_keys, grad_mask)
 
-    spec_meta = {
+    spec_meta = _mtl_spec_meta(mc, spec, names, meta)
+    for i in range(n_bags):
+        p = jax.tree.map(lambda a, i=i: np.asarray(a[i]), best_params)
+        mpath = ctx.path_finder.model_path(i, "mtl")
+        ctx.path_finder.ensure(mpath)
+        save_model(mpath, "mtl", spec_meta, p)
+    log.info("train[MTL]: %d tasks, %d bag(s), best val %s in %.2fs",
+             len(names), n_bags, np.round(np.asarray(best_val), 6).tolist(),
+             time.time() - t0)
+    return None
+
+
+def _mtl_spec_meta(mc, spec, names, meta):
+    return {
         "kind": "mtl",
         "spec": {"input_dim": spec.input_dim, "n_tasks": spec.n_tasks,
                  "hidden_dims": list(spec.hidden_dims),
@@ -120,12 +135,73 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
         "normType": mc.normalize.normType.value,
         "modelSetName": mc.model_set_name,
     }
-    for i in range(n_bags):
-        p = jax.tree.map(lambda a, i=i: np.asarray(a[i]), best_params)
-        mpath = ctx.path_finder.model_path(i, "mtl")
-        ctx.path_finder.ensure(mpath)
-        save_model(mpath, "mtl", spec_meta, p)
-    log.info("train[MTL]: %d tasks, %d bag(s), best val %s in %.2fs",
-             len(names), n_bags, np.round(np.asarray(best_val), 6).tolist(),
+
+
+def _run_mtl_streaming(ctx: ProcessorContext, seed: int):
+    """train#trainOnDisk for MTL: mmap'd dense + (R, T) task-tag
+    chunks through the shared streaming core."""
+    from shifu_tpu.train.streaming import (mmap_layout,
+                                           streaming_train_args,
+                                           train_streaming_core,
+                                           upsampled_weights)
+    t0 = time.time()
+    mc = ctx.model_config
+    path = ctx.path_finder.normalized_data_path()
+    dense, task_tags, weights = mmap_layout(
+        path, "dense", "task_tags", "weights")
+    if dense is None:
+        raise FileNotFoundError(
+            f"streaming layout not found at {path}; run `norm` with "
+            "train#trainOnDisk=true")
+    if task_tags is None:
+        raise FileNotFoundError(
+            "MTL needs the task_tags block; re-run `norm` (multi-task "
+            "targetColumnName) with train#trainOnDisk=true")
+    meta = norm_proc.load_normalized_meta(path)
+    names = task_names(mc)
+    spec = mtl.MTLSpec.from_train_params(mc.train.params, dense.shape[1],
+                                         len(names))
+
+    def get_chunk(a, b):
+        y = np.asarray(task_tags[a:b], np.float32)
+        w = upsampled_weights(y[:, 0],
+                              np.asarray(weights[a:b], np.float32),
+                              mc.train.upSampleWeight)
+        return (np.asarray(dense[a:b], np.float32), y, w)
+
+    def loss_fn(params, inputs, w_, key_):
+        x_, y_ = inputs
+        return mtl.loss_fn(spec, params, x_, y_, w_)
+
+    def metric_sum_fn(params, inputs, w_):
+        # mtl.mse's numerator (masked weighted error SUM) — the core
+        # divides by the accumulated valid-mass, so chunks with uneven
+        # labeled fractions can't bias the epoch metric vs resident
+        x_, y_ = inputs
+        p = mtl.forward(spec, params, x_)
+        valid = ~jnp.isnan(y_)
+        err = jnp.where(valid, jnp.square(jnp.where(valid, y_, 0.0) - p),
+                        0.0)
+        return jnp.sum(err * w_[:, None])
+
+    def metric_mass_fn(inputs, w_):
+        _, y_ = inputs
+        return jnp.sum((~jnp.isnan(y_)) * w_[:, None])
+
+    chunk_rows, n_val = streaming_train_args(mc, meta)
+    res = train_streaming_core(
+        mc.train, get_chunk, len(weights), seed=seed,
+        chunk_rows=chunk_rows,
+        init_fn=lambda k: mtl.init_params(spec, k),
+        loss_fn=loss_fn, metric_sum_fn=metric_sum_fn, n_val=n_val,
+        spec=spec, metric_mass_fn=metric_mass_fn)
+    spec_meta = _mtl_spec_meta(mc, spec, names, meta)
+    for i, p in enumerate(res.params_per_bag):
+        out = ctx.path_finder.model_path(i, "mtl")
+        ctx.path_finder.ensure(out)
+        save_model(out, "mtl", spec_meta, p)
+    log.info("train[MTL streaming]: %d tasks, %d bag(s), best val %s "
+             "in %.2fs", len(names), len(res.params_per_bag),
+             np.round(np.asarray(res.best_val), 6).tolist(),
              time.time() - t0)
     return None

@@ -130,7 +130,9 @@ def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
         log.warning("WDL without categorical index block — deep-only "
                     "model")
     meta = norm_proc.load_normalized_meta(path)
-    from shifu_tpu.train.streaming import mmap_layout, upsampled_weights
+    from shifu_tpu.train.streaming import (mmap_layout,
+                                           streaming_train_args,
+                                           upsampled_weights)
     dense, idx, tags, weights = mmap_layout(path, "dense", "index",
                                             "tags", "weights")
 
@@ -146,8 +148,7 @@ def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
     n_cat = idx.shape[1] if idx is not None else 0
     spec = wdl.WDLSpec.from_train_params(mc.train.params, dense.shape[1],
                                          n_cat, vocab)
-    chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
-    n_val = (meta.get("validSplit") or {}).get("nVal")
+    chunk_rows, n_val = streaming_train_args(mc, meta)
     res = train_wdl_streaming(mc.train, get_chunk, len(tags), spec,
                               seed=seed, chunk_rows=chunk_rows,
                               n_val=n_val)

@@ -164,13 +164,17 @@ def train_streaming_core(train_conf: ModelTrainConf,
                          init_params=None,
                          fixed_layers=None,
                          n_val: Optional[int] = None,
-                         spec=None) -> TrainResult:
+                         spec=None,
+                         metric_mass_fn=None) -> TrainResult:
     """Model-agnostic streaming trainer core (NN/LR/WDL/MTL wrappers
     feed it their loss): get_chunk(a, b) → (*inputs, w) row-aligned
     numpy blocks (any number of 1-D/2-D input arrays, weights LAST);
     loss_fn(params, inputs_tuple, w, key) → scalar weighted-mean loss;
     metric_sum_fn(params, inputs_tuple, w) → SUM of weighted per-row
-    errors (summed across chunks, normalized by Σw at epoch end)."""
+    errors (summed across chunks, normalized at epoch end by the sum of
+    metric_mass_fn(inputs, w) — default Σw; models with per-cell
+    validity masks, e.g. MTL NaN-labeled tasks, pass the matching
+    valid-mass so the streamed metric equals the resident one)."""
     t0 = time.time()
     if n_val is None:
         n_val = int(n_rows * max(train_conf.validSetRate, 0.0))
@@ -234,6 +238,10 @@ def train_streaming_core(train_conf: ModelTrainConf,
 
         return jax.vmap(one)(stacked, opt_state, w_bags)
 
+    if metric_mass_fn is None:
+        def metric_mass_fn(inputs, w):
+            return jnp.sum(w)
+
     @jax.jit
     def val_chunk_err(stacked, *chunk):
         *inputs, w = chunk
@@ -241,7 +249,7 @@ def train_streaming_core(train_conf: ModelTrainConf,
 
         def one(params):
             return metric_sum_fn(params, inputs, w)
-        return jax.vmap(one)(stacked), jnp.sum(w)
+        return jax.vmap(one)(stacked), metric_mass_fn(inputs, w)
 
     def chunk_bounds(lo, hi):
         starts = list(range(lo, hi, chunk_rows))
@@ -427,3 +435,12 @@ def train_wdl_streaming(train_conf: ModelTrainConf,
         train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
         init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
         n_val=n_val, spec=spec)
+
+
+def streaming_train_args(mc, meta):
+    """(chunk_rows, n_val) for a streaming trainer from the train
+    params + the norm layout's recorded split — one definition for the
+    NN/WDL/MTL wrappers."""
+    chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
+    n_val = (meta.get("validSplit") or {}).get("nVal")
+    return chunk_rows, n_val

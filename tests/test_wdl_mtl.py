@@ -160,3 +160,41 @@ def test_wdl_streaming_train_on_disk(tmp_path, rng):
     assert models == ["model0.wdl"]
     perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
     assert perf["areaUnderRoc"] > 0.85, perf["areaUnderRoc"]
+
+
+def test_mtl_streaming_train_on_disk(tmp_path, rng):
+    """train#trainOnDisk routes MTL through the streaming core with the
+    (R, T) task-tag block persisted in the mmap layout."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (init as init_proc, norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=2500, algorithm="MTL",
+                          train_params={"NumHiddenNodes": [8],
+                                        "ActivationFunc": ["relu"],
+                                        "LearningRate": 0.05,
+                                        "Propagation": "ADAM",
+                                        "ChunkRows": 500})
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    # two tasks over the same synthetic label (the second task is the
+    # first's complement column; synth writes a single diagnosis column,
+    # so duplicate it as task 2)
+    mc["dataSet"]["targetColumnName"] = "diagnosis|diagnosis"
+    mc["train"]["trainOnDisk"] = True
+    mc["train"]["numTrainEpochs"] = 25
+    json.dump(mc, open(mcp, "w"))
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    nd = ctx.path_finder.normalized_data_path()
+    assert os.path.exists(os.path.join(nd, "task_tags.npy"))
+    models = os.listdir(ctx.path_finder.models_path())
+    assert models == ["model0.mtl"]
+    from shifu_tpu.models.spec import load_model
+    kind, meta2, params = load_model(ctx.path_finder.model_path(0, "mtl"))
+    assert kind == "mtl" and meta2["spec"]["n_tasks"] == 2
