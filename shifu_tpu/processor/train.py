@@ -319,9 +319,14 @@ def _train_dense_streaming(ctx: ProcessorContext,
                                                       dense.shape[1]))
     meta = norm_proc.load_normalized_meta(path)
     chunk_rows, n_val = streaming_train_args(mc, meta)
+    ck_int = int(mc.train.get_param("CheckpointInterval", 0) or 0)
     res = train_nn_streaming(mc.train, get_chunk, len(tags), dense.shape[1],
                              seed=seed, spec=spec, chunk_rows=chunk_rows,
                              n_val=n_val,
+                             checkpoint_dir=(os.path.join(
+                                 ctx.path_finder.checkpoint_path(0),
+                                 "streaming") if ck_int else None),
+                             checkpoint_interval=ck_int,
                              init_params=(jax.tree.map(jnp.asarray,
                                                        init_params)
                                           if init_params is not None

@@ -92,7 +92,9 @@ def train_nn_streaming(train_conf: ModelTrainConf,
                        chunk_rows: int = 262_144,
                        init_params=None,
                        fixed_layers=None,
-                       n_val: Optional[int] = None) -> TrainResult:
+                       n_val: Optional[int] = None,
+                       checkpoint_dir: Optional[str] = None,
+                       checkpoint_interval: int = 0) -> TrainResult:
     """Train `baggingNum` NN/LR models by streaming row chunks.
 
     get_chunk(start, stop) → (x, y, w) numpy slices — typically views of
@@ -129,7 +131,8 @@ def train_nn_streaming(train_conf: ModelTrainConf,
         train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
         init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
         init_params=init_params, fixed_layers=fixed_layers, n_val=n_val,
-        spec=spec)
+        spec=spec, checkpoint_dir=checkpoint_dir,
+        checkpoint_interval=checkpoint_interval)
 
 
 def mmap_layout(path: str, *names: str):
@@ -165,7 +168,9 @@ def train_streaming_core(train_conf: ModelTrainConf,
                          fixed_layers=None,
                          n_val: Optional[int] = None,
                          spec=None,
-                         metric_mass_fn=None) -> TrainResult:
+                         metric_mass_fn=None,
+                         checkpoint_dir: Optional[str] = None,
+                         checkpoint_interval: int = 0) -> TrainResult:
     """Model-agnostic streaming trainer core (NN/LR/WDL/MTL wrappers
     feed it their loss): get_chunk(a, b) → (*inputs, w) row-aligned
     numpy blocks (any number of 1-D/2-D input arrays, weights LAST);
@@ -325,14 +330,58 @@ def train_streaming_core(train_conf: ModelTrainConf,
     window = train_conf.earlyStoppingRounds or 0
     conv = float(train_conf.convergenceThreshold or 0.0)
     train_errs, val_errs = [], []
-    order_rng = np.random.default_rng(seed ^ 0x5EED)
+    start_epoch = 0
 
-    for epoch in range(train_conf.numTrainEpochs):
-        key, sub = jax.random.split(key)
+    # mid-training fault tolerance for long >RAM runs
+    # (CheckpointInterval; the resident trainer's orbax analog): both
+    # the per-epoch PRNG key and the chunk order derive from the epoch
+    # NUMBER, so a restored run replays the exact schedule
+    if checkpoint_dir and checkpoint_interval > 0:
+        from shifu_tpu.train import checkpoint as ckpt_mod
+        step = ckpt_mod.latest_step(checkpoint_dir)
+        if step is not None and step >= train_conf.numTrainEpochs:
+            # a finished run's leftover (or one from a LARGER epoch
+            # budget): resuming would skip training entirely — start
+            # fresh instead (the resident guard is 0 < last <= epochs;
+            # completed checkpoints are deleted below, so this is the
+            # stale-config case)
+            log.warning("streaming train: ignoring stale checkpoint at "
+                        "epoch %d (numTrainEpochs=%d)", step,
+                        train_conf.numTrainEpochs)
+            step = None
+        if step is not None and step > 0:
+            like = {"stacked": stacked, "opt_state": opt_state,
+                    "best": best, "best_val": best_val,
+                    "best_epoch": best_epoch, "bad": bad,
+                    "stopped": stopped,
+                    "train_errs": np.zeros((step, n_bags), np.float32),
+                    "val_errs": np.zeros((step, n_bags), np.float32)}
+            st = ckpt_mod.restore_state(checkpoint_dir, step, like)
+            stacked = mesh_mod.place_replicated(
+                mesh, jax.tree.map(jnp.asarray, st["stacked"]))
+            opt_state = mesh_mod.place_replicated(
+                mesh, jax.tree.map(jnp.asarray, st["opt_state"]))
+            best = mesh_mod.place_replicated(
+                mesh, jax.tree.map(jnp.asarray, st["best"]))
+            best_val = np.asarray(st["best_val"], np.float32)
+            best_epoch = np.asarray(st["best_epoch"], np.int64)
+            bad = np.asarray(st["bad"], np.int32)
+            stopped = np.asarray(st["stopped"], bool)
+            train_errs = [r for r in np.asarray(st["train_errs"],
+                                                np.float32)]
+            val_errs = [r for r in np.asarray(st["val_errs"], np.float32)]
+            start_epoch = int(step)
+            log.info("streaming train: resumed from checkpoint at "
+                     "epoch %d", start_epoch)
+
+    for epoch in range(start_epoch, train_conf.numTrainEpochs):
+        sub = jax.random.fold_in(key, epoch)
         # per-epoch chunk-order reshuffle: chunked SGD sees a new data
         # order every epoch (the shuffle the reference runs as a
-        # one-time MR job, done for free at the access layer)
-        order = order_rng.permutation(len(train_chunks))
+        # one-time MR job, done for free at the access layer); the
+        # order derives from (seed, epoch) so resumes replay it
+        order = np.random.default_rng(
+            (seed ^ 0x5EED) + epoch).permutation(len(train_chunks))
         epoch_loss = np.zeros(n_bags, np.float64)
         epoch_w = np.zeros(n_bags, np.float64)
         nxt = put(train_chunks[order[0]], True)
@@ -385,10 +434,27 @@ def train_streaming_core(train_conf: ModelTrainConf,
         bad = np.where(stopped, bad, np.where(improved, 0, bad + 1))
         stopped |= (window > 0) & (bad >= window)
         stopped |= (conv > 0) & (train_err <= conv)
+        if checkpoint_dir and checkpoint_interval > 0 and \
+                (epoch + 1) % checkpoint_interval == 0 and proc == 0:
+            # host-0 only: every process holds identical (replicated)
+            # state, and concurrent rmtree/os.replace on a shared
+            # checkpoint dir would race
+            from shifu_tpu.train import checkpoint as ckpt_mod
+            ckpt_mod.save_state(checkpoint_dir, epoch + 1, {
+                "stacked": stacked, "opt_state": opt_state, "best": best,
+                "best_val": best_val, "best_epoch": best_epoch,
+                "bad": bad, "stopped": stopped,
+                "train_errs": np.stack(train_errs),
+                "val_errs": np.stack(val_errs)})
         if stopped.all():
             log.info("streaming train: all bags stopped at epoch %d", epoch)
             break
 
+    if checkpoint_dir and checkpoint_interval > 0 and proc == 0:
+        # training completed — a leftover checkpoint would make the
+        # NEXT fresh run silently resume past its epoch budget
+        import shutil as _shutil
+        _shutil.rmtree(checkpoint_dir, ignore_errors=True)
     host = [jax.tree.map(lambda p, i=i: np.asarray(p[i]), best)
             for i in range(n_bags)]
     res = TrainResult(
