@@ -18,7 +18,7 @@ import numpy as np
 
 
 def format_block(columns: Sequence[np.ndarray],
-                 fmts: Sequence[str]) -> str:
+                 fmts: Sequence[str], sep: str = ",") -> str:
     """Render equal-length 1-D columns into CSV text (no header).
     fmt "%s" passes values through `astype(str)`; anything else goes
     through np.char.mod (C-level printf). Row assembly goes through
@@ -38,17 +38,18 @@ def format_block(columns: Sequence[np.ndarray],
             parts.append(np.char.mod(fmt, a))
     buf = io.StringIO()
     pd.DataFrame({i: p for i, p in enumerate(parts)}).to_csv(
-        buf, header=False, index=False, quoting=csv.QUOTE_NONE)
+        buf, header=False, index=False, quoting=csv.QUOTE_NONE, sep=sep)
     return buf.getvalue().rstrip("\n")
 
 
 def write_rows(f: IO[str], columns: Sequence[np.ndarray],
-               fmts: Sequence[str], chunk_rows: int = 1_000_000) -> None:
+               fmts: Sequence[str], chunk_rows: int = 1_000_000,
+               sep: str = ",") -> None:
     """Append formatted rows to an open file, chunked."""
     n = len(columns[0])
     for a in range(0, n, chunk_rows):
         b = min(a + chunk_rows, n)
-        block = format_block([c[a:b] for c in columns], fmts)
+        block = format_block([c[a:b] for c in columns], fmts, sep=sep)
         if block:
             f.write(block + "\n")
 

@@ -49,7 +49,9 @@ def run(ctx: ProcessorContext) -> int:
     mc = ctx.model_config
     ctx.require_columns()
     cols = norm_proc.selected_candidates(ctx.column_configs)
-    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols)
+    from shifu_tpu.processor.chunking import analysis_frame
+    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols,
+                                              df=analysis_frame(ctx, log=log))
 
     # numeric raw values + categorical posRate encodings, like
     # NormPearson mode correlating normalized values

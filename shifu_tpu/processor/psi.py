@@ -36,7 +36,10 @@ def run(ctx: ProcessorContext) -> int:
                          "cohort column (e.g. a month field) to compute PSI")
 
     cols = norm_proc.selected_candidates(ctx.column_configs)
-    df = read_raw_table(mc)
+    from shifu_tpu.processor.chunking import analysis_frame
+    df = analysis_frame(ctx, log=log)
+    if df is None:
+        df = read_raw_table(mc)
     if mc.dataSet.filterExpressions:
         from shifu_tpu.data.purifier import DataPurifier
         keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)

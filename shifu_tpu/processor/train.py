@@ -318,14 +318,14 @@ def _train_dense_streaming(ctx: ProcessorContext,
         ctx, spec or nn_mod.MLPSpec.from_train_params(mc.train.params,
                                                       dense.shape[1]))
     meta = norm_proc.load_normalized_meta(path)
+    from shifu_tpu.train.streaming import (checkpoint_args,
+                                           cleanup_checkpoints)
     chunk_rows, n_val = streaming_train_args(mc, meta)
-    ck_int = int(mc.train.get_param("CheckpointInterval", 0) or 0)
+    ck_dir, ck_int = checkpoint_args(mc, ctx, "streaming")
     res = train_nn_streaming(mc.train, get_chunk, len(tags), dense.shape[1],
                              seed=seed, spec=spec, chunk_rows=chunk_rows,
                              n_val=n_val,
-                             checkpoint_dir=(os.path.join(
-                                 ctx.path_finder.checkpoint_path(0),
-                                 "streaming") if ck_int else None),
+                             checkpoint_dir=ck_dir,
                              checkpoint_interval=ck_int,
                              init_params=(jax.tree.map(jnp.asarray,
                                                        init_params)
@@ -334,6 +334,7 @@ def _train_dense_streaming(ctx: ProcessorContext,
                              fixed_layers=fixed)
     _save_dense_models(ctx, res, alg)
     _write_val_errors(ctx, res)
+    cleanup_checkpoints(ck_dir)
     return [res]
 
 

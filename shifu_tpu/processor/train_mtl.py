@@ -140,7 +140,9 @@ def _mtl_spec_meta(mc, spec, names, meta):
 def _run_mtl_streaming(ctx: ProcessorContext, seed: int):
     """train#trainOnDisk for MTL: mmap'd dense + (R, T) task-tag
     chunks through the shared streaming core."""
-    from shifu_tpu.train.streaming import (mmap_layout,
+    from shifu_tpu.train.streaming import (checkpoint_args,
+                                           cleanup_checkpoints,
+                                           mmap_layout,
                                            streaming_train_args,
                                            train_streaming_core,
                                            upsampled_weights)
@@ -189,17 +191,20 @@ def _run_mtl_streaming(ctx: ProcessorContext, seed: int):
         return jnp.sum((~jnp.isnan(y_)) * w_[:, None])
 
     chunk_rows, n_val = streaming_train_args(mc, meta)
+    ck_dir, ck_int = checkpoint_args(mc, ctx, "streaming-mtl")
     res = train_streaming_core(
         mc.train, get_chunk, len(weights), seed=seed,
         chunk_rows=chunk_rows,
         init_fn=lambda k: mtl.init_params(spec, k),
         loss_fn=loss_fn, metric_sum_fn=metric_sum_fn, n_val=n_val,
-        spec=spec, metric_mass_fn=metric_mass_fn)
+        spec=spec, metric_mass_fn=metric_mass_fn,
+        checkpoint_dir=ck_dir, checkpoint_interval=ck_int)
     spec_meta = _mtl_spec_meta(mc, spec, names, meta)
     for i, p in enumerate(res.params_per_bag):
         out = ctx.path_finder.model_path(i, "mtl")
         ctx.path_finder.ensure(out)
         save_model(out, "mtl", spec_meta, p)
+    cleanup_checkpoints(ck_dir)
     log.info("train[MTL streaming]: %d tasks, %d bag(s), best val %s "
              "in %.2fs", len(names), len(res.params_per_bag),
              np.round(np.asarray(res.best_val), 6).tolist(),

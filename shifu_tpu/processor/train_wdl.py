@@ -130,7 +130,9 @@ def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
         log.warning("WDL without categorical index block — deep-only "
                     "model")
     meta = norm_proc.load_normalized_meta(path)
-    from shifu_tpu.train.streaming import (mmap_layout,
+    from shifu_tpu.train.streaming import (checkpoint_args,
+                                           cleanup_checkpoints,
+                                           mmap_layout,
                                            streaming_train_args,
                                            upsampled_weights)
     dense, idx, tags, weights = mmap_layout(path, "dense", "index",
@@ -149,14 +151,17 @@ def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
     spec = wdl.WDLSpec.from_train_params(mc.train.params, dense.shape[1],
                                          n_cat, vocab)
     chunk_rows, n_val = streaming_train_args(mc, meta)
+    ck_dir, ck_int = checkpoint_args(mc, ctx, "streaming-wdl")
     res = train_wdl_streaming(mc.train, get_chunk, len(tags), spec,
                               seed=seed, chunk_rows=chunk_rows,
-                              n_val=n_val)
+                              n_val=n_val, checkpoint_dir=ck_dir,
+                              checkpoint_interval=ck_int)
     spec_meta = _wdl_spec_meta(mc, spec, meta)
     for i, p in enumerate(res.params_per_bag):
         out = ctx.path_finder.model_path(i, "wdl")
         ctx.path_finder.ensure(out)
         save_model(out, "wdl", spec_meta, p)
+    cleanup_checkpoints(ck_dir)
     log.info("train[WDL streaming]: %d bag(s), best val %s in %.2fs",
              len(res.params_per_bag),
              np.round(np.asarray(res.best_val), 6).tolist(),

@@ -339,14 +339,21 @@ def train_streaming_core(train_conf: ModelTrainConf,
     if checkpoint_dir and checkpoint_interval > 0:
         from shifu_tpu.train import checkpoint as ckpt_mod
         step = ckpt_mod.latest_step(checkpoint_dir)
-        if step is not None and step >= train_conf.numTrainEpochs:
-            # a finished run's leftover (or one from a LARGER epoch
-            # budget): resuming would skip training entirely — start
-            # fresh instead (the resident guard is 0 < last <= epochs;
-            # completed checkpoints are deleted below, so this is the
-            # stale-config case)
-            log.warning("streaming train: ignoring stale checkpoint at "
-                        "epoch %d (numTrainEpochs=%d)", step,
+        if n_proc > 1:
+            # every process must agree on the resume epoch or they
+            # issue different collective counts and deadlock — host 0
+            # (the writer) decides (non-shared checkpoint dirs leave
+            # other hosts empty-handed)
+            from jax.experimental import multihost_utils
+            step = int(multihost_utils.broadcast_one_to_all(
+                np.int64(step if step is not None else -1)))
+            if step < 0:
+                step = None
+        if step is not None and step > train_conf.numTrainEpochs:
+            # a larger previous epoch budget: state beyond this run's
+            # schedule — start fresh (resident guard: 0 < last <= n)
+            log.warning("streaming train: ignoring checkpoint at epoch "
+                        "%d beyond numTrainEpochs=%d", step,
                         train_conf.numTrainEpochs)
             step = None
         if step is not None and step > 0:
@@ -373,6 +380,11 @@ def train_streaming_core(train_conf: ModelTrainConf,
             start_epoch = int(step)
             log.info("streaming train: resumed from checkpoint at "
                      "epoch %d", start_epoch)
+            if stopped.all():
+                # every bag had already early-stopped — the restored
+                # best IS the result; a loop epoch would only waste
+                # compute and append an extra error row
+                start_epoch = train_conf.numTrainEpochs
 
     for epoch in range(start_epoch, train_conf.numTrainEpochs):
         sub = jax.random.fold_in(key, epoch)
@@ -450,11 +462,10 @@ def train_streaming_core(train_conf: ModelTrainConf,
             log.info("streaming train: all bags stopped at epoch %d", epoch)
             break
 
-    if checkpoint_dir and checkpoint_interval > 0 and proc == 0:
-        # training completed — a leftover checkpoint would make the
-        # NEXT fresh run silently resume past its epoch budget
-        import shutil as _shutil
-        _shutil.rmtree(checkpoint_dir, ignore_errors=True)
+    # NB the checkpoint dir is NOT deleted here: the caller removes it
+    # only after the trained models are persisted (a crash between the
+    # final epoch and the model write must stay resumable —
+    # cleanup_checkpoints)
     host = [jax.tree.map(lambda p, i=i: np.asarray(p[i]), best)
             for i in range(n_bags)]
     res = TrainResult(
@@ -478,7 +489,9 @@ def train_wdl_streaming(train_conf: ModelTrainConf,
                         spec,
                         seed: int = 12306,
                         chunk_rows: int = 262_144,
-                        n_val: Optional[int] = None) -> TrainResult:
+                        n_val: Optional[int] = None,
+                        checkpoint_dir: Optional[str] = None,
+                        checkpoint_interval: int = 0) -> TrainResult:
     """Streaming wide-and-deep training (the Criteo-scale family IS the
     >RAM case): get_chunk(a, b) → (dense, idx, y, w). Same chunked
     double-buffered core as NN — embedding/wide tables replicate,
@@ -500,7 +513,8 @@ def train_wdl_streaming(train_conf: ModelTrainConf,
     return train_streaming_core(
         train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
         init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
-        n_val=n_val, spec=spec)
+        n_val=n_val, spec=spec, checkpoint_dir=checkpoint_dir,
+        checkpoint_interval=checkpoint_interval)
 
 
 def streaming_train_args(mc, meta):
@@ -510,3 +524,24 @@ def streaming_train_args(mc, meta):
     chunk_rows = int(mc.train.get_param("ChunkRows", 262_144) or 262_144)
     n_val = (meta.get("validSplit") or {}).get("nVal")
     return chunk_rows, n_val
+
+
+def checkpoint_args(mc, ctx, route: str):
+    """(checkpoint_dir, interval) for a streaming trainer — one rule
+    for the NN/WDL/MTL processors (per-route subdir; None when
+    CheckpointInterval unset)."""
+    import os as _os
+    ck_int = int(mc.train.get_param("CheckpointInterval", 0) or 0)
+    if not ck_int:
+        return None, 0
+    return _os.path.join(ctx.path_finder.checkpoint_path(0), route), ck_int
+
+
+def cleanup_checkpoints(checkpoint_dir: Optional[str]) -> None:
+    """Remove a streaming run's checkpoints AFTER its models are
+    persisted (host 0 only) — a finished run's leftovers must not be
+    resumable into the next fresh run, but deleting before the model
+    write would lose a multi-day run to a crash in between."""
+    import shutil as _shutil
+    if checkpoint_dir and jax.process_index() == 0:
+        _shutil.rmtree(checkpoint_dir, ignore_errors=True)

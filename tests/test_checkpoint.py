@@ -143,6 +143,9 @@ def test_streaming_checkpoint_resume(tmp_path, rng, caplog):
     for pf, pr in zip(full.params_per_bag[0], resumed.params_per_bag[0]):
         for k in pf:
             np.testing.assert_allclose(pf[k], pr[k], rtol=1e-5, atol=1e-6)
-    # completion removed the checkpoint dir — the NEXT fresh run cannot
-    # silently resume a finished run's leftovers
+    # cleanup happens AFTER the caller persists models (processors call
+    # cleanup_checkpoints); until then a crash stays resumable
+    assert os.path.exists(ck)
+    from shifu_tpu.train.streaming import cleanup_checkpoints
+    cleanup_checkpoints(ck)
     assert not os.path.exists(ck)
