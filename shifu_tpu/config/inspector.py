@@ -266,6 +266,10 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
         if t.isContinuous:
             r.fail("k-fold cross validation cannot be combined with "
                    "isContinuous")
+        if t.trainOnDisk:
+            r.fail("train#numKFold is not supported with trainOnDisk "
+                   "(the streaming layout has one fixed validation "
+                   "region) — run k-fold resident or use validSetRate")
         if t.numKFold > 20:
             r.fail(f"train#numKFold must be <= 20, got {t.numKFold}")
     from shifu_tpu.train.grid_search import expand

@@ -105,6 +105,12 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
     # streaming first: loading the npz here would materialize the very
     # table trainOnDisk exists to keep out of RAM
     if mc.train.trainOnDisk and not mc.is_multi_classification:
+        if (mc.train.numKFold or 0) > 1:
+            raise ValueError(
+                "train#numKFold is not supported with trainOnDisk — the "
+                "streaming layout carries one fixed validation region; "
+                "run k-fold resident (drop trainOnDisk) or use "
+                "validSetRate instead")
         return _train_dense_streaming(ctx, seed)
 
     data, meta = _load_dense_training_data(ctx)

@@ -377,3 +377,9 @@ def test_eval_bucket_num_too_small(ms):
     json.dump(raw, open(path, "w"))
     mc = ModelConfig.load(ms)
     assert "performanceBucketNum" in _causes(mc, ModelStep.EVAL)
+
+
+def test_kfold_with_train_on_disk_rejected(ms):
+    assert "trainOnDisk" in _causes(
+        _mc(ms, **{"train.numKFold": 5, "train.trainOnDisk": True}),
+        ModelStep.TRAIN)
