@@ -266,7 +266,9 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
         if t.isContinuous:
             r.fail("k-fold cross validation cannot be combined with "
                    "isContinuous")
-        if t.trainOnDisk:
+        if t.trainOnDisk and not mc.is_multi_classification:
+            # multi-class ignores trainOnDisk (resident route) and
+            # honors k-fold — mirror the runtime guard exactly
             r.fail("train#numKFold is not supported with trainOnDisk "
                    "(the streaming layout has one fixed validation "
                    "region) — run k-fold resident or use validSetRate")
