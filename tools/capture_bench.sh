@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-shot TPU bench capture: probe the backend, run the full ladder
+# (each sub-bench persists to BENCH_LOCAL.jsonl the moment it
+# finishes), and commit whatever new records landed. Safe to re-run;
+# exits nonzero without committing when the tunnel is down.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "[capture] probing backend..."
+if ! timeout 90 python -c "import jax; print('backend:', jax.default_backend())"; then
+    echo "[capture] backend unreachable — not running the ladder"
+    exit 1
+fi
+
+before=$(wc -l < BENCH_LOCAL.jsonl 2>/dev/null || echo 0)
+echo "[capture] running bench ladder (records persist as they land)..."
+python bench.py || true
+after=$(wc -l < BENCH_LOCAL.jsonl 2>/dev/null || echo 0)
+
+if [ "$after" -gt "$before" ]; then
+    echo "[capture] $((after - before)) new record(s) — committing"
+    git add BENCH_LOCAL.jsonl
+    git commit -m "Capture TPU bench records ($((after - before)) new in BENCH_LOCAL.jsonl)
+
+No-Verification-Needed: measurement-data-only commit (BENCH_LOCAL.jsonl)"
+else
+    echo "[capture] no new records persisted"
+    exit 1
+fi
