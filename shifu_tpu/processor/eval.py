@@ -105,12 +105,15 @@ def _score_dataset(mc: ModelConfig, scorer: Scorer, dset, cols):
 
 
 def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,
-                        df=None, apply_filter: bool = True):
+                        df=None, apply_filter: bool = True,
+                        want_meta: bool = True):
     """Build the (chunk of the) eval set as a ColumnarDataset; returns
     (dataset, selected-candidate cols) for _score_dataset.
     `apply_filter=False` for callers that already ran the purifier on
     `df` (the audit head-read) — re-filtering is idempotent but wasted
-    work."""
+    work. `want_meta=False` skips the champion score-meta columns
+    (-norm never writes them, and loading them per chunk would also
+    re-validate the meta file)."""
     mc = ctx.model_config
     ds = effective_dataset_conf(mc, ec)
     cols = norm_proc.selected_candidates(ctx.column_configs)
@@ -118,8 +121,8 @@ def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,
     eval_mc.dataSet = ds
     dset = norm_proc.load_dataset_for_columns(
         eval_mc, ctx.column_configs, cols, ds_conf=ds,
-        extra_columns=score_meta_columns(ctx, ec), df=df,
-        apply_filter=apply_filter)
+        extra_columns=(score_meta_columns(ctx, ec) if want_meta else None),
+        df=df, apply_filter=apply_filter)
     return dset, cols
 
 
@@ -163,34 +166,72 @@ def eval_chunk_rows(ctx: ProcessorContext, ec: EvalConfig) -> int:
 
 def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
     """`shifu eval -norm` — write the eval set's normalized matrix as
-    CSV (`EvalModelProcessor` NORM step / `udf/EvalNormUDF.java`)."""
+    CSV (`EvalModelProcessor` NORM step / `udf/EvalNormUDF.java`).
+    A full-dataset transform: always processed in chunks so >RAM eval
+    sets export with bounded memory (normalization is row-local; all
+    tables come from ColumnConfig)."""
+    from shifu_tpu.data.reader import iter_raw_table
+    from shifu_tpu.eval import csv_out
+
     mc = ctx.model_config
     ctx.require_columns()
     for ec in mc.evals:
         if eval_name is not None and ec.name != eval_name:
             continue
         ds = effective_dataset_conf(mc, ec)
-        cols = norm_proc.selected_candidates(ctx.column_configs)
-        eval_mc = copy.copy(mc)
-        eval_mc.dataSet = ds
-        dset = norm_proc.load_dataset_for_columns(eval_mc, ctx.column_configs,
-                                                  cols, ds_conf=ds)
-        result = norm_proc.normalize_columns(mc, cols, dset)
+        chunk = eval_chunk_rows(ctx, ec)
         out = ctx.path_finder.eval_norm_path(ec.name)
         os.makedirs(os.path.dirname(out), exist_ok=True)
-        from shifu_tpu.eval import csv_out
-        header = ["tag", "weight"] + list(result.dense_names) \
-            + list(result.index_names)
-        columns = [dset.tags.astype(np.int64), dset.weights] \
-            + [result.dense[:, j] for j in range(result.dense.shape[1])] \
-            + [result.index[:, j].astype(np.int64)
-               for j in range(result.index.shape[1] if result.index_names
-                              else 0)]
-        fmts = ["%d", "%.6g"] + ["%.6f"] * result.dense.shape[1] \
-            + ["%d"] * (result.index.shape[1] if result.index_names else 0)
-        csv_out.write_csv(out, header, columns, fmts)
-        log.info("eval[%s] -norm → %s (%d rows)", ec.name, out,
-                 len(dset.tags))
+        n_rows = 0
+
+        def _write_chunk(f, dset, cols, first):
+            result = norm_proc.normalize_columns(mc, cols, dset)
+            if first:
+                f.write(",".join(
+                    ["tag", "weight"] + list(result.dense_names)
+                    + list(result.index_names)) + "\n")
+            k_idx = result.index.shape[1] if result.index_names else 0
+            columns = [dset.tags.astype(np.int64), dset.weights] \
+                + [result.dense[:, j]
+                   for j in range(result.dense.shape[1])] \
+                + [result.index[:, j].astype(np.int64)
+                   for j in range(k_idx)]
+            fmts = ["%d", "%.6g"] + ["%.6f"] * result.dense.shape[1] \
+                + ["%d"] * k_idx
+            csv_out.write_rows(f, columns, fmts)
+            return len(dset.tags)
+
+        with open(out, "w") as f:
+            if not chunk:
+                # resident fast path (native mmap reader) for sets
+                # under the streaming threshold
+                dset, cols = _build_eval_dataset(ctx, ec, want_meta=False)
+                n_rows = _write_chunk(f, dset, cols, True)
+            else:
+                for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk):
+                    dset, cols = _build_eval_dataset(ctx, ec, df=df,
+                                                     want_meta=False)
+                    if not len(dset.tags):
+                        continue
+                    n_rows += _write_chunk(f, dset, cols, n_rows == 0)
+                if n_rows == 0:
+                    # fully-filtered/empty set: still a header-only CSV
+                    # (downstream readers expect the header row); an
+                    # empty frame with the right columns yields the
+                    # output names without reading data
+                    import pandas as pd
+                    from shifu_tpu.data.reader import read_header
+                    hdr = [c for c in read_header(ds, mc.resolve_path)]
+                    from shifu_tpu.data.reader import simple_column_name
+                    simple = [simple_column_name(c) for c in hdr]
+                    names = simple if len(set(simple)) == len(simple) \
+                        else hdr
+                    empty = pd.DataFrame(
+                        {c: pd.Series([], dtype=str) for c in names})
+                    dset, cols = _build_eval_dataset(ctx, ec, df=empty,
+                                                     want_meta=False)
+                    _write_chunk(f, dset, cols, True)
+        log.info("eval[%s] -norm → %s (%d rows)", ec.name, out, n_rows)
     return 0
 
 

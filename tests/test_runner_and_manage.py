@@ -117,3 +117,15 @@ def test_upsample_weight_changes_training(rng, tmp_path):
     train_proc.run(ctx)
     _, _, p2 = load_model(ctx.path_finder.model_path(0, "nn"))
     assert not np.allclose(p1[0]["w"], p2[0]["w"])
+
+
+def test_eval_norm_chunked_matches(trained, monkeypatch):
+    """eval -norm output is identical for any chunking (row-local
+    normalization; >RAM sets export with bounded memory)."""
+    assert cli_main(["--dir", trained, "eval", "-norm"]) == 0
+    ctx = ProcessorContext.load(trained)
+    path = ctx.path_finder.eval_norm_path("Eval1")
+    whole = open(path).read()
+    monkeypatch.setenv("SHIFU_TPU_EVAL_CHUNK_ROWS", "97")
+    assert cli_main(["--dir", trained, "eval", "-norm"]) == 0
+    assert open(path).read() == whole

@@ -19,10 +19,9 @@ after=$(wc -l < BENCH_LOCAL.jsonl 2>/dev/null || echo 0)
 
 if [ "$after" -gt "$before" ]; then
     echo "[capture] $((after - before)) new record(s) — committing"
-    git add BENCH_LOCAL.jsonl
     git commit -m "Capture TPU bench records ($((after - before)) new in BENCH_LOCAL.jsonl)
 
-No-Verification-Needed: measurement-data-only commit (BENCH_LOCAL.jsonl)"
+No-Verification-Needed: measurement-data-only commit (BENCH_LOCAL.jsonl)" -- BENCH_LOCAL.jsonl
 else
     echo "[capture] no new records persisted"
     exit 1
