@@ -81,11 +81,14 @@ HIST_SLOTS = 64
 HIST_REPS = 10
 
 # HIGGS-shape GBT end-to-end train (BASELINE.md ladder step 3:
-# 11M rows × 28 features)
+# 11M rows × 28 features); the _SMALL variant exists so SOME
+# end-to-end tree number lands even when the tunnel window is short
 GBT_ROWS = 11_000_000
 GBT_COLS = 28
 GBT_TREES = 20
 GBT_DEPTH = 6
+GBT_SMALL_ROWS = 2_000_000
+GBT_SMALL_TREES = 10
 
 # v5e bf16 MXU peak; f32 runs at half rate. Used only for a utilization
 # *estimate* in extra.
@@ -429,7 +432,7 @@ def task_hist(mode):
                       "wall_s": wall, "checksum": checksum}))
 
 
-def task_gbt():
+def task_gbt(rows=None, trees=None):
     """HIGGS-scale GBT training end-to-end (the BASELINE.md 11M-row
     ladder step): full boosting loop on synthetic separable data.
 
@@ -445,31 +448,34 @@ def task_gbt():
     from shifu_tpu.models import gbdt
     from shifu_tpu.ops.metrics import auc
 
+    rows = rows or GBT_ROWS
+    trees = trees or GBT_TREES
     n_bins = 64
     key = jax.random.PRNGKey(0)
     kb, kbeta, kn = jax.random.split(key, 3)
-    binsT = jax.random.randint(kb, (GBT_COLS, GBT_ROWS), 0, n_bins - 1,
+    binsT = jax.random.randint(kb, (GBT_COLS, rows), 0, n_bins - 1,
                                dtype=jnp.int32)
     beta = jax.random.normal(kbeta, (GBT_COLS,))
     margin = (beta @ binsT.astype(jnp.float32)) / np.sqrt(GBT_COLS)
-    noise = jax.random.normal(kn, (GBT_ROWS,)) * jnp.std(margin) * 0.5
+    noise = jax.random.normal(kn, (rows,)) * jnp.std(margin) * 0.5
     y = (margin + noise > jnp.median(margin)).astype(jnp.float32)
-    w = jnp.ones(GBT_ROWS, jnp.float32)
+    w = jnp.ones(rows, jnp.float32)
     y = jax.block_until_ready(y)
     cfg = gbdt.TreeConfig(max_depth=GBT_DEPTH, n_bins=n_bins,
                           learning_rate=0.2, loss="log")
 
     t0 = time.time()
-    trees, _ = gbdt.build_gbt(cfg, binsT, y, w, n_trees=GBT_TREES)
+    built, _ = gbdt.build_gbt(cfg, binsT, y, w, n_trees=trees)
     wall = time.time() - t0       # build_gbt ends with np.asarray = sync
+    probe_rows = min(rows, 500_000)
     scores = np.asarray(gbdt.predict_trees(
-        jax.tree.map(jnp.asarray, trees), binsT[:, :500_000],
+        jax.tree.map(jnp.asarray, built), binsT[:, :probe_rows],
         cfg.max_depth, cfg.n_bins)).sum(axis=0)
-    a = float(auc(jnp.asarray(scores), y[:500_000]))
+    a = float(auc(jnp.asarray(scores), y[:probe_rows]))
     print(json.dumps({
-        "row_trees_per_sec": GBT_ROWS * GBT_TREES / wall,
+        "row_trees_per_sec": rows * trees / wall,
         "wall_s": wall, "auc": a,
-        "rows": GBT_ROWS, "trees": GBT_TREES, "depth": GBT_DEPTH,
+        "rows": rows, "trees": trees, "depth": GBT_DEPTH,
     }))
 
 
@@ -496,6 +502,53 @@ def _run_task(task, env_extra=None, timeout=1200):
         except json.JSONDecodeError:
             continue
     return None, "no JSON line in output: " + (p.stdout or "")[-500:]
+
+
+def _workload(task):
+    """The shape constants a task's numbers are a function of — stamped
+    into persisted records so a cached record is only ever reused for
+    the SAME workload (constants change across rounds)."""
+    return {
+        "nn": {"rows": N_ROWS, "features": N_FEATURES, "hidden": HIDDEN,
+               "epochs": [BENCH_EPOCHS_SHORT, BENCH_EPOCHS]},
+        "nn_wide": {"rows": WIDE_ROWS, "features": WIDE_FEATURES,
+                    "hidden": list(WIDE_HIDDEN),
+                    "epochs": [WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG]},
+        "wdl": {"rows": WDL_ROWS, "dense": WDL_DENSE, "cat": WDL_CAT,
+                "vocab": WDL_VOCAB, "embed": WDL_EMBED,
+                "epochs": [WDL_EPOCHS_SHORT, WDL_EPOCHS_LONG]},
+        "hist_xla": {"rows": HIST_ROWS, "cols": HIST_COLS,
+                     "bins": HIST_BINS, "slots": HIST_SLOTS},
+        "hist_pallas": {"rows": HIST_ROWS, "cols": HIST_COLS,
+                        "bins": HIST_BINS, "slots": HIST_SLOTS},
+        "gbt": {"rows": GBT_ROWS, "cols": GBT_COLS, "trees": GBT_TREES,
+                "depth": GBT_DEPTH},
+        "gbt_small": {"rows": GBT_SMALL_ROWS, "cols": GBT_COLS,
+                      "trees": GBT_SMALL_TREES, "depth": GBT_DEPTH},
+    }.get(task, {})
+
+
+def _run_or_reuse(task, backend, diags, env_extra, timeout=1200):
+    """Run a sub-bench — or reuse its most recent persisted TPU record
+    when one exists FOR THE SAME WORKLOAD (SHIFU_TPU_BENCH_REFRESH=1
+    forces live runs). The tunnel can die mid-round; captured evidence
+    should never be spent re-measuring what BENCH_LOCAL.jsonl already
+    holds while other tasks have nothing. Reuse is recorded in `diags`
+    (→ extra["diagnostics"]) so the headline JSON carries provenance."""
+    if backend == "tpu" and \
+            os.environ.get("SHIFU_TPU_BENCH_REFRESH", "0") != "1":
+        cached = _latest_persisted(task, backend_filter="tpu")
+        if cached and cached.get("workload") == _workload(task):
+            diags.append(f"{task}: value reused from persisted TPU "
+                         f"record ts={cached.get('ts')} (same workload); "
+                         "SHIFU_TPU_BENCH_REFRESH=1 re-measures")
+            out = dict(cached)
+            out["_reused_ts"] = cached.get("ts")
+            return out, None
+    out, err = _run_task(task, env_extra=env_extra, timeout=timeout)
+    if out:
+        _persist(task, backend, {**out, "workload": _workload(task)})
+    return out, err
 
 
 def _resolve_backend(diags):
@@ -538,6 +591,8 @@ def main():
         return task_hist(args.task.split("_", 1)[1])
     if args.task == "gbt":
         return task_gbt()
+    if args.task == "gbt_small":
+        return task_gbt(rows=GBT_SMALL_ROWS, trees=GBT_SMALL_TREES)
 
     diags = []
     extra = {}
@@ -551,9 +606,8 @@ def main():
 
         _log(f"backend: {backend}; running NN flagship bench "
              f"({N_ROWS}x{N_FEATURES}, {BENCH_EPOCHS} epochs)...")
-        nn, err = _run_task("nn", env_extra=env_extra)
+        nn, err = _run_or_reuse("nn", backend, diags, env_extra)
         if nn:
-            _persist("nn", backend, nn)
             value = round(nn["row_epochs_per_sec"] / 1e6, 3)
             vs_baseline = round(nn["row_epochs_per_sec"] /
                                 REFERENCE_WORKER_ROW_EPOCHS_PER_SEC, 2)
@@ -566,9 +620,8 @@ def main():
                          (err.splitlines()[-1] if err else "?"))
 
         _log("running GBDT histogram bench (xla scatter)...")
-        hx, err = _run_task("hist_xla", env_extra=env_extra)
+        hx, err = _run_or_reuse("hist_xla", backend, diags, env_extra)
         if hx:
-            _persist("hist_xla", backend, hx)
             extra["gbdt_hist_xla_gcells_per_s"] = round(
                 hx["cells_per_sec"] / 1e9, 3)
         else:
@@ -577,9 +630,8 @@ def main():
         if backend == "tpu":
             _log(f"running wide-NN utilization bench "
                  f"({WIDE_ROWS}x{WIDE_FEATURES}, {WIDE_HIDDEN})...")
-            nw, err = _run_task("nn_wide", env_extra=env_extra)
+            nw, err = _run_or_reuse("nn_wide", backend, diags, env_extra)
             if nw:
-                _persist("nn_wide", backend, nw)
                 extra["nn_wide_Mrow_epochs_per_s"] = round(
                     nw["row_epochs_per_sec"] / 1e6, 3)
                 extra["nn_wide_achieved_tflops"] = round(
@@ -599,9 +651,8 @@ def main():
                              (err.splitlines()[-1] if err else "?"))
             _log(f"running WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
                  f"vocab {WDL_VOCAB})...")
-            wd, err = _run_task("wdl", env_extra=env_extra)
+            wd, err = _run_or_reuse("wdl", backend, diags, env_extra)
             if wd:
-                _persist("wdl", backend, wd)
                 extra["wdl_Mrow_epochs_per_s"] = round(
                     wd["row_epochs_per_sec"] / 1e6, 3)
                 extra["wdl_auc"] = round(wd["auc"], 4)
@@ -613,22 +664,38 @@ def main():
             # Pallas interpret mode on CPU is not a perf path; only
             # measure the kernel where it actually runs.
             _log("running GBDT histogram bench (pallas MXU)...")
-            hp, err = _run_task("hist_pallas", env_extra=env_extra)
+            hp, err = _run_or_reuse("hist_pallas", backend, diags,
+                                    env_extra)
             if hp:
-                _persist("hist_pallas", backend, hp)
                 extra["gbdt_hist_pallas_gcells_per_s"] = round(
                     hp["cells_per_sec"] / 1e9, 3)
                 if hx:
                     extra["gbdt_pallas_vs_xla"] = round(
                         hp["cells_per_sec"] / hx["cells_per_sec"], 2)
+                    if ("_reused_ts" in hp) != ("_reused_ts" in hx):
+                        extra["gbdt_pallas_vs_xla_provenance"] = \
+                            "mixed (one side reused from a prior run)"
             else:
                 diags.append("hist_pallas failed: " +
                              (err.splitlines()[-1] if err else "?"))
+            # small GBT first: SOME end-to-end tree number should land
+            # even when the tunnel window is too short for the 11M run
+            _log(f"running GBT small train bench "
+                 f"({GBT_SMALL_ROWS}x{GBT_COLS}, {GBT_SMALL_TREES} "
+                 "trees)...")
+            gs_, err = _run_or_reuse("gbt_small", backend, diags,
+                                     env_extra)
+            if gs_:
+                extra["gbt_small_Mrow_trees_per_s"] = round(
+                    gs_["row_trees_per_sec"] / 1e6, 3)
+                extra["gbt_small_wall_s"] = round(gs_["wall_s"], 2)
+            else:
+                diags.append("gbt_small failed: " +
+                             (err.splitlines()[-1] if err else "?"))
             _log(f"running GBT end-to-end train bench "
                  f"({GBT_ROWS}x{GBT_COLS}, {GBT_TREES} trees)...")
-            gb, err = _run_task("gbt", env_extra=env_extra)
+            gb, err = _run_or_reuse("gbt", backend, diags, env_extra)
             if gb:
-                _persist("gbt", backend, gb)
                 extra["gbt_train_Mrow_trees_per_s"] = round(
                     gb["row_trees_per_sec"] / 1e6, 3)
                 extra["gbt_train_wall_s"] = round(gb["wall_s"], 2)

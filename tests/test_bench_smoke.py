@@ -101,3 +101,30 @@ def test_task_wdl(monkeypatch, capsys):
     rec = _last_json(capsys)
     assert rec["row_epochs_per_sec"] > 0
     assert rec["auc"] > 0.7
+
+
+def test_task_gbt_small(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "GBT_COLS", 8)
+    bench.task_gbt(rows=20_000, trees=3)
+    rec = _last_json(capsys)
+    assert rec["rows"] == 20_000 and rec["trees"] == 3
+    assert rec["row_trees_per_sec"] > 0
+
+
+def test_run_or_reuse_prefers_persisted(monkeypatch, tmp_path, capsys):
+    """A persisted TPU record satisfies a task without a live run, so a
+    short tunnel window is spent only on MISSING records."""
+    monkeypatch.delenv("SHIFU_TPU_BENCH_REFRESH", raising=False)
+    monkeypatch.setattr(bench, "BENCH_LOCAL", str(tmp_path / "b.jsonl"))
+    bench._persist("nn", "tpu", {"row_epochs_per_sec": 123.0,
+                                 "workload": bench._workload("nn")})
+    called = {"n": 0}
+    monkeypatch.setattr(bench, "_run_task",
+                        lambda *a, **k: called.__setitem__("n", 1) or
+                        (None, "should not run"))
+    out, err = bench._run_or_reuse("nn", "tpu", [], {})
+    assert out["row_epochs_per_sec"] == 123.0 and called["n"] == 0
+    # refresh forces a live run
+    monkeypatch.setenv("SHIFU_TPU_BENCH_REFRESH", "1")
+    out, err = bench._run_or_reuse("nn", "tpu", [], {})
+    assert called["n"] == 1
