@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+from contextlib import contextmanager
 from typing import Optional
 
 import jax
@@ -46,6 +47,39 @@ def initialize(coordinator_address: Optional[str] = None,
 def process_shard() -> tuple:
     """(index, count) for sharded file reads in this process."""
     return jax.process_index(), jax.process_count()
+
+
+def is_writer() -> bool:
+    """True on the single process allowed to write shared-storage
+    outputs (ColumnConfig.json, EvalScore.csv, normalized layouts, …).
+    In a multi-host pod every process computes identical results, but
+    N concurrent ``open(path, 'w')`` on the same shared file can
+    interleave or truncate each other — same guard the streaming
+    trainer's checkpoint save uses."""
+    return jax.process_index() == 0
+
+
+def writer_barrier(tag: str) -> None:
+    """Block until every process reaches this point — hosts must not
+    read a shared output file the writer is still producing. No-op
+    single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+@contextmanager
+def single_writer(tag: str):
+    """`with dist.single_writer("psi") as w:` — yields True on the one
+    process allowed to write (process 0), and releases a barrier on
+    exit EVEN WHEN THE WRITER RAISES: hosts >= 1 are already parked at
+    the barrier, and an unreleased barrier turns one host's error into
+    a pod-wide hang (the error itself still propagates on the
+    writer)."""
+    try:
+        yield is_writer()
+    finally:
+        writer_barrier(tag)
 
 
 def global_row_array(mesh, local_rows: np.ndarray):

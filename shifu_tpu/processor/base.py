@@ -50,7 +50,14 @@ class ProcessorContext:
                 + "; ".join(res.causes))
 
     def save_column_configs(self) -> None:
-        save_column_configs(self.column_configs, self.path_finder.column_config_path())
+        # multi-host: identical content on every process, but only one
+        # may hold the pen on shared storage; barrier so no host reads
+        # a half-written file in a later step of the same run
+        from shifu_tpu.parallel import dist
+        with dist.single_writer("save_column_configs") as w:
+            if w:
+                save_column_configs(self.column_configs,
+                                    self.path_finder.column_config_path())
 
     def require_columns(self) -> None:
         if not self.column_configs:

@@ -9,6 +9,7 @@ drift between the three streaming steps.
 
 from __future__ import annotations
 
+import logging
 import os
 
 import numpy as np
@@ -44,9 +45,24 @@ def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
 
         total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
                     for p in files)
-    except (OSError, FileNotFoundError, ValueError, RuntimeError):
+    except (OSError, FileNotFoundError, ValueError, RuntimeError) as e:
+        # a silent 0 here sends a genuinely >RAM dataset down the
+        # resident path — leave the operator a trace of why
+        logging.getLogger("shifu_tpu").warning(
+            "%s: could not estimate raw data size (%s) — streaming "
+            "auto-trigger disabled, falling back to resident read",
+            label, e)
         return 0
-    limit = int(os.environ.get(byte_env, 2 * 1024 ** 3))
+    raw_limit = os.environ.get(byte_env)
+    if raw_limit is None or str(raw_limit).strip() == "":
+        limit = 2 * 1024 ** 3
+    else:
+        try:
+            limit = int(float(raw_limit))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{label} stream-bytes threshold ({byte_env}) must be "
+                f"a number, got {raw_limit!r}")
     return default_rows if total > limit else 0
 
 

@@ -81,10 +81,14 @@ def run(ctx: ProcessorContext) -> int:
         mesh_mod.shard_axis(mesh, x, 0, pad_value=np.nan)))
     out = ctx.path_finder.correlation_path()
     ctx.path_finder.ensure(out)
-    with open(out, "w") as f:
-        f.write("column," + ",".join(names) + "\n")
-        for i, n in enumerate(names):
-            f.write(n + "," + ",".join(f"{v:.6f}" for v in corr[i]) + "\n")
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("correlation") as w:
+        if w:   # all hosts computed via psum; one writes
+            with open(out, "w") as f:
+                f.write("column," + ",".join(names) + "\n")
+                for i, n in enumerate(names):
+                    f.write(n + ","
+                            + ",".join(f"{v:.6f}" for v in corr[i]) + "\n")
     log.info("correlation: %dx%d matrix → %s in %.2fs", len(names),
              len(names), out, time.time() - t0)
     return 0

@@ -100,13 +100,16 @@ def run(ctx: ProcessorContext, df=None,
     ctx.path_finder.ensure(out)
     metrics = ["count", "missing", "mean", "stdDev", "min", "max", "sum",
                "posCount"]
-    with open(out, "w") as f:
-        f.write("date,column," + ",".join(metrics) + "\n")
-        for d in range(len(uniq)):
-            for j, name in enumerate(dataset.num_names):
-                f.write(f"{uniq[d]},{name},"
-                        + ",".join(f"{stats[m][d, j]:.6g}" for m in metrics)
-                        + "\n")
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("datestat") as w:
+        if w:   # identical stats on every host; one pen
+            with open(out, "w") as f:
+                f.write("date,column," + ",".join(metrics) + "\n")
+                for d in range(len(uniq)):
+                    for j, name in enumerate(dataset.num_names):
+                        f.write(f"{uniq[d]},{name},"
+                                + ",".join(f"{stats[m][d, j]:.6g}"
+                                           for m in metrics) + "\n")
     log.info("date stats: %d dates × %d columns → %s in %.2fs",
              len(uniq), len(dataset.num_names), out, time.time() - t0)
     return 0

@@ -34,6 +34,33 @@ from shifu_tpu.processor.base import ProcessorContext
 log = logging.getLogger("shifu_tpu")
 
 
+def _opath(path: str, readback: bool = False) -> str:
+    """Output path for this process. Eval computes identical results on
+    every host of a multi-host pod (the scoring collectives need all
+    processes), but N concurrent ``open(path, 'w')`` handles on one
+    shared file interleave or truncate each other — so only process 0
+    writes the real outputs. Non-writers send write-only outputs to
+    os.devnull (a full EvalScore.csv copy per host would fill /tmp at
+    the >RAM scale the streaming path exists for); ``readback=True``
+    outputs (the streaming score dumps, re-read by the metrics phase
+    and deleted in its finally) get a process-local scratch file."""
+    from shifu_tpu.parallel import dist
+    if dist.is_writer():
+        return path
+    if not readback:
+        return os.devnull
+    import jax
+    import tempfile
+    # PID-keyed: two jobs whose process N lands on the same machine
+    # (or a SIGKILLed run's leftovers) must not interleave into one
+    # dump that _finish_streaming then reads back as wrong metrics
+    scratch = os.path.join(
+        tempfile.gettempdir(),
+        f"shifu_eval_p{jax.process_index()}_{os.getpid()}")
+    os.makedirs(scratch, exist_ok=True)
+    return os.path.join(scratch, os.path.basename(path))
+
+
 def run(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
     mc = ctx.model_config
     ctx.validate(ModelStep.EVAL)
@@ -180,7 +207,7 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
             continue
         ds = effective_dataset_conf(mc, ec)
         chunk = eval_chunk_rows(ctx, ec)
-        out = ctx.path_finder.eval_norm_path(ec.name)
+        out = _opath(ctx.path_finder.eval_norm_path(ec.name))
         os.makedirs(os.path.dirname(out), exist_ok=True)
         n_rows = 0
 
@@ -288,8 +315,8 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
         n = min(n_records, len(tags))
         tmp_dir = os.path.join(ctx.path_finder.root, "tmp")
         os.makedirs(tmp_dir, exist_ok=True)
-        out = os.path.join(tmp_dir,
-                           f"{mc.model_set_name}_{ec.name}_audit.data")
+        out = _opath(os.path.join(
+            tmp_dir, f"{mc.model_set_name}_{ec.name}_audit.data"))
         var_names = list(dset.num_names) + list(dset.cat_names)
         meta_names = sorted(dset.meta.keys())
         with open(out, "w") as f:
@@ -327,6 +354,14 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     chunk_rows = eval_chunk_rows(ctx, ec)
     if chunk_rows and not mc.is_multi_classification:
         return _run_one_streaming(ctx, ec, chunk_rows, t0)
+    if chunk_rows:
+        # multi-class has no chunked path (the CxC confusion matrix
+        # wants all rows); falling through to a resident read of a
+        # >threshold set can OOM — leave the operator a trace
+        log.warning("eval[%s]: multi-class eval has no streaming path — "
+                    "reading the whole set resident despite exceeding "
+                    "the streaming threshold (chunkRows=%d ignored)",
+                    ec.name, chunk_rows)
     scores, tags, weights, dset = score_eval_set(ctx, ec)
     final = scores["final"]
 
@@ -338,7 +373,7 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
 
     # EvalScore.csv: tag | weight | per-model scores | ensemble
     model_cols = sorted(k for k in scores if k.startswith("model"))
-    with open(ctx.path_finder.eval_score_path(ec.name), "w") as f:
+    with open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w") as f:
         f.write("tag,weight," + ",".join(model_cols) + ",mean,max,min,median\n")
         _write_eval_score_chunk(f, scores, tags, weights, model_cols)
 
@@ -378,7 +413,7 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
         cperf = performance_result(vals[ok], tags[ok], weights[ok],
                                    n_buckets=ec.performanceBucketNum)
         champions[col] = cperf
-        cpath = os.path.join(base, f"EvalPerformance-{col}.json")
+        cpath = _opath(os.path.join(base, f"EvalPerformance-{col}.json"))
         with open(cpath, "w") as f:
             json.dump(cperf, f, indent=1)
         log.info("eval[%s] champion %s: AUC=%.4f (challenger %.4f)",
@@ -388,15 +423,16 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
         perf["championAuc"] = {c: p["areaUnderRoc"]
                                for c, p in champions.items()}
 
-    with open(ctx.path_finder.eval_performance_path(ec.name), "w") as f:
+    with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
+              "w") as f:
         json.dump(perf, f, indent=1)
 
     cm = confusion_matrix_table(final, tags, weights)
-    _write_confusion_csv(ctx.path_finder.eval_confusion_path(ec.name), cm)
+    _write_confusion_csv(_opath(ctx.path_finder.eval_confusion_path(ec.name)), cm)
 
-    gain_chart.write_html(ctx.path_finder.gain_chart_path(ec.name, "html"),
+    gain_chart.write_html(_opath(ctx.path_finder.gain_chart_path(ec.name, "html")),
                           perf, f"{mc.model_set_name} — {ec.name}")
-    gain_chart.write_csv(ctx.path_finder.gain_chart_path(ec.name, "csv"), perf)
+    gain_chart.write_csv(_opath(ctx.path_finder.gain_chart_path(ec.name, "csv")), perf)
 
     log.info("eval[%s]: %d rows, AUC=%.4f (weighted %.4f) in %.2fs",
              ec.name, len(final), perf["areaUnderRoc"],
@@ -435,8 +471,10 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     os.makedirs(base, exist_ok=True)
 
     champ_names = score_meta_columns(ctx, ec)
-    dump_path = os.path.join(base, ".scores.bin")     # (final, tag, w) f32
-    champ_dumps = {c: os.path.join(base, f".champ{i}.bin")
+    dump_path = _opath(os.path.join(base, ".scores.bin"),
+                       readback=True)      # (final, tag, w) f32
+    champ_dumps = {c: _opath(os.path.join(base, f".champ{i}.bin"),
+                             readback=True)
                    for i, c in enumerate(champ_names)}
 
     status = {"records": 0, "posCount": 0, "negCount": 0,
@@ -445,7 +483,7 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     model_cols: List[str] = []
     n_chunks = 0
     done = False
-    score_f = open(ctx.path_finder.eval_score_path(ec.name), "w")
+    score_f = open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w")
     dump_f = open(dump_path, "wb")
     champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}
     try:
@@ -498,8 +536,8 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
             # failure mid-stream: the multi-GB side dumps (and the
             # truncated EvalScore.csv) must not linger in the eval dir
             for p in [dump_path, *champ_dumps.values(),
-                      ctx.path_finder.eval_score_path(ec.name)]:
-                if os.path.exists(p):
+                      _opath(ctx.path_finder.eval_score_path(ec.name))]:
+                if p != os.devnull and os.path.exists(p):
                     os.remove(p)
     try:
         return _finish_streaming(ctx, ec, chunk_rows, t0, status,
@@ -509,7 +547,7 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
         # the dumps are function-scoped scratch: reclaim them on every
         # exit path (success, no-rows, metrics-phase failure alike)
         for p in (dump_path, *champ_dumps.values()):
-            if os.path.exists(p):
+            if p != os.devnull and os.path.exists(p):
                 os.remove(p)
 
 
@@ -564,7 +602,7 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
             continue
         cperf = ch.performance_result(n_buckets=ec.performanceBucketNum)
         champions[c] = cperf
-        with open(os.path.join(base, f"EvalPerformance-{c}.json"),
+        with open(_opath(os.path.join(base, f"EvalPerformance-{c}.json")),
                   "w") as f:
             json.dump(cperf, f, indent=1)
         log.info("eval[%s] champion %s: AUC=%.4f (challenger %.4f)",
@@ -573,13 +611,14 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
         perf["championAuc"] = {c: p["areaUnderRoc"]
                                for c, p in champions.items()}
 
-    with open(ctx.path_finder.eval_performance_path(ec.name), "w") as f:
+    with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
+              "w") as f:
         json.dump(perf, f, indent=1)
-    _write_confusion_csv(ctx.path_finder.eval_confusion_path(ec.name),
+    _write_confusion_csv(_opath(ctx.path_finder.eval_confusion_path(ec.name)),
                          hist.confusion_table())
-    gain_chart.write_html(ctx.path_finder.gain_chart_path(ec.name, "html"),
+    gain_chart.write_html(_opath(ctx.path_finder.gain_chart_path(ec.name, "html")),
                           perf, f"{mc.model_set_name} — {ec.name}")
-    gain_chart.write_csv(ctx.path_finder.gain_chart_path(ec.name, "csv"),
+    gain_chart.write_csv(_opath(ctx.path_finder.gain_chart_path(ec.name, "csv")),
                          perf)
     log.info("eval[%s] streaming: %d rows in %d chunks, AUC=%.4f "
              "(weighted %.4f) in %.2fs", ec.name, status["records"],
@@ -606,7 +645,7 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
     class_cols = [f"class{c}" for c in range(n_c)]
     from shifu_tpu.eval import csv_out
     csv_out.write_csv(
-        ctx.path_finder.eval_score_path(ec.name),
+        _opath(ctx.path_finder.eval_score_path(ec.name)),
         ["tag", "weight"] + class_cols + ["predicted"],
         [true, weights] + [scores[c] for c in class_cols] + [pred],
         ["%d", "%.6g"] + ["%.6f"] * n_c + ["%d"])
@@ -614,7 +653,8 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
     # weighted C×C confusion matrix: rows = actual, cols = predicted
     cm = np.zeros((n_c, n_c), np.float64)
     np.add.at(cm, (true, pred), weights)
-    with open(ctx.path_finder.eval_confusion_path(ec.name), "w") as f:
+    with open(_opath(ctx.path_finder.eval_confusion_path(ec.name)),
+              "w") as f:
         f.write("actual\\predicted," + ",".join(str(c) for c in classes) + "\n")
         for a in range(n_c):
             f.write(str(classes[a]) + ","
@@ -635,7 +675,8 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
             "support": float(cm[c].sum())})
     perf = {"accuracy": acc, "records": int(len(pred)),
             "classes": [str(c) for c in classes], "perClass": per_class}
-    with open(ctx.path_finder.eval_performance_path(ec.name), "w") as f:
+    with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
+              "w") as f:
         json.dump(perf, f, indent=1)
     log.info("eval[%s]: %d rows, multi-class accuracy=%.4f in %.2fs",
              ec.name, len(pred), acc, time.time() - t0)

@@ -29,6 +29,27 @@ def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
     t0 = time.time()
     ctx.require_columns()
     et = (export_type or "columnstats").lower()
+    known = ("columnstats", "woemapping", "correlation", "pmml", "tf",
+             "bagging", "baggingpmml", "woe", "ume", "baggingume",
+             "normume")
+    if et not in known:
+        # validate on EVERY host before anyone parks at the barrier —
+        # a writer-only ValueError would hang the other processes
+        raise ValueError(f"unknown export type {export_type!r}")
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("export") as w:
+        # exports other than correlation are host-side file conversions
+        # with no collectives — multi-host processes >= 1 have nothing
+        # to compute and must not race host 0's writes (correlation
+        # computes via psum, so every host runs it; its own
+        # single_writer guards the CSV)
+        if w or et == "correlation":
+            return _run_writer(ctx, et, export_type, t0)
+    return 0
+
+
+def _run_writer(ctx: ProcessorContext, et: str, export_type: str,
+                t0: float) -> int:
     if et == "columnstats":
         out = _export_columnstats(ctx)
     elif et == "woemapping":
@@ -47,10 +68,8 @@ def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
         out = _export_bagging_pmml(ctx)
     elif et == "woe":
         out = _export_woe_info(ctx)
-    elif et in ("ume", "baggingume", "normume"):
+    else:   # et in ("ume", "baggingume", "normume") — validated above
         return _export_ume(ctx, et)
-    else:
-        raise ValueError(f"unknown export type {export_type!r}")
     log.info("export[%s] → %s in %.2fs", et, out, time.time() - t0)
     return 0
 

@@ -184,6 +184,16 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
     if task_tags is not None and task_tags.size:
         extra["task_tags"] = task_tags.astype(np.float32)
     dense = apply_precision(result.dense, ptype)
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("save_normalized") as w:
+        if w:   # every process computed identical arrays; one pen
+            _write_normalized(path, result, dense, index, tags, weights,
+                              task_tags, extra, ptype, streaming,
+                              shuffle_seed)
+
+
+def _write_normalized(path, result, dense, index, tags, weights,
+                      task_tags, extra, ptype, streaming, shuffle_seed):
     np.savez_compressed(
         os.path.join(path, "data.npz"),
         dense=dense, index=index,

@@ -97,6 +97,20 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
                     "data.npz, which a >RAM set cannot materialize)")
     purifier = DataPurifier(mc.dataSet.filterExpressions) \
         if mc.dataSet.filterExpressions else None
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("norm_streaming") as w:
+        # the mmap layout is written once on shared storage; hosts >= 1
+        # park at the exit barrier until host 0's passes finish
+        if w:
+            return _writer_passes(ctx, chunk_rows, seed, t0, mc,
+                                  norm_proc, cols, purifier)
+    return 0
+
+
+def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
+                   t0: float, mc, norm_proc, cols, purifier) -> int:
+    """The two chunked passes + mmap writes — host 0 only (the barrier
+    discipline lives in run_streaming)."""
     val_rate = max(float(mc.train.validSetRate or 0.0), 0.0)
 
     # ---- pass 1: exact region sizes -----------------------------------

@@ -102,9 +102,12 @@ def run(ctx: ProcessorContext) -> int:
 
     out = ctx.path_finder.psi_path()
     ctx.path_finder.ensure(out)
-    with open(out, "w") as f:
-        f.write("column,psi," + ",".join(uniq) + "\n")
-        f.write("\n".join(rows) + "\n")
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("psi") as w:
+        if w:   # identical rows on every host; one pen
+            with open(out, "w") as f:
+                f.write("column,psi," + ",".join(uniq) + "\n")
+                f.write("\n".join(rows) + "\n")
     ctx.save_column_configs()
     log.info("psi: %d cohorts × %d columns → %s in %.2fs", len(uniq),
              len(rows), out, time.time() - t0)

@@ -305,10 +305,19 @@ def _fill_from_sketch(ctx, mc, num_names, num_nums, A, fine,
         pos_idx = np.minimum((cum < tgt[:, None]).sum(axis=1), K - 1)
         quartiles[qi] = edges[np.arange(cn), pos_idx + 1]
 
-    if mc.stats.binningMethod in (BinningMethod.EqualInterval,
-                                  BinningMethod.WeightEqualInterval):
-        cut_edges = [np.arange(1, max_bins) * K // max_bins
-                     for _ in range(cn)]
+    interval = mc.stats.binningMethod in (BinningMethod.EqualInterval,
+                                          BinningMethod.WeightEqualInterval)
+    if interval:
+        # count cuts land on the nearest fine-bin edge at-or-below each
+        # exact interval boundary (aggregated counts must split on fine
+        # edges); the REPORTED boundaries are computed exactly from
+        # min/max in the loop below — equal-interval cuts, unlike the
+        # quantile methods, need no sketch, and reusing the quantile
+        # right-edge convention here shifted every boundary by one
+        # fine-bin width (span/K)
+        cut_edges = [np.maximum(
+            np.arange(1, max_bins) * K // max_bins - 1, 0)
+            for _ in range(cn)]
     else:
         qw = _quantile_weights_hist(mc.stats.binningMethod, fine)
         qcum = np.cumsum(qw, axis=1)
@@ -328,7 +337,14 @@ def _fill_from_sketch(ctx, mc, num_names, num_nums, A, fine,
     keys = ("count_pos", "count_neg", "weight_pos", "weight_neg")
     for j in range(cn):
         ce = cut_edges[j]
-        bounds = np.concatenate(([-np.inf], edges[j, ce + 1]))
+        if interval:
+            span = A["max"][j] - A["min"][j]
+            span = span if span > 0 else 1.0
+            bounds = np.concatenate(
+                ([-np.inf],
+                 A["min"][j] + np.arange(1, max_bins) * span / max_bins))
+        else:
+            bounds = np.concatenate(([-np.inf], edges[j, ce + 1]))
         # aggregate fine bins into final bins: fine bin f belongs to
         # final bin = #cuts with cut_fine_index < f
         assign = np.searchsorted(ce, np.arange(K), side="left")

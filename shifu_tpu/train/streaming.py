@@ -338,7 +338,8 @@ def train_streaming_core(train_conf: ModelTrainConf,
     # NUMBER, so a restored run replays the exact schedule
     if checkpoint_dir and checkpoint_interval > 0:
         from shifu_tpu.train import checkpoint as ckpt_mod
-        step = ckpt_mod.latest_step(checkpoint_dir)
+        local_step = ckpt_mod.latest_step(checkpoint_dir)
+        step = local_step
         if n_proc > 1:
             # every process must agree on the resume epoch or they
             # issue different collective counts and deadlock — host 0
@@ -363,7 +364,21 @@ def train_streaming_core(train_conf: ModelTrainConf,
                     "stopped": stopped,
                     "train_errs": np.zeros((step, n_bags), np.float32),
                     "val_errs": np.zeros((step, n_bags), np.float32)}
-            st = ckpt_mod.restore_state(checkpoint_dir, step, like)
+            if n_proc > 1:
+                # only host 0 ever WRITES checkpoints, so only its
+                # files are authoritative — a matching step number on
+                # another host can only be a stale leftover from an
+                # earlier run (non-shared dirs), and restoring it
+                # per-host would silently diverge the replicated
+                # state. Host 0 restores; everyone gets its pytree via
+                # a one-time startup broadcast.
+                from jax.experimental import multihost_utils
+                st = (ckpt_mod.restore_state(checkpoint_dir, step, like)
+                      if proc == 0
+                      else jax.tree.map(np.asarray, like))
+                st = multihost_utils.broadcast_one_to_all(st)
+            else:
+                st = ckpt_mod.restore_state(checkpoint_dir, step, like)
             stacked = mesh_mod.place_replicated(
                 mesh, jax.tree.map(jnp.asarray, st["stacked"]))
             opt_state = mesh_mod.place_replicated(
