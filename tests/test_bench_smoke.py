@@ -111,6 +111,57 @@ def test_task_gbt_small(monkeypatch, capsys):
     assert rec["row_trees_per_sec"] > 0
 
 
+def _run_main(monkeypatch, capsys, results):
+    """Drive bench.main() with stubbed backend + task results; returns
+    the headline JSON record."""
+    import sys
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.setattr(bench, "_resolve_backend", lambda d: ("tpu", {}))
+    monkeypatch.setattr(
+        bench, "_run_or_reuse",
+        lambda task, backend, diags, env_extra, timeout=1200:
+        (results.get(task), None if task in results else "stubbed out"))
+    bench.main()
+    return _last_json(capsys)
+
+
+def test_headline_prefers_wide_and_labels_baseline(monkeypatch, tmp_path,
+                                                   capsys):
+    """VERDICT r3 next #9: the wide (utilization) shape is the headline
+    when captured, and the record self-describes its denominator."""
+    monkeypatch.setattr(bench, "BENCH_LOCAL", str(tmp_path / "b.jsonl"))
+    rec = _run_main(monkeypatch, capsys, {
+        "nn": {"row_epochs_per_sec": 3.0e6, "auc": 0.97, "wall_s": 1.0,
+               "mxu_util_est": 1e-4},
+        "nn_wide": {"row_epochs_per_sec": 4.0e5, "auc": 0.9,
+                    "wall_s": 2.0, "achieved_tflops": 50.0,
+                    "mxu_util": 0.12, "hbm_util_est": 0.3,
+                    "hbm_gbps_est": 250.0},
+    })
+    assert rec["metric"] == "nn_wide_train_throughput"
+    assert rec["value"] == 0.4
+    assert "denominator = ESTIMATED" in rec["baseline"]
+    assert rec["extra"]["nn_wide_mxu_util"] == 0.12
+    # workers-replaced scales with FLOPs/row: 4e5 rows/s at the wide
+    # shape is far more work than the flagship baseline shape
+    wide_worker = bench.REFERENCE_WORKER_FLOPS / bench._flops_per_row(
+        bench.WIDE_FEATURES, bench.WIDE_HIDDEN)
+    assert rec["vs_baseline"] == pytest.approx(4.0e5 / wide_worker,
+                                               rel=0.01)
+
+
+def test_headline_falls_back_to_flagship(monkeypatch, tmp_path, capsys):
+    monkeypatch.setattr(bench, "BENCH_LOCAL", str(tmp_path / "b.jsonl"))
+    rec = _run_main(monkeypatch, capsys, {
+        "nn": {"row_epochs_per_sec": 3.0e6, "auc": 0.97, "wall_s": 1.0,
+               "mxu_util_est": 1e-4},
+    })
+    assert rec["metric"] == "nn_fullbatch_train_throughput"
+    assert rec["value"] == 3.0
+    assert rec["vs_baseline"] == pytest.approx(1.5, rel=0.01)
+    assert "baseline" in rec
+
+
 def test_run_or_reuse_prefers_persisted(monkeypatch, tmp_path, capsys):
     """A persisted TPU record satisfies a task without a live run, so a
     short tunnel window is spent only on MISSING records."""

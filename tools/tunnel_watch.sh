@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Poll the axon TPU tunnel; the moment a probe succeeds, run the bench
+# capture ladder (tools/capture_bench.sh commits records as they land).
+# Logs to tools/tunnel_watch.log. Exits after a successful capture, or
+# after MAX_TRIES probes.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tunnel_watch.log
+MAX_TRIES=${MAX_TRIES:-150}
+SLEEP=${SLEEP:-120}
+
+for i in $(seq 1 "$MAX_TRIES"); do
+    echo "[watch $(date -u +%H:%M:%S)] probe $i" >> "$LOG"
+    if timeout 90 python -c "import jax; assert jax.default_backend() != 'cpu'; print(jax.default_backend())" >> "$LOG" 2>&1; then
+        echo "[watch $(date -u +%H:%M:%S)] tunnel UP — running capture" >> "$LOG"
+        bash tools/capture_bench.sh >> "$LOG" 2>&1
+        rc=$?
+        echo "[watch $(date -u +%H:%M:%S)] capture exit=$rc" >> "$LOG"
+        if [ "$rc" -eq 0 ]; then exit 0; fi
+    fi
+    sleep "$SLEEP"
+done
+echo "[watch] gave up after $MAX_TRIES probes" >> "$LOG"
+exit 1
