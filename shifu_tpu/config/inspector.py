@@ -253,10 +253,23 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
                                f"supported: {_SUBSET_STRATEGIES} or an int")
     fixed = t.get_param("FixedLayers")
     if fixed is not None:
+        # 1-based hidden-layer indices, like the reference (layer 1 =
+        # input→hidden1 weights; input/output layers cannot be fixed —
+        # NNMaster.getFixedWights:605-624)
+        n_hidden = t.get_param("NumHiddenLayers")
+        if not isinstance(n_hidden, int):
+            # optional param: depth falls back to len(NumHiddenNodes)
+            # (models/nn.parse_arch_params does the same)
+            nodes = t.get_param("NumHiddenNodes")
+            n_hidden = len(nodes) if isinstance(nodes, list) else None
         if not isinstance(fixed, list) or \
-                any(not isinstance(i, int) or i < 0 for i in fixed):
-            r.fail(f"FixedLayers must be a list of layer indices >= 0, "
-                   f"got {fixed!r}")
+                any(not isinstance(i, int) or i < 1 for i in fixed):
+            r.fail(f"FixedLayers must be a list of 1-based hidden layer "
+                   f"indices, got {fixed!r}")
+        elif isinstance(n_hidden, int) and any(i > n_hidden
+                                               for i in fixed):
+            r.fail(f"FixedLayers {fixed!r} exceeds NumHiddenLayers="
+                   f"{n_hidden} (only hidden layers can be fixed)")
         elif not t.isContinuous:
             r.fail("FixedLayers only applies to continuous training "
                    "(train#isContinuous=true)")

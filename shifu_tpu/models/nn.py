@@ -164,6 +164,70 @@ def init_params(spec: MLPSpec, key: jax.Array) -> Params:
     return params
 
 
+def compare_structure(old_dims: Sequence[int],
+                      new_dims: Sequence[int]) -> int:
+    """0 = identical, 1 = the new network can absorb the old one,
+    -1 = it cannot (`NNStructureComparator.compare`: input count,
+    output count, and per-layer feed counts aligned at the input end
+    must all be >=; `TrainModelProcessor.inputOutputModelCheckSuccess:
+    1389-1450` additionally requires equal output counts, which is the
+    check used here since the output layer's meaning must not change).
+    `*_dims` are forward-order layer widths [input, *hidden, output]."""
+    old, new = list(old_dims), list(new_dims)
+    if old == new:
+        return 0
+    if len(new) < len(old) or new[-1] != old[-1]:
+        return -1
+    # input-end alignment: old layer i ↔ new layer i (extra new layers
+    # sit nearest the output, mirroring fitExistingModelIn's
+    # toLayer = toLen - (fromLen - layer) walk over Encog's
+    # output-first arrays). Every aligned old width — INCLUDING the old
+    # output when depth grows (it lands on a hidden layer) — must fit.
+    ok = all(new[i] >= old[i] for i in range(len(old)))
+    return 1 if ok else -1
+
+
+def absorb_params(old_params: Params, new_params: Params,
+                  fixed_layers: Optional[Sequence[int]] = None,
+                  fixed_bias: bool = True):
+    """Fit a smaller trained network into a freshly-initialized larger
+    one (`NNMaster.fitExistingModelIn:644-684`): each old layer's
+    weight matrix copies into the top-left corner of the aligned new
+    layer, biases into the leading slots. Returns (params, grad_mask)
+    where grad_mask zeros the absorbed positions of 1-based
+    `fixed_layers` (the reference freezes only the copied indices —
+    the grown portion of a fixed layer still trains).
+
+    TPU-first deviation, documented: the cross-block rows
+    w[old_in:, :old_out] of every absorbed layer are ZEROED, so the
+    grown units feed the absorbed units nothing at step 0 — for
+    same-depth growth the new network starts as an exact functional
+    copy of the old model (validation error resumes where it left
+    off), instead of the reference's randomly-perturbed start. The
+    zeros are trainable unless the layer is fixed."""
+    params = [dict(layer) for layer in new_params]
+    grad_mask = [
+        {k: jnp.ones_like(v) for k, v in layer.items()}
+        for layer in new_params]
+    fixed = {int(f) for f in (fixed_layers or ())}
+    for i, old_layer in enumerate(old_params):
+        oi, oo = old_layer["w"].shape
+        w = params[i]["w"]
+        w = w.at[:oi, :oo].set(jnp.asarray(old_layer["w"]))
+        w = w.at[oi:, :oo].set(0.0)
+        params[i]["w"] = w
+        params[i]["b"] = params[i]["b"].at[:oo].set(
+            jnp.asarray(old_layer["b"]))
+        if (i + 1) in fixed:
+            # freeze exactly the absorbed indices (getFixedWights /
+            # fitExistingModelIn add only copied weights to the set)
+            mw = grad_mask[i]["w"].at[:oi, :oo].set(0.0)
+            grad_mask[i]["w"] = mw
+            if fixed_bias:
+                grad_mask[i]["b"] = grad_mask[i]["b"].at[:oo].set(0.0)
+    return params, grad_mask
+
+
 def forward(spec: MLPSpec, params: Params, x: jax.Array,
             dropout_key: Optional[jax.Array] = None) -> jax.Array:
     """Batched forward pass → (N,) score in (0,1) for binary output.

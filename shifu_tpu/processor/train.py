@@ -170,13 +170,14 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
         if kfold:
             res = _train_kfold(conf, spec, x, y, w, kfold, seed)
         else:
-            init_params, fixed = _continuous_init(ctx, spec)
+            init_params, fixed, gmask = _continuous_init(ctx, spec, seed)
             # mid-training fault tolerance: CheckpointInterval epochs per
             # orbax checkpoint (NNOutput tmp models / DTMaster
             # checkpointInterval analog); grid-search combos skip it
             ck_int = int(tc.get_param("CheckpointInterval", 0) or 0)
             res = train_nn(conf, x, y, w, seed=seed + ci, spec=spec,
                            init_params=init_params, fixed_layers=fixed,
+                           grad_mask=gmask,
                            checkpoint_dir=(ctx.path_finder.checkpoint_path(0)
                                            if ck_int and not is_gs else None),
                            checkpoint_interval=ck_int)
@@ -201,30 +202,56 @@ def _conf_with_params(tc, params):
     return conf
 
 
-def _continuous_init(ctx: ProcessorContext, spec: nn_mod.MLPSpec):
+def _continuous_init(ctx: ProcessorContext, spec: nn_mod.MLPSpec,
+                     seed: int = 12306):
     """Continuous training: resume from models/model0 when structure
-    matches (`NNMaster.initOrRecoverParams:356-387` +
-    `NNStructureComparator`); FixedLayers freeze
-    (TrainModelProcessor.inputOutputModelCheckSuccess:1389-1450)."""
+    matches; absorb the old model into a LARGER new structure (old
+    weights into the corner, 1-based FixedLayers freezing the absorbed
+    indices); hard-error when the new structure cannot hold the old one
+    (`NNMaster.initOrRecoverParams:356-387` absorbs via
+    fitExistingModelIn / throws GuaguaRuntimeException on shrinkage;
+    `NNStructureComparator`;
+    `TrainModelProcessor.inputOutputModelCheckSuccess:1389-1450`).
+    Returns (init_params, fixed_layers, grad_mask) — grad_mask is only
+    set on the growth path, where frozen indices are element-wise."""
     mc = ctx.model_config
     if not mc.train.isContinuous:
-        return None, None
+        return None, None, None
     path = ctx.path_finder.model_path(0)
     if not os.path.exists(path):
         log.info("continuous training: no existing model at %s, fresh start",
                  path)
-        return None, None
+        return None, None, None
     kind, meta, params = load_model(path)
-    old_dims = meta.get("spec", {}).get("hidden_dims")
-    if old_dims != list(spec.hidden_dims) or \
-            meta.get("spec", {}).get("input_dim") != spec.input_dim:
-        log.warning("continuous training: structure changed %s→%s, fresh start",
-                    old_dims, spec.hidden_dims)
-        return None, None
+    old_spec = meta.get("spec", {})
+    old_dims = [old_spec.get("input_dim")] \
+        + list(old_spec.get("hidden_dims") or []) \
+        + [old_spec.get("output_dim", 1)]
     fixed = mc.train.get_param("FixedLayers") or None
     if fixed is not None:
         fixed = [int(i) for i in fixed]
-    return params, fixed
+    cmp = nn_mod.compare_structure(old_dims, spec.layer_dims)
+    if cmp == 0:
+        return params, fixed, None
+    if cmp < 0:
+        # warn-and-discard would silently throw away the old model's
+        # knowledge on the feature's primary use case — refuse instead
+        raise ValueError(
+            "continuous training: new network "
+            f"{spec.layer_dims} cannot hold the existing model "
+            f"{old_dims} (shrunk input/hidden/output). Grow the "
+            "structure, or set train#isContinuous=false to retrain "
+            "from scratch")
+    log.info("continuous training: absorbing existing model %s into "
+             "larger structure %s%s", old_dims, spec.layer_dims,
+             f" (FixedLayers={fixed})" if fixed else "")
+    import jax
+    fresh = nn_mod.init_params(spec, jax.random.PRNGKey(seed))
+    grown, grad_mask = nn_mod.absorb_params(params, fresh,
+                                            fixed_layers=fixed)
+    # fixed_layers=None: the element-wise grad_mask already encodes the
+    # frozen absorbed indices; passing both would re-freeze whole layers
+    return grown, None, grad_mask
 
 
 def _train_kfold(conf, spec, x, y, w, k: int, seed: int) -> TrainResult:
@@ -320,9 +347,10 @@ def _train_dense_streaming(ctx: ProcessorContext,
         spec = _svm_spec(mc.train.params, dense.shape[1])
     else:
         spec = None
-    init_params, fixed = _continuous_init(
+    init_params, fixed, gmask = _continuous_init(
         ctx, spec or nn_mod.MLPSpec.from_train_params(mc.train.params,
-                                                      dense.shape[1]))
+                                                      dense.shape[1]),
+        seed)
     meta = norm_proc.load_normalized_meta(path)
     from shifu_tpu.train.streaming import (checkpoint_args,
                                            cleanup_checkpoints)
@@ -337,7 +365,7 @@ def _train_dense_streaming(ctx: ProcessorContext,
                                                        init_params)
                                           if init_params is not None
                                           else None),
-                             fixed_layers=fixed)
+                             fixed_layers=fixed, grad_mask=gmask)
     _save_dense_models(ctx, res, alg)
     _write_val_errors(ctx, res)
     cleanup_checkpoints(ck_dir)

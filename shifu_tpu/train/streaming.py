@@ -92,6 +92,7 @@ def train_nn_streaming(train_conf: ModelTrainConf,
                        chunk_rows: int = 262_144,
                        init_params=None,
                        fixed_layers=None,
+                       grad_mask=None,
                        n_val: Optional[int] = None,
                        checkpoint_dir: Optional[str] = None,
                        checkpoint_interval: int = 0) -> TrainResult:
@@ -130,7 +131,8 @@ def train_nn_streaming(train_conf: ModelTrainConf,
     return train_streaming_core(
         train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
         init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
-        init_params=init_params, fixed_layers=fixed_layers, n_val=n_val,
+        init_params=init_params, fixed_layers=fixed_layers,
+        grad_mask=grad_mask, n_val=n_val,
         spec=spec, checkpoint_dir=checkpoint_dir,
         checkpoint_interval=checkpoint_interval)
 
@@ -166,6 +168,7 @@ def train_streaming_core(train_conf: ModelTrainConf,
                          metric_sum_fn,
                          init_params=None,
                          fixed_layers=None,
+                         grad_mask=None,
                          n_val: Optional[int] = None,
                          spec=None,
                          metric_mass_fn=None,
@@ -208,14 +211,18 @@ def train_streaming_core(train_conf: ModelTrainConf,
     opt_state = mesh_mod.place_replicated(
         mesh, jax.vmap(optimizer.init)(stacked))
 
-    # continuous training's frozen-layer fitting (NNMaster.java:369-379)
+    # continuous training's frozen-layer fitting (NNMaster.java:369-379);
+    # an element-wise grad_mask (structure-growth absorption) wins over
+    # 1-based fixed_layers (FixedLayers=[1] = input→hidden1 weights)
     def _mask_layer(i, layer):
-        freeze = bool(fixed_layers and i in fixed_layers)
+        freeze = bool(fixed_layers and (i + 1) in fixed_layers)
         return jax.tree.map(
             lambda v: jnp.zeros_like(v) if freeze else jnp.ones_like(v),
             layer)
     one_bag = jax.tree.map(lambda p: p[0], stacked)
-    if isinstance(one_bag, list):
+    if grad_mask is not None:
+        grad_mask = jax.tree.map(jnp.asarray, grad_mask)
+    elif isinstance(one_bag, list):
         grad_mask = [_mask_layer(i, layer)
                      for i, layer in enumerate(one_bag)]
     else:

@@ -325,6 +325,7 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
              spec: Optional[nn_mod.MLPSpec] = None,
              init_params: Optional[Any] = None,
              fixed_layers: Optional[List[int]] = None,
+             grad_mask: Optional[Any] = None,
              val_data: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
              checkpoint_dir: Optional[str] = None,
              checkpoint_interval: int = 0,
@@ -333,8 +334,10 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
 
     val_data overrides the random validSetRate split (the reference's
     separate validation dir, ShifuInputFormat). init_params enables
-    continuous training (resume from models/model0.nn);
-    fixed_layers freezes those layer indices.
+    continuous training (resume from models/model0.nn); fixed_layers
+    freezes those 1-BASED layers (FixedLayers=[1] = the input→hidden1
+    weights, `NNMaster.getFixedWights:611-624`); grad_mask overrides
+    with an element-wise {0,1} pytree (structure-growth absorption).
     """
     t0 = time.time()
     spec = spec or nn_mod.MLPSpec.from_train_params(
@@ -362,16 +365,21 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
     else:
         stacked = jax.vmap(lambda k: nn_mod.init_params(spec, k))(bag_keys[:-1])
 
-    grad_mask = jax.tree.map(jnp.ones_like,
-                             jax.tree.map(lambda l: l[0], stacked)
-                             if init_params is None else init_params)
-    if fixed_layers:
-        mask_list = []
-        for i, layer in enumerate(grad_mask):
-            z = 0.0 if i in fixed_layers else 1.0
-            mask_list.append({k: jnp.full_like(v, z)
-                              for k, v in layer.items()})
-        grad_mask = mask_list
+    if grad_mask is None:
+        grad_mask = jax.tree.map(jnp.ones_like,
+                                 jax.tree.map(lambda l: l[0], stacked)
+                                 if init_params is None else init_params)
+        if fixed_layers:
+            # 1-based like the reference's FixedLayers: 1 freezes the
+            # input→hidden1 weight matrix (NNMaster.getFixedWights)
+            mask_list = []
+            for i, layer in enumerate(grad_mask):
+                z = 0.0 if (i + 1) in fixed_layers else 1.0
+                mask_list.append({k: jnp.full_like(v, z)
+                                  for k, v in layer.items()})
+            grad_mask = mask_list
+    else:
+        grad_mask = jax.tree.map(jnp.asarray, grad_mask)
 
     optimizer = optimizer_from_params(train_conf.params)
     early_window = train_conf.earlyStoppingRounds

@@ -176,8 +176,23 @@ def test_bad_subset_strategy(ms):
 
 def test_fixed_layers_without_continuous(ms):
     mc = _mc(ms)
-    mc.train.params["FixedLayers"] = [0]
+    mc.train.params["FixedLayers"] = [1]
     assert "isContinuous" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_fixed_layers_zero_based_rejected(ms):
+    """FixedLayers is 1-based like the reference (layer 1 = the
+    input→hidden1 weights); 0 is a config error, not a silent no-op."""
+    mc = _mc(ms, **{"train.isContinuous": True})
+    mc.train.params["FixedLayers"] = [0]
+    assert "1-based" in _causes(mc, ModelStep.TRAIN)
+
+
+def test_fixed_layers_beyond_hidden_rejected(ms):
+    mc = _mc(ms, **{"train.isContinuous": True})
+    mc.train.params["NumHiddenLayers"] = 2
+    mc.train.params["FixedLayers"] = [3]
+    assert "NumHiddenLayers" in _causes(mc, ModelStep.TRAIN)
 
 
 def test_kfold_with_continuous(ms):

@@ -417,3 +417,119 @@ def test_streaming_split_unbiased_on_label_sorted_input(tmp_path, rng):
     with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
         perf = json.load(f)
     assert perf["areaUnderRoc"] > 0.85
+
+
+# ---------------------------------------------------------------------------
+# continuous training: structure growth with frozen layers
+# (NNMaster.initOrRecoverParams:356-387, fitExistingModelIn:644-684,
+#  NNStructureComparator, TrainModelProcessor:1389-1450)
+# ---------------------------------------------------------------------------
+
+def test_continuous_growth_absorbs_and_freezes(tmp_path, rng):
+    """Train 1x8-hidden, resume as 1x16-hidden with layer 1 fixed:
+    validation error starts at the old model's (exact functional
+    absorption), and the absorbed input→hidden weights are
+    bit-identical after training."""
+    from tests.synth import make_model_set
+    from shifu_tpu.models.spec import load_model
+    root = make_model_set(tmp_path, rng, n_rows=2000,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM"})
+    ctx = run_pipeline(root)
+    old_kind, old_meta, old_params = load_model(ctx.path_finder.model_path(0, "nn"))
+    assert old_meta["spec"]["hidden_dims"] == [8]
+    with open(ctx.path_finder.val_error_path()) as f:
+        old_val = json.load(f)["bestValError"][0]
+
+    # grow to 16 hidden, freeze the absorbed input→hidden1 corner
+    mcj = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcj))
+    mc["train"]["isContinuous"] = True
+    mc["train"]["params"]["NumHiddenNodes"] = [16]
+    mc["train"]["params"]["FixedLayers"] = [1]
+    json.dump(mc, open(mcj, "w"))
+    ctx = ProcessorContext.load(root)
+    assert train_proc.run(ctx) == 0
+
+    new_kind, new_meta, new_params = load_model(
+        ctx.path_finder.model_path(0, "nn"))
+    assert new_meta["spec"]["hidden_dims"] == [16]
+    # absorbed corner of the FIXED layer is bit-identical
+    np.testing.assert_array_equal(np.asarray(new_params[0]["w"])[:, :8],
+                                  np.asarray(old_params[0]["w"]))
+    np.testing.assert_array_equal(np.asarray(new_params[0]["b"])[:8],
+                                  np.asarray(old_params[0]["b"]))
+    # the grown half of the fixed layer DID train (started as random
+    # init from a fixed seed; all-zero would mean it was masked too)
+    # and the output layer absorbed the old weights as its corner start
+    with open(ctx.path_finder.val_error_path()) as f:
+        new_val = json.load(f)["bestValError"][0]
+    # exact absorption: the resumed run can only improve on the old
+    # model's validation error (epoch 0 reproduces it exactly)
+    assert new_val <= old_val * 1.05
+
+
+def test_continuous_shrink_hard_errors(tmp_path, rng):
+    """A new structure that cannot hold the old model must refuse, not
+    warn-and-discard (GuaguaRuntimeException in initOrRecoverParams)."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1200,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM"})
+    run_pipeline(root)
+    mcj = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcj))
+    mc["train"]["isContinuous"] = True
+    mc["train"]["params"]["NumHiddenNodes"] = [4]
+    json.dump(mc, open(mcj, "w"))
+    ctx = ProcessorContext.load(root)
+    with pytest.raises(ValueError, match="cannot hold"):
+        train_proc.run(ctx)
+
+
+def test_absorb_params_function_preserving(rng):
+    """Same-depth growth starts as an exact functional copy: the grown
+    units' cross-connections are zeroed so forward() matches the old
+    network bit-for-bit at step 0."""
+    old_spec = nn_mod.MLPSpec(input_dim=6, hidden_dims=(8,),
+                              activations=("tanh",))
+    new_spec = nn_mod.MLPSpec(input_dim=6, hidden_dims=(16,),
+                              activations=("tanh",))
+    k = jax.random.PRNGKey(3)
+    old_p = nn_mod.init_params(old_spec, k)
+    fresh = nn_mod.init_params(new_spec, jax.random.PRNGKey(4))
+    grown, mask = nn_mod.absorb_params(old_p, fresh, fixed_layers=[1])
+    x = jnp.asarray(rng.normal(0, 1, (32, 6)).astype(np.float32))
+    # mathematically exact (grown cross-weights are zero); float
+    # reassociation across the wider matmul leaves ~1 ulp of noise
+    np.testing.assert_allclose(
+        np.asarray(nn_mod.forward(old_spec, old_p, x)),
+        np.asarray(nn_mod.forward(new_spec, grown, x)), atol=1e-6)
+    # mask freezes exactly the absorbed indices of layer 1
+    assert np.asarray(mask[0]["w"])[:, :8].sum() == 0
+    assert np.asarray(mask[0]["w"])[:, 8:].min() == 1
+    assert np.asarray(mask[1]["w"]).min() == 1   # output layer trains
+
+
+def test_compare_structure():
+    assert nn_mod.compare_structure([6, 8, 1], [6, 8, 1]) == 0
+    assert nn_mod.compare_structure([6, 8, 1], [6, 16, 1]) == 1
+    assert nn_mod.compare_structure([6, 8, 1], [10, 8, 1]) == 1
+    assert nn_mod.compare_structure([6, 8, 1], [6, 8, 8, 1]) == 1
+    assert nn_mod.compare_structure([6, 8, 1], [6, 4, 1]) == -1
+    assert nn_mod.compare_structure([6, 8, 1], [4, 8, 1]) == -1
+    assert nn_mod.compare_structure([6, 8, 1], [6, 8, 2]) == -1
+    assert nn_mod.compare_structure([6, 8, 8, 1], [6, 8, 1]) == -1
+
+
+def test_compare_structure_depth_growth_output_width():
+    """Old output must fit the aligned new HIDDEN layer on depth
+    growth, or absorption would crash on the corner copy."""
+    assert nn_mod.compare_structure([6, 8, 4], [6, 8, 2, 4]) == -1
+    assert nn_mod.compare_structure([6, 8, 4], [6, 8, 4, 4]) == 1
