@@ -259,9 +259,12 @@ def _check_train(mc: ModelConfig, r: ValidateResult) -> None:
         n_hidden = t.get_param("NumHiddenLayers")
         if not isinstance(n_hidden, int):
             # optional param: depth falls back to len(NumHiddenNodes)
-            # (models/nn.parse_arch_params does the same)
+            # (models/nn.parse_arch_params does the same); a grid-form
+            # list-of-lists has no single depth — skip the bound (grid
+            # + isContinuous is rejected below anyway)
             nodes = t.get_param("NumHiddenNodes")
-            n_hidden = len(nodes) if isinstance(nodes, list) else None
+            n_hidden = len(nodes) if isinstance(nodes, list) \
+                and not _grid_list(nodes) else None
         if not isinstance(fixed, list) or \
                 any(not isinstance(i, int) or i < 1 for i in fixed):
             r.fail(f"FixedLayers must be a list of 1-based hidden layer "

@@ -65,6 +65,10 @@ def read_header(ds: ModelSourceDataConf, base_resolver=None) -> List[str]:
         delim = ds.headerDelimiter or "|"
     else:
         files = expand_data_files(resolve(ds.dataPath))
+        if is_parquet(files[0]):
+            # columnar schema IS the header (NNParquetWorker reads the
+            # schema from the parquet footer, not a header line)
+            return [c.strip() for c in parquet_column_names(files[0])]
         opener = _opener_for(files[0])
         with opener(files[0]) as f:
             line = f.readline().rstrip("\r\n")
@@ -75,6 +79,51 @@ def read_header(ds: ModelSourceDataConf, base_resolver=None) -> List[str]:
 def simple_column_name(name: str) -> str:
     """NSColumn semantics: 'namespace::col' matches by its simple name."""
     return name.split("::")[-1].strip()
+
+
+def is_parquet(path: str) -> bool:
+    """Columnar input files (`nn/NNParquetWorker.java:55`,
+    `shifu/guagua/GuaguaParquetMapReduceClient.java`): dispatched by
+    extension, mixable with delimited part files in one dataPath."""
+    return path.split("?")[0].lower().endswith((".parquet", ".parq"))
+
+
+def _parquet_file(path: str):
+    import pyarrow.parquet as pq
+    if fs_mod.has_scheme(path):
+        return pq.ParquetFile(fs_mod.open_text(path, mode="rb"))
+    return pq.ParquetFile(path)
+
+
+def parquet_column_names(path: str) -> List[str]:
+    return [str(c) for c in _parquet_file(path).schema_arrow.names]
+
+
+def _frame_to_contract(df: pd.DataFrame, header, simple,
+                       numeric_columns=None) -> pd.DataFrame:
+    """Make a parquet batch obey the text reader's contract: header
+    names applied positionally, all-string values with missing as ''
+    — except `numeric_columns`, which come back float32 with NaN for
+    missing (the native text parser's convention)."""
+    if len(df.columns) != len(header):
+        raise ValueError(
+            f"parquet file has {len(df.columns)} columns but the header "
+            f"declares {len(header)}")
+    df.columns = list(header)
+    names = simple if simple is not None else list(header)
+    num = set(numeric_columns or ())
+    out = {}
+    for pos, c in enumerate(df.columns):
+        ser = df.iloc[:, pos]
+        if names[pos] in num:
+            out[c] = pd.to_numeric(ser, errors="coerce").astype(np.float32)
+        else:
+            mask = ser.isna()
+            s = ser.astype(str)
+            if mask.any():
+                s = s.mask(mask, "")
+            out[c] = s
+    return pd.DataFrame(out)
 
 
 def _opener_for(path: str):
@@ -111,6 +160,7 @@ def read_raw_table(mc: ModelConfig,
 
     if numeric_columns and max_rows is None and \
             not any(fs_mod.has_scheme(p) for p in files) and \
+            not any(is_parquet(p) for p in files) and \
             os.environ.get("SHIFU_TPU_NATIVE_READER", "1") != "0":
         from shifu_tpu.data.native_reader import read_files_native
         names = simple if simple is not None else list(header)
@@ -123,12 +173,30 @@ def read_raw_table(mc: ModelConfig,
     frames = []
     rows_left = max_rows
     for path in files:
-        skip = 1 if (has_header_line and path == first_file) else 0
-        df = pd.read_csv(
-            path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
-            names=header, skiprows=skip, na_filter=False,
-            engine="c", compression="infer", quoting=3,
-            nrows=rows_left)
+        if is_parquet(path):
+            if rows_left is not None:
+                # bounded read (init's type-sampling head): stop at the
+                # row-group boundary past rows_left instead of decoding
+                # the whole file (the text path's nrows analog)
+                batches, have = [], 0
+                for b in _parquet_file(path).iter_batches(
+                        batch_size=max(rows_left, 1)):
+                    batches.append(b.to_pandas())
+                    have += len(batches[-1])
+                    if have >= rows_left:
+                        break
+                raw = pd.concat(batches, ignore_index=True) \
+                    .iloc[:rows_left]
+            else:
+                raw = _parquet_file(path).read().to_pandas()
+            df = _frame_to_contract(raw, header, simple, numeric_columns)
+        else:
+            skip = 1 if (has_header_line and path == first_file) else 0
+            df = pd.read_csv(
+                path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
+                names=header, skiprows=skip, na_filter=False,
+                engine="c", compression="infer", quoting=3,
+                nrows=rows_left)
         frames.append(df)
         if rows_left is not None:
             rows_left -= len(df)
@@ -173,6 +241,16 @@ def iter_raw_table(mc: ModelConfig,
     ds, header, files, first_file, has_header_line, simple = \
         _table_layout(mc, ds, file_shard)
     for path in files:
+        if is_parquet(path):
+            # row-group-bounded batches: the columnar analog of the
+            # chunked CSV reader (never materializes the file)
+            for batch in _parquet_file(path).iter_batches(
+                    batch_size=chunk_rows):
+                df = _frame_to_contract(batch.to_pandas(), header, simple)
+                if simple is not None:
+                    df.columns = simple
+                yield df.reset_index(drop=True)
+            continue
         skip = 1 if (has_header_line and path == first_file) else 0
         reader = pd.read_csv(
             path, sep=ds.dataDelimiter or "|", header=None, dtype=str,

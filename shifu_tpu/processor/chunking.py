@@ -43,8 +43,13 @@ def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
                 return int(fs_mod.size(p))
             return os.path.getsize(p) if os.path.exists(p) else 0
 
-        total = sum(_size(p) * (6 if p.endswith((".gz", ".bz2")) else 1)
-                    for p in files)
+        def _expansion(p):
+            if p.endswith((".gz", ".bz2")):
+                return 6
+            from shifu_tpu.data.reader import is_parquet
+            return 4 if is_parquet(p) else 1   # columnar compression
+
+        total = sum(_size(p) * _expansion(p) for p in files)
     except (OSError, FileNotFoundError, ValueError, RuntimeError) as e:
         # a silent 0 here sends a genuinely >RAM dataset down the
         # resident path — leave the operator a trace of why

@@ -49,10 +49,33 @@ def make_raw_frame(rng, n_rows: int = 2000, n_num: int = 6, n_cat: int = 2,
     return header, rows, y
 
 
+def write_parquet_part(path, header, rows, row_group_size: int = 0):
+    """Typed parquet part file: numeric columns as float64 (missing
+    tokens → null), the rest as string (missing → null) — the layout
+    NNParquetWorker consumes. Small row groups exercise the chunked
+    batch reader."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    missing = {"?", ""}
+    cols = {}
+    for j, name in enumerate(header):
+        v = rows[:, j]
+        if name.startswith("num_") or name == "wgt":
+            cols[name] = pa.array(
+                [None if s in missing else float(s) for s in v],
+                type=pa.float64())
+        else:
+            cols[name] = pa.array([None if s in missing else str(s)
+                                   for s in v], type=pa.string())
+    pq.write_table(pa.table(cols), path,
+                   row_group_size=row_group_size or len(rows))
+
+
 def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
                    algorithm: str = "NN", train_params: dict | None = None,
                    n_classes: int = 2, multi_classify: str = "NATIVE",
-                   seg_expressions: list | None = None):
+                   seg_expressions: list | None = None,
+                   data_format: str = "text"):
     root = os.path.join(str(tmp_path), "ModelSet")
     data_dir = os.path.join(root, "data")
     eval_dir = os.path.join(root, "evaldata")
@@ -65,17 +88,24 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
         pos_tags, neg_tags = ["c0"], [f"c{k}" for k in range(1, n_classes)]
     else:
         pos_tags, neg_tags = ["M"], ["B"]
-    with open(os.path.join(data_dir, ".pig_header"), "w") as f:
-        f.write("|".join(header) + "\n")
     split = int(n_rows * 0.8)
-    with open(os.path.join(data_dir, "part-00000"), "w") as f:
-        for r in rows[:split]:
-            f.write("|".join(r) + "\n")
-    with open(os.path.join(eval_dir, ".pig_header"), "w") as f:
-        f.write("|".join(header) + "\n")
-    with open(os.path.join(eval_dir, "part-00000"), "w") as f:
-        for r in rows[split:]:
-            f.write("|".join(r) + "\n")
+    if data_format == "parquet":
+        # schema carries the header (no .pig_header / headerPath)
+        write_parquet_part(os.path.join(data_dir, "part-00000.parquet"),
+                           header, rows[:split], row_group_size=256)
+        write_parquet_part(os.path.join(eval_dir, "part-00000.parquet"),
+                           header, rows[split:], row_group_size=256)
+    else:
+        with open(os.path.join(data_dir, ".pig_header"), "w") as f:
+            f.write("|".join(header) + "\n")
+        with open(os.path.join(data_dir, "part-00000"), "w") as f:
+            for r in rows[:split]:
+                f.write("|".join(r) + "\n")
+        with open(os.path.join(eval_dir, ".pig_header"), "w") as f:
+            f.write("|".join(header) + "\n")
+        with open(os.path.join(eval_dir, "part-00000"), "w") as f:
+            for r in rows[split:]:
+                f.write("|".join(r) + "\n")
     with open(os.path.join(root, "columns", "meta.column.names"), "w") as f:
         f.write("rowid\n")
     with open(os.path.join(root, "columns", "categorical.column.names"), "w") as f:
@@ -87,7 +117,8 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
                   "customPaths": {}},
         "dataSet": {
             "source": "LOCAL", "dataPath": data_dir, "dataDelimiter": "|",
-            "headerPath": os.path.join(data_dir, ".pig_header"),
+            "headerPath": ("" if data_format == "parquet"
+                           else os.path.join(data_dir, ".pig_header")),
             "headerDelimiter": "|", "filterExpressions": "",
             "weightColumnName": "wgt", "targetColumnName": "diagnosis",
             "posTags": pos_tags, "negTags": neg_tags,
@@ -124,7 +155,8 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
             "name": "Eval1",
             "dataSet": {
                 "source": "LOCAL", "dataPath": eval_dir, "dataDelimiter": "|",
-                "headerPath": os.path.join(eval_dir, ".pig_header"),
+                "headerPath": ("" if data_format == "parquet"
+                               else os.path.join(eval_dir, ".pig_header")),
                 "headerDelimiter": "|", "filterExpressions": "",
                 "weightColumnName": "wgt",
                 "targetColumnName": "diagnosis",

@@ -205,3 +205,72 @@ def test_iter_raw_table_matches_read(tmp_path):
                    ignore_index=True)
     r0 = read_raw_table(mc, file_shard=(0, 2))
     pd.testing.assert_frame_equal(s0, r0.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# parquet input (NNParquetWorker.java:55, GuaguaParquetMapReduceClient)
+# ---------------------------------------------------------------------------
+
+def test_parquet_reader_matches_text(tmp_path, rng):
+    """The same synthetic table read via parquet and via delimited text
+    must produce identical string frames (missing → '')."""
+    import pandas as pd
+    from tests.synth import make_model_set
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import iter_raw_table, read_raw_table
+    seed_rows = 500
+    rng2 = np.random.default_rng(77)
+    t_root = make_model_set(tmp_path / "t", rng2, n_rows=seed_rows)
+    rng2 = np.random.default_rng(77)
+    p_root = make_model_set(tmp_path / "p", rng2, n_rows=seed_rows,
+                            data_format="parquet")
+    t_df = read_raw_table(ModelConfig.load(t_root))
+    p_df = read_raw_table(ModelConfig.load(p_root))
+    assert list(t_df.columns) == list(p_df.columns)
+    for c in t_df.columns:
+        tv = t_df[c].to_numpy(dtype=object)
+        pv = p_df[c].to_numpy(dtype=object)
+        if c.startswith("num_") or c == "wgt":
+            # float round-trip: compare numerically, '' stays ''
+            tn = pd.to_numeric(pd.Series(tv), errors="coerce")
+            pn = pd.to_numeric(pd.Series(pv), errors="coerce")
+            assert np.isnan(tn).equals(np.isnan(pn)) if hasattr(np.isnan(tn), "equals") else True
+            np.testing.assert_allclose(tn.fillna(0), pn.fillna(0), rtol=1e-6)
+        else:
+            # missing tokens: text carries '?', parquet nulls read back
+            # as '' — both are in missingOrInvalidValues, so the
+            # pipeline treats them identically
+            tvn = np.where(tv == "?", "", tv)
+            assert (tvn == pv).all(), c
+    # chunked iteration spans row groups and concatenates to the same table
+    chunks = list(iter_raw_table(ModelConfig.load(p_root), chunk_rows=100))
+    assert all(len(c) <= 256 for c in chunks)   # row-group bounded
+    whole = pd.concat(chunks, ignore_index=True)
+    assert len(whole) == len(p_df)
+    assert (whole["diagnosis"].to_numpy() == p_df["diagnosis"].to_numpy()).all()
+
+
+def test_parquet_full_pipeline(tmp_path, rng):
+    """A parquet model set runs init→stats→norm→train→eval end-to-end
+    with the schema as the header (VERDICT r3 next #6)."""
+    import json as json_mod
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import eval as eval_proc
+    from shifu_tpu.processor import init as init_proc
+    from shifu_tpu.processor import norm as norm_proc
+    from shifu_tpu.processor import stats as stats_proc
+    from shifu_tpu.processor import train as train_proc
+    from shifu_tpu.processor.base import ProcessorContext
+    root = make_model_set(tmp_path, rng, n_rows=1500,
+                          data_format="parquet")
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    assert eval_proc.run(ctx) == 0
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json_mod.load(f)
+    assert perf["areaUnderRoc"] > 0.85
+    # init inferred the header from the parquet schema
+    names = [c.columnName for c in ctx.column_configs]
+    assert "num_0" in names and "cat_0" in names and "diagnosis" in names
