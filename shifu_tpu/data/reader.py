@@ -99,30 +99,33 @@ def parquet_column_names(path: str) -> List[str]:
     return [str(c) for c in _parquet_file(path).schema_arrow.names]
 
 
-def _frame_to_contract(df: pd.DataFrame, header, simple,
+def _table_to_contract(tbl, header, simple,
                        numeric_columns=None) -> pd.DataFrame:
-    """Make a parquet batch obey the text reader's contract: header
-    names applied positionally, all-string values with missing as ''
-    — except `numeric_columns`, which come back float32 with NaN for
-    missing (the native text parser's convention)."""
-    if len(df.columns) != len(header):
+    """Make a parquet table/batch obey the text reader's contract:
+    header names applied positionally, all-string values with missing
+    as '' — except `numeric_columns`, which come back float32 with NaN
+    for missing (the native text parser's convention). Stringification
+    is an ARROW cast, not pandas astype: pandas upcasts a nullable
+    int64 to float64 first, turning category code 5 into '5.0' and
+    silently unmatching every vocab learned from text data; arrow
+    casts from the stored type ('5' stays '5')."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if tbl.num_columns != len(header):
         raise ValueError(
-            f"parquet file has {len(df.columns)} columns but the header "
+            f"parquet file has {tbl.num_columns} columns but the header "
             f"declares {len(header)}")
-    df.columns = list(header)
     names = simple if simple is not None else list(header)
     num = set(numeric_columns or ())
     out = {}
-    for pos, c in enumerate(df.columns):
-        ser = df.iloc[:, pos]
+    for pos, c in enumerate(header):
+        col = tbl.column(pos)
         if names[pos] in num:
-            out[c] = pd.to_numeric(ser, errors="coerce").astype(np.float32)
+            out[c] = pd.to_numeric(col.to_pandas(), errors="coerce") \
+                .astype(np.float32)
         else:
-            mask = ser.isna()
-            s = ser.astype(str)
-            if mask.any():
-                s = s.mask(mask, "")
-            out[c] = s
+            s = pc.fill_null(pc.cast(col, pa.string()), "")
+            out[c] = s.to_pandas().astype(str)
     return pd.DataFrame(out)
 
 
@@ -172,24 +175,31 @@ def read_raw_table(mc: ModelConfig,
             return df
     frames = []
     rows_left = max_rows
+    # a MIXED text+parquet dataPath must stay dtype-homogeneous: the
+    # text branch yields all-string frames, so the float32
+    # numeric_columns fast-path only applies when every file is parquet
+    pq_numeric = numeric_columns \
+        if all(is_parquet(p) for p in files) else None
     for path in files:
         if is_parquet(path):
+            import pyarrow as pa
+            pf = _parquet_file(path)
             if rows_left is not None:
                 # bounded read (init's type-sampling head): stop at the
                 # row-group boundary past rows_left instead of decoding
                 # the whole file (the text path's nrows analog)
                 batches, have = [], 0
-                for b in _parquet_file(path).iter_batches(
-                        batch_size=max(rows_left, 1)):
-                    batches.append(b.to_pandas())
-                    have += len(batches[-1])
+                for b in pf.iter_batches(batch_size=max(rows_left, 1)):
+                    batches.append(b)
+                    have += len(b)
                     if have >= rows_left:
                         break
-                raw = pd.concat(batches, ignore_index=True) \
-                    .iloc[:rows_left]
+                tbl = pa.Table.from_batches(batches,
+                                            schema=pf.schema_arrow) \
+                    .slice(0, rows_left)
             else:
-                raw = _parquet_file(path).read().to_pandas()
-            df = _frame_to_contract(raw, header, simple, numeric_columns)
+                tbl = pf.read()
+            df = _table_to_contract(tbl, header, simple, pq_numeric)
         else:
             skip = 1 if (has_header_line and path == first_file) else 0
             df = pd.read_csv(
@@ -244,9 +254,11 @@ def iter_raw_table(mc: ModelConfig,
         if is_parquet(path):
             # row-group-bounded batches: the columnar analog of the
             # chunked CSV reader (never materializes the file)
+            import pyarrow as pa
             for batch in _parquet_file(path).iter_batches(
                     batch_size=chunk_rows):
-                df = _frame_to_contract(batch.to_pandas(), header, simple)
+                df = _table_to_contract(pa.Table.from_batches([batch]),
+                                        header, simple)
                 if simple is not None:
                     df.columns = simple
                 yield df.reset_index(drop=True)

@@ -274,3 +274,33 @@ def test_parquet_full_pipeline(tmp_path, rng):
     # init inferred the header from the parquet schema
     names = [c.columnName for c in ctx.column_configs]
     assert "num_0" in names and "cat_0" in names and "diagnosis" in names
+
+
+def test_parquet_int_categories_and_empty_parts(tmp_path, rng):
+    """Int-typed parquet categorical codes stringify as '5' (arrow-level
+    cast), never pandas' null-upcast '5.0'; zero-row part files (Hadoop
+    writers emit them) read as empty, not a crash."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from tests.synth import make_model_set
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import read_raw_table
+    root = make_model_set(tmp_path, rng, n_rows=50, data_format="parquet")
+    data_dir = os.path.join(root, "data")
+    # rewrite the part with an int64 categorical (with a null) + add an
+    # empty trailing part
+    src = pq.read_table(os.path.join(data_dir, "part-00000.parquet"))
+    codes = pa.array([5 if i % 2 else 7 for i in range(len(src) - 1)]
+                     + [None], type=pa.int64())
+    tbl = src.set_column(src.schema.get_field_index("cat_0"), "cat_0", codes)
+    pq.write_table(tbl, os.path.join(data_dir, "part-00000.parquet"))
+    pq.write_table(tbl.slice(0, 0),
+                   os.path.join(data_dir, "part-00001.parquet"))
+    mc = ModelConfig.load(root)
+    df = read_raw_table(mc)
+    assert set(df["cat_0"].unique()) == {"5", "7", ""}
+    assert len(df) == len(src)
+    # bounded head over the same layout (exercises the batch early-stop
+    # AND the empty part)
+    head = read_raw_table(mc, max_rows=10)
+    assert len(head) == 10
