@@ -1,0 +1,486 @@
+"""Independent PMML scoring engine for conformance tests.
+
+Written strictly from the PMML 4.2 specification (dmg.org/pmml/v4-2-1)
+— NOT from `shifu_tpu/pmml.py`. The reference proves its exports
+against an external evaluator (`core/pmml/PMMLTranslatorTest.java`,
+`PMMLVerifySuit.java` via jpmml); this image cannot install
+pypmml/jpmml (JVM-backed, no pip), so this module plays that role: a
+second, independently-derived implementation of the standard whose
+scores must agree with the repo's writer + built-in evaluator. To stay
+independent it imports nothing from shifu_tpu, parses namespaces
+generically, evaluates row-at-a-time (jpmml-style) instead of
+vectorized, and implements the SPEC semantics (interval closures,
+piecewise LinearNorm interpolation, missing-value strategies) rather
+than the writer's emission subset.
+"""
+
+from __future__ import annotations
+
+import math
+import xml.etree.ElementTree as ET
+
+
+def _local(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _children(el, name=None):
+    return [c for c in el if name is None or _local(c.tag) == name]
+
+
+def _child(el, name):
+    for c in el:
+        if _local(c.tag) == name:
+            return c
+    return None
+
+
+MISSING = object()
+
+
+def _is_missing(v) -> bool:
+    return v is MISSING or v is None or \
+        (isinstance(v, float) and math.isnan(v))
+
+
+def _as_number(v):
+    if _is_missing(v):
+        return MISSING
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return MISSING
+
+
+# -- activation functions (spec 4.2 NeuralNetwork) --------------------------
+
+def _activation(name, z):
+    if name == "logistic":
+        return 1.0 / (1.0 + math.exp(-min(max(z, -700.0), 700.0)))
+    if name == "tanh":
+        return math.tanh(z)
+    if name == "rectifier":
+        return max(z, 0.0)
+    if name == "identity" or name == "linear":
+        return z
+    if name == "sine":
+        return math.sin(z)
+    if name == "Gauss":
+        return math.exp(-(z * z))
+    if name == "exponential":
+        return math.exp(z)
+    if name == "reciprocal":
+        return 1.0 / z
+    if name == "square":
+        return z * z
+    raise ValueError(f"activationFunction {name!r} not in PMML 4.2")
+
+
+_APPLY_FNS = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b,
+    "min": min, "max": max, "pow": lambda a, b: a ** b,
+}
+_APPLY_UNARY = {
+    "exp": math.exp, "ln": math.log, "sqrt": math.sqrt, "abs": abs,
+    "floor": math.floor, "ceil": math.ceil,
+}
+
+
+class PMMLScorer:
+    """Score raw records (dict of column → string/number) against one
+    PMML document, per the 4.2 spec."""
+
+    def __init__(self, xml: str):
+        self.root = ET.fromstring(xml)
+        if _local(self.root.tag) != "PMML":
+            raise ValueError("not a PMML document")
+        self.types = {}
+        dd = _child(self.root, "DataDictionary")
+        for f in _children(dd, "DataField"):
+            self.types[f.get("name")] = f.get("optype", "continuous")
+        self.model = None
+        for c in self.root:
+            if _local(c.tag) in ("NeuralNetwork", "RegressionModel",
+                                 "TreeModel", "MiningModel"):
+                self.model = c
+                break
+        if self.model is None:
+            raise ValueError("no supported model element")
+
+    # -- public ------------------------------------------------------------
+
+    def score(self, records):
+        """records: dict of column → list (pandas orient='list') or a
+        list of per-row dicts. Returns a list of float scores."""
+        if isinstance(records, dict):
+            cols = list(records)
+            n = len(records[cols[0]]) if cols else 0
+            rows = [{c: records[c][i] for c in cols} for i in range(n)]
+        else:
+            rows = list(records)
+        return [self._score_row(r) for r in rows]
+
+    def _score_row(self, raw):
+        fields = {}
+        for name, optype in self.types.items():
+            if name not in raw:
+                continue
+            v = raw[name]
+            if optype == "continuous":
+                fields[name] = _as_number(
+                    MISSING if (isinstance(v, str) and v.strip() == "")
+                    else v)
+            else:
+                fields[name] = MISSING if (
+                    _is_missing(v) or (isinstance(v, str) and v == "")) \
+                    else str(v)
+        return self._eval_model(self.model, fields)
+
+    # -- expressions (spec: EXPRESSION) -------------------------------------
+
+    def _expr(self, el, fields):
+        tag = _local(el.tag)
+        if tag == "Constant":
+            return float(el.text)
+        if tag == "FieldRef":
+            v = fields.get(el.get("field"), MISSING)
+            if _is_missing(v) and el.get("mapMissingTo") is not None:
+                return float(el.get("mapMissingTo"))
+            return v
+        if tag == "NormContinuous":
+            return self._norm_continuous(el, fields)
+        if tag == "Discretize":
+            return self._discretize(el, fields)
+        if tag == "MapValues":
+            return self._map_values(el, fields)
+        if tag == "Apply":
+            return self._apply(el, fields)
+        raise ValueError(f"expression {tag!r} not supported")
+
+    def _apply(self, el, fields):
+        fn = el.get("function")
+        args = [self._expr(c, fields) for c in el
+                if _local(c.tag) != "Extension"]
+        if any(_is_missing(a) for a in args):
+            mm = el.get("mapMissingTo")
+            return float(mm) if mm is not None else MISSING
+        args = [float(a) for a in args]
+        if fn in _APPLY_UNARY and len(args) == 1:
+            return _APPLY_UNARY[fn](args[0])
+        if fn in _APPLY_FNS:
+            # n-ary fold, left to right (spec: built-in arithmetics)
+            acc = args[0]
+            for a in args[1:]:
+                acc = _APPLY_FNS[fn](acc, a)
+            return acc
+        raise ValueError(f"Apply function {fn!r} not supported")
+
+    def _norm_continuous(self, el, fields):
+        v = _as_number(fields.get(el.get("field"), MISSING))
+        if _is_missing(v):
+            mm = el.get("mapMissingTo")
+            return float(mm) if mm is not None else MISSING
+        pts = [(float(ln.get("orig")), float(ln.get("norm")))
+               for ln in _children(el, "LinearNorm")]
+        pts.sort()
+        outliers = el.get("outliers", "asIs")
+        if v <= pts[0][0]:
+            if outliers == "asExtremeValues":
+                return pts[0][1]
+            if outliers == "asMissingValues":
+                return MISSING
+            seg = (pts[0], pts[1])
+        elif v >= pts[-1][0]:
+            if outliers == "asExtremeValues":
+                return pts[-1][1]
+            if outliers == "asMissingValues":
+                return MISSING
+            seg = (pts[-2], pts[-1])
+        else:
+            seg = None
+            for a, b in zip(pts, pts[1:]):
+                if a[0] <= v <= b[0]:
+                    seg = (a, b)
+                    break
+        (o1, n1), (o2, n2) = seg
+        if o2 == o1:
+            return n1
+        return n1 + (v - o1) / (o2 - o1) * (n2 - n1)
+
+    def _discretize(self, el, fields):
+        v = _as_number(fields.get(el.get("field"), MISSING))
+        if _is_missing(v):
+            mm = el.get("mapMissingTo")
+            return float(mm) if mm is not None else MISSING
+        for b in _children(el, "DiscretizeBin"):
+            iv = _child(b, "Interval")
+            closure = iv.get("closure", "closedOpen")
+            lo = iv.get("leftMargin")
+            hi = iv.get("rightMargin")
+            lo_ok = True if lo is None else (
+                v >= float(lo) if closure.startswith("closed")
+                else v > float(lo))
+            hi_ok = True if hi is None else (
+                v <= float(hi) if closure.endswith("Closed")
+                else v < float(hi))
+            if lo_ok and hi_ok:
+                out = b.get("binValue")
+                return float(out)
+        dv = el.get("defaultValue")
+        return float(dv) if dv is not None else MISSING
+
+    def _map_values(self, el, fields):
+        pair = _child(el, "FieldColumnPair")
+        v = fields.get(pair.get("field"), MISSING)
+        if _is_missing(v):
+            mm = el.get("mapMissingTo")
+            return float(mm) if mm is not None else MISSING
+        in_col = pair.get("column")
+        out_col = el.get("outputColumn")
+        for row in _children(_child(el, "InlineTable"), "row"):
+            cells = {_local(c.tag): (c.text if c.text is not None else "")
+                     for c in row}
+            if cells.get(in_col) == str(v):
+                return float(cells[out_col])
+        dv = el.get("defaultValue")
+        return float(dv) if dv is not None else MISSING
+
+    # -- transformations -----------------------------------------------------
+
+    def _with_local_transforms(self, model_el, fields):
+        lt = _child(model_el, "LocalTransformations")
+        if lt is None:
+            return fields
+        fields = dict(fields)
+        for df in _children(lt, "DerivedField"):
+            body = [c for c in df if _local(c.tag) != "Extension"][0]
+            fields[df.get("name")] = self._expr(body, fields)
+        return fields
+
+    def _output_transform(self, model_el, fields, predicted):
+        """Output/OutputField feature=transformedValue: evaluate its
+        expression with the predictedValue field(s) visible."""
+        out = _child(model_el, "Output")
+        if out is None:
+            return predicted
+        env = dict(fields)
+        value = predicted
+        for of in _children(out, "OutputField"):
+            if of.get("feature", "predictedValue") == "predictedValue":
+                env[of.get("name")] = predicted
+        for of in _children(out, "OutputField"):
+            if of.get("feature") == "transformedValue":
+                body = [c for c in of if _local(c.tag) != "Extension"]
+                if body:
+                    value = self._expr(body[0], env)
+        return value
+
+    # -- models --------------------------------------------------------------
+
+    def _eval_model(self, m, fields):
+        tag = _local(m.tag)
+        if tag == "NeuralNetwork":
+            return self._neural_network(m, fields)
+        if tag == "RegressionModel":
+            return self._regression(m, fields)
+        if tag == "TreeModel":
+            return self._tree(m, fields)
+        if tag == "MiningModel":
+            return self._mining(m, fields)
+        raise ValueError(f"model {tag!r} not supported")
+
+    def _neural_network(self, net, fields):
+        fields = self._with_local_transforms(net, fields)
+        acts = {}
+        for ni in _children(_child(net, "NeuralInputs"), "NeuralInput"):
+            df = _child(ni, "DerivedField")
+            body = [c for c in df if _local(c.tag) != "Extension"][0]
+            v = self._expr(body, fields)
+            acts[ni.get("id")] = 0.0 if _is_missing(v) else float(v)
+        last_ids = []
+        for nl in _children(net, "NeuralLayer"):
+            fn = nl.get("activationFunction",
+                        net.get("activationFunction"))
+            new = {}
+            for neuron in _children(nl, "Neuron"):
+                z = float(neuron.get("bias", "0"))
+                for con in _children(neuron, "Con"):
+                    z += acts[con.get("from")] * float(con.get("weight"))
+                new[neuron.get("id")] = _activation(fn, z)
+            acts.update(new)
+            last_ids = list(new)
+        no = _child(_child(net, "NeuralOutputs"), "NeuralOutput")
+        out_id = no.get("outputNeuron") if no is not None else last_ids[0]
+        return self._output_transform(net, fields, acts[out_id])
+
+    def _regression(self, rm, fields):
+        fields = self._with_local_transforms(rm, fields)
+        tbl = _child(rm, "RegressionTable")
+        z = float(tbl.get("intercept", "0"))
+        for p in _children(tbl, "NumericPredictor"):
+            v = _as_number(fields.get(p.get("name"), MISSING))
+            if _is_missing(v):
+                return MISSING   # spec: missing input → missing result
+            z += float(p.get("coefficient")) * \
+                v ** float(p.get("exponent", "1"))
+        for p in _children(tbl, "CategoricalPredictor"):
+            v = fields.get(p.get("name"), MISSING)
+            if not _is_missing(v) and str(v) == p.get("value"):
+                z += float(p.get("coefficient"))
+        norm = rm.get("normalizationMethod", "none")
+        if norm == "logit":
+            z = 1.0 / (1.0 + math.exp(-min(max(z, -700.0), 700.0)))
+        elif norm == "exp":
+            z = math.exp(z)
+        return self._output_transform(rm, fields, z)
+
+    def _predicate(self, pred, fields):
+        """Spec 4.2 predicate semantics: True/False/unknown(None)."""
+        tag = _local(pred.tag)
+        if tag == "True":
+            return True
+        if tag == "False":
+            return False
+        if tag == "SimplePredicate":
+            op = pred.get("operator")
+            v = fields.get(pred.get("field"), MISSING)
+            if op == "isMissing":
+                return _is_missing(v)
+            if op == "isNotMissing":
+                return not _is_missing(v)
+            if _is_missing(v):
+                return None
+            t = pred.get("value")
+            # categorical fields stay strings end-to-end (spec: compare
+            # per the field's optype — _score_row already typed v, so
+            # a str here IS a categorical value and must NOT coerce:
+            # '1.0' vs '1' are different categories)
+            if isinstance(v, str):
+                t = str(t)
+            else:
+                tn = _as_number(t)
+                if tn is MISSING:
+                    return None
+                t = tn
+            return {"equal": v == t, "notEqual": v != t,
+                    "lessThan": v < t, "lessOrEqual": v <= t,
+                    "greaterThan": v > t, "greaterOrEqual": v >= t}[op]
+        if tag == "SimpleSetPredicate":
+            v = fields.get(pred.get("field"), MISSING)
+            if _is_missing(v):
+                return None
+            arr = _child(pred, "Array")
+            txt = (arr.text or "").strip()
+            # space-separated, values may be double-quoted
+            vals, cur, q = [], [], False
+            for ch in txt:
+                if ch == '"':
+                    q = not q
+                elif ch.isspace() and not q:
+                    if cur:
+                        vals.append("".join(cur))
+                        cur = []
+                else:
+                    cur.append(ch)
+            if cur:
+                vals.append("".join(cur))
+            isin = str(v) in vals
+            return isin if pred.get("booleanOperator") == "isIn" \
+                else not isin
+        if tag == "CompoundPredicate":
+            op = pred.get("booleanOperator")
+            parts = [self._predicate(c, fields) for c in pred
+                     if _local(c.tag) != "Extension"]
+            if op == "and":
+                if any(p is False for p in parts):
+                    return False
+                return None if any(p is None for p in parts) else True
+            if op == "or":
+                if any(p is True for p in parts):
+                    return True
+                return None if any(p is None for p in parts) else False
+            if op == "surrogate":
+                for p in parts:
+                    if p is not None:
+                        return p
+                return None
+            raise ValueError(f"CompoundPredicate {op!r} not supported")
+        raise ValueError(f"predicate {tag!r} not supported")
+
+    def _tree(self, tm, fields):
+        fields = self._with_local_transforms(tm, fields)
+        missing_strategy = tm.get("missingValueStrategy", "none")
+        node = _child(tm, "Node")
+        last_score = node.get("score")
+        while True:
+            children = _children(node, "Node")
+            if not children:
+                return float(node.get("score"))
+            if node.get("score") is not None:
+                last_score = node.get("score")
+            chosen = None
+            saw_unknown = False
+            for ch in children:
+                pred = [c for c in ch
+                        if _local(c.tag) in ("True", "False",
+                                             "SimplePredicate",
+                                             "SimpleSetPredicate",
+                                             "CompoundPredicate")][0]
+                r = self._predicate(pred, fields)
+                if r is True:
+                    chosen = ch
+                    break
+                if r is None:
+                    saw_unknown = True
+            if chosen is None:
+                if saw_unknown and missing_strategy == "defaultChild":
+                    dc = node.get("defaultChild")
+                    chosen = next((c for c in children
+                                   if c.get("id") == dc), None)
+                if chosen is None:
+                    # noTrueChildStrategy
+                    if tm.get("noTrueChildStrategy",
+                              "returnNullPrediction") \
+                            == "returnLastPrediction" and \
+                            last_score is not None:
+                        return float(last_score)
+                    return MISSING
+            node = chosen
+
+    def _mining(self, mm, fields):
+        fields = self._with_local_transforms(mm, fields)
+        seg_el = _child(mm, "Segmentation")
+        method = seg_el.get("multipleModelMethod")
+        vals, weights = [], []
+        for s in _children(seg_el, "Segment"):
+            pred = [c for c in s
+                    if _local(c.tag) in ("True", "False", "SimplePredicate",
+                                         "SimpleSetPredicate",
+                                         "CompoundPredicate")]
+            if pred and self._predicate(pred[0], fields) is not True:
+                continue
+            sub = [c for c in s
+                   if _local(c.tag) in ("NeuralNetwork", "RegressionModel",
+                                        "TreeModel", "MiningModel")][0]
+            v = self._eval_model(sub, fields)
+            if _is_missing(v):
+                return MISSING
+            vals.append(float(v))
+            weights.append(float(s.get("weight", "1")))
+        if not vals:
+            return MISSING
+        if method == "sum":
+            agg = sum(vals)
+        elif method == "weightedAverage":
+            agg = sum(v * w for v, w in zip(vals, weights)) / sum(weights)
+        elif method == "average":
+            agg = sum(vals) / len(vals)
+        else:
+            # unsupported methods must raise, not silently average —
+            # a conformance check that guesses defeats its purpose
+            raise ValueError(
+                f"multipleModelMethod {method!r} not supported")
+        return self._output_transform(mm, fields, agg)

@@ -101,13 +101,40 @@ def _assert_same_structure(got: ET.Element, want: ET.Element, path="/"):
         _assert_same_structure(gc, wc, path=f"{path}{gt}[{i}]/")
 
 
+@pytest.fixture(scope="session")
+def built_fixtures(tmp_path_factory):
+    """One _build_fixture run per kind per session — several tests
+    compare against the same deterministic export instead of each
+    re-training identical models."""
+    cache = {}
+
+    def get(kind):
+        if kind not in cache:
+            cache[kind] = _build_fixture(
+                str(tmp_path_factory.mktemp(f"pmml_{kind}")), kind)
+        return cache[kind]
+    return get
+
+
+def _assert_internal_external_agree(xml, df):
+    """Built-in evaluator vs the independent spec implementation: one
+    agreement bar for every conformance test."""
+    from shifu_tpu import pmml as pmml_mod
+    from tests.pmml_external_eval import PMMLScorer
+    internal = np.asarray(pmml_mod.evaluate_pmml(xml, df), np.float64)
+    external = np.asarray(
+        PMMLScorer(xml).score(df.to_dict(orient="list")), np.float64)
+    assert np.isfinite(external).all()
+    np.testing.assert_allclose(external, internal, rtol=1e-6, atol=1e-4)
+
+
 @pytest.mark.parametrize("kind", sorted(FIXTURES))
-def test_pmml_matches_golden(tmp_path, kind):
+def test_pmml_matches_golden(built_fixtures, kind):
     golden_xml = os.path.join(GOLDEN, f"{kind}.pmml")
     golden_scores = os.path.join(GOLDEN, f"{kind}.scores.json")
     assert os.path.exists(golden_xml), \
         "golden missing — run: python tests/test_pmml_golden.py regen"
-    _, pmml_path, scores = _build_fixture(tmp_path, kind)
+    _, pmml_path, scores = built_fixtures(kind)
     got = ET.parse(pmml_path).getroot()
     want = ET.parse(golden_xml).getroot()
     _assert_same_structure(got, want)
@@ -175,3 +202,72 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "regen":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         regen()
+
+
+@pytest.mark.parametrize("kind", sorted(FIXTURES))
+def test_golden_scores_with_independent_evaluator(kind):
+    """Conformance against a second, independently-written PMML
+    implementation (tests/pmml_external_eval.py, derived from the 4.2
+    spec, zero shifu_tpu imports) — the PMMLVerifySuit/jpmml analog
+    for an image where pypmml cannot be installed. Scores must agree
+    with the golden sidecar to 1e-4 (VERDICT r3 next #7)."""
+    from tests.pmml_external_eval import PMMLScorer
+    golden_xml = os.path.join(GOLDEN, f"{kind}.pmml")
+    side = json.load(open(os.path.join(GOLDEN, f"{kind}.scores.json")))
+    got = PMMLScorer(open(golden_xml).read()).score(side["records"])
+    np.testing.assert_allclose(np.asarray(got, np.float64),
+                               np.asarray(side["scores"]),
+                               rtol=1e-6, atol=1e-4)
+
+
+@pytest.mark.parametrize("kind", sorted(FIXTURES))
+def test_fresh_export_scores_with_independent_evaluator(built_fixtures,
+                                                        kind):
+    """A freshly-trained export must also score identically through the
+    built-in evaluator and the independent spec implementation."""
+    from shifu_tpu.data.reader import read_raw_table
+    from shifu_tpu.processor.base import ProcessorContext
+    root, pmml_path, _ = built_fixtures(kind)
+    ctx = ProcessorContext.load(root)
+    df = read_raw_table(ctx.model_config).head(40)
+    _assert_internal_external_agree(open(pmml_path).read(), df)
+
+
+def test_cancer_judgement_pmml_conformance(tmp_path):
+    """The reference's own cancer-judgement model set: train → export →
+    the independent evaluator agrees with the built-in one to 1e-4 on
+    real records (score-agreement bar of PMMLTranslatorTest)."""
+    import shutil
+    ref = ("/root/reference/src/test/resources/example/cancer-judgement/"
+           "ModelStore/ModelSet1")
+    if not os.path.isdir(ref):
+        pytest.skip("reference cancer-judgement set not present")
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.data.reader import read_raw_table
+    from shifu_tpu.processor.base import ProcessorContext
+    root = os.path.join(tmp_path, "cancer")
+    shutil.copytree(ref, root)
+    # the reference set ships its own trained Encog binaries — clear
+    # them so this run's models are the only ones in models/
+    shutil.rmtree(os.path.join(root, "models"), ignore_errors=True)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["train"]["numTrainEpochs"] = 15
+    mc["train"]["baggingNum"] = 1
+    # the reference stores dataPath relative to ITS repo root — repoint
+    ref_base = os.path.dirname(os.path.dirname(os.path.dirname(ref)))
+    data = os.path.join(ref_base, "cancer-judgement", "DataStore",
+                        "DataSet1")
+    mc["dataSet"]["dataPath"] = data
+    mc["dataSet"]["headerPath"] = os.path.join(data, ".pig_header")
+    for ev in mc.get("evals") or []:
+        ev["dataSet"]["dataPath"] = data
+        ev["dataSet"]["headerPath"] = os.path.join(data, ".pig_header")
+    json.dump(mc, open(mcp, "w"))
+    for cmd in (["init"], ["stats"], ["norm"], ["train"],
+                ["export", "-t", "pmml"]):
+        assert cli_main(["--dir", root] + cmd) == 0, cmd
+    ctx = ProcessorContext.load(root)
+    df = read_raw_table(ctx.model_config).head(60)
+    _assert_internal_external_agree(
+        open(ctx.path_finder.pmml_path(0)).read(), df)
