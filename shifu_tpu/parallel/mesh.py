@@ -43,10 +43,20 @@ def default_mesh() -> Mesh:
     cap = os.environ.get("SHIFU_TPU_MESH_DEVICES")
     devs = jax.devices()
     n = min(int(cap), len(devs)) if cap else len(devs)
-    key = (n, tuple(d.id for d in devs[:n]))
+    # SHIFU_TPU_MESH_MODEL=K carves K devices onto the 'model' axis for
+    # vocab-heavy WDL/MTL configs (embedding tables sharded instead of
+    # replicated); default 1 = pure data parallel, the reference's only
+    # strategy
+    n_model = int(os.environ.get("SHIFU_TPU_MESH_MODEL", "1") or 1)
+    if n_model < 1 or n % n_model != 0:
+        raise ValueError(
+            f"SHIFU_TPU_MESH_MODEL={n_model} must divide the device "
+            f"count {n}")
+    key = (n, n_model, tuple(d.id for d in devs[:n]))
     m = _MESH_CACHE.get(key)
     if m is None:
-        m = make_mesh(n_data=n, n_model=1, devices=devs[:n])
+        m = make_mesh(n_data=n // n_model, n_model=n_model,
+                      devices=devs[:n])
         _MESH_CACHE[key] = m
     return m
 
@@ -130,19 +140,79 @@ def mlp_param_shardings(mesh: Mesh, n_layers: int):
 
 
 def wdl_param_shardings(mesh: Mesh, params) -> dict:
-    """WDL layout: embedding + wide tables sharded over 'model' on the
-    per-column axis (each shard owns a subset of categorical columns —
-    expert-parallel for tabular), deep MLP tensor-parallel."""
-    out = {}
-    if "embed" in params:
-        out["embed"] = NamedSharding(mesh, P("model", None, None))
-        out["wide_cat"] = NamedSharding(mesh, P("model", None))
-    out["wide_dense"] = NamedSharding(mesh, P())
-    out["wide_bias"] = NamedSharding(mesh, P())
-    out["deep"] = mlp_param_shardings(mesh, len(params["deep"]))
-    return out
+    """Dryrun certification layout: wdl_train_shardings with the deep
+    MLP additionally Megatron-split (exercises tensor-parallel compile
+    paths the product trainer deliberately skips)."""
+    return wdl_train_shardings(mesh, params, megatron_deep=True)
 
 
 def place(params, shardings):
     """device_put a pytree with a matching pytree of shardings."""
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+def _model_spec(mesh: Mesh, axis_len: int, spec: P,
+                label: str = "") -> NamedSharding:
+    """Shard over 'model' only when the axis divides evenly (jax
+    requires it); otherwise replicate that leaf — LOUDLY, since the
+    user set the model axis precisely to avoid replicating it."""
+    n_model = mesh.shape.get("model", 1)
+    if n_model > 1 and axis_len % n_model == 0:
+        return NamedSharding(mesh, spec)
+    if n_model > 1:
+        import logging
+        logging.getLogger("shifu_tpu").warning(
+            "model axis: %s axis length %d is not divisible by "
+            "SHIFU_TPU_MESH_MODEL=%d — that leaf replicates per chip",
+            label or "a parameter", axis_len, n_model)
+    return NamedSharding(mesh, P())
+
+
+def wdl_train_shardings(mesh: Mesh, params, megatron_deep: bool = False
+                        ) -> dict:
+    """WDL layout (one UNSTACKED parameter set): the per-column
+    embedding + wide tables — the memory hog for vocab-heavy configs,
+    (n_cat, vocab, embed) floats that data-parallel would replicate
+    per chip — shard over 'model' on the categorical-column axis. The
+    deep MLP stays replicated in the product trainer (a few hundred
+    hidden units buy nothing from tensor parallelism and Megatron
+    splits would add two collectives per step); `megatron_deep=True`
+    (the dryrun's compile certification) splits it anyway."""
+    out = {}
+    if "embed" in params:
+        nc = int(np.shape(params["embed"])[0])
+        out["embed"] = _model_spec(mesh, nc, P("model", None, None),
+                                   "WDL embed (n_cat)")
+        out["wide_cat"] = _model_spec(mesh, nc, P("model", None),
+                                      "WDL wide_cat (n_cat)")
+    out["wide_dense"] = NamedSharding(mesh, P())
+    out["wide_bias"] = NamedSharding(mesh, P())
+    out["deep"] = mlp_param_shardings(mesh, len(params["deep"])) \
+        if megatron_deep else [{"w": NamedSharding(mesh, P()),
+                                "b": NamedSharding(mesh, P())}
+                               for _ in params["deep"]]
+    return out
+
+
+def mtl_train_shardings(mesh: Mesh, params) -> dict:
+    """Product-path MTL layout: per-task head rows shard over 'model'
+    (tasks are independent — the expert-parallel analog); the shared
+    trunk is replicated (every task reads it)."""
+    n_tasks = int(np.shape(params["heads_w"])[0])
+    return {"trunk": [{"w": NamedSharding(mesh, P()),
+                       "b": NamedSharding(mesh, P())}
+                      for _ in params["trunk"]],
+            "heads_w": _model_spec(mesh, n_tasks, P("model", None),
+                                   "MTL heads (n_tasks)"),
+            "heads_b": _model_spec(mesh, n_tasks, P("model"),
+                                   "MTL heads (n_tasks)")}
+
+
+def place_stacked(tree, shardings):
+    """device_put a bag-STACKED pytree (leading (B, ...) axis) using
+    per-leaf UNSTACKED shardings — the bag axis is replicated, the
+    remaining axes follow the given spec."""
+    return jax.tree.map(
+        lambda leaf, ns: jax.device_put(
+            leaf, NamedSharding(ns.mesh, P(None, *ns.spec))),
+        tree, shardings)

@@ -104,6 +104,14 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
     optimizer = optimizer_from_params(mc.train.params)
     ew = mc.train.earlyStoppingRounds
     # train_bags shards rows / replicates params over the default mesh
+    # with SHIFU_TPU_MESH_MODEL > 1, per-task head rows shard over
+    # 'model' (tasks are independent); the shared trunk replicates
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.default_mesh()
+    shardings = None
+    if mesh.shape.get("model", 1) > 1:
+        one = jax.tree.map(lambda l: l[0], stacked)
+        shardings = mesh_mod.mtl_train_shardings(mesh, one)
     best_params, _, _, best_val, _ = train_bags(
         loss, metric, optimizer, mc.train.numTrainEpochs,
         ew if ew and ew > 0 else 0,
@@ -111,7 +119,7 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
         stacked, (dense[tr_mask], y[tr_mask]),
         bag_w,
         (dense[val_mask], y[val_mask]),
-        w[val_mask], bag_keys, grad_mask)
+        w[val_mask], bag_keys, grad_mask, param_shardings=shardings)
 
     spec_meta = _mtl_spec_meta(mc, spec, names, meta)
     for i in range(n_bags):

@@ -72,7 +72,15 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
 
     optimizer = optimizer_from_params(mc.train.params)
     ew = mc.train.earlyStoppingRounds
-    # train_bags shards rows / replicates params over the default mesh
+    # rows shard over 'data'; with SHIFU_TPU_MESH_MODEL > 1 the
+    # embedding + wide tables additionally shard over 'model' (the
+    # vocab-heavy leaves that data-parallel would replicate per chip)
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.default_mesh()
+    shardings = None
+    if mesh.shape.get("model", 1) > 1:
+        one = jax.tree.map(lambda l: l[0], stacked)
+        shardings = mesh_mod.wdl_train_shardings(mesh, one)
     best_params, train_errs, val_errs, best_val, best_epoch = train_bags(
         loss, metric, optimizer, mc.train.numTrainEpochs,
         ew if ew and ew > 0 else 0,
@@ -81,7 +89,7 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
         (dense[tr_mask], idx[tr_mask], y[tr_mask]),
         bag_w,
         (dense[val_mask], idx[val_mask], y[val_mask]),
-        w[val_mask], bag_keys, grad_mask)
+        w[val_mask], bag_keys, grad_mask, param_shardings=shardings)
 
     spec_meta = _wdl_spec_meta(mc, spec, meta)
     for i in range(n_bags):

@@ -207,6 +207,12 @@ def train_streaming_core(train_conf: ModelTrainConf,
     else:
         bag_keys = jax.random.split(key, n_bags)
         stacked = jax.vmap(init_fn)(bag_keys)
+    if mesh.shape.get("model", 1) > 1:
+        log.warning(
+            "SHIFU_TPU_MESH_MODEL=%d but the streaming trainer has no "
+            "model-axis layout — params replicate and rows shard over "
+            "only the %d-device data axis (the model axis helps only "
+            "resident WDL/MTL)", mesh.shape["model"], mesh.shape["data"])
     stacked = mesh_mod.place_replicated(mesh, stacked)
     opt_state = mesh_mod.place_replicated(
         mesh, jax.vmap(optimizer.init)(stacked))

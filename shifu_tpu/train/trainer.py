@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 import numpy as np
 import optax
 
@@ -194,11 +195,38 @@ def train_bags_carry(loss_fn, metric_fn, optimizer, n_epochs: int,
 
 
 
+def _init_opt_state(optimizer, stacked_params):
+    """vmapped optimizer.init whose outputs FOLLOW the parameter
+    shardings: moment leaves (adam mu/nu, momentum traces) mirror a
+    param leaf's shape+dtype and take its sharding via explicit
+    out_shardings — eager init would materialize full-size moments on
+    one device first, an HBM OOM at exactly the model-axis sizes the
+    sharding exists for. Anything unmatched (step counters) replicates."""
+    leaves = jax.tree.leaves(stacked_params)
+    shardings = {}
+    mesh = None
+    for leaf in leaves:
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            shardings.setdefault((leaf.shape, leaf.dtype), sh)
+            mesh = sh.mesh
+    if mesh is None or all(s.is_fully_replicated
+                           for s in shardings.values()):
+        return jax.vmap(optimizer.init)(stacked_params)
+    replicated = NamedSharding(mesh, P())
+    out_shapes = jax.eval_shape(jax.vmap(optimizer.init), stacked_params)
+    out_sh = jax.tree.map(
+        lambda s: shardings.get((s.shape, s.dtype), replicated),
+        out_shapes)
+    return jax.jit(jax.vmap(optimizer.init),
+                   out_shardings=out_sh)(stacked_params)
+
+
 def init_train_carry(optimizer, stacked_params, keys):
     """Fresh per-bag training carry (params, opt_state, best tracker,
     early-stop state, PRNG key) — the checkpointable training state
     (NNOutput tmp-model + NNMaster recovery state in one pytree)."""
-    opt_state = jax.vmap(optimizer.init)(stacked_params)
+    opt_state = _init_opt_state(optimizer, stacked_params)
     n_bags = keys.shape[0]
     return (stacked_params, opt_state,
             {"params": stacked_params,
@@ -214,7 +242,8 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
                val_inputs, w_val, dropout_keys, grad_mask,
                checkpoint_dir: Optional[str] = None,
                checkpoint_interval: int = 0,
-               batch_rows: int = 0, perm_seed: int = 0):
+               batch_rows: int = 0, perm_seed: int = 0,
+               param_shardings=None):
     """Non-resumable façade over train_bags_carry, with optional
     checkpointing: when checkpoint_dir is set, training runs in
     `checkpoint_interval`-epoch chunks, saving the full carry after each
@@ -275,8 +304,25 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
         w_train_bags = mesh_mod.shard_axis(mesh, w_train_bags, axis=1)
     val_inputs = tuple(mesh_mod.shard_axis(mesh, t, 0) for t in val_inputs)
     w_val = mesh_mod.shard_axis(mesh, w_val, 0)
-    stacked_params = mesh_mod.place_replicated(mesh, stacked_params)
-    grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
+    if param_shardings is not None and mesh.shape.get("model", 1) > 1:
+        # model-axis layout (SHIFU_TPU_MESH_MODEL > 1): vocab-heavy
+        # leaves (WDL embedding/wide tables, MTL head rows) shard over
+        # 'model' instead of replicating per chip; optimizer moments
+        # get the same layout via _init_opt_state's out_shardings
+        stacked_params = mesh_mod.place_stacked(stacked_params,
+                                                param_shardings)
+        # grad_mask is UNSTACKED (applied per-bag inside the vmap)
+        grad_mask = mesh_mod.place(grad_mask, param_shardings)
+    else:
+        if mesh.shape.get("model", 1) > 1:
+            log.warning(
+                "SHIFU_TPU_MESH_MODEL=%d but this trainer has no "
+                "model-axis layout — params replicate and rows shard "
+                "over only the %d-device data axis (the model axis "
+                "helps only resident WDL/MTL)",
+                mesh.shape["model"], mesh.shape["data"])
+        stacked_params = mesh_mod.place_replicated(mesh, stacked_params)
+        grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
     dropout_keys = mesh_mod.place_replicated(mesh, jnp.asarray(dropout_keys))
 
     carry = init_train_carry(optimizer, stacked_params, dropout_keys)
