@@ -484,3 +484,21 @@ def test_hist_subtraction_matches_direct(rng, monkeypatch):
     np.testing.assert_allclose(np.asarray(f_direct["leaf_value"]),
                                np.asarray(f_sub["leaf_value"]),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_gbt_scan_matches_per_round_loop(rng):
+    """The one-dispatch lax.scan boosting path (no val_data) must build
+    bit-identical trees to the per-round host loop (val_data present,
+    early stop off) — same rounds, one dispatch vs n."""
+    from shifu_tpu.models import gbdt
+    r, c = 3000, 6
+    bins = rng.integers(0, 7, (r, c)).astype(np.int32)
+    y = (bins[:, 0] + bins[:, 1] > 6).astype(np.float32)
+    w = np.ones(r, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=3, n_bins=8, learning_rate=0.3,
+                          loss="log")
+    scan_trees, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=4)
+    loop_trees, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=4,
+                                   val_data=(bins, y))
+    for k in scan_trees:
+        np.testing.assert_array_equal(scan_trees[k], loop_trees[k], err_msg=k)
