@@ -122,6 +122,22 @@ GBT_DEPTH = 6
 GBT_SMALL_ROWS = 2_000_000
 GBT_SMALL_TREES = 10
 
+# >HBM streaming demo (VERDICT r3 next #8): trainOnDisk NN over a
+# disk-resident matrix LARGER than one chip's HBM (v5e: 16 GB).
+# 20M rows × 300 f32 = 24 GB on disk; chunks of 262144 rows (~315 MB)
+# stream host→device double-buffered — small enough that the tunnel's
+# ~1 GB single-transfer wedge point is never approached.
+STREAM_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_ROWS", 20_000_000))
+STREAM_FEATURES = int(os.environ.get("SHIFU_TPU_STREAM_FEATURES", 300))
+STREAM_HIDDEN = (256,)
+STREAM_CHUNK_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_CHUNK_ROWS",
+                                       262_144))
+STREAM_VALID_RATE = 0.02
+STREAM_EPOCHS_SHORT = 1
+STREAM_EPOCHS_LONG = 3
+STREAM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tmp", "bench_stream")
+
 # v5e bf16 MXU peak; f32 runs at half rate. Used only for a utilization
 # *estimate* in extra.
 TPU_PEAK_FLOPS_BF16 = 394e12
@@ -464,6 +480,137 @@ def task_hist(mode):
                       "wall_s": wall, "checksum": checksum}))
 
 
+def _ensure_stream_layout(rows, feats, chunk=1_000_000, seed=11):
+    """Materialize the disk-resident training matrix (dense/tags/
+    weights .npy mmaps) if absent or mis-shaped. Written chunked so
+    host RAM stays bounded; the signal is a fixed linear margin so AUC
+    is checkable. Returns (dense_mm, tags_mm, weights_mm)."""
+    import numpy as np
+    os.makedirs(STREAM_DIR, exist_ok=True)
+    dense_p = os.path.join(STREAM_DIR, "dense.npy")
+    tags_p = os.path.join(STREAM_DIR, "tags.npy")
+    w_p = os.path.join(STREAM_DIR, "weights.npy")
+    done_p = os.path.join(STREAM_DIR, "layout.json")
+    ok = False
+    if os.path.exists(done_p):
+        # open_memmap writes full-shape headers up front, so a shape
+        # check alone would bless a half-written crash leftover; the
+        # sidecar is written only after the data is flushed
+        try:
+            meta = json.load(open(done_p))
+            ok = meta == {"rows": rows, "feats": feats, "seed": seed,
+                          "complete": True}
+        except (OSError, json.JSONDecodeError):
+            ok = False
+    if not ok:
+        _log(f"stream bench: writing {rows}x{feats} f32 "
+             f"({rows * feats * 4 / 1e9:.1f} GB) to {STREAM_DIR}...")
+        rng = np.random.default_rng(seed)
+        beta = rng.normal(0, 1, feats).astype(np.float32)
+        dm = np.lib.format.open_memmap(dense_p, mode="w+",
+                                       dtype=np.float32,
+                                       shape=(rows, feats))
+        tm = np.lib.format.open_memmap(tags_p, mode="w+",
+                                       dtype=np.float32, shape=(rows,))
+        wm = np.lib.format.open_memmap(w_p, mode="w+",
+                                       dtype=np.float32, shape=(rows,))
+        for a in range(0, rows, chunk):
+            b = min(a + chunk, rows)
+            # counter-based per-chunk stream → identical layout for any
+            # chunk size
+            # counter strides by the per-row DRAW count, not the row
+            # index — a row-index stride would overlap consecutive
+            # chunks' keystreams (each row consumes feats+1 draws)
+            crng = np.random.Generator(np.random.Philox(
+                key=seed, counter=a * (feats + 2)))
+            x = crng.normal(0, 1, (b - a, feats)).astype(np.float32)
+            margin = x @ beta / np.sqrt(feats) * 2.0
+            noise = crng.normal(0, 1, b - a).astype(np.float32)
+            dm[a:b] = x
+            tm[a:b] = (margin + noise > 0).astype(np.float32)
+            wm[a:b] = 1.0
+        for m in (dm, tm, wm):
+            m.flush()
+        with open(done_p, "w") as f:
+            json.dump({"rows": rows, "feats": feats, "seed": seed,
+                       "complete": True}, f)
+    return (np.load(dense_p, mmap_mode="r"),
+            np.load(tags_p, mmap_mode="r"),
+            np.load(w_p, mmap_mode="r"))
+
+
+def task_streaming():
+    """>HBM trainOnDisk NN: the real train_nn_streaming path over a
+    24 GB disk matrix (chip HBM is 16 GB) — double-buffered ~315 MB
+    chunks host→device, per-epoch reshuffled chunk order, trailing
+    validation region. Throughput via the shared two-length delta so
+    compile + first-touch page-cache costs cancel."""
+    import numpy as np
+
+    from shifu_tpu.config.model_config import ModelTrainConf
+    from shifu_tpu.train.streaming import train_nn_streaming
+
+    dense, tags, weights = _ensure_stream_layout(STREAM_ROWS,
+                                                 STREAM_FEATURES)
+
+    def get_chunk(a, b):
+        return (np.asarray(dense[a:b], np.float32),
+                np.asarray(tags[a:b], np.float32),
+                np.asarray(weights[a:b], np.float32))
+
+    def conf_for(epochs):
+        conf = ModelTrainConf()
+        conf.params = {"NumHiddenLayers": len(STREAM_HIDDEN),
+                       "NumHiddenNodes": list(STREAM_HIDDEN),
+                       "ActivationFunc": ["relu"] * len(STREAM_HIDDEN),
+                       "Propagation": "ADAM", "LearningRate": 0.02}
+        conf.numTrainEpochs = epochs
+        conf.baggingNum = 1
+        conf.validSetRate = STREAM_VALID_RATE
+        conf.earlyStoppingRounds = 0
+        conf.convergenceThreshold = 0.0
+        return conf
+
+    def run(epochs):
+        return train_nn_streaming(conf_for(epochs), get_chunk,
+                                  STREAM_ROWS, STREAM_FEATURES, seed=1,
+                                  chunk_rows=STREAM_CHUNK_ROWS)
+
+    # warm-up epoch BEFORE the clock: jit compile + cold page-cache
+    # reads otherwise land only in the short run and SUBTRACT from the
+    # delta (overstating throughput) instead of cancelling
+    run(1)
+
+    def measure(epochs):
+        t0 = time.time()
+        return t0, run(epochs)
+
+    res, walls, d_wall = _delta_timed(measure, STREAM_EPOCHS_SHORT,
+                                      STREAM_EPOCHS_LONG)
+    d_epochs = STREAM_EPOCHS_LONG - STREAM_EPOCHS_SHORT
+    n_train = STREAM_ROWS - int(STREAM_ROWS * STREAM_VALID_RATE)
+    # AUC probe on a 200k sample via the returned model
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.ops.metrics import auc
+    probe_x = np.asarray(dense[:200_000], np.float32)
+    probe_y = np.asarray(tags[:200_000], np.float32)
+    scores = nn_mod.forward(res.spec, res.params_per_bag[0],
+                            jnp.asarray(probe_x))
+    a = float(auc(scores, jnp.asarray(probe_y)))
+    if a <= 0.75:
+        raise ValueError(f"streaming model failed to learn (AUC {a})")
+    gb = STREAM_ROWS * STREAM_FEATURES * 4 / 1e9
+    print(json.dumps({
+        "row_epochs_per_sec": n_train * d_epochs / d_wall,
+        "wall_s": d_wall, "wall_short_s": walls[STREAM_EPOCHS_SHORT],
+        "wall_long_s": walls[STREAM_EPOCHS_LONG], "auc": a,
+        "disk_gb": round(gb, 1),
+        "stream_gbps": gb * d_epochs / d_wall,
+    }))
+
+
 def task_gbt(rows=None, trees=None):
     """HIGGS-scale GBT training end-to-end (the BASELINE.md 11M-row
     ladder step): full boosting loop on synthetic separable data.
@@ -557,6 +704,11 @@ def _workload(task):
                 "depth": GBT_DEPTH},
         "gbt_small": {"rows": GBT_SMALL_ROWS, "cols": GBT_COLS,
                       "trees": GBT_SMALL_TREES, "depth": GBT_DEPTH},
+        "streaming": {"rows": STREAM_ROWS, "features": STREAM_FEATURES,
+                      "hidden": list(STREAM_HIDDEN),
+                      "chunk": STREAM_CHUNK_ROWS,
+                      "epochs": [STREAM_EPOCHS_SHORT,
+                                 STREAM_EPOCHS_LONG]},
     }.get(task, {})
 
 
@@ -607,10 +759,27 @@ def _resolve_backend(diags):
     return None, {}
 
 
+def _honor_pinned_platform():
+    """A pre-registered accelerator plugin (axon) pins jax_platforms
+    via jax.config at interpreter start, so the JAX_PLATFORMS env var
+    alone does NOT win — a task subprocess asked to run on cpu would
+    still probe the (possibly wedged) tunnel and hang. Same workaround
+    as tests/conftest.py and __graft_entry__."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--task", default=None)
     args = ap.parse_args()
+    if args.task:
+        _honor_pinned_platform()
     if args.task == "probe":
         return task_probe()
     if args.task == "nn":
@@ -625,6 +794,8 @@ def main():
         return task_gbt()
     if args.task == "gbt_small":
         return task_gbt(rows=GBT_SMALL_ROWS, trees=GBT_SMALL_TREES)
+    if args.task == "streaming":
+        return task_streaming()
 
     diags = []
     extra = {}
@@ -734,6 +905,23 @@ def main():
             else:
                 diags.append("gbt failed: " +
                              (err.splitlines()[-1] if err else "?"))
+            # >HBM streaming demo LAST: it pushes ~24 GB/epoch of
+            # chunks through the tunnel, the riskiest transfer pattern
+            # of the ladder (skippable: SHIFU_TPU_BENCH_STREAMING=0)
+            if os.environ.get("SHIFU_TPU_BENCH_STREAMING", "1") != "0":
+                _log(f"running >HBM streaming bench ({STREAM_ROWS}x"
+                     f"{STREAM_FEATURES}, 24 GB on disk)...")
+                st, err = _run_or_reuse("streaming", backend, diags,
+                                        env_extra, timeout=3000)
+                if st:
+                    extra["streaming_Mrow_epochs_per_s"] = round(
+                        st["row_epochs_per_sec"] / 1e6, 3)
+                    extra["streaming_auc"] = round(st["auc"], 4)
+                    extra["streaming_disk_gb"] = st["disk_gb"]
+                    extra["streaming_gbps"] = round(st["stream_gbps"], 2)
+                else:
+                    diags.append("streaming failed: " +
+                                 (err.splitlines()[-1] if err else "?"))
     except Exception as e:  # noqa: BLE001 — never crash the driver
         diags.append(f"{type(e).__name__}: {e}")
 

@@ -179,3 +179,24 @@ def test_run_or_reuse_prefers_persisted(monkeypatch, tmp_path, capsys):
     monkeypatch.setenv("SHIFU_TPU_BENCH_REFRESH", "1")
     out, err = bench._run_or_reuse("nn", "tpu", [], {})
     assert called["n"] == 1
+
+
+def test_task_streaming(monkeypatch, capsys, tmp_path):
+    """>HBM streaming bench task at toy shape: disk layout generation +
+    the real train_nn_streaming path + delta timing."""
+    monkeypatch.setattr(bench, "STREAM_ROWS", 6_000)
+    monkeypatch.setattr(bench, "STREAM_FEATURES", 12)
+    monkeypatch.setattr(bench, "STREAM_HIDDEN", (8,))
+    monkeypatch.setattr(bench, "STREAM_CHUNK_ROWS", 1_024)
+    monkeypatch.setattr(bench, "STREAM_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "STREAM_EPOCHS_LONG", 30)
+    monkeypatch.setattr(bench, "STREAM_DIR", str(tmp_path / "stream"))
+    bench.task_streaming()
+    rec = _last_json(capsys)
+    assert rec["row_epochs_per_sec"] > 0
+    assert rec["auc"] > 0.75
+    # re-running reuses the on-disk layout (no rewrite)
+    import os
+    mtime = os.path.getmtime(str(tmp_path / "stream" / "dense.npy"))
+    bench.task_streaming()
+    assert os.path.getmtime(str(tmp_path / "stream" / "dense.npy")) == mtime
