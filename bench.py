@@ -799,131 +799,137 @@ def main():
 
     diags = []
     extra = {}
-    nn = nw = None
+    res = {}
     try:
         backend, env_extra = _resolve_backend(diags)
         extra["backend"] = backend
         if backend is None:
             raise RuntimeError("no usable JAX backend")
+        _log(f"backend: {backend}")
 
-        _log(f"backend: {backend}; running NN flagship bench "
-             f"({N_ROWS}x{N_FEATURES}, {BENCH_EPOCHS} epochs)...")
-        nn, err = _run_or_reuse("nn", backend, diags, env_extra)
-        if nn:
-            extra["nn_Mrow_epochs_per_s"] = round(
-                nn["row_epochs_per_sec"] / 1e6, 3)
-            extra["nn_auc"] = round(nn["auc"], 4)
-            extra["nn_wall_s"] = round(nn["wall_s"], 2)
-            extra["nn_mxu_util_est"] = round(nn["mxu_util_est"], 5)
-            _log(f"nn: {extra['nn_Mrow_epochs_per_s']} Mrow-epochs/s "
-                 f"(AUC {nn['auc']:.4f})")
-        else:
-            diags.append("nn task failed: " +
-                         (err.splitlines()[-1] if err else "?"))
+        def step(task, banner, timeout=1200):
+            _log(f"running {banner}...")
+            out, err = _run_or_reuse(task, backend, diags, env_extra,
+                                     timeout=timeout)
+            if out:
+                res[task] = out
+            else:
+                diags.append(f"{task} failed: "
+                             + (err.splitlines()[-1] if err else "?"))
+            return out
 
-        _log("running GBDT histogram bench (xla scatter)...")
-        hx, err = _run_or_reuse("hist_xla", backend, diags, env_extra)
-        if hx:
-            extra["gbdt_hist_xla_gcells_per_s"] = round(
-                hx["cells_per_sec"] / 1e9, 3)
-        else:
-            diags.append("hist_xla failed: " +
-                         (err.splitlines()[-1] if err else "?"))
         if backend == "tpu":
-            _log(f"running wide-NN utilization bench "
-                 f"({WIDE_ROWS}x{WIDE_FEATURES}, {WIDE_HIDDEN})...")
-            nw, err = _run_or_reuse("nn_wide", backend, diags, env_extra)
-            if nw:
-                extra["nn_wide_Mrow_epochs_per_s"] = round(
-                    nw["row_epochs_per_sec"] / 1e6, 3)
-                extra["nn_wide_achieved_tflops"] = round(
-                    nw["achieved_tflops"], 2)
-                extra["nn_wide_mxu_util"] = round(nw["mxu_util"], 4)
-                extra["nn_wide_hbm_util_est"] = round(nw["hbm_util_est"], 4)
-                # roofline: which wall the wide shape is against
-                bound = "HBM-bound" if nw["hbm_util_est"] > nw["mxu_util"] \
-                    else "MXU-bound"
-                extra["nn_wide_roofline"] = (
-                    f"{bound}: {nw['achieved_tflops']:.1f} TF/s "
-                    f"({100 * nw['mxu_util']:.1f}% of bf16 peak), "
-                    f"~{nw['hbm_gbps_est']:.0f} GB/s "
-                    f"({100 * nw['hbm_util_est']:.1f}% of HBM)")
-            else:
-                diags.append("nn_wide failed: " +
-                             (err.splitlines()[-1] if err else "?"))
-            _log(f"running WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
-                 f"vocab {WDL_VOCAB})...")
-            wd, err = _run_or_reuse("wdl", backend, diags, env_extra)
-            if wd:
-                extra["wdl_Mrow_epochs_per_s"] = round(
-                    wd["row_epochs_per_sec"] / 1e6, 3)
-                extra["wdl_auc"] = round(wd["auc"], 4)
-                extra["wdl_embed_gather_gbps_est"] = round(
-                    wd["embed_gather_gbps_est"], 1)
-            else:
-                diags.append("wdl failed: " +
-                             (err.splitlines()[-1] if err else "?"))
+            # MISSING-evidence-first ordering: the tunnel can wedge at
+            # any point, and nn/hist_xla already have committed round-3
+            # records — the utilization stories (nn_wide MFU, wdl,
+            # pallas-vs-xla) have never produced a committed number,
+            # so they spend the window first. Streaming stays LAST
+            # (riskiest transfer pattern: ~24 GB of chunks per epoch).
+            step("nn_wide", f"wide-NN utilization bench ({WIDE_ROWS}x"
+                 f"{WIDE_FEATURES}, {WIDE_HIDDEN})")
+            step("wdl", f"WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
+                 f"vocab {WDL_VOCAB})")
             # Pallas interpret mode on CPU is not a perf path; only
             # measure the kernel where it actually runs.
-            _log("running GBDT histogram bench (pallas MXU)...")
-            hp, err = _run_or_reuse("hist_pallas", backend, diags,
-                                    env_extra)
-            if hp:
-                extra["gbdt_hist_pallas_gcells_per_s"] = round(
-                    hp["cells_per_sec"] / 1e9, 3)
-                if hx:
-                    extra["gbdt_pallas_vs_xla"] = round(
-                        hp["cells_per_sec"] / hx["cells_per_sec"], 2)
-                    if ("_reused_ts" in hp) != ("_reused_ts" in hx):
-                        extra["gbdt_pallas_vs_xla_provenance"] = \
-                            "mixed (one side reused from a prior run)"
-            else:
-                diags.append("hist_pallas failed: " +
-                             (err.splitlines()[-1] if err else "?"))
-            # small GBT first: SOME end-to-end tree number should land
-            # even when the tunnel window is too short for the 11M run
-            _log(f"running GBT small train bench "
-                 f"({GBT_SMALL_ROWS}x{GBT_COLS}, {GBT_SMALL_TREES} "
-                 "trees)...")
-            gs_, err = _run_or_reuse("gbt_small", backend, diags,
-                                     env_extra)
-            if gs_:
-                extra["gbt_small_Mrow_trees_per_s"] = round(
-                    gs_["row_trees_per_sec"] / 1e6, 3)
-                extra["gbt_small_wall_s"] = round(gs_["wall_s"], 2)
-            else:
-                diags.append("gbt_small failed: " +
-                             (err.splitlines()[-1] if err else "?"))
-            _log(f"running GBT end-to-end train bench "
-                 f"({GBT_ROWS}x{GBT_COLS}, {GBT_TREES} trees)...")
-            gb, err = _run_or_reuse("gbt", backend, diags, env_extra)
-            if gb:
-                extra["gbt_train_Mrow_trees_per_s"] = round(
-                    gb["row_trees_per_sec"] / 1e6, 3)
-                extra["gbt_train_wall_s"] = round(gb["wall_s"], 2)
-                extra["gbt_auc"] = round(gb["auc"], 4)
-            else:
-                diags.append("gbt failed: " +
-                             (err.splitlines()[-1] if err else "?"))
-            # >HBM streaming demo LAST: it pushes ~24 GB/epoch of
-            # chunks through the tunnel, the riskiest transfer pattern
-            # of the ladder (skippable: SHIFU_TPU_BENCH_STREAMING=0)
+            step("hist_pallas", "GBDT histogram bench (pallas MXU)")
+            step("hist_xla", "GBDT histogram bench (xla scatter)")
+            step("gbt_small", f"GBT small train bench ({GBT_SMALL_ROWS}x"
+                 f"{GBT_COLS}, {GBT_SMALL_TREES} trees)")
+            step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
+                 f"{BENCH_EPOCHS} epochs)")
+            step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
+                 f"{GBT_COLS}, {GBT_TREES} trees)")
             if os.environ.get("SHIFU_TPU_BENCH_STREAMING", "1") != "0":
-                _log(f"running >HBM streaming bench ({STREAM_ROWS}x"
-                     f"{STREAM_FEATURES}, 24 GB on disk)...")
-                st, err = _run_or_reuse("streaming", backend, diags,
-                                        env_extra, timeout=3000)
-                if st:
-                    extra["streaming_Mrow_epochs_per_s"] = round(
-                        st["row_epochs_per_sec"] / 1e6, 3)
-                    extra["streaming_auc"] = round(st["auc"], 4)
-                    extra["streaming_disk_gb"] = st["disk_gb"]
-                    extra["streaming_gbps"] = round(st["stream_gbps"], 2)
-                else:
-                    diags.append("streaming failed: " +
-                                 (err.splitlines()[-1] if err else "?"))
+                step("streaming", f">HBM streaming bench ({STREAM_ROWS}"
+                     f"x{STREAM_FEATURES}, 24 GB on disk)",
+                     timeout=3000)
+        else:
+            step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
+                 f"{BENCH_EPOCHS} epochs)")
+            step("hist_xla", "GBDT histogram bench (xla scatter)")
     except Exception as e:  # noqa: BLE001 — never crash the driver
         diags.append(f"{type(e).__name__}: {e}")
+
+    def fill(task, fn):
+        """Map one task's record into extra — degrading, never fatal:
+        a reused persisted record can predate a field (the driver's
+        contract is 'always exits 0 with a parseable line')."""
+        out = res.get(task)
+        if not out:
+            return
+        try:
+            fn(out)
+        except (KeyError, TypeError) as e:
+            diags.append(f"{task}: record missing field ({e!r})")
+
+    def _fill_nn(nn):
+        extra["nn_Mrow_epochs_per_s"] = round(
+            nn["row_epochs_per_sec"] / 1e6, 3)
+        extra["nn_auc"] = round(nn["auc"], 4)
+        extra["nn_wall_s"] = round(nn["wall_s"], 2)
+        extra["nn_mxu_util_est"] = round(nn["mxu_util_est"], 5)
+
+    def _fill_nn_wide(nw):
+        extra["nn_wide_Mrow_epochs_per_s"] = round(
+            nw["row_epochs_per_sec"] / 1e6, 3)
+        extra["nn_wide_achieved_tflops"] = round(nw["achieved_tflops"], 2)
+        extra["nn_wide_mxu_util"] = round(nw["mxu_util"], 4)
+        extra["nn_wide_hbm_util_est"] = round(nw["hbm_util_est"], 4)
+        # roofline: which wall the wide shape is against
+        bound = "HBM-bound" if nw["hbm_util_est"] > nw["mxu_util"] \
+            else "MXU-bound"
+        extra["nn_wide_roofline"] = (
+            f"{bound}: {nw['achieved_tflops']:.1f} TF/s "
+            f"({100 * nw['mxu_util']:.1f}% of bf16 peak), "
+            f"~{nw['hbm_gbps_est']:.0f} GB/s "
+            f"({100 * nw['hbm_util_est']:.1f}% of HBM)")
+
+    def _fill_wdl(wd):
+        extra["wdl_Mrow_epochs_per_s"] = round(
+            wd["row_epochs_per_sec"] / 1e6, 3)
+        extra["wdl_auc"] = round(wd["auc"], 4)
+        extra["wdl_embed_gather_gbps_est"] = round(
+            wd["embed_gather_gbps_est"], 1)
+
+    def _fill_hists(hp):
+        hx = res.get("hist_xla")
+        extra["gbdt_hist_pallas_gcells_per_s"] = round(
+            hp["cells_per_sec"] / 1e9, 3)
+        if hx:
+            extra["gbdt_pallas_vs_xla"] = round(
+                hp["cells_per_sec"] / hx["cells_per_sec"], 2)
+            if ("_reused_ts" in hp) != ("_reused_ts" in hx):
+                extra["gbdt_pallas_vs_xla_provenance"] = \
+                    "mixed (one side reused from a prior run)"
+
+    def _fill_gbt_small(gs_):
+        extra["gbt_small_Mrow_trees_per_s"] = round(
+            gs_["row_trees_per_sec"] / 1e6, 3)
+        extra["gbt_small_wall_s"] = round(gs_["wall_s"], 2)
+
+    def _fill_gbt(gb):
+        extra["gbt_train_Mrow_trees_per_s"] = round(
+            gb["row_trees_per_sec"] / 1e6, 3)
+        extra["gbt_train_wall_s"] = round(gb["wall_s"], 2)
+        extra["gbt_auc"] = round(gb["auc"], 4)
+
+    def _fill_streaming(st):
+        extra["streaming_Mrow_epochs_per_s"] = round(
+            st["row_epochs_per_sec"] / 1e6, 3)
+        extra["streaming_auc"] = round(st["auc"], 4)
+        extra["streaming_disk_gb"] = st["disk_gb"]
+        extra["streaming_gbps"] = round(st["stream_gbps"], 2)
+
+    fill("nn", _fill_nn)
+    fill("nn_wide", _fill_nn_wide)
+    fill("wdl", _fill_wdl)
+    fill("hist_xla", lambda hx: extra.__setitem__(
+        "gbdt_hist_xla_gcells_per_s", round(hx["cells_per_sec"] / 1e9, 3)))
+    fill("hist_pallas", _fill_hists)
+    fill("gbt_small", _fill_gbt_small)
+    fill("gbt", _fill_gbt)
+    fill("streaming", _fill_streaming)
+    nn, nw = res.get("nn"), res.get("nn_wide")
 
     # headline selection: the wide shape (600x512x256) is the
     # utilization story; the narrow flagship is dispatch-bound by
@@ -952,9 +958,11 @@ def main():
         if "mxu_util" in nw and "nn_wide_mxu_util" not in extra:
             extra["nn_wide_mxu_util"] = round(nw["mxu_util"], 4)
     else:
-        if nn is None:
-            # flaky tunnel: surface the most recent persisted hardware
-            # measurement instead of zero, provenance explicit
+        if nn is None or extra.get("backend") == "cpu":
+            # flaky tunnel: a persisted same-workload TPU measurement
+            # beats nothing AND beats a live cpu-fallback number as
+            # the headline (the live cpu extras stay in extra);
+            # provenance explicit either way
             cached = _latest_persisted("nn", backend_filter="tpu")
             if cached and cached.get("workload") == _workload("nn"):
                 nn = cached
