@@ -122,8 +122,11 @@ def precision_type(mc: ModelConfig) -> str:
     """Output precision of normalized values
     (`udf/norm/PrecisionType.java:20-56`): FLOAT7 / FLOAT16 / FLOAT32 /
     DOUBLE64, from -Dshifu.precision.type or normalize#precisionType."""
+    # _extras before the field: the field's default is a truthy
+    # "FLOAT32" that would otherwise shadow an extras-carried setting
     p = str(os.environ.get("shifu.precision.type")
             or mc.normalize._extras.get("precisionType")
+            or mc.normalize.precisionType
             or "FLOAT32").upper()
     if p not in ("FLOAT7", "FLOAT16", "FLOAT32", "DOUBLE64"):
         raise ValueError(f"unknown precisionType {p!r}; expected one of "
@@ -133,8 +136,10 @@ def precision_type(mc: ModelConfig) -> str:
 
 def apply_precision(dense: np.ndarray, ptype: str) -> np.ndarray:
     """Quantize the dense block. FLOAT16 rounds through half precision
-    (storage stays float32 — TPUs compute in bf16/f32 anyway, this
-    reproduces the reference's value truncation, not its byte layout)."""
+    and returns float32 VALUES (the resident data.npz keeps f32);
+    the STREAMING layout writers additionally store those values as
+    real f16 bytes — half the disk and half the host→device chunk
+    transfer, widened back on device (train/streaming._upcast)."""
     if ptype == "FLOAT16":
         return dense.astype(np.float16).astype(np.float32)
     if ptype == "DOUBLE64":
@@ -200,8 +205,13 @@ def _write_normalized(path, result, dense, index, tags, weights,
         tags=tags.astype(np.float32), weights=weights.astype(np.float32),
         **extra)
     if streaming:
+        # FLOAT16 stores the streaming block as REAL f16: dense was
+        # already rounded through half precision, so the bytes halve
+        # (disk AND host→device chunk transfer) with zero value change;
+        # the streaming trainer widens to f32 on device
         np.save(os.path.join(path, "dense.npy"),
-                np.ascontiguousarray(dense))
+                np.ascontiguousarray(dense.astype(np.float16)
+                                     if ptype == "FLOAT16" else dense))
         np.save(os.path.join(path, "tags.npy"), tags.astype(np.float32))
         np.save(os.path.join(path, "weights.npy"),
                 weights.astype(np.float32))

@@ -167,7 +167,11 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
 
     norm_dir = ctx.path_finder.normalized_data_path()
     clean_dir = ctx.path_finder.cleaned_data_path()
-    dtype_dense = np.float64 if ptype == "DOUBLE64" else np.float32
+    # FLOAT16 lays the normalized block out as real f16 (values are
+    # rounded through half precision anyway): half the disk and half
+    # the host→device chunk bytes; trainers widen on device
+    dtype_dense = np.float64 if ptype == "DOUBLE64" else (
+        np.float16 if ptype == "FLOAT16" else np.float32)
     norm_spec = [("dense.npy", (n_rows, f_dense), dtype_dense),
                  ("tags.npy", (n_rows,), np.float32),
                  ("weights.npy", (n_rows,), np.float32)]

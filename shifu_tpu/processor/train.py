@@ -334,7 +334,9 @@ def _train_dense_streaming(ctx: ProcessorContext,
     dense, tags, weights = mmap_layout(path, "dense", "tags", "weights")
 
     def get_chunk(a, b):
-        x = np.asarray(dense[a:b], np.float32)
+        # keep the stored dtype: an f16 layout transfers at half the
+        # bytes and widens on device (streaming core's _upcast)
+        x = np.asarray(dense[a:b])
         y = np.asarray(tags[a:b], np.float32)
         w = upsampled_weights(y, np.asarray(weights[a:b], np.float32),
                               mc.train.upSampleWeight)

@@ -177,7 +177,9 @@ def _run_mtl_streaming(ctx: ProcessorContext, seed: int):
         w = upsampled_weights(y[:, 0],
                               np.asarray(weights[a:b], np.float32),
                               mc.train.upSampleWeight)
-        return (np.asarray(dense[a:b], np.float32), y, w)
+        # stored dtype preserved: f16 layouts transfer at half
+        # the bytes and widen on device
+        return (np.asarray(dense[a:b]), y, w)
 
     def loss_fn(params, inputs, w_, key_):
         x_, y_ = inputs

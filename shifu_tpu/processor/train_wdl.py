@@ -152,7 +152,9 @@ def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
                               mc.train.upSampleWeight)
         i_blk = (np.asarray(idx[a:b], np.int32) if idx is not None
                  else np.zeros((b - a, 0), np.int32))
-        return (np.asarray(dense[a:b], np.float32), i_blk, y, w)
+        # stored dtype preserved: f16 layouts transfer at half
+        # the bytes and widen on device
+        return (np.asarray(dense[a:b]), i_blk, y, w)
 
     vocab = max(meta["indexVocabSizes"], default=1)
     n_cat = idx.shape[1] if idx is not None else 0

@@ -237,12 +237,20 @@ def train_streaming_core(train_conf: ModelTrainConf,
         grad_mask = jax.tree.map(jnp.ones_like, one_bag)
     grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
 
+    def _upcast(t):
+        """Half-precision chunks (FLOAT16 streaming layouts) transfer
+        at half the host→device bytes and widen ON DEVICE — the
+        values are identical (the layout was rounded through f16 at
+        norm time), only the transfer shrinks."""
+        return t.astype(jnp.float32) \
+            if t.dtype in (jnp.float16, jnp.bfloat16) else t
+
     @jax.jit
     def update(stacked, opt_state, *chunk_and_key):
         """One chunk's SGD step for every bag at once (vmap over the
         bag axis = the reference's ≤5 parallel bagging jobs)."""
         *inputs, w_bags, key_ = chunk_and_key
-        inputs = tuple(inputs)
+        inputs = tuple(jax.tree.map(_upcast, t) for t in inputs)
 
         def one(params, o_state, w):
             loss, grads = jax.value_and_grad(
@@ -263,7 +271,7 @@ def train_streaming_core(train_conf: ModelTrainConf,
     @jax.jit
     def val_chunk_err(stacked, *chunk):
         *inputs, w = chunk
-        inputs = tuple(inputs)
+        inputs = tuple(jax.tree.map(_upcast, t) for t in inputs)
 
         def one(params):
             return metric_sum_fn(params, inputs, w)

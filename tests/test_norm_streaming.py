@@ -112,3 +112,58 @@ def test_fully_streaming_pipeline(tmp_path, rng, monkeypatch):
         for k in ("SHIFU_TPU_STATS_CHUNK_ROWS", "SHIFU_TPU_NORM_CHUNK_ROWS",
                   "SHIFU_TPU_EVAL_CHUNK_ROWS"):
             monkeypatch.delenv(k, raising=False)
+
+
+def test_float16_streaming_layout_halves_bytes(tmp_path, rng):
+    """precisionType FLOAT16 + trainOnDisk: the dense block lands on
+    disk as REAL f16 (the values are rounded through half precision
+    anyway), the chunked trainer widens on device, and the pipeline
+    still learns. Covers both layout writers (resident save_normalized
+    and the chunked norm_streaming pass)."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.processor import (eval as eval_proc, init as init_proc,
+                                     norm as norm_proc,
+                                     stats as stats_proc,
+                                     train as train_proc)
+    from shifu_tpu.processor.base import ProcessorContext
+
+    for mode, env in (("resident-writer", {}),
+                      ("chunked-writer",
+                       {"SHIFU_TPU_NORM_CHUNK_ROWS": "256",
+                        "SHIFU_TPU_STATS_CHUNK_ROWS": "256"})):
+        root = make_model_set(tmp_path / mode, np.random.default_rng(55),
+                              n_rows=1500,
+                              train_params={"NumHiddenLayers": 1,
+                                            "NumHiddenNodes": [8],
+                                            "ActivationFunc": ["tanh"],
+                                            "LearningRate": 0.1,
+                                            "Propagation": "ADAM",
+                                            "ChunkRows": 256})
+        mcp = os.path.join(root, "ModelConfig.json")
+        mc = json.load(open(mcp))
+        mc["train"]["trainOnDisk"] = True
+        mc["normalize"]["precisionType"] = "FLOAT16"
+        json.dump(mc, open(mcp, "w"))
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            for proc in (init_proc, stats_proc, norm_proc, train_proc):
+                ctx = ProcessorContext.load(root)
+                assert proc.run(ctx) == 0, mode
+            ctx = ProcessorContext.load(root)
+            assert eval_proc.run(ctx) == 0, mode
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        dense = np.load(os.path.join(
+            ctx.path_finder.normalized_data_path(), "dense.npy"),
+            mmap_mode="r")
+        assert dense.dtype == np.float16, (mode, dense.dtype)
+        perf = json.load(open(
+            ctx.path_finder.eval_performance_path("Eval1")))
+        assert perf["areaUnderRoc"] > 0.85, (mode, perf["areaUnderRoc"])
