@@ -97,7 +97,9 @@ def cmd_norm(args) -> int:
 
 def cmd_varselect(args) -> int:
     from shifu_tpu.processor import varselect as p
-    return p.run(_ctx(args), recursive=args.recursive)
+    return p.run(_ctx(args), recursive=args.recursive,
+                 reset=args.reset, list_only=args.list,
+                 select_file=args.file)
 
 
 def cmd_train(args) -> int:
@@ -112,11 +114,24 @@ def cmd_posttrain(args) -> int:
 
 def cmd_eval(args) -> int:
     from shifu_tpu.processor import eval as p
+    if args.list:
+        return p.run_list(_ctx(args))
+    if args.new:
+        return p.run_new(_ctx(args), args.new)
+    if args.delete:
+        return p.run_delete(_ctx(args), args.delete)
     if args.norm:
         return p.run_norm(_ctx(args), eval_name=args.run)
     if args.audit:
         return p.run_audit(_ctx(args), eval_name=args.run,
                            n_records=args.n)
+    if args.score is not False:
+        return p.run_score(_ctx(args), eval_name=args.score or args.run)
+    if args.confmat is not False:
+        return p.run_confmat(_ctx(args),
+                             eval_name=args.confmat or args.run)
+    if args.perf is not False:
+        return p.run_perf(_ctx(args), eval_name=args.perf or args.run)
     return p.run(_ctx(args), eval_name=args.run)
 
 
@@ -231,12 +246,33 @@ def build_parser() -> argparse.ArgumentParser:
     for alias in ("varsel", "varselect"):
         p = sub.add_parser(alias, help="variable selection")
         p.add_argument("-r", "--recursive", type=int, default=0)
+        p.add_argument("-reset", "--reset", action="store_true",
+                       help="reset all variables to finalSelect=false")
+        p.add_argument("-list", "--list", action="store_true",
+                       help="print currently selected variables")
+        p.add_argument("-f", "--file", default=None, metavar="FILE",
+                       help="select exactly the variables named in FILE")
         p.set_defaults(fn=cmd_varselect)
     sub.add_parser("train", help="train models").set_defaults(fn=cmd_train)
     sub.add_parser("posttrain", help="post-train analysis") \
         .set_defaults(fn=cmd_posttrain)
     p = sub.add_parser("eval", help="evaluate models")
     p.add_argument("-run", "--run", default=None, metavar="EVAL_NAME")
+    p.add_argument("-list", "--list", action="store_true",
+                   help="list configured eval sets")
+    p.add_argument("-new", "--new", default=None, metavar="EVAL_NAME",
+                   help="create a new eval set")
+    p.add_argument("-delete", "--delete", default=None,
+                   metavar="EVAL_NAME", help="delete an eval set")
+    p.add_argument("-score", "--score", nargs="?", const=None,
+                   default=False, metavar="EVAL_NAME",
+                   help="scoring only (EvalScore.csv, no metrics)")
+    p.add_argument("-confmat", "--confmat", nargs="?", const=None,
+                   default=False, metavar="EVAL_NAME",
+                   help="confusion matrix from an existing score file")
+    p.add_argument("-perf", "--perf", nargs="?", const=None,
+                   default=False, metavar="EVAL_NAME",
+                   help="performance curves from an existing score file")
     p.add_argument("-norm", "--norm", action="store_true",
                    help="export normalized eval data instead of scoring")
     p.add_argument("-audit", "--audit", action="store_true",

@@ -49,6 +49,34 @@ def process_shard() -> tuple:
     return jax.process_index(), jax.process_count()
 
 
+def _multi_process() -> bool:
+    """Whether shared-storage writes need the single-writer guard —
+    decided WITHOUT initializing a backend when none is up yet.
+    `jax.process_index()` lazily creates the default backend, and for
+    pure file operations (ColumnConfig writes from `shifu init`) that
+    means probing — and possibly hanging on — an unreachable
+    accelerator the command never needed.
+
+    - a backend is already live (every device-using command) → ask it;
+    - `jax.distributed` client present (explicit SHIFU_TPU_* init) →
+      multi-process;
+    - neither → treat as single-process: a FILE-ONLY command on a
+      TPU pod then writes identical content from every host without
+      the guard (the pre-guard behavior), which beats hanging every
+      laptop/CI `init` on an unreachable accelerator."""
+    try:
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, "_backends", None):
+            return jax.process_count() > 1
+    except Exception:
+        pass
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+    except Exception:  # internal API moved: fall back to the real call
+        return jax.process_count() > 1
+
+
 def is_writer() -> bool:
     """True on the single process allowed to write shared-storage
     outputs (ColumnConfig.json, EvalScore.csv, normalized layouts, …).
@@ -56,14 +84,14 @@ def is_writer() -> bool:
     N concurrent ``open(path, 'w')`` on the same shared file can
     interleave or truncate each other — same guard the streaming
     trainer's checkpoint save uses."""
-    return jax.process_index() == 0
+    return not _multi_process() or jax.process_index() == 0
 
 
 def writer_barrier(tag: str) -> None:
     """Block until every process reaches this point — hosts must not
     read a shared output file the writer is still producing. No-op
     single-process."""
-    if jax.process_count() > 1:
+    if _multi_process() and jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(tag)
 

@@ -61,15 +61,20 @@ def _opath(path: str, readback: bool = False) -> str:
     return os.path.join(scratch, os.path.basename(path))
 
 
-def run(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
+def _eval_by_name(ctx, eval_name):
     mc = ctx.model_config
-    ctx.validate(ModelStep.EVAL)
-    ctx.require_columns()
-    evals = [e for e in mc.evals if eval_name is None or e.name == eval_name]
+    evals = [e for e in mc.evals
+             if eval_name is None or e.name == eval_name]
     if not evals:
         raise ValueError(f"no eval set named {eval_name!r}; have "
                          f"{[e.name for e in mc.evals]}")
-    for ec in evals:
+    return evals
+
+
+def run(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
+    ctx.validate(ModelStep.EVAL)
+    ctx.require_columns()
+    for ec in _eval_by_name(ctx, eval_name):
         run_one(ctx, ec)
     return 0
 
@@ -272,11 +277,7 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
     heads the output into tmp/<set>_<eval>_audit.data)."""
     mc = ctx.model_config
     ctx.require_columns()
-    evals = [e for e in mc.evals if eval_name is None or e.name == eval_name]
-    if not evals:
-        raise ValueError(f"no eval set named {eval_name!r}; have "
-                         f"{[e.name for e in mc.evals]}")
-    for ec in evals:
+    for ec in _eval_by_name(ctx, eval_name):
         # the audit wants N records, not the whole set: read chunks
         # until N scorable rows survive the filter/tag mask, then score
         # just those (the reference heads the full score job's output;
@@ -681,3 +682,195 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
     log.info("eval[%s]: %d rows, multi-class accuracy=%.4f in %.2fs",
              ec.name, len(pred), acc, time.time() - t0)
     return perf
+
+
+# ---------------------------------------------------------------------------
+# Eval-set management + split steps (ShifuCLI eval -list/-new/-delete/
+# -score/-confmat/-perf — EvalModelProcessor.java:165-196)
+# ---------------------------------------------------------------------------
+
+def run_list(ctx: ProcessorContext) -> int:
+    """`shifu eval -list` (EvalModelProcessor.listEvalSet)."""
+    names = [e.name for e in ctx.model_config.evals]
+    log.info("%d eval set(s) configured", len(names))
+    for n in names:
+        print(n)
+    return 0
+
+
+def run_new(ctx: ProcessorContext, name: str) -> int:
+    """`shifu eval -new <name>` — clone the model dataSet into a fresh
+    EvalConfig + empty meta/score-meta name files
+    (EvalModelProcessor.createNewEval:639-668)."""
+    import copy as copy_mod
+
+    from shifu_tpu.config.model_config import EvalConfig
+    mc = ctx.model_config
+    if any(e.name == name for e in mc.evals):
+        raise ValueError(f"EvalSet - {name} already exists in "
+                         "ModelConfig. Please use another evalset name")
+    ec = EvalConfig()
+    ec.name = name
+    ec.dataSet = copy_mod.deepcopy(mc.dataSet)
+    cols_dir = os.path.join(ctx.path_finder.root, "columns")
+    os.makedirs(cols_dir, exist_ok=True)
+    meta = os.path.join("columns", f"{name}.meta.column.names")
+    score_meta = os.path.join("columns", f"{name}Score.meta.column.names")
+    ec.dataSet.metaColumnNameFile = meta
+    ec.scoreMetaColumnNameFile = score_meta
+    mc.evals.append(ec)
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("eval_new") as w:
+        if w:
+            for rel in (meta, score_meta):
+                p = os.path.join(ctx.path_finder.root, rel)
+                if not os.path.exists(p):
+                    open(p, "a").close()
+            mc.save(ctx.path_finder.root)
+    log.info("Create Eval - %s", name)
+    return 0
+
+
+def run_delete(ctx: ProcessorContext, name: str) -> int:
+    """`shifu eval -delete <name>` (EvalModelProcessor.deleteEvalSet)."""
+    mc = ctx.model_config
+    before = len(mc.evals)
+    mc.evals = [e for e in mc.evals if e.name != name]
+    if len(mc.evals) == before:
+        raise ValueError(f"no eval set named {name!r}; have "
+                         f"{[e.name for e in mc.evals]}")
+    from shifu_tpu.parallel import dist
+    with dist.single_writer("eval_delete") as w:
+        if w:
+            mc.save(ctx.path_finder.root)
+    log.info("Delete Eval - %s", name)
+    return 0
+
+
+def run_score(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
+    """`shifu eval -score [name]` — scoring ONLY (EvalScore.csv), no
+    metrics pass (EvalModelProcessor.runScore — the reference's
+    score-then-perf split lets huge sets score once and be re-analyzed
+    cheaply with -confmat/-perf)."""
+    mc = ctx.model_config
+    ctx.validate(ModelStep.EVAL)
+    ctx.require_columns()
+    for ec in _eval_by_name(ctx, eval_name):
+        base = ctx.path_finder.eval_base_path(ec.name)
+        os.makedirs(base, exist_ok=True)
+        chunk_rows = eval_chunk_rows(ctx, ec)
+        scorer = _make_scorer(ctx, ec)
+        out_path = _opath(ctx.path_finder.eval_score_path(ec.name))
+        if mc.is_multi_classification:
+            # per-class probability columns + argmax, like run_one's
+            # _finish_multiclass score block (no mean/max ensemble cols)
+            from shifu_tpu.eval import csv_out
+            dset, cols = _build_eval_dataset(ctx, ec, want_meta=False)
+            scores = _score_dataset(mc, scorer, dset, cols)
+            class_cols = sorted(k for k in scores if k.startswith("class"))
+            pred = scores["final"].astype(np.int32)
+            csv_out.write_csv(
+                out_path,
+                ["tag", "weight"] + class_cols + ["predicted"],
+                [dset.tags.astype(np.int32), dset.weights]
+                + [scores[c] for c in class_cols] + [pred],
+                ["%d", "%.6g"] + ["%.6f"] * len(class_cols) + ["%d"])
+            log.info("eval[%s] -score → %s (%d rows, multi-class)",
+                     ec.name, ctx.path_finder.eval_score_path(ec.name),
+                     len(pred))
+            continue
+        n = 0
+        with open(out_path, "w") as f:
+            if chunk_rows and not mc.is_multi_classification:
+                from shifu_tpu.data.reader import iter_raw_table
+                ds = effective_dataset_conf(mc, ec)
+                model_cols: List[str] = []
+                for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows):
+                    dset, cols = _build_eval_dataset(ctx, ec, df=df,
+                                                     want_meta=False)
+                    if not len(dset.tags):
+                        continue
+                    scores = _score_dataset(mc, scorer, dset, cols)
+                    if n == 0:
+                        model_cols = sorted(k for k in scores
+                                            if k.startswith("model"))
+                        f.write("tag,weight," + ",".join(model_cols)
+                                + ",mean,max,min,median\n")
+                    _write_eval_score_chunk(f, scores, dset.tags,
+                                            dset.weights, model_cols)
+                    n += len(dset.tags)
+            else:
+                dset, cols = _build_eval_dataset(ctx, ec, want_meta=False)
+                scores = _score_dataset(mc, scorer, dset, cols)
+                model_cols = sorted(k for k in scores
+                                    if k.startswith("model"))
+                f.write("tag,weight," + ",".join(model_cols)
+                        + ",mean,max,min,median\n")
+                _write_eval_score_chunk(f, scores, dset.tags,
+                                        dset.weights, model_cols)
+                n = len(dset.tags)
+        if n == 0:
+            raise ValueError(f"eval set {ec.name}: no scorable rows")
+        log.info("eval[%s] -score → %s (%d rows)", ec.name,
+                 ctx.path_finder.eval_score_path(ec.name), n)
+    return 0
+
+
+def _read_scores_csv(ctx, ec):
+    """(final, tags, weights) from a previously-written EvalScore.csv —
+    the input of the -confmat/-perf split steps."""
+    import pandas as pd
+    if ctx.model_config.is_multi_classification:
+        raise ValueError(
+            "eval -confmat/-perf are binary-model steps (the multiclass "
+            "score file has per-class columns, and the CxC confusion "
+            "matrix is produced by `eval -run`)")
+    p = ctx.path_finder.eval_score_path(ec.name)
+    if not os.path.exists(p):
+        raise FileNotFoundError(
+            f"{p} not found; run `eval -score {ec.name}` (or -run) first")
+    df = pd.read_csv(p)
+    sel = str(ec.performanceScoreSelector or "mean").lower()
+    col = sel if sel in df.columns else "mean"
+    return (df[col].to_numpy(np.float64),
+            df["tag"].to_numpy(np.float64),
+            df["weight"].to_numpy(np.float64))
+
+
+def run_confmat(ctx: ProcessorContext,
+                eval_name: Optional[str] = None) -> int:
+    """`shifu eval -confmat [name]` — confusion matrix from the score
+    file (EvalModelProcessor.runConfusionMatrix)."""
+    ctx.require_columns()
+    for ec in _eval_by_name(ctx, eval_name):
+        final, tags, weights = _read_scores_csv(ctx, ec)
+        cm = confusion_matrix_table(final, tags, weights)
+        _write_confusion_csv(_opath(
+            ctx.path_finder.eval_confusion_path(ec.name)), cm)
+        log.info("eval[%s] -confmat → %s", ec.name,
+                 ctx.path_finder.eval_confusion_path(ec.name))
+    return 0
+
+
+def run_perf(ctx: ProcessorContext,
+             eval_name: Optional[str] = None) -> int:
+    """`shifu eval -perf [name]` — PR/ROC/gains + charts from the score
+    file (EvalModelProcessor.runPerformance)."""
+    mc = ctx.model_config
+    ctx.require_columns()
+    for ec in _eval_by_name(ctx, eval_name):
+        final, tags, weights = _read_scores_csv(ctx, ec)
+        perf = performance_result(final, tags, weights,
+                                  n_buckets=ec.performanceBucketNum)
+        with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
+                  "w") as f:
+            json.dump(perf, f, indent=1)
+        gain_chart.write_html(
+            _opath(ctx.path_finder.gain_chart_path(ec.name, "html")),
+            perf, f"{mc.model_set_name} — {ec.name}")
+        gain_chart.write_csv(
+            _opath(ctx.path_finder.gain_chart_path(ec.name, "csv")), perf)
+        log.info("eval[%s] -perf: AUC=%.4f → %s", ec.name,
+                 perf["areaUnderRoc"],
+                 ctx.path_finder.eval_performance_path(ec.name))
+    return 0

@@ -53,12 +53,58 @@ def analysis_frame(ctx):
 
 
 
-def run(ctx: ProcessorContext, recursive: int = 0, seed: int = 12306) -> int:
+def run(ctx: ProcessorContext, recursive: int = 0, seed: int = 12306,
+        reset: bool = False, list_only: bool = False,
+        select_file: Optional[str] = None) -> int:
     t0 = time.time()
     mc = ctx.model_config
     ctx.validate(ModelStep.VARSELECT)
     ctx.require_columns()
     vs = mc.varSelect
+
+    if reset:
+        # `shifu varsel -reset` — all selections back to false
+        # (VarSelectModelProcessor.resetAllFinalSelect:479)
+        for cc in ctx.column_configs:
+            cc.finalSelect = False
+        ctx.save_column_configs()
+        log.info("varsel -reset: all %d columns finalSelect=false",
+                 len(ctx.column_configs))
+        return 0
+    if list_only:
+        # `shifu varsel -list` — print the current selection
+        # (VarSelectModelProcessor getIsToList branch)
+        sel = [c.columnName for c in ctx.column_configs if c.finalSelect]
+        log.info("varsel -list: %d variables selected", len(sel))
+        for name in sel:
+            print(name)
+        return 0
+    if select_file:
+        # `shifu varsel -f <file>` — reset, then select exactly the
+        # names in the file (VarSelectModelProcessor:202-220)
+        names = set(mc.column_names_from_file(select_file))
+        if not names:
+            # a typo'd path or empty file must FAIL the step — scripts
+            # chaining `varsel -f && train` would otherwise train on a
+            # stale selection with rc 0
+            raise ValueError(
+                f"varsel -f: {select_file!r} does not exist (relative "
+                "paths resolve against the model-set dir) or names no "
+                "variables")
+        n_sel = 0
+        for cc in ctx.column_configs:
+            cc.finalSelect = cc.columnName in names
+            n_sel += int(cc.finalSelect)
+        if n_sel == 0:
+            # names that match NO column (case typo, renamed schema)
+            # must not silently deselect everything with rc 0
+            raise ValueError(
+                f"varsel -f: none of the {len(names)} name(s) in "
+                f"{select_file!r} match a column; selection unchanged")
+        ctx.save_column_configs()
+        log.info("varsel -f: %d variables selected based on %s", n_sel,
+                 select_file)
+        return 0
 
     candidates = _apply_pre_filters(ctx)
     if not vs.filterEnable:

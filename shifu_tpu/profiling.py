@@ -30,10 +30,23 @@ log = logging.getLogger("shifu_tpu")
 
 def device_stats() -> Dict:
     """Backend + device count + memory stats (peak HBM) when the
-    runtime exposes them (TPU does; CPU returns none)."""
+    runtime exposes them (TPU does; CPU returns none).
+
+    Reports only ALREADY-INITIALIZED backends: metrics run after every
+    command, including pure file operations (`init`, `save`), and
+    jax.devices() would lazily initialize every registered platform —
+    probing (and possibly hanging on) an unreachable accelerator the
+    command never used."""
     out: Dict = {}
     try:
         import jax
+        from jax._src import xla_bridge
+        cache = getattr(xla_bridge, "_backends", None)
+        if cache is not None and not cache:
+            return out   # nothing initialized — nothing to report
+        # cache is None only if the internal attr moved in a jax
+        # upgrade: fall back to reporting (the old behavior) rather
+        # than silently losing metrics forever
         devs = jax.devices()
         out["backend"] = jax.default_backend()
         out["deviceCount"] = len(devs)

@@ -395,3 +395,74 @@ def test_streaming_eval_matches_resident(model_set, monkeypatch):
     # temp dumps cleaned up
     base = ctx.path_finder.eval_base_path("Eval1")
     assert not [p for p in os.listdir(base) if p.startswith(".scores")]
+
+
+def test_eval_split_steps_and_management(tmp_path, rng):
+    """ShifuCLI eval -new/-list/-delete and the -score/-confmat/-perf
+    split (EvalModelProcessor.java:165-196): score once, re-analyze
+    cheaply from the score file."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = make_model_set(tmp_path, rng, n_rows=1200)
+    for cmd in (["init"], ["stats"], ["norm"], ["train"]):
+        assert cli_main(["--dir", root] + cmd) == 0
+    # score-only: EvalScore.csv written, no performance file yet
+    assert cli_main(["--dir", root, "eval", "-score"]) == 0
+    ctx = ProcessorContext.load(root)
+    assert os.path.exists(ctx.path_finder.eval_score_path("Eval1"))
+    assert not os.path.exists(
+        ctx.path_finder.eval_performance_path("Eval1"))
+    # perf + confmat from the existing score file
+    assert cli_main(["--dir", root, "eval", "-perf"]) == 0
+    assert cli_main(["--dir", root, "eval", "-confmat"]) == 0
+    perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
+    assert perf["areaUnderRoc"] > 0.85
+    assert os.path.exists(ctx.path_finder.eval_confusion_path("Eval1"))
+    # management: new / list / delete
+    assert cli_main(["--dir", root, "eval", "-new", "Holdout"]) == 0
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    assert [e["name"] for e in mc["evals"]] == ["Eval1", "Holdout"]
+    assert os.path.exists(os.path.join(
+        root, "columns", "Holdout.meta.column.names"))
+    assert cli_main(["--dir", root, "eval", "-delete", "Holdout"]) == 0
+    mc = json.load(open(os.path.join(root, "ModelConfig.json")))
+    assert [e["name"] for e in mc["evals"]] == ["Eval1"]
+    # duplicate -new refuses
+    assert cli_main(["--dir", root, "eval", "-new", "Eval1"]) != 0
+
+
+def test_varsel_reset_list_and_file(tmp_path, rng, capsys):
+    """ShifuCLI varsel -reset / -list / -f <file>
+    (VarSelectModelProcessor.java:155-220)."""
+    import json
+
+    from tests.synth import make_model_set
+    from shifu_tpu.cli import main as cli_main
+
+    root = make_model_set(tmp_path, rng, n_rows=800)
+    for cmd in (["init"], ["stats"], ["varsel"]):
+        assert cli_main(["--dir", root] + cmd) == 0
+    cc = json.load(open(os.path.join(root, "ColumnConfig.json")))
+    assert any(c["finalSelect"] for c in cc)
+    # -list prints the selection
+    assert cli_main(["--dir", root, "varsel", "-list"]) == 0
+    listed = [ln for ln in capsys.readouterr().out.splitlines()
+              if ln.strip()]
+    assert set(listed) == {c["columnName"] for c in cc
+                           if c["finalSelect"]}
+    # -f selects exactly the named variables
+    sel_file = os.path.join(root, "columns", "picked.names")
+    with open(sel_file, "w") as f:
+        f.write("num_0\nnum_2\n")
+    assert cli_main(["--dir", root, "varsel", "-f", sel_file]) == 0
+    cc = json.load(open(os.path.join(root, "ColumnConfig.json")))
+    assert {c["columnName"] for c in cc if c["finalSelect"]} == \
+        {"num_0", "num_2"}
+    # -reset clears everything
+    assert cli_main(["--dir", root, "varsel", "-reset"]) == 0
+    cc = json.load(open(os.path.join(root, "ColumnConfig.json")))
+    assert not any(c["finalSelect"] for c in cc)

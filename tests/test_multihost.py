@@ -84,3 +84,23 @@ def test_two_process_streaming_matches_single_process(tmp_path):
                                rtol=5e-3, atol=5e-4)
     # resident-path global device_put executed on both rigs and agreed
     np.testing.assert_allclose(a["row_sum"], b["row_sum"], rtol=1e-5)
+
+
+def test_writer_guard_never_initializes_backend(monkeypatch):
+    """is_writer/writer_barrier are called from pure FILE operations
+    (shifu init writing ColumnConfig.json); they must not lazily
+    initialize a JAX backend — on a machine with an unreachable
+    accelerator plugin that means hanging a command that never needed
+    a device."""
+    from jax._src import xla_bridge
+
+    from shifu_tpu.parallel import dist
+
+    def boom(*a, **k):
+        raise AssertionError("backend initialization attempted")
+
+    monkeypatch.setattr(xla_bridge, "get_backend", boom)
+    assert dist.is_writer() is True
+    dist.writer_barrier("t")   # no-op, no backend touch
+    with dist.single_writer("t2") as w:
+        assert w is True
