@@ -203,13 +203,26 @@ def _delta_timed(measure, short_epochs: int, long_epochs: int):
     re-measure once on a timing inversion (tunnel jitter); raise if the
     inversion survives — a bad sample must fail loudly, not print an
     absurd headline into BENCH_LOCAL.jsonl. Returns
-    (result_of_long_run, walls dict, d_wall)."""
+    (result_of_long_run, walls dict, d_wall).
+
+    SHIFU_TPU_BENCH_ATTEMPTS (default 2) bounds the re-measures: the
+    CPU smoke tests raise it because a loaded CI host can invert the
+    two lengths for real (the short run descheduled behind another
+    suite), while on TPU two attempts is the right guard — a surviving
+    inversion there means the sample is unusable."""
+    attempts = max(1, int(os.environ.get("SHIFU_TPU_BENCH_ATTEMPTS", "2")))
     walls = {}
     res = None
-    for attempt in range(2):
+    for attempt in range(attempts):
         for epochs in (short_epochs, long_epochs):
+            t_in = time.time()
             t0, res = measure(epochs)
             walls[epochs] = time.time() - t0
+            # stderr breadcrumb: a later step timeout should leave
+            # evidence of where the wall went (compile vs timed run)
+            print(f"[delta] epochs={epochs} compile+setup="
+                  f"{t0 - t_in:.1f}s timed_run={walls[epochs]:.1f}s",
+                  file=sys.stderr, flush=True)
         if walls[long_epochs] > walls[short_epochs]:
             break
     d_wall = walls[long_epochs] - walls[short_epochs]
@@ -222,22 +235,25 @@ def _delta_timed(measure, short_epochs: int, long_epochs: int):
 
 def task_nn():
     """Flagship: the REAL train_bags path (vmapped bags, scanned epochs,
-    in-graph early stop + best-val tracking), 1 bag, full batch."""
-    import numpy as np
+    in-graph early stop + best-val tracking), 1 bag, full batch.
 
+    Data is generated ON DEVICE (jax.random): 2M×32 f32 is ~256 MB,
+    and the tunneled TPU's host→device rate varies enough run-to-run
+    to dominate wall-clock and risk the ladder step timeout."""
     import jax
+    import jax.numpy as jnp
 
     from shifu_tpu.config.model_config import ModelTrainConf
     from shifu_tpu.models import nn as nn_mod
     from shifu_tpu.ops.metrics import auc
     from shifu_tpu.train import trainer
 
-    rng = np.random.default_rng(0)
-    beta = rng.normal(0, 1, N_FEATURES).astype(np.float32)
-    x = rng.normal(0, 1, (N_ROWS, N_FEATURES)).astype(np.float32)
-    logits = x @ beta * 0.7 + rng.normal(0, 1, N_ROWS)
-    y = (logits > 0).astype(np.float32)
-    w = np.ones(N_ROWS, np.float32)
+    kb, kx, kn = jax.random.split(jax.random.PRNGKey(0), 3)
+    beta = jax.random.normal(kb, (N_FEATURES,), jnp.float32)
+    x = jax.random.normal(kx, (N_ROWS, N_FEATURES), jnp.float32)
+    logits = x @ beta * 0.7 + jax.random.normal(kn, (N_ROWS,))
+    y = (logits > 0).astype(jnp.float32)
+    w = jnp.ones(N_ROWS, jnp.float32)
 
     def conf_for(epochs):
         conf = ModelTrainConf()
@@ -293,25 +309,26 @@ def task_nn_wide():
 
     Timing is a two-length delta: train the same shape for 2 and 102
     epochs and attribute wall(102) − wall(2) to 100 epochs of pure
-    in-graph compute — the one-time host→device transfer (720 MB over
-    a tunnel whose rate varies run to run) cancels instead of
-    polluting the utilization estimate."""
-    import numpy as np
-
+    in-graph compute — per-call dispatch and result readback cancel
+    instead of polluting the utilization estimate. Data is generated
+    ON DEVICE (jax.random): 300k×600 f32 is 720 MB, which over the
+    tunnel's variable host→device rate used to dominate wall-clock
+    and trip the ladder step timeout."""
     import jax
+    import jax.numpy as jnp
 
     from shifu_tpu.config.model_config import ModelTrainConf
     from shifu_tpu.models import nn as nn_mod
     from shifu_tpu.ops.metrics import auc
     from shifu_tpu.train import trainer
 
-    rng = np.random.default_rng(0)
-    beta = rng.normal(0, 1, WIDE_FEATURES).astype(np.float32)
-    x = rng.normal(0, 1, (WIDE_ROWS, WIDE_FEATURES)).astype(np.float32)
-    logits = x @ beta / np.sqrt(WIDE_FEATURES) * 2.0 \
-        + rng.normal(0, 1, WIDE_ROWS)
-    y = (logits > 0).astype(np.float32)
-    w = np.ones(WIDE_ROWS, np.float32)
+    kb, kx, kn = jax.random.split(jax.random.PRNGKey(0), 3)
+    beta = jax.random.normal(kb, (WIDE_FEATURES,), jnp.float32)
+    x = jax.random.normal(kx, (WIDE_ROWS, WIDE_FEATURES), jnp.float32)
+    logits = x @ beta / jnp.sqrt(float(WIDE_FEATURES)) * 2.0 \
+        + jax.random.normal(kn, (WIDE_ROWS,))
+    y = (logits > 0).astype(jnp.float32)
+    w = jnp.ones(WIDE_ROWS, jnp.float32)
 
     def conf_for(epochs):
         conf = ModelTrainConf()
@@ -364,9 +381,9 @@ def task_wdl():
     """Criteo-like WDL training throughput: the real train_bags path
     with embedding + wide tables + deep MLP (models/wdl.py, the
     WDLWorker/WideAndDeep replacement). Delta timing like the MLP
-    benches so the one-time transfer cancels."""
-    import numpy as np
-
+    benches so per-call dispatch cost cancels; data generated ON
+    DEVICE (jax.random) like the other tasks so the tunnel's variable
+    transfer rate never touches the wall-clock."""
     import jax
     import jax.numpy as jnp
 
@@ -375,14 +392,16 @@ def task_wdl():
     from shifu_tpu.train.optimizers import optimizer_from_params
     from shifu_tpu.train.trainer import split_validation, train_bags
 
-    rng = np.random.default_rng(0)
-    dense = rng.normal(0, 1, (WDL_ROWS, WDL_DENSE)).astype(np.float32)
-    idx = rng.integers(0, WDL_VOCAB, (WDL_ROWS, WDL_CAT)).astype(np.int32)
+    kd, ki, ke, kn = jax.random.split(jax.random.PRNGKey(0), 4)
+    dense = jax.random.normal(kd, (WDL_ROWS, WDL_DENSE), jnp.float32)
+    idx = jax.random.randint(ki, (WDL_ROWS, WDL_CAT), 0, WDL_VOCAB,
+                             jnp.int32)
     # informative signal: a few embedding ids + dense margin
-    eff = rng.normal(0, 1, WDL_VOCAB).astype(np.float32)
+    eff = jax.random.normal(ke, (WDL_VOCAB,), jnp.float32)
     margin = dense[:, 0] * 0.8 + eff[idx[:, 0]] + eff[idx[:, 1]] * 0.5
-    y = (margin + rng.normal(0, 1, WDL_ROWS) > 0).astype(np.float32)
-    w = np.ones(WDL_ROWS, np.float32)
+    y = (margin + jax.random.normal(kn, (WDL_ROWS,)) > 0) \
+        .astype(jnp.float32)
+    w = jnp.ones(WDL_ROWS, jnp.float32)
 
     spec = wdl.WDLSpec(dense_dim=WDL_DENSE, n_cat=WDL_CAT,
                        vocab_size=WDL_VOCAB, embed_size=WDL_EMBED,
@@ -664,15 +683,29 @@ def task_gbt(rows=None, trees=None):
 
 def _run_task(task, env_extra=None, timeout=1200):
     env = dict(os.environ)
+    # persistent XLA compilation cache: the tunneled TPU's compile
+    # round-trips are minutes-scale and identical across ladder
+    # attempts — cache hits turn a re-run's compile cost into ~0
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     env.update(env_extra or {})
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--task", task],
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
-        # a hung backend init must degrade to retry/fallback, not crash
-        return None, f"task {task} timed out after {timeout}s"
+    except subprocess.TimeoutExpired as e:
+        # a hung backend init must degrade to retry/fallback, not
+        # crash — and the partial stderr says where the wall went
+        tail = ""
+        if e.stderr:
+            err_text = e.stderr if isinstance(e.stderr, str) \
+                else e.stderr.decode("utf-8", "replace")
+            tail = " | stderr tail: " + " / ".join(
+                err_text.strip().splitlines()[-3:])
+        return None, f"task {task} timed out after {timeout}s{tail}"
     if p.returncode != 0:
         return None, (p.stderr or p.stdout or "")[-2000:]
     for line in reversed(p.stdout.strip().splitlines()):
@@ -825,24 +858,29 @@ def main():
             # pallas-vs-xla) have never produced a committed number,
             # so they spend the window first. Streaming stays LAST
             # (riskiest transfer pattern: ~24 GB of chunks per epoch).
+            # timeouts sized for a BAD tunnel day: each heavy task
+            # spends minutes in compile round-trips alone (observed
+            # 2026-07-31: nn_wide and wdl both exceeded 1200s before
+            # their first record); the compilation cache makes retries
+            # cheaper but a first capture still needs the headroom
             step("nn_wide", f"wide-NN utilization bench ({WIDE_ROWS}x"
-                 f"{WIDE_FEATURES}, {WIDE_HIDDEN})")
+                 f"{WIDE_FEATURES}, {WIDE_HIDDEN})", timeout=2700)
             step("wdl", f"WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
-                 f"vocab {WDL_VOCAB})")
+                 f"vocab {WDL_VOCAB})", timeout=2700)
             # Pallas interpret mode on CPU is not a perf path; only
             # measure the kernel where it actually runs.
             step("hist_pallas", "GBDT histogram bench (pallas MXU)")
             step("hist_xla", "GBDT histogram bench (xla scatter)")
             step("gbt_small", f"GBT small train bench ({GBT_SMALL_ROWS}x"
-                 f"{GBT_COLS}, {GBT_SMALL_TREES} trees)")
+                 f"{GBT_COLS}, {GBT_SMALL_TREES} trees)", timeout=2400)
             step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
-                 f"{BENCH_EPOCHS} epochs)")
+                 f"{BENCH_EPOCHS} epochs)", timeout=2400)
             step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
-                 f"{GBT_COLS}, {GBT_TREES} trees)")
+                 f"{GBT_COLS}, {GBT_TREES} trees)", timeout=3000)
             if os.environ.get("SHIFU_TPU_BENCH_STREAMING", "1") != "0":
                 step("streaming", f">HBM streaming bench ({STREAM_ROWS}"
                      f"x{STREAM_FEATURES}, 24 GB on disk)",
-                     timeout=3000)
+                     timeout=3600)
         else:
             step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
                  f"{BENCH_EPOCHS} epochs)")

@@ -66,14 +66,22 @@ def shard_axis(mesh: Mesh, a: np.ndarray, axis: int = 0,
     """Place one host array onto the mesh sharded along `axis`, padding
     that axis to a multiple of the data-axis size with `pad_value`
     (weight-0 / NaN-missing padding keeps downstream results exact —
-    callers choose the value that is inert for their kernel)."""
-    a = np.asarray(a)
+    callers choose the value that is inert for their kernel).
+
+    Accepts device arrays too (on-device data generation): padding
+    then uses jnp so the array never round-trips device→host — over a
+    tunneled TPU that readback costs more than the compute it feeds."""
     n_data = mesh.shape["data"]
+    on_device = isinstance(a, jax.Array)
+    if not on_device:
+        a = np.asarray(a)
     pad = (-a.shape[axis]) % n_data
     if pad:
+        import jax.numpy as jnp
         widths = [(0, 0)] * a.ndim
         widths[axis] = (0, pad)
-        a = np.pad(a, widths, constant_values=pad_value)
+        xp = jnp if on_device else np
+        a = xp.pad(a, widths, constant_values=pad_value)
     spec = [None] * a.ndim
     spec[axis] = "data"
     return jax.device_put(a, NamedSharding(mesh, P(*spec)))

@@ -262,7 +262,10 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
     shuffled batches (see train_bags_carry) — activation memory scales
     with batch_rows × bags instead of rows × bags."""
     mesh = mesh_mod.default_mesh()
-    n_rows = int(np.asarray(train_inputs[0]).shape[0])
+    # .shape, not np.asarray(...).shape: the inputs can be device
+    # arrays (on-device data generation), and asarray would pull the
+    # whole array back to host just to read a dimension
+    n_rows = int(train_inputs[0].shape[0])
     n_batches = 1
     if batch_rows and 0 < batch_rows < n_rows:
         n_batches = -(-n_rows // batch_rows)
@@ -273,6 +276,14 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
         # caller's train seed so bags/runs don't all share one order.
         perm = np.random.default_rng(
             np.uint64(0xB47C4) ^ np.uint64(perm_seed)).permutation(n_rows)
+        if any(isinstance(t, jax.Array) for t in train_inputs):
+            # to_batches permutes on the HOST (single-allocation
+            # permute+pad — mini-batch mode exists to bound host
+            # memory): device inputs get pulled back first, which on a
+            # tunneled TPU costs the transfer the caller was avoiding
+            log.warning("mini-batch mode with device-array inputs: "
+                        "rows are permuted on host, forcing a "
+                        "device->host readback of the full dataset")
 
         def to_batches(a, axis_rows=0):
             # permute + pad + reshape in ONE allocation (a permuted

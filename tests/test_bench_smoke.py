@@ -15,6 +15,14 @@ import pytest
 import bench
 
 
+@pytest.fixture(autouse=True)
+def _tolerant_delta_timing(monkeypatch):
+    # a loaded CI host can invert the two-length delta timing for real
+    # (short run descheduled behind a concurrent suite) — give the
+    # smoke runs more re-measures than the TPU default of 2
+    monkeypatch.setenv("SHIFU_TPU_BENCH_ATTEMPTS", "5")
+
+
 def _patch_small(monkeypatch):
     monkeypatch.setattr(bench, "N_ROWS", 20_000)
     monkeypatch.setattr(bench, "N_FEATURES", 16)
