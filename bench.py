@@ -124,17 +124,23 @@ GBT_SMALL_TREES = 10
 
 # >HBM streaming demo (VERDICT r3 next #8): trainOnDisk NN over a
 # disk-resident matrix LARGER than one chip's HBM (v5e: 16 GB).
-# 20M rows × 300 f32 = 24 GB on disk; chunks of 262144 rows (~315 MB)
-# stream host→device double-buffered — small enough that the tunnel's
-# ~1 GB single-transfer wedge point is never approached.
-STREAM_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_ROWS", 20_000_000))
+# 15M rows × 300 f32 = 18.0 GB on disk; chunks of 262144 rows
+# (~315 MB) stream host→device double-buffered — small enough that the
+# tunnel's ~1 GB single-transfer wedge point is never approached.
+# Workload sized to the tunnel's MEASURED effective stream rate: the
+# original 20M×300 / 1→3-epoch delta moved 120 GB total and blew a
+# 3600 s budget (and a 7000 s retry) without finishing; a 3-chunk
+# warm-up (~1 GB) + 2 measured epochs of 18.0 GB ≈ 38 GB fits the
+# window while still exceeding HBM. Rows stay a multiple of the 1M
+# generation chunk so a larger on-disk layout can serve by prefix
+# slice (see _ensure_stream_layout).
+STREAM_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_ROWS", 15_000_000))
 STREAM_FEATURES = int(os.environ.get("SHIFU_TPU_STREAM_FEATURES", 300))
 STREAM_HIDDEN = (256,)
 STREAM_CHUNK_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_CHUNK_ROWS",
                                        262_144))
 STREAM_VALID_RATE = 0.02
-STREAM_EPOCHS_SHORT = 1
-STREAM_EPOCHS_LONG = 3
+STREAM_EPOCHS_LONG = 2
 STREAM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tmp", "bench_stream")
 
@@ -518,12 +524,43 @@ def _ensure_stream_layout(rows, feats, chunk=1_000_000, seed=11):
         try:
             meta = json.load(open(done_p))
             ok = meta == {"rows": rows, "feats": feats, "seed": seed,
-                          "complete": True}
+                          "chunk": chunk, "complete": True}
+            # a LARGER complete layout serves a smaller request by
+            # prefix slice (saves rewriting ~18 GB when the workload
+            # constants shrink between rounds) — but ONLY at a
+            # boundary of the chunk size the FILE was generated with:
+            # within a generation chunk the noise draws follow all x
+            # draws in one Philox stream, so a mid-chunk cut's tags
+            # would differ from a fresh generation's. The mmap shape
+            # check guards against a sidecar left stale by a crashed
+            # regeneration.
+            # pre-sidecar-versioning layouts carry no "chunk" key;
+            # every historical generation used the parameter default,
+            # so that is the safe assumption for them
+            gen_chunk = meta.get("chunk", 1_000_000)
+            if (not ok and meta.get("complete")
+                    and meta.get("feats") == feats
+                    and meta.get("seed") == seed
+                    and meta.get("rows", 0) > rows
+                    and gen_chunk == chunk
+                    and rows % gen_chunk == 0):
+                dm = np.load(dense_p, mmap_mode="r")
+                if dm.shape[0] == meta["rows"]:
+                    return (dm[:rows],
+                            np.load(tags_p, mmap_mode="r")[:rows],
+                            np.load(w_p, mmap_mode="r")[:rows])
         except (OSError, json.JSONDecodeError):
             ok = False
     if not ok:
         _log(f"stream bench: writing {rows}x{feats} f32 "
              f"({rows * feats * 4 / 1e9:.1f} GB) to {STREAM_DIR}...")
+        # regeneration truncates the data files: drop the sidecar
+        # FIRST so a crash mid-write can't leave it blessing a
+        # half-written layout for the prefix-reuse path
+        try:
+            os.remove(done_p)
+        except OSError:
+            pass
         rng = np.random.default_rng(seed)
         beta = rng.normal(0, 1, feats).astype(np.float32)
         dm = np.lib.format.open_memmap(dense_p, mode="w+",
@@ -535,11 +572,12 @@ def _ensure_stream_layout(rows, feats, chunk=1_000_000, seed=11):
                                        dtype=np.float32, shape=(rows,))
         for a in range(0, rows, chunk):
             b = min(a + chunk, rows)
-            # counter-based per-chunk stream → identical layout for any
-            # chunk size
             # counter strides by the per-row DRAW count, not the row
             # index — a row-index stride would overlap consecutive
-            # chunks' keystreams (each row consumes feats+1 draws)
+            # chunks' keystreams (each row consumes feats+1 draws).
+            # NOTE: within a chunk all x draws precede the noise
+            # draws, so the layout is a function of (seed, chunk) —
+            # which is why `chunk` is part of the sidecar identity
             crng = np.random.Generator(np.random.Philox(
                 key=seed, counter=a * (feats + 2)))
             x = crng.normal(0, 1, (b - a, feats)).astype(np.float32)
@@ -552,18 +590,25 @@ def _ensure_stream_layout(rows, feats, chunk=1_000_000, seed=11):
             m.flush()
         with open(done_p, "w") as f:
             json.dump({"rows": rows, "feats": feats, "seed": seed,
-                       "complete": True}, f)
+                       "chunk": chunk, "complete": True}, f)
     return (np.load(dense_p, mmap_mode="r"),
             np.load(tags_p, mmap_mode="r"),
             np.load(w_p, mmap_mode="r"))
 
 
 def task_streaming():
-    """>HBM trainOnDisk NN: the real train_nn_streaming path over a
-    24 GB disk matrix (chip HBM is 16 GB) — double-buffered ~315 MB
+    """>HBM trainOnDisk NN: the real train_nn_streaming path over an
+    18.0 GB disk matrix (chip HBM is 16 GB) — double-buffered ~315 MB
     chunks host→device, per-epoch reshuffled chunk order, trailing
-    validation region. Throughput via the shared two-length delta so
-    compile + first-touch page-cache costs cancel."""
+    validation region.
+
+    Timing: ONE measured multi-epoch run after a 3-chunk warm-up that
+    compiles the train step. The earlier two-length delta needed twice
+    the transfers and the tunneled transport's rate swings made the
+    delta meaningless (measured: 1 epoch 2588 s vs 3 epochs 2372 s on
+    consecutive runs). The number is TRANSPORT-bound on a tunneled
+    chip — a real TPU host streams from local NVMe at GB/s — so the
+    record carries the stream rate alongside throughput."""
     import numpy as np
 
     from shifu_tpu.config.model_config import ModelTrainConf
@@ -590,23 +635,24 @@ def task_streaming():
         conf.convergenceThreshold = 0.0
         return conf
 
-    def run(epochs):
+    def run(epochs, n_rows=STREAM_ROWS):
         return train_nn_streaming(conf_for(epochs), get_chunk,
-                                  STREAM_ROWS, STREAM_FEATURES, seed=1,
+                                  n_rows, STREAM_FEATURES, seed=1,
                                   chunk_rows=STREAM_CHUNK_ROWS)
 
-    # warm-up epoch BEFORE the clock: jit compile + cold page-cache
-    # reads otherwise land only in the short run and SUBTRACT from the
-    # delta (overstating throughput) instead of cancelling
-    run(1)
+    # warm-up on a 3-chunk prefix BEFORE the clock: compiles the
+    # full-chunk train step (~1 GB of transfer instead of a whole
+    # 18 GB epoch; the real run's differently-shaped validation
+    # forward still compiles inside the clock — seconds against a
+    # >1000 s measured run). Bounded by the layout so a small
+    # STREAM_ROWS override can't slice the mmap past its end.
+    run(1, n_rows=min(3 * STREAM_CHUNK_ROWS, STREAM_ROWS))
 
-    def measure(epochs):
-        t0 = time.time()
-        return t0, run(epochs)
-
-    res, walls, d_wall = _delta_timed(measure, STREAM_EPOCHS_SHORT,
-                                      STREAM_EPOCHS_LONG)
-    d_epochs = STREAM_EPOCHS_LONG - STREAM_EPOCHS_SHORT
+    t0 = time.time()
+    res = run(STREAM_EPOCHS_LONG)
+    d_wall = time.time() - t0
+    _log(f"[stream] {STREAM_EPOCHS_LONG} epochs in {d_wall:.0f}s")
+    d_epochs = STREAM_EPOCHS_LONG
     n_train = STREAM_ROWS - int(STREAM_ROWS * STREAM_VALID_RATE)
     # AUC probe on a 200k sample via the returned model
     import jax.numpy as jnp
@@ -623,10 +669,14 @@ def task_streaming():
     gb = STREAM_ROWS * STREAM_FEATURES * 4 / 1e9
     print(json.dumps({
         "row_epochs_per_sec": n_train * d_epochs / d_wall,
-        "wall_s": d_wall, "wall_short_s": walls[STREAM_EPOCHS_SHORT],
-        "wall_long_s": walls[STREAM_EPOCHS_LONG], "auc": a,
+        "wall_s": d_wall, "epochs": d_epochs, "auc": a,
         "disk_gb": round(gb, 1),
         "stream_gbps": gb * d_epochs / d_wall,
+        "note": "transport-bound on a tunneled chip: chunks cross the "
+                "tunnel at ~10-30 MB/s; a real TPU host streams from "
+                "local NVMe. The record evidences >HBM capability "
+                "(bounded device+host memory, model learns), not "
+                "steady-state rate.",
     }))
 
 
@@ -648,6 +698,11 @@ def task_gbt(rows=None, trees=None):
 
     rows = rows or GBT_ROWS
     trees = trees or GBT_TREES
+    # bound per-dispatch device time: the all-rounds-in-one-execute
+    # path held the tunnel for ~300 s at 11M×20 and the transport
+    # declared the worker dead ("TPU worker process crashed"); ~5
+    # rounds per execute keeps each dispatch around a minute
+    os.environ.setdefault("SHIFU_TPU_GBT_SCAN_GROUP", "5")
     n_bins = 64
     key = jax.random.PRNGKey(0)
     kb, kbeta, kn = jax.random.split(key, 3)
@@ -740,8 +795,7 @@ def _workload(task):
         "streaming": {"rows": STREAM_ROWS, "features": STREAM_FEATURES,
                       "hidden": list(STREAM_HIDDEN),
                       "chunk": STREAM_CHUNK_ROWS,
-                      "epochs": [STREAM_EPOCHS_SHORT,
-                                 STREAM_EPOCHS_LONG]},
+                      "epochs": STREAM_EPOCHS_LONG},
     }.get(task, {})
 
 

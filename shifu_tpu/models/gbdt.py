@@ -619,11 +619,30 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
             vraw = cfg.learning_rate * jnp.sum(predict_trees(
                 init_trees, vb, cfg.max_depth, cfg.n_bins), axis=0)
     if val_data is None and n_trees > 0:
-        # no per-round host decision to make → run every round in ONE
-        # device dispatch (see _gbt_rounds)
-        new_stacked, pred = _gbt_rounds(cfg, jb, jy, jw, pred, fm,
-                                        n_trees, mesh=hist_mesh,
-                                        subtract=subtract)
+        # no per-round host decision to make → scan rounds device-side
+        # (see _gbt_rounds), in groups of SHIFU_TPU_GBT_SCAN_GROUP
+        # rounds per dispatch (0/unset = all rounds in one). A single
+        # execute spanning minutes of device time can outlive the
+        # tunneled transport's liveness window ("TPU worker process
+        # crashed" on the 11M-row bench); equal-size groups reuse one
+        # compiled program, and a scalar FETCH between groups keeps
+        # exactly one long execute in flight — block_until_ready is a
+        # no-op on the tunneled transport (0.3 ms wall observed for a
+        # 100 s computation), a device→host value round-trip is not.
+        import os
+        group = int(os.environ.get("SHIFU_TPU_GBT_SCAN_GROUP", "0"))
+        group = n_trees if group <= 0 else min(group, n_trees)
+        parts = []
+        for start in range(0, n_trees, group):
+            k = min(group, n_trees - start)
+            part, pred = _gbt_rounds(cfg, jb, jy, jw, pred, fm,
+                                     k, mesh=hist_mesh,
+                                     subtract=subtract)
+            if start + k < n_trees:
+                float(pred[0])
+            parts.append(part)
+        new_stacked = parts[0] if len(parts) == 1 else jax.tree.map(
+            lambda *a: jnp.concatenate(a), *parts)
         if init_trees is not None:
             # continuous-training resume: prepend the old ensemble
             # (init_trees IS the stacked pytree already)

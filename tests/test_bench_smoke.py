@@ -191,12 +191,11 @@ def test_run_or_reuse_prefers_persisted(monkeypatch, tmp_path, capsys):
 
 def test_task_streaming(monkeypatch, capsys, tmp_path):
     """>HBM streaming bench task at toy shape: disk layout generation +
-    the real train_nn_streaming path + delta timing."""
+    the real train_nn_streaming path + single measured run."""
     monkeypatch.setattr(bench, "STREAM_ROWS", 6_000)
     monkeypatch.setattr(bench, "STREAM_FEATURES", 12)
     monkeypatch.setattr(bench, "STREAM_HIDDEN", (8,))
     monkeypatch.setattr(bench, "STREAM_CHUNK_ROWS", 1_024)
-    monkeypatch.setattr(bench, "STREAM_EPOCHS_SHORT", 2)
     monkeypatch.setattr(bench, "STREAM_EPOCHS_LONG", 30)
     monkeypatch.setattr(bench, "STREAM_DIR", str(tmp_path / "stream"))
     bench.task_streaming()
@@ -208,3 +207,33 @@ def test_task_streaming(monkeypatch, capsys, tmp_path):
     mtime = os.path.getmtime(str(tmp_path / "stream" / "dense.npy"))
     bench.task_streaming()
     assert os.path.getmtime(str(tmp_path / "stream" / "dense.npy")) == mtime
+
+
+def test_stream_layout_prefix_reuse(tmp_path, monkeypatch):
+    """A larger complete layout serves a smaller generation-chunk-
+    aligned request by prefix slice, bit-identical to a fresh
+    generation; a mid-chunk request regenerates instead."""
+    import numpy as np
+    monkeypatch.setattr(bench, "STREAM_DIR", str(tmp_path / "s1"))
+    big = bench._ensure_stream_layout(4_000, 5, chunk=1_000)
+    big_dense = np.array(big[0][:2_000])
+    big_tags = np.array(big[1][:2_000])
+    import os
+    mtime = os.path.getmtime(str(tmp_path / "s1" / "dense.npy"))
+    # aligned prefix: reused, no rewrite
+    d2, t2, w2 = bench._ensure_stream_layout(2_000, 5, chunk=1_000)
+    assert os.path.getmtime(str(tmp_path / "s1" / "dense.npy")) == mtime
+    assert d2.shape == (2_000, 5) and t2.shape == (2_000,)
+    # prefix equals a fresh generation of the same size
+    monkeypatch.setattr(bench, "STREAM_DIR", str(tmp_path / "s2"))
+    f_dense, f_tags, _ = bench._ensure_stream_layout(2_000, 5,
+                                                     chunk=1_000)
+    np.testing.assert_array_equal(np.array(d2), np.array(f_dense))
+    np.testing.assert_array_equal(np.array(t2), np.array(f_tags))
+    np.testing.assert_array_equal(big_dense, np.array(f_dense))
+    np.testing.assert_array_equal(big_tags, np.array(f_tags))
+    # mid-chunk request: must NOT prefix-slice (content would differ)
+    monkeypatch.setattr(bench, "STREAM_DIR", str(tmp_path / "s1"))
+    d3, _, _ = bench._ensure_stream_layout(1_500, 5, chunk=1_000)
+    assert os.path.getmtime(str(tmp_path / "s1" / "dense.npy")) != mtime
+    assert d3.shape == (1_500, 5)

@@ -502,3 +502,23 @@ def test_gbt_scan_matches_per_round_loop(rng):
                                    val_data=(bins, y))
     for k in scan_trees:
         np.testing.assert_array_equal(scan_trees[k], loop_trees[k], err_msg=k)
+
+
+def test_gbt_grouped_dispatch_matches_single(rng, monkeypatch):
+    """SHIFU_TPU_GBT_SCAN_GROUP splits the device-side boosting scan
+    into bounded-size dispatches (tunnel-liveness guard); grouping must
+    not change the math — trees bit-identical to the one-dispatch
+    build, including an uneven trailing group."""
+    from shifu_tpu.models import gbdt
+    r, c = 3000, 6
+    bins = rng.integers(0, 7, (r, c)).astype(np.int32)
+    y = (bins[:, 0] + bins[:, 1] > 6).astype(np.float32)
+    w = np.ones(r, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=3, n_bins=8, learning_rate=0.3,
+                          loss="log")
+    monkeypatch.delenv("SHIFU_TPU_GBT_SCAN_GROUP", raising=False)
+    one, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=5)
+    monkeypatch.setenv("SHIFU_TPU_GBT_SCAN_GROUP", "2")  # 2+2+1
+    grouped, _ = gbdt.build_gbt(cfg, bins, y, w, n_trees=5)
+    for k in one:
+        np.testing.assert_array_equal(one[k], grouped[k], err_msg=k)
