@@ -122,6 +122,20 @@ GBT_DEPTH = 6
 GBT_SMALL_ROWS = 2_000_000
 GBT_SMALL_TREES = 10
 
+# LR + SE-sensitivity variable selection at HIGGS scale (BASELINE.md
+# measured-ladder step 2): train a logistic regression (0-hidden MLP,
+# the reference's LR trainer analog) on 11M×28, then rank every
+# column by the VarSelectMapper MSE-delta ablation. The vmapped
+# column ablation runs over row blocks: _sensitivity_kernel's
+# `n_real` divides each block by the TOTAL row count, so block
+# results sum to the exact full-data deltas while the vmap
+# intermediate stays bounded.
+VARSEL_ROWS = 11_000_000
+VARSEL_COLS = 28
+VARSEL_BLOCK = 2_000_000
+VARSEL_EPOCHS_SHORT = 2
+VARSEL_EPOCHS_LONG = 22
+
 # >HBM streaming demo (VERDICT r3 next #8): trainOnDisk NN over a
 # disk-resident matrix LARGER than one chip's HBM (v5e: 16 GB).
 # 15M rows × 300 f32 = 18.0 GB on disk; chunks of 262144 rows
@@ -239,6 +253,43 @@ def _delta_timed(measure, short_epochs: int, long_epochs: int):
     return res, walls, d_wall
 
 
+def _mlp_train_conf(epochs, hidden, act, lr, valid_rate):
+    """The MLP-bench ModelTrainConf shared by the nn/nn_wide/varsel/
+    streaming tasks: fixed-length scan (no early stop) for clean
+    timing, 1 bag."""
+    from shifu_tpu.config.model_config import ModelTrainConf
+    conf = ModelTrainConf()
+    conf.params = {"NumHiddenLayers": len(hidden),
+                   "NumHiddenNodes": list(hidden),
+                   "ActivationFunc": [act] * len(hidden),
+                   "Propagation": "ADAM", "LearningRate": lr}
+    conf.numTrainEpochs = epochs
+    conf.baggingNum = 1
+    conf.validSetRate = valid_rate
+    conf.earlyStoppingRounds = 0
+    conf.convergenceThreshold = 0.0
+    return conf
+
+
+def _delta_timed_train(x, y, w, short_epochs, long_epochs, **conf_kw):
+    """Compile-then-time trainer.train_nn at two scan lengths via
+    _delta_timed (ONE shared copy of the protocol — a fix here reaches
+    every MLP task). Per length: first call compiles (scan length is
+    part of the shape), second measures; train_nn's np.asarray on
+    results is a real device sync (block_until_ready is NOT reliable
+    under the axon TPU tunnel). Per-call transfer/dispatch cost
+    cancels in the delta."""
+    from shifu_tpu.train import trainer
+
+    def measure(epochs):
+        conf = _mlp_train_conf(epochs, **conf_kw)
+        trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
+        t0 = time.time()
+        return t0, trainer.train_nn(conf, x, y, w, seed=1)
+
+    return _delta_timed(measure, short_epochs, long_epochs)
+
+
 def task_nn():
     """Flagship: the REAL train_bags path (vmapped bags, scanned epochs,
     in-graph early stop + best-val tracking), 1 bag, full batch.
@@ -249,10 +300,8 @@ def task_nn():
     import jax
     import jax.numpy as jnp
 
-    from shifu_tpu.config.model_config import ModelTrainConf
     from shifu_tpu.models import nn as nn_mod
     from shifu_tpu.ops.metrics import auc
-    from shifu_tpu.train import trainer
 
     kb, kx, kn = jax.random.split(jax.random.PRNGKey(0), 3)
     beta = jax.random.normal(kb, (N_FEATURES,), jnp.float32)
@@ -261,31 +310,9 @@ def task_nn():
     y = (logits > 0).astype(jnp.float32)
     w = jnp.ones(N_ROWS, jnp.float32)
 
-    def conf_for(epochs):
-        conf = ModelTrainConf()
-        conf.params = {"NumHiddenLayers": 1, "NumHiddenNodes": [HIDDEN],
-                       "ActivationFunc": ["tanh"], "Propagation": "ADAM",
-                       "LearningRate": 0.05}
-        conf.numTrainEpochs = epochs
-        conf.baggingNum = 1
-        conf.validSetRate = VALID_RATE
-        conf.earlyStoppingRounds = 0  # fixed-length scan for clean timing
-        conf.convergenceThreshold = 0.0
-        return conf
-
-    # per length: first call compiles (scan length is part of the
-    # shape), second measures. train_nn's np.asarray on results is a
-    # real device sync (NB block_until_ready is NOT reliable under the
-    # axon TPU tunnel — returns early). Throughput = the delta between
-    # the two measured walls, so per-call transfer cost cancels.
-    def measure(epochs):
-        conf = conf_for(epochs)
-        trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
-        t0 = time.time()
-        return t0, trainer.train_nn(conf, x, y, w, seed=1)
-
-    res, walls, wall = _delta_timed(measure, BENCH_EPOCHS_SHORT,
-                                    BENCH_EPOCHS)
+    res, walls, wall = _delta_timed_train(
+        x, y, w, BENCH_EPOCHS_SHORT, BENCH_EPOCHS,
+        hidden=(HIDDEN,), act="tanh", lr=0.05, valid_rate=VALID_RATE)
     d_epochs = BENCH_EPOCHS - BENCH_EPOCHS_SHORT
     n_train = int(N_ROWS * (1 - VALID_RATE))
     row_epochs_per_sec = n_train * d_epochs / wall
@@ -323,10 +350,8 @@ def task_nn_wide():
     import jax
     import jax.numpy as jnp
 
-    from shifu_tpu.config.model_config import ModelTrainConf
     from shifu_tpu.models import nn as nn_mod
     from shifu_tpu.ops.metrics import auc
-    from shifu_tpu.train import trainer
 
     kb, kx, kn = jax.random.split(jax.random.PRNGKey(0), 3)
     beta = jax.random.normal(kb, (WIDE_FEATURES,), jnp.float32)
@@ -336,27 +361,9 @@ def task_nn_wide():
     y = (logits > 0).astype(jnp.float32)
     w = jnp.ones(WIDE_ROWS, jnp.float32)
 
-    def conf_for(epochs):
-        conf = ModelTrainConf()
-        conf.params = {"NumHiddenLayers": len(WIDE_HIDDEN),
-                       "NumHiddenNodes": list(WIDE_HIDDEN),
-                       "ActivationFunc": ["relu"] * len(WIDE_HIDDEN),
-                       "Propagation": "ADAM", "LearningRate": 0.02}
-        conf.numTrainEpochs = epochs
-        conf.baggingNum = 1
-        conf.validSetRate = 0.05
-        conf.earlyStoppingRounds = 0
-        conf.convergenceThreshold = 0.0
-        return conf
-
-    def measure(epochs):
-        conf = conf_for(epochs)
-        trainer.train_nn(conf, x, y, w, seed=1)   # compile this length
-        t0 = time.time()
-        return t0, trainer.train_nn(conf, x, y, w, seed=1)
-
-    res, walls, d_wall = _delta_timed(measure, WIDE_EPOCHS_SHORT,
-                                      WIDE_EPOCHS_LONG)
+    res, walls, d_wall = _delta_timed_train(
+        x, y, w, WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG,
+        hidden=WIDE_HIDDEN, act="relu", lr=0.02, valid_rate=0.05)
     d_epochs = WIDE_EPOCHS_LONG - WIDE_EPOCHS_SHORT
     n_train = int(WIDE_ROWS * 0.95)
     row_epochs_per_sec = n_train * d_epochs / d_wall
@@ -611,7 +618,6 @@ def task_streaming():
     record carries the stream rate alongside throughput."""
     import numpy as np
 
-    from shifu_tpu.config.model_config import ModelTrainConf
     from shifu_tpu.train.streaming import train_nn_streaming
 
     dense, tags, weights = _ensure_stream_layout(STREAM_ROWS,
@@ -622,21 +628,10 @@ def task_streaming():
                 np.asarray(tags[a:b], np.float32),
                 np.asarray(weights[a:b], np.float32))
 
-    def conf_for(epochs):
-        conf = ModelTrainConf()
-        conf.params = {"NumHiddenLayers": len(STREAM_HIDDEN),
-                       "NumHiddenNodes": list(STREAM_HIDDEN),
-                       "ActivationFunc": ["relu"] * len(STREAM_HIDDEN),
-                       "Propagation": "ADAM", "LearningRate": 0.02}
-        conf.numTrainEpochs = epochs
-        conf.baggingNum = 1
-        conf.validSetRate = STREAM_VALID_RATE
-        conf.earlyStoppingRounds = 0
-        conf.convergenceThreshold = 0.0
-        return conf
-
     def run(epochs, n_rows=STREAM_ROWS):
-        return train_nn_streaming(conf_for(epochs), get_chunk,
+        conf = _mlp_train_conf(epochs, STREAM_HIDDEN, "relu", 0.02,
+                               STREAM_VALID_RATE)
+        return train_nn_streaming(conf, get_chunk,
                                   n_rows, STREAM_FEATURES, seed=1,
                                   chunk_rows=STREAM_CHUNK_ROWS)
 
@@ -677,6 +672,86 @@ def task_streaming():
                 "local NVMe. The record evidences >HBM capability "
                 "(bounded device+host memory, model learns), not "
                 "steady-state rate.",
+    }))
+
+
+def task_varsel():
+    """LR + SE-sensitivity varselect at HIGGS scale (BASELINE.md
+    ladder step 2): the REAL trainer (0-hidden MLP = LR,
+    processor/train.py's LR route) + the REAL ablation kernel
+    (processor/varselect._sensitivity_kernel — the VarSelectMapper
+    MSE delta, reference `varselect/VarSelectMapper.java:54`).
+
+    Columns get distinct planted magnitudes (beta_c ∝ c+1) so the
+    ranking is checkable: the recovered deltas must correlate with
+    beta² (gate below). Data generated ON DEVICE (1.23 GB would
+    otherwise cross the tunnel)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.ops.metrics import auc
+    from shifu_tpu.processor.varselect import _sensitivity_kernel
+
+    kx, kn = jax.random.split(jax.random.PRNGKey(3), 2)
+    beta = (jnp.arange(VARSEL_COLS, dtype=jnp.float32) + 1.0) \
+        / VARSEL_COLS
+    x = jax.random.normal(kx, (VARSEL_ROWS, VARSEL_COLS), jnp.float32)
+    logits = x @ beta + jax.random.normal(kn, (VARSEL_ROWS,))
+    y = (logits > 0).astype(jnp.float32)
+    w = jnp.ones(VARSEL_ROWS, jnp.float32)
+
+    res, walls, lr_wall = _delta_timed_train(
+        x, y, w, VARSEL_EPOCHS_SHORT, VARSEL_EPOCHS_LONG,
+        hidden=(), act="relu", lr=0.05, valid_rate=VALID_RATE)
+    d_epochs = VARSEL_EPOCHS_LONG - VARSEL_EPOCHS_SHORT
+    n_train = int(VARSEL_ROWS * (1 - VALID_RATE))
+    params = jax.tree.map(jnp.asarray, res.params_per_bag[0])
+
+    a = float(auc(nn_mod.forward(res.spec, params, x[:200_000]),
+                  y[:200_000]))
+    if a <= 0.75:
+        raise ValueError(f"LR failed to learn (AUC {a})")
+
+    def sensitivity():
+        # accumulate ON DEVICE: a per-block host fetch would charge
+        # one tunnel round-trip of idle device time per block to the
+        # timed wall; the single trailing np.asarray is the sync
+        total = jnp.zeros(VARSEL_COLS, jnp.float32)
+        for s in range(0, VARSEL_ROWS, VARSEL_BLOCK):
+            e = min(s + VARSEL_BLOCK, VARSEL_ROWS)
+            xb = x[s:e]
+            base = nn_mod.forward(res.spec, params, xb)
+            total = total + _sensitivity_kernel(
+                res.spec, params, xb, base, n_real=VARSEL_ROWS)
+        return np.asarray(total)
+
+    sensitivity()                                  # compile both shapes
+    t0 = time.time()
+    deltas = sensitivity()                         # np.asarray = sync
+    sens_wall = time.time() - t0
+
+    # planted-importance recovery: LR sensitivity of column c is
+    # ~ w_c^2 E[x_c^2] and the trained w tracks beta, so the delta
+    # ranking must correlate strongly with beta (both ascending here)
+    order = np.argsort(deltas)
+    rank_of = np.empty(VARSEL_COLS, np.int64)
+    rank_of[order] = np.arange(VARSEL_COLS)
+    expect = np.arange(VARSEL_COLS)
+    rho = float(np.corrcoef(rank_of, expect)[0, 1])
+    if rho <= 0.9:
+        raise ValueError(f"sensitivity ranking failed to recover the "
+                         f"planted importances (spearman {rho})")
+
+    print(json.dumps({
+        "lr_row_epochs_per_sec": n_train * d_epochs / lr_wall,
+        "lr_auc": a,
+        "sens_wall_s": sens_wall,
+        "sens_col_rows_per_sec": VARSEL_ROWS * VARSEL_COLS / sens_wall,
+        "rank_spearman": rho,
+        "rows": VARSEL_ROWS, "cols": VARSEL_COLS,
     }))
 
 
@@ -792,6 +867,9 @@ def _workload(task):
                 "depth": GBT_DEPTH},
         "gbt_small": {"rows": GBT_SMALL_ROWS, "cols": GBT_COLS,
                       "trees": GBT_SMALL_TREES, "depth": GBT_DEPTH},
+        "varsel": {"rows": VARSEL_ROWS, "cols": VARSEL_COLS,
+                   "block": VARSEL_BLOCK,
+                   "epochs": [VARSEL_EPOCHS_SHORT, VARSEL_EPOCHS_LONG]},
         "streaming": {"rows": STREAM_ROWS, "features": STREAM_FEATURES,
                       "hidden": list(STREAM_HIDDEN),
                       "chunk": STREAM_CHUNK_ROWS,
@@ -875,6 +953,8 @@ def main():
         return task_nn_wide()
     if args.task == "wdl":
         return task_wdl()
+    if args.task == "varsel":
+        return task_varsel()
     if args.task in ("hist_pallas", "hist_xla"):
         return task_hist(args.task.split("_", 1)[1])
     if args.task == "gbt":
@@ -927,6 +1007,8 @@ def main():
             step("hist_xla", "GBDT histogram bench (xla scatter)")
             step("gbt_small", f"GBT small train bench ({GBT_SMALL_ROWS}x"
                  f"{GBT_COLS}, {GBT_SMALL_TREES} trees)", timeout=2400)
+            step("varsel", f"LR + SE varselect bench ({VARSEL_ROWS}x"
+                 f"{VARSEL_COLS})", timeout=2400)
             step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
                  f"{BENCH_EPOCHS} epochs)", timeout=2400)
             step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
@@ -1005,6 +1087,14 @@ def main():
         extra["gbt_train_wall_s"] = round(gb["wall_s"], 2)
         extra["gbt_auc"] = round(gb["auc"], 4)
 
+    def _fill_varsel(vs_):
+        extra["varsel_lr_Mrow_epochs_per_s"] = round(
+            vs_["lr_row_epochs_per_sec"] / 1e6, 3)
+        extra["varsel_lr_auc"] = round(vs_["lr_auc"], 4)
+        extra["varsel_sens_Mcol_rows_per_s"] = round(
+            vs_["sens_col_rows_per_sec"] / 1e6, 1)
+        extra["varsel_rank_spearman"] = round(vs_["rank_spearman"], 3)
+
     def _fill_streaming(st):
         extra["streaming_Mrow_epochs_per_s"] = round(
             st["row_epochs_per_sec"] / 1e6, 3)
@@ -1019,6 +1109,7 @@ def main():
         "gbdt_hist_xla_gcells_per_s", round(hx["cells_per_sec"] / 1e9, 3)))
     fill("hist_pallas", _fill_hists)
     fill("gbt_small", _fill_gbt_small)
+    fill("varsel", _fill_varsel)
     fill("gbt", _fill_gbt)
     fill("streaming", _fill_streaming)
     nn, nw = res.get("nn"), res.get("nn_wide")

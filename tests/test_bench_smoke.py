@@ -83,6 +83,21 @@ def test_task_gbt(monkeypatch, capsys):
     assert rec["auc"] > 0.6
 
 
+def test_task_varsel(monkeypatch, capsys):
+    """LR + SE-sensitivity ladder step at toy shape: the planted
+    column importances must be recovered through the real trainer +
+    ablation kernel (uneven trailing block included: 50k % 20k != 0)."""
+    monkeypatch.setattr(bench, "VARSEL_ROWS", 50_000)
+    monkeypatch.setattr(bench, "VARSEL_COLS", 8)
+    monkeypatch.setattr(bench, "VARSEL_BLOCK", 20_000)
+    monkeypatch.setattr(bench, "VARSEL_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "VARSEL_EPOCHS_LONG", 40)
+    bench.task_varsel()  # gates AUC > 0.75 and spearman > 0.9 itself
+    rec = _last_json(capsys)
+    assert rec["lr_row_epochs_per_sec"] > 0
+    assert rec["sens_col_rows_per_sec"] > 0
+
+
 def test_task_nn_wide(monkeypatch, capsys):
     monkeypatch.setattr(bench, "WIDE_ROWS", 4_000)
     monkeypatch.setattr(bench, "WIDE_FEATURES", 24)
