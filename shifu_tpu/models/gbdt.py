@@ -370,9 +370,10 @@ def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
         active, 2 * node_of_row + jnp.where(go_left, 1, 2), node_of_row)
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract"))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract",
+                                   "return_nodes"))
 def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None,
-               subtract=None):
+               subtract=None, return_nodes=False):
     """Grow one tree level-by-level (all nodes of a level at once —
     DTMaster's todoNodes batch IS the level here).
 
@@ -383,7 +384,13 @@ def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None,
     `mesh`: row-shard the histogram build over its 'data' axis
     (see _level_histograms).
     Returns flat arrays sized n_nodes: feature, bin, default_left,
-    is_leaf, leaf_value.
+    is_leaf, leaf_value. With return_nodes=True also returns the
+    (R,) landing node of every row — growth already routed each row
+    to its final node (leaves park: _route_level only advances rows
+    whose node has feature >= 0), so callers that need per-row leaf
+    values (the boosting update) can gather leaf_value[node] instead
+    of re-walking the tree from the root (predict_trees), saving
+    max_depth gathers over the (C, R) bin matrix per round.
     """
     c, r = binsT.shape
     tree = _empty_tree(cfg)
@@ -401,7 +408,10 @@ def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None,
     g_hist, h_hist = _child_level_histograms(
         cfg, binsT, node_of_row, grad, hess, cfg.max_depth, prev_g,
         prev_h, tree["is_leaf"], tree["feature"], mesh, subtract)
-    return _final_leaves(cfg, tree, g_hist, h_hist)
+    tree = _final_leaves(cfg, tree, g_hist, h_hist)
+    if return_nodes:
+        return tree, node_of_row
+    return tree
 
 
 def _use_hist_subtract() -> bool:
@@ -525,11 +535,13 @@ def gbt_gradients(y, pred_raw, weights, loss: str):
 def _gbt_round_core(cfg: TreeConfig, binsT, y, weights, pred_raw,
                     feature_mask, mesh=None, subtract=None):
     grad, hess = gbt_gradients(y, pred_raw, weights, cfg.loss)
-    tree = build_tree(cfg, binsT, grad, hess, feature_mask, mesh=mesh,
-                      subtract=subtract)
-    contrib = predict_trees(
-        jax.tree.map(lambda a: a[None], tree), binsT,
-        cfg.max_depth, cfg.n_bins)[0]
+    # growth already landed every row on its leaf: one (R,) gather of
+    # leaf_value replaces a full predict_trees re-walk (max_depth
+    # gathers over the (C, R) bin matrix) for the boosting update
+    tree, node_of_row = build_tree(cfg, binsT, grad, hess, feature_mask,
+                                   mesh=mesh, subtract=subtract,
+                                   return_nodes=True)
+    contrib = tree["leaf_value"][node_of_row]
     return tree, pred_raw + cfg.learning_rate * contrib
 
 

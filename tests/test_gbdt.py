@@ -58,6 +58,24 @@ def test_tree_predict_partitions(rng):
     assert a > 0.85
 
 
+def test_landing_nodes_match_tree_walk(rng):
+    """build_tree(return_nodes=True)'s landing nodes gather the exact
+    same per-row leaf values as the predict_trees re-walk — the
+    boosting update's one-gather shortcut must be bit-identical."""
+    bins, y = _binned(rng, n=3000, c=5)
+    cfg = TreeConfig(max_depth=4, n_bins=17)
+    binsT = jnp.asarray(bins.T)
+    tree, nodes = gbdt.build_tree(
+        cfg, binsT, jnp.asarray(-(y)), jnp.asarray(np.ones_like(y)),
+        jnp.ones(bins.shape[1], jnp.float32), return_nodes=True)
+    via_nodes = np.asarray(tree["leaf_value"][nodes])
+    via_walk = np.asarray(gbdt.predict_trees(
+        jax.tree.map(lambda a: a[None], tree), binsT, 4, 17))[0]
+    np.testing.assert_array_equal(via_nodes, via_walk)
+    # every landing node is a leaf
+    assert bool(np.asarray(tree["is_leaf"])[np.asarray(nodes)].all())
+
+
 def test_gbt_boosting_reduces_error(rng):
     bins, y = _binned(rng, n=3000)
     cfg = TreeConfig(max_depth=3, n_bins=17, learning_rate=0.3, loss="log")
