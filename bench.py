@@ -150,6 +150,7 @@ VARSEL_EPOCHS_LONG = 22
 # slice (see _ensure_stream_layout).
 STREAM_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_ROWS", 15_000_000))
 STREAM_FEATURES = int(os.environ.get("SHIFU_TPU_STREAM_FEATURES", 300))
+STREAM_GB = STREAM_ROWS * STREAM_FEATURES * 4 / 1e9   # f32 on disk
 STREAM_HIDDEN = (256,)
 STREAM_CHUNK_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_CHUNK_ROWS",
                                        262_144))
@@ -661,7 +662,7 @@ def task_streaming():
     a = float(auc(scores, jnp.asarray(probe_y)))
     if a <= 0.75:
         raise ValueError(f"streaming model failed to learn (AUC {a})")
-    gb = STREAM_ROWS * STREAM_FEATURES * 4 / 1e9
+    gb = STREAM_GB
     print(json.dumps({
         "row_epochs_per_sec": n_train * d_epochs / d_wall,
         "wall_s": d_wall, "epochs": d_epochs, "auc": a,
@@ -991,7 +992,8 @@ def main():
             # records — the utilization stories (nn_wide MFU, wdl,
             # pallas-vs-xla) have never produced a committed number,
             # so they spend the window first. Streaming stays LAST
-            # (riskiest transfer pattern: ~24 GB of chunks per epoch).
+            # (riskiest transfer pattern: the whole on-disk matrix
+            # crosses the tunnel as chunks every epoch).
             # timeouts sized for a BAD tunnel day: each heavy task
             # spends minutes in compile round-trips alone (observed
             # 2026-07-31: nn_wide and wdl both exceeded 1200s before
@@ -1015,7 +1017,8 @@ def main():
                  f"{GBT_COLS}, {GBT_TREES} trees)", timeout=3000)
             if os.environ.get("SHIFU_TPU_BENCH_STREAMING", "1") != "0":
                 step("streaming", f">HBM streaming bench ({STREAM_ROWS}"
-                     f"x{STREAM_FEATURES}, 24 GB on disk)",
+                     f"x{STREAM_FEATURES}, "
+                     f"{STREAM_GB:.0f} GB on disk)",
                      timeout=3600)
         else:
             step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "

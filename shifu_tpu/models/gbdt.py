@@ -651,7 +651,11 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
                                      k, mesh=hist_mesh,
                                      subtract=subtract)
             if start + k < n_trees:
-                float(pred[0])
+                # sync via a LOCALLY-addressable shard: pred is
+                # row-sharded, and indexing pred[0] on a multi-host
+                # mesh raises "spans non-addressable devices" on the
+                # processes that don't hold shard 0
+                np.asarray(pred.addressable_shards[0].data[:1])
             parts.append(part)
         new_stacked = parts[0] if len(parts) == 1 else jax.tree.map(
             lambda *a: jnp.concatenate(a), *parts)

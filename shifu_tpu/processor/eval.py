@@ -349,6 +349,30 @@ def _write_eval_score_chunk(f, scores: Dict[str, np.ndarray],
     csv_out.write_rows(f, columns, fmts)
 
 
+class _ScoreCsvWriter:
+    """The EvalScore.csv protocol, in ONE place for every producer
+    (run_one resident, _run_one_streaming, run_score chunked+resident):
+    model columns are discovered from the first non-empty chunk, the
+    header is written exactly once, then each chunk appends vectorized
+    rows with the same column ordering."""
+
+    def __init__(self, f):
+        self.f = f
+        self.model_cols: List[str] = []
+        self.chunks = 0
+
+    def write(self, scores: Dict[str, np.ndarray], tags: np.ndarray,
+              weights: np.ndarray) -> None:
+        if self.chunks == 0:
+            self.model_cols = sorted(k for k in scores
+                                     if k.startswith("model"))
+            self.f.write("tag,weight," + ",".join(self.model_cols)
+                         + ",mean,max,min,median\n")
+        _write_eval_score_chunk(self.f, scores, tags, weights,
+                                self.model_cols)
+        self.chunks += 1
+
+
 def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     t0 = time.time()
     mc = ctx.model_config
@@ -373,10 +397,8 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     os.makedirs(base, exist_ok=True)
 
     # EvalScore.csv: tag | weight | per-model scores | ensemble
-    model_cols = sorted(k for k in scores if k.startswith("model"))
     with open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w") as f:
-        f.write("tag,weight," + ",".join(model_cols) + ",mean,max,min,median\n")
-        _write_eval_score_chunk(f, scores, tags, weights, model_cols)
+        _ScoreCsvWriter(f).write(scores, tags, weights)
 
     perf = performance_result(final, tags, weights,
                               n_buckets=ec.performanceBucketNum)
@@ -481,10 +503,10 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     status = {"records": 0, "posCount": 0, "negCount": 0,
               "weightedPos": 0.0, "weightedNeg": 0.0,
               "maxScore": -np.inf, "minScore": np.inf}
-    model_cols: List[str] = []
     n_chunks = 0
     done = False
     score_f = open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w")
+    score_w = _ScoreCsvWriter(score_f)
     dump_f = open(dump_path, "wb")
     champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}
     try:
@@ -495,13 +517,7 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
             scores = _score_dataset(mc, scorer, dset, norm_cols)
             final = scores["final"]
             tags, weights = dset.tags, dset.weights
-            if n_chunks == 0:
-                model_cols = sorted(k for k in scores
-                                    if k.startswith("model"))
-                score_f.write("tag,weight," + ",".join(model_cols)
-                              + ",mean,max,min,median\n")
-            _write_eval_score_chunk(score_f, scores, tags, weights,
-                                    model_cols)
+            score_w.write(scores, tags, weights)
             np.stack([final.astype(np.float32),
                       tags.astype(np.float32),
                       weights.astype(np.float32)], axis=1).tofile(dump_f)
@@ -781,33 +797,22 @@ def run_score(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
             continue
         n = 0
         with open(out_path, "w") as f:
+            w = _ScoreCsvWriter(f)
             if chunk_rows and not mc.is_multi_classification:
                 from shifu_tpu.data.reader import iter_raw_table
                 ds = effective_dataset_conf(mc, ec)
-                model_cols: List[str] = []
                 for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows):
                     dset, cols = _build_eval_dataset(ctx, ec, df=df,
                                                      want_meta=False)
                     if not len(dset.tags):
                         continue
                     scores = _score_dataset(mc, scorer, dset, cols)
-                    if n == 0:
-                        model_cols = sorted(k for k in scores
-                                            if k.startswith("model"))
-                        f.write("tag,weight," + ",".join(model_cols)
-                                + ",mean,max,min,median\n")
-                    _write_eval_score_chunk(f, scores, dset.tags,
-                                            dset.weights, model_cols)
+                    w.write(scores, dset.tags, dset.weights)
                     n += len(dset.tags)
             else:
                 dset, cols = _build_eval_dataset(ctx, ec, want_meta=False)
                 scores = _score_dataset(mc, scorer, dset, cols)
-                model_cols = sorted(k for k in scores
-                                    if k.startswith("model"))
-                f.write("tag,weight," + ",".join(model_cols)
-                        + ",mean,max,min,median\n")
-                _write_eval_score_chunk(f, scores, dset.tags,
-                                        dset.weights, model_cols)
+                w.write(scores, dset.tags, dset.weights)
                 n = len(dset.tags)
         if n == 0:
             raise ValueError(f"eval set {ec.name}: no scorable rows")

@@ -359,8 +359,7 @@ def train_streaming_core(train_conf: ModelTrainConf,
     # NUMBER, so a restored run replays the exact schedule
     if checkpoint_dir and checkpoint_interval > 0:
         from shifu_tpu.train import checkpoint as ckpt_mod
-        local_step = ckpt_mod.latest_step(checkpoint_dir)
-        step = local_step
+        step = ckpt_mod.latest_step(checkpoint_dir)
         if n_proc > 1:
             # every process must agree on the resume epoch or they
             # issue different collective counts and deadlock — host 0

@@ -1,0 +1,42 @@
+"""Run one bench ladder task in a subprocess and persist its record to
+BENCH_LOCAL.jsonl exactly as the bench orchestrator would (`_persist`
+with the workload stamp) — for targeted re-measurement of a single
+task outside a full `python bench.py` run.
+
+Usage: python tools/run_and_persist.py <task> [timeout_s]
+Exits 0 only when the task produced a JSON record on a TPU backend.
+"""
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import bench  # noqa: E402
+
+
+def main():
+    task = sys.argv[1]
+    timeout = int(sys.argv[2]) if len(sys.argv) > 2 else 1500
+    out, err = bench._run_task(task, timeout=timeout)
+    if not out:
+        print(f"[run_and_persist] {task} failed: {(err or '?')[-800:]}",
+              file=sys.stderr)
+        return 1
+    backend = out.get("backend") or "tpu"
+    if "backend" not in out:
+        # ladder tasks don't self-report a backend; trust only a live
+        # TPU probe so a CPU fallback can't masquerade as TPU evidence
+        probe, _ = bench._run_task("probe", timeout=300)
+        backend = (probe or {}).get("backend", "unknown")
+    if backend != "tpu":
+        print(f"[run_and_persist] backend was {backend}, not persisting"
+              " as TPU evidence", file=sys.stderr)
+        return 1
+    bench._persist(task, backend,
+                   {**out, "workload": bench._workload(task)})
+    print(json.dumps({"persisted": task, **out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
