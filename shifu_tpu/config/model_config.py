@@ -477,6 +477,13 @@ class ModelTrainConf:
     convergenceThreshold: float = 0.0
     gridConfigFile: str = ""
     earlyStoppingRounds: int = -1  # window early-stop (WindowEarlyStop.java)
+    # bagging-sampling refinements (ModelTrainConf.java:128,444;
+    # applied in train.bagging_weights). fixInitialInput
+    # (ModelConfig.java:670) is accepted but always-on here: bags
+    # derive from a fixed seed, so resumes replay identical samples.
+    stratifiedSample: bool = False
+    sampleNegOnly: bool = False
+    fixInitialInput: bool = False
     _extras: Dict[str, Any] = field(default_factory=dict, repr=False)
 
     KNOWN = ["baggingNum", "baggingWithReplacement", "baggingSampleRate",
@@ -484,7 +491,8 @@ class ModelTrainConf:
              "trainOnDisk", "isContinuous", "workerThreadCount", "algorithm",
              "params", "customPaths", "multiClassifyMethod", "isCrossOver",
              "numKFold", "upSampleWeight", "convergenceThreshold",
-             "gridConfigFile", "earlyStoppingRounds"]
+             "gridConfigFile", "earlyStoppingRounds", "stratifiedSample",
+             "sampleNegOnly", "fixInitialInput"]
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ModelTrainConf":
@@ -510,6 +518,9 @@ class ModelTrainConf:
             convergenceThreshold=float(d.get("convergenceThreshold", 0.0)),
             gridConfigFile=d.get("gridConfigFile", "") or "",
             earlyStoppingRounds=int(d.get("earlyStoppingRounds", -1)),
+            stratifiedSample=bool(d.get("stratifiedSample", False)),
+            sampleNegOnly=bool(d.get("sampleNegOnly", False)),
+            fixInitialInput=bool(d.get("fixInitialInput", False)),
         )
         _extras_roundtrip(o, d, cls.KNOWN)
         return o
@@ -533,6 +544,9 @@ class ModelTrainConf:
                 "convergenceThreshold": self.convergenceThreshold,
                 "gridConfigFile": self.gridConfigFile,
                 "earlyStoppingRounds": self.earlyStoppingRounds,
+                "stratifiedSample": self.stratifiedSample,
+                "sampleNegOnly": self.sampleNegOnly,
+                "fixInitialInput": self.fixInitialInput,
                 **self._extras}
 
     def get_param(self, key: str, default=None):

@@ -44,23 +44,65 @@ def selected_candidates(ccs: List[ColumnConfig]) -> List[ColumnConfig]:
     return [c for c in ccs if c.is_candidate]
 
 
+def norm_sample_flags(mc: ModelConfig, df, seed: int,
+                      start_row: int = 0) -> Optional[np.ndarray]:
+    """normalize.sampleRate row sampling for the norm output
+    (`udf/NormalizeUDF.java:375-385` DataSampler; sampleNegOnly keeps
+    every positive). Stateless per-absolute-raw-row flags (splitmix64,
+    like the streaming val split) so resident, streaming-pass-1 and
+    streaming-pass-2 all agree. Returns None when sampling is off;
+    multi-task models reject sampling like the reference
+    (`udf/NormalizeUDF.java:238-241`)."""
+    rate = float(mc.normalize.sampleRate)   # 0.0 is a real rate
+    if rate >= 1.0:                          # ("positives only" under
+        return None                          # sampleNegOnly)
+    if mc.is_multi_task:
+        raise ValueError("normalize.sampleRate < 1 is not supported for "
+                         "multi-task models (NormalizeUDF rejects norm "
+                         "sampling under MTL)")
+    from shifu_tpu.processor.chunking import splitmix64_uniform
+    samp = splitmix64_uniform(start_row, len(df), seed,
+                              purpose="norm-sample") < rate
+    if mc.normalize.sampleNegOnly:
+        from shifu_tpu.data.reader import simple_column_name
+        tgt_col = simple_column_name(
+            mc.dataSet.targetColumnName.split("|")[0])
+        if tgt_col in df.columns:
+            tgt = df[tgt_col].astype(str).str.strip()
+            samp |= tgt.isin(mc.pos_tags).to_numpy()
+    return samp
+
+
 def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
                              cols: List[ColumnConfig],
                              ds_conf=None,
                              apply_filter: bool = True,
                              extra_columns: Optional[List[str]] = None,
-                             df=None) -> ColumnarDataset:
+                             df=None,
+                             norm_sampling: bool = False,
+                             sample_seed: int = 12306) -> ColumnarDataset:
     """Read raw data and build columnar blocks for `cols`, with
     categorical vocabularies pinned to ColumnConfig binCategory so codes
     line up with the stats phase. `df` short-circuits the read — the
-    streaming eval path feeds pre-read chunks through the same build."""
+    streaming eval path feeds pre-read chunks through the same build.
+    `norm_sampling` applies normalize.sampleRate (norm step only — eval
+    reuses this loader and must see every row)."""
     if df is None:
         df = read_raw_table(mc, ds=ds_conf, numeric_columns=[
             c.columnName for c in ccs
             if c.is_candidate and not c.is_categorical and not c.is_segment])
     ds_conf = ds_conf or mc.dataSet
+    keep = np.ones(len(df), bool)
     if apply_filter and ds_conf.filterExpressions:
-        keep = DataPurifier(ds_conf.filterExpressions).apply(df)
+        keep &= DataPurifier(ds_conf.filterExpressions).apply(df)
+    if norm_sampling:
+        # flags key on RAW row index (before the purifier filter), the
+        # same convention as the streaming passes — both paths sample
+        # the identical rows
+        samp = norm_sample_flags(mc, df, sample_seed)
+        if samp is not None:
+            keep &= samp
+    if not keep.all():
         df = df[keep].reset_index(drop=True)
     if any(c.is_segment for c in ccs):
         # segment columns were created by stats; recreate their masked
@@ -258,7 +300,8 @@ def run(ctx: ProcessorContext,
         chunk = norm_streaming.norm_chunk_rows(ctx)
         if chunk:
             return norm_streaming.run_streaming(ctx, chunk)
-        dataset = load_dataset_for_columns(mc, ctx.column_configs, cols)
+        dataset = load_dataset_for_columns(mc, ctx.column_configs, cols,
+                                           norm_sampling=True)
     result = normalize_columns(mc, cols, dataset)
     out = ctx.path_finder.normalized_data_path()
     save_normalized(out, result, dataset.tags, dataset.weights,

@@ -122,6 +122,9 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
         keep = np.ones(len(df), bool)
         if purifier is not None:
             keep &= purifier.apply(df)
+        sf = norm_proc.norm_sample_flags(mc, df, seed, start_row=start)
+        if sf is not None:
+            keep &= sf
         keep &= valid_tag_mask(mc, df)
         vf = _val_flags(seed, start, len(df), val_rate)
         n_val += int((keep & vf).sum())
@@ -196,6 +199,9 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
         keep = np.ones(len(df), bool)
         if purifier is not None:
             keep &= purifier.apply(df)
+        sf = norm_proc.norm_sample_flags(mc, df, seed, start_row=start)
+        if sf is not None:
+            keep &= sf
         vf_all = _val_flags(seed, start, len(df), val_rate)
         df = df[keep].reset_index(drop=True)
         vf = vf_all[keep]

@@ -361,6 +361,8 @@ def _train_dense_streaming(ctx: ProcessorContext,
     res = train_nn_streaming(mc.train, get_chunk, len(tags), dense.shape[1],
                              seed=seed, spec=spec, chunk_rows=chunk_rows,
                              n_val=n_val,
+                             bag_labels=lambda a, b: np.asarray(
+                                 tags[a:b], np.float32),
                              checkpoint_dir=ck_dir,
                              checkpoint_interval=ck_int,
                              init_params=(jax.tree.map(jnp.asarray,

@@ -83,9 +83,14 @@ def run_mtl(ctx: ProcessorContext, seed: int = 12306):
 
     tr_mask, val_mask = split_validation(len(y), mc.train.validSetRate, seed)
     n_bags = max(mc.train.baggingNum, 1)
+    # stratify/neg-sample on the primary task's label (task 0 — the
+    # same label upSampleWeight keys on above)
     bag_w = bagging_weights(int(tr_mask.sum()), n_bags,
                             mc.train.baggingSampleRate,
-                            mc.train.baggingWithReplacement, seed) \
+                            mc.train.baggingWithReplacement, seed,
+                            labels=np.asarray(y[tr_mask][:, 0]),
+                            stratified=mc.train.stratifiedSample,
+                            neg_only=mc.train.sampleNegOnly) \
         * w[tr_mask][None, :]
 
     key = jax.random.PRNGKey(seed)
@@ -208,7 +213,9 @@ def _run_mtl_streaming(ctx: ProcessorContext, seed: int):
         init_fn=lambda k: mtl.init_params(spec, k),
         loss_fn=loss_fn, metric_sum_fn=metric_sum_fn, n_val=n_val,
         spec=spec, metric_mass_fn=metric_mass_fn,
-        checkpoint_dir=ck_dir, checkpoint_interval=ck_int)
+        checkpoint_dir=ck_dir, checkpoint_interval=ck_int,
+        # primary (task-0) tag keys neg-only sampling, as resident MTL
+        bag_labels=lambda a, b: np.asarray(task_tags[a:b, 0], np.float32))
     spec_meta = _mtl_spec_meta(mc, spec, names, meta)
     for i, p in enumerate(res.params_per_bag):
         out = ctx.path_finder.model_path(i, "mtl")

@@ -132,10 +132,26 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     # their own instances, TrainModelProcessor.runDistributedBagging)
     from shifu_tpu.train.trainer import bagging_weights
     # single-bag runs train on the full data — only multi-bag runs
-    # resample per bag (mirrors _run_tree_streaming's n_bags==1 skip)
-    bag_w = None if n_bags == 1 else bagging_weights(
+    # resample per bag (mirrors _run_tree_streaming's n_bags==1 skip) —
+    # UNLESS sampleNegOnly/stratifiedSample ask for an explicit
+    # single-model rebalance. RF/DT sample per TREE inside build_rf;
+    # layering bag sampling on top would double-sample, so the flags
+    # warn-and-ignore there.
+    _neg, _strat = mc.train.sampleNegOnly, mc.train.stratifiedSample
+    if (_neg or _strat) and alg is not Algorithm.GBT:
+        log.warning("sampleNegOnly/stratifiedSample shape GBT bag "
+                    "sampling; RF/DT per-tree Poisson sampling ignores "
+                    "them")
+        _neg = _strat = False
+    # rate>=1 without replacement makes flag-driven sampling a no-op —
+    # don't construct weights just to multiply by 1
+    explicit = (_neg or _strat) and (mc.train.baggingSampleRate < 1.0
+                                     or mc.train.baggingWithReplacement)
+    bag_w = None if (n_bags == 1 and not explicit) else bagging_weights(
         int(tr_mask.sum()), n_bags, mc.train.baggingSampleRate,
-        mc.train.baggingWithReplacement, seed)
+        mc.train.baggingWithReplacement, seed,
+        labels=np.asarray(y[tr_mask]),
+        stratified=_strat, neg_only=_neg)
     for bag in range(n_bags):
         if alg is Algorithm.GBT:
             init_trees = _continuous_trees(ctx, mc, bag)
@@ -171,15 +187,21 @@ class _BaggedWeights:
     """Sliceable view multiplying a weight view by counter-based
     Poisson/Bernoulli bag multiplicities (same Philox scheme as
     train/streaming._chunk_bag_weights: global row counter ⇒ identical
-    membership every pass)."""
+    membership every pass). `labels` (a row-aligned sliceable) enables
+    train.sampleNegOnly: positives keep multiplicity 1, only negatives
+    sample at the rate."""
 
-    def __init__(self, base, rate: float, with_replacement: bool, key: int):
+    def __init__(self, base, rate: float, with_replacement: bool, key: int,
+                 labels=None, neg_only: bool = False):
         self._base, self._rate = base, rate
         # rate>=1 without replacement would make every bag identical —
-        # degrade to Poisson like trainer.bagging_weights (callers only
-        # construct this view for multi-bag runs)
-        self._repl = with_replacement or rate >= 1.0
+        # degrade to Poisson like trainer.bagging_weights. NOT under
+        # neg_only: there "rate 1, no replacement" means keep every
+        # row (the resident neg_only branch's behavior), and bags
+        # differing is the config's concern, not ours
+        self._repl = with_replacement or (rate >= 1.0 and not neg_only)
         self._key = key
+        self._labels = labels if neg_only else None
 
     def __getitem__(self, sl):
         w = np.asarray(self._base[sl], np.float32)
@@ -189,6 +211,10 @@ class _BaggedWeights:
             m = gen.poisson(self._rate, len(w)).astype(np.float32)
         else:
             m = (gen.random(len(w)) < self._rate).astype(np.float32)
+        if self._labels is not None:
+            lab = np.asarray(self._labels[sl], np.float32)
+            # keep positives and NaN labels, like the resident path
+            m = np.where(np.isnan(lab) | (lab > 0.5), np.float32(1.0), m)
         return w * m
 
 
@@ -318,9 +344,19 @@ def _run_tree_streaming(ctx: ProcessorContext, seed: int):
     for bag in range(n_bags):
         if alg is Algorithm.GBT:
             init_trees = _continuous_trees(ctx, mc, bag)
-            w_bag = w if n_bags == 1 else _BaggedWeights(
+            _neg = mc.train.sampleNegOnly
+            if mc.train.stratifiedSample:
+                log.info("stratifiedSample on the streaming tree path: "
+                         "per-record rate sampling (the reference's own "
+                         "streaming semantics); exact per-class counts "
+                         "apply on the resident path only")
+            explicit = (_neg or mc.train.stratifiedSample) and (
+                mc.train.baggingSampleRate < 1.0
+                or mc.train.baggingWithReplacement)
+            w_bag = w if (n_bags == 1 and not explicit) else _BaggedWeights(
                 w, mc.train.baggingSampleRate,
-                mc.train.baggingWithReplacement, seed + 7919 * bag)
+                mc.train.baggingWithReplacement, seed + 7919 * bag,
+                labels=y, neg_only=_neg)
             trees, val_errs = gbdt.build_gbt_streaming(
                 cfg, bins_mm, y, w_bag, n_trees,
                 valid_rate=mc.train.validSetRate,

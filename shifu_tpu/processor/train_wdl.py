@@ -54,7 +54,10 @@ def run_wdl(ctx: ProcessorContext, seed: int = 12306):
     n_bags = max(mc.train.baggingNum, 1)
     bag_w = bagging_weights(int(tr_mask.sum()), n_bags,
                             mc.train.baggingSampleRate,
-                            mc.train.baggingWithReplacement, seed) \
+                            mc.train.baggingWithReplacement, seed,
+                            labels=np.asarray(y[tr_mask]),
+                            stratified=mc.train.stratifiedSample,
+                            neg_only=mc.train.sampleNegOnly) \
         * w[tr_mask][None, :]
 
     key = jax.random.PRNGKey(seed)
@@ -165,7 +168,9 @@ def _run_wdl_streaming(ctx: ProcessorContext, seed: int):
     res = train_wdl_streaming(mc.train, get_chunk, len(tags), spec,
                               seed=seed, chunk_rows=chunk_rows,
                               n_val=n_val, checkpoint_dir=ck_dir,
-                              checkpoint_interval=ck_int)
+                              checkpoint_interval=ck_int,
+                              bag_labels=lambda a, b: np.asarray(
+                                  tags[a:b], np.float32))
     spec_meta = _wdl_spec_meta(mc, spec, meta)
     for i, p in enumerate(res.params_per_bag):
         out = ctx.path_finder.model_path(i, "wdl")

@@ -58,18 +58,28 @@ log = logging.getLogger("shifu_tpu")
 
 def _chunk_bag_weights(n_bags: int, sample_rate: float,
                        with_replacement: bool, seed: int,
-                       start: int, stop: int) -> np.ndarray:
+                       start: int, stop: int,
+                       labels: Optional[np.ndarray] = None,
+                       neg_only: bool = False) -> np.ndarray:
     """(bags, stop-start) bagging multiplicities for a row range,
     counter-based on the GLOBAL row index so every epoch (and every
     resume) sees identical bag membership.
+
+    `neg_only` (train.sampleNegOnly, `wdl/WDLWorker.java:431-455`):
+    positives always multiplicity 1; only negatives sample at the
+    rate. Streaming stratifiedSample needs no special path: the
+    reference's stratification IS per-record per-class rate sampling,
+    which the per-row draws here already are (exact per-class counts
+    exist only on the resident path, `trainer.bagging_weights`).
 
     A bag that draws nothing in some chunk simply contributes a
     zero-weight chunk: loss_fn clamps its weight denominator, so the
     data gradient is exactly zero for that chunk — no per-chunk rescue
     (which would wrongly re-admit excluded rows)."""
-    if n_bags == 1 and sample_rate >= 1.0 and not with_replacement:
-        return np.ones((1, stop - start), np.float32)
     rows = stop - start
+    neg_only = neg_only and labels is not None
+    if n_bags == 1 and sample_rate >= 1.0 and not with_replacement:
+        return np.ones((1, rows), np.float32)
     out = np.empty((n_bags, rows), np.float32)
     for b in range(n_bags):
         # Philox is counter-based: jumping to `start` is O(1)-ish and
@@ -80,6 +90,12 @@ def _chunk_bag_weights(n_bags: int, sample_rate: float,
             out[b] = bit.poisson(sample_rate, rows).astype(np.float32)
         else:
             out[b] = (bit.random(rows) < sample_rate).astype(np.float32)
+        if neg_only:
+            lab = np.asarray(labels)
+            # keep positives AND NaN-labeled rows (resident
+            # bagging_weights: `lab < 0.5` is False for NaN)
+            out[b] = np.where(np.isnan(lab) | (lab > 0.5),
+                              np.float32(1.0), out[b])
     return out
 
 
@@ -95,7 +111,10 @@ def train_nn_streaming(train_conf: ModelTrainConf,
                        grad_mask=None,
                        n_val: Optional[int] = None,
                        checkpoint_dir: Optional[str] = None,
-                       checkpoint_interval: int = 0) -> TrainResult:
+                       checkpoint_interval: int = 0,
+                       bag_labels: Optional[
+                           Callable[[int, int], np.ndarray]] = None
+                       ) -> TrainResult:
     """Train `baggingNum` NN/LR models by streaming row chunks.
 
     get_chunk(start, stop) → (x, y, w) numpy slices — typically views of
@@ -134,7 +153,7 @@ def train_nn_streaming(train_conf: ModelTrainConf,
         init_params=init_params, fixed_layers=fixed_layers,
         grad_mask=grad_mask, n_val=n_val,
         spec=spec, checkpoint_dir=checkpoint_dir,
-        checkpoint_interval=checkpoint_interval)
+        checkpoint_interval=checkpoint_interval, bag_labels=bag_labels)
 
 
 def mmap_layout(path: str, *names: str):
@@ -173,7 +192,10 @@ def train_streaming_core(train_conf: ModelTrainConf,
                          spec=None,
                          metric_mass_fn=None,
                          checkpoint_dir: Optional[str] = None,
-                         checkpoint_interval: int = 0) -> TrainResult:
+                         checkpoint_interval: int = 0,
+                         bag_labels: Optional[
+                             Callable[[int, int], np.ndarray]] = None
+                         ) -> TrainResult:
     """Model-agnostic streaming trainer core (NN/LR/WDL/MTL wrappers
     feed it their loss): get_chunk(a, b) → (*inputs, w) row-aligned
     numpy blocks (any number of 1-D/2-D input arrays, weights LAST);
@@ -182,8 +204,21 @@ def train_streaming_core(train_conf: ModelTrainConf,
     errors (summed across chunks, normalized at epoch end by the sum of
     metric_mass_fn(inputs, w) — default Σw; models with per-cell
     validity masks, e.g. MTL NaN-labeled tasks, pass the matching
-    valid-mass so the streamed metric equals the resident one)."""
+    valid-mass so the streamed metric equals the resident one).
+    bag_labels(a, b) → (b-a,) labels for train.sampleNegOnly bag
+    sampling (see _chunk_bag_weights)."""
     t0 = time.time()
+    neg_only = bool(getattr(train_conf, "sampleNegOnly", False))
+    if neg_only and bag_labels is None:
+        log.warning("train.sampleNegOnly is set but this streaming route "
+                    "passes no label accessor — the flag is ignored; "
+                    "negatives sample at the plain bagging rate")
+        neg_only = False
+    if getattr(train_conf, "stratifiedSample", False):
+        log.info("train.stratifiedSample on the streaming path: per-row "
+                 "rate sampling (the reference's own per-record per-class "
+                 "semantics); exact per-class counts apply on the "
+                 "resident path only")
     if n_val is None:
         n_val = int(n_rows * max(train_conf.validSetRate, 0.0))
     # (streaming norm records the EXACT trailing-region size in
@@ -287,9 +322,11 @@ def train_streaming_core(train_conf: ModelTrainConf,
     def chunk_bags(a, b):
         """Bag weights for global chunk [a, b) — generated over the
         WHOLE chunk so membership is invariant to process count."""
+        lab = bag_labels(a, b) if neg_only else None
         return _chunk_bag_weights(n_bags, train_conf.baggingSampleRate,
                                   train_conf.baggingWithReplacement,
-                                  seed, a, b)
+                                  seed, a, b, labels=lab,
+                                  neg_only=neg_only)
 
     def _pad_rows(arr, pad):
         arr = np.ascontiguousarray(arr)
@@ -526,7 +563,10 @@ def train_wdl_streaming(train_conf: ModelTrainConf,
                         chunk_rows: int = 262_144,
                         n_val: Optional[int] = None,
                         checkpoint_dir: Optional[str] = None,
-                        checkpoint_interval: int = 0) -> TrainResult:
+                        checkpoint_interval: int = 0,
+                        bag_labels: Optional[
+                            Callable[[int, int], np.ndarray]] = None
+                        ) -> TrainResult:
     """Streaming wide-and-deep training (the Criteo-scale family IS the
     >RAM case): get_chunk(a, b) → (dense, idx, y, w). Same chunked
     double-buffered core as NN — embedding/wide tables replicate,
@@ -549,7 +589,7 @@ def train_wdl_streaming(train_conf: ModelTrainConf,
         train_conf, get_chunk, n_rows, seed=seed, chunk_rows=chunk_rows,
         init_fn=init_fn, loss_fn=loss_fn, metric_sum_fn=metric_sum_fn,
         n_val=n_val, spec=spec, checkpoint_dir=checkpoint_dir,
-        checkpoint_interval=checkpoint_interval)
+        checkpoint_interval=checkpoint_interval, bag_labels=bag_labels)
 
 
 def streaming_train_args(mc, meta):

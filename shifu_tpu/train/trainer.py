@@ -71,12 +71,67 @@ def split_validation(n: int, valid_rate: float, seed: int,
 
 
 def bagging_weights(n: int, n_bags: int, sample_rate: float,
-                    with_replacement: bool, seed: int) -> np.ndarray:
+                    with_replacement: bool, seed: int,
+                    labels: Optional[np.ndarray] = None,
+                    stratified: bool = False,
+                    neg_only: bool = False) -> np.ndarray:
     """(bags, n) per-row multiplicities: Poisson(rate) for
     with-replacement (AbstractNNWorker Poisson bagging), Bernoulli mask
     otherwise. Bag 0 of a 1-bag run sees the full data (reference runs
-    the plain training as bag 0)."""
+    the plain training as bag 0).
+
+    `neg_only` (train.sampleNegOnly, `wdl/WDLWorker.java:431-455`):
+    positive records are always kept; only negatives are sampled at
+    the bagging rate. `stratified` (train.stratifiedSample,
+    `nn/AbstractNNWorker.java:173,216-222` per-class bagging random
+    maps): each label class contributes exactly round(rate·n_class)
+    rows per bag, removing class-imbalance variance from the bags.
+    The reference's fixInitialInput (hash-range sampling so resumed
+    runs see identical bags) is always-on here: weights derive from a
+    fixed seed, so every resume replays the same bags.
+    """
     rng = np.random.default_rng(seed)
+    if neg_only and stratified and labels is not None:
+        log.warning("sampleNegOnly and stratifiedSample are both set: "
+                    "neg-only sampling wins (every positive kept, "
+                    "negatives rate-sampled); stratification is subsumed")
+    if neg_only and labels is not None:
+        lab = np.asarray(labels)
+        # NaN labels (MTL primary-task gaps) are kept, like positives
+        # (lab < 0.5 is False for NaN) — the streaming counterpart
+        # (_chunk_bag_weights) mirrors this
+        neg = lab < 0.5
+        n_neg = int(neg.sum())
+        w = np.ones((n_bags, n), np.float32)
+        if with_replacement:
+            w[:, neg] = rng.poisson(sample_rate, size=(n_bags, n_neg))
+        else:
+            w[:, neg] = rng.random((n_bags, n_neg)) < sample_rate
+        return _rescue_empty_bags(w)
+    if stratified and labels is not None and sample_rate < 1.0:
+        lab = np.asarray(labels)
+        w = np.zeros((n_bags, n), np.float32)
+        valid = ~np.isnan(lab)
+        for cls in np.unique(lab[valid]):
+            idx = np.flatnonzero(valid & (lab == cls))
+            k = max(1, int(round(sample_rate * len(idx))))
+            for b in range(n_bags):
+                if with_replacement:
+                    np.add.at(w[b], rng.choice(idx, size=k, replace=True),
+                              1.0)
+                else:
+                    w[b, rng.choice(idx, size=min(k, len(idx)),
+                                    replace=False)] = 1.0
+        nan_idx = np.flatnonzero(~valid)
+        if len(nan_idx):
+            # NaN labels (MTL primary-task gaps) have no class to
+            # stratify into — they sample at the plain rate
+            for b in range(n_bags):
+                if with_replacement:
+                    w[b, nan_idx] = rng.poisson(sample_rate, len(nan_idx))
+                else:
+                    w[b, nan_idx] = rng.random(len(nan_idx)) < sample_rate
+        return _rescue_empty_bags(w)
     if n_bags == 1 and sample_rate >= 1.0 and not with_replacement:
         return np.ones((1, n), np.float32)
     if n_bags > 1 and sample_rate >= 1.0 and not with_replacement:
@@ -90,7 +145,12 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
         w = rng.poisson(sample_rate, size=(n_bags, n)).astype(np.float32)
     else:
         w = (rng.random((n_bags, n)) < sample_rate).astype(np.float32)
-    # guard: a bag with zero total weight would divide by ~0
+    return _rescue_empty_bags(w)
+
+
+def _rescue_empty_bags(w: np.ndarray) -> np.ndarray:
+    """A bag with zero total weight would divide by ~0 — reset it to
+    the full data (every bagging branch shares this guard)."""
     empty = w.sum(axis=1) == 0
     w[empty] = 1.0
     return w
@@ -410,8 +470,21 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
         x_tr, y_tr, w_tr = x[tr_mask], y[tr_mask], w[tr_mask]
         x_v, y_v, w_v = x[val_mask], y[val_mask], w[val_mask]
 
+    neg_only = train_conf.sampleNegOnly
+    if neg_only and spec.output_dim > 1:
+        # native multi-class y holds CLASS INDICES — "negative" (< 0.5)
+        # would mean class 0 only; the reference's sampleNegOnly is a
+        # binary/one-vs-all semantics (WDLWorker.sampleNegOnly checks
+        # isRegression/isOneVsAll), so warn-and-ignore like
+        # upSampleWeight does for multi-class
+        log.warning("sampleNegOnly ignored for native multi-class "
+                    "training (binary/one-vs-all semantics only)")
+        neg_only = False
     bag_w = bagging_weights(len(y_tr), n_bags, train_conf.baggingSampleRate,
-                            train_conf.baggingWithReplacement, seed) \
+                            train_conf.baggingWithReplacement, seed,
+                            labels=np.asarray(y_tr),
+                            stratified=train_conf.stratifiedSample,
+                            neg_only=neg_only) \
         * w_tr[None, :]
 
     key = jax.random.PRNGKey(seed)

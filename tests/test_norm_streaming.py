@@ -62,6 +62,71 @@ def test_streaming_norm_matches_resident_rows(tmp_path, rng, monkeypatch):
     assert json.load(open(os.path.join(cd, "meta.json")))["streamingNorm"]
 
 
+def test_norm_sampling_resident_streaming_parity(tmp_path, rng,
+                                                 monkeypatch):
+    """normalize.sampleRate drops rows in the norm output
+    (NormalizeUDF DataSampler); sampleNegOnly keeps every positive;
+    resident and streaming paths pick the IDENTICAL rows (stateless
+    per-raw-row flags)."""
+    root = _prep(tmp_path, rng)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["normalize"]["sampleRate"] = 0.5
+    mc["normalize"]["sampleNegOnly"] = True
+    json.dump(mc, open(mcp, "w"))
+
+    monkeypatch.delenv("SHIFU_TPU_NORM_CHUNK_ROWS", raising=False)
+    ctx = ProcessorContext.load(root)
+    assert norm_proc.run(ctx) == 0
+    nd = ctx.path_finder.normalized_data_path()
+    res_dense = np.load(os.path.join(nd, "dense.npy"))
+    res_tags = np.load(os.path.join(nd, "tags.npy"))
+
+    monkeypatch.setenv("SHIFU_TPU_NORM_CHUNK_ROWS", "512")
+    ctx = ProcessorContext.load(root)
+    assert norm_proc.run(ctx) == 0
+    st_dense = np.load(os.path.join(nd, "dense.npy"))
+    st_tags = np.load(os.path.join(nd, "tags.npy"))
+
+    # sampled down, but every positive kept (sampleNegOnly)
+    full = _full_counts(root)
+    assert len(res_tags) < full["rows"]
+    assert res_tags.sum() == full["pos"]
+    # identical row multiset across paths
+    assert st_dense.shape == res_dense.shape
+    np.testing.assert_allclose(
+        res_dense[np.lexsort(res_dense.T)],
+        st_dense[np.lexsort(st_dense.T)], rtol=1e-6, atol=1e-7)
+
+
+def _full_counts(root):
+    """Raw row/positive counts of the model set's training data."""
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import read_raw_table, simple_column_name
+    mc = ModelConfig.load(root)
+    df = read_raw_table(mc)
+    tgt = df[simple_column_name(
+        mc.dataSet.targetColumnName.split("|")[0])].astype(str).str.strip()
+    pos = tgt.isin(mc.pos_tags).sum()
+    return {"rows": len(df), "pos": int(pos)}
+
+
+def test_norm_sampling_rejected_for_multitask(tmp_path, rng):
+    root = _prep(tmp_path, rng)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["normalize"]["sampleRate"] = 0.5
+    mc["basic"]["multiTask"] = True
+    tgt = mc["dataSet"]["targetColumnName"]
+    mc["dataSet"]["targetColumnName"] = f"{tgt}|{tgt}"
+    json.dump(mc, open(mcp, "w"))
+    ctx = ProcessorContext.load(root)
+    if not ctx.model_config.is_multi_task:
+        pytest.skip("synth set cannot express a multi-task config")
+    with pytest.raises(ValueError, match="multi-task"):
+        norm_proc.run(ctx)
+
+
 def test_streaming_norm_split_unbiased_on_sorted_input(tmp_path, rng,
                                                        monkeypatch):
     """Label-sorted input: the trailing val region is a uniform-random

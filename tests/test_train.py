@@ -72,6 +72,71 @@ def test_bagging_weights_single_full_bag():
     assert (w == 1.0).all()
 
 
+def test_bagging_weights_stratified_exact_class_counts():
+    """train.stratifiedSample: every bag draws exactly
+    round(rate · n_class) rows of each class
+    (AbstractNNWorker.java:173,216-222 per-class bagging maps)."""
+    labels = np.array([0] * 800 + [1] * 200, np.float32)
+    w = bagging_weights(1000, 3, 0.5, with_replacement=False, seed=3,
+                        labels=labels, stratified=True)
+    for b in range(3):
+        assert w[b, :800].sum() == 400     # negatives: 0.5 * 800
+        assert w[b, 800:].sum() == 100     # positives: 0.5 * 200
+        assert set(np.unique(w[b])) <= {0.0, 1.0}
+    assert not np.array_equal(w[0], w[1])
+    # with replacement: exact per-class totals as multiplicities
+    w = bagging_weights(1000, 2, 0.5, with_replacement=True, seed=4,
+                        labels=labels, stratified=True)
+    assert w[0, :800].sum() == 400 and w[0, 800:].sum() == 100
+
+
+def test_bagging_weights_stratified_nan_labels():
+    """NaN labels (MTL primary-task gaps) must not crash stratified
+    sampling — they sample at the plain rate."""
+    labels = np.array([0] * 400 + [1] * 400 + [np.nan] * 200, np.float32)
+    for repl in (False, True):
+        w = bagging_weights(1000, 2, 0.5, with_replacement=repl, seed=9,
+                            labels=labels, stratified=True)
+        assert w[0, :400].sum() == 200 and w[0, 400:800].sum() == 200
+        nan_frac = (w[:, 800:] > 0).mean()
+        assert 0.3 < nan_frac < 0.7
+
+
+def test_bagging_weights_neg_only_keeps_positives():
+    """train.sampleNegOnly: positives always kept, negatives sampled
+    at the bagging rate (wdl/WDLWorker.java:431-455)."""
+    labels = np.array([0] * 900 + [1] * 100, np.float32)
+    w = bagging_weights(1000, 2, 0.3, with_replacement=False, seed=5,
+                        labels=labels, neg_only=True)
+    assert (w[:, 900:] == 1.0).all()               # every positive, every bag
+    frac_neg = w[:, :900].mean()
+    assert 0.2 < frac_neg < 0.4                    # negatives ~rate
+
+
+def test_chunk_bag_weights_neg_only_matches_semantics():
+    """Streaming counter-based bag weights honor sampleNegOnly the
+    same way the resident path does: positives multiplicity 1, only
+    negatives sampled — and chunking cannot change membership."""
+    from shifu_tpu.train.streaming import _chunk_bag_weights
+    labels = (np.arange(1000) % 5 == 0).astype(np.float32)   # 20% pos
+    whole = _chunk_bag_weights(2, 0.3, False, 7, 0, 1000,
+                               labels=labels, neg_only=True)
+    assert (whole[:, labels > 0.5] == 1.0).all()
+    frac_neg = whole[:, labels < 0.5].mean()
+    assert 0.2 < frac_neg < 0.4
+    # same chunk bounds ⇒ identical membership every epoch/resume
+    # (the counter-based scheme's invariant; chunk bounds are fixed
+    # per run by chunk_rows)
+    again = _chunk_bag_weights(2, 0.3, False, 7, 0, 1000,
+                               labels=labels, neg_only=True)
+    np.testing.assert_array_equal(whole, again)
+    # neg-only mask composes on the SAME draws as plain sampling:
+    # positions where the plain mask kept a negative stay kept
+    plain = _chunk_bag_weights(2, 0.3, False, 7, 0, 1000)
+    np.testing.assert_array_equal(whole[:, labels < 0.5],
+                                  plain[:, labels < 0.5])
+
+
 def test_train_nn_learns_xor_ish(rng):
     """Separable data: the trained net must beat chance massively."""
     n = 2000
