@@ -570,15 +570,26 @@ class EvalConfig:
     scoreMetaColumnNameFile: str = ""
     customPaths: Dict[str, str] = field(default_factory=dict)
     gbtScoreConvertStrategy: str = "RAW"  # RAW | SIGMOID | CUTOFF | MAXMIN_SCALE
+    # display units for bucket thresholds in EvalPerformance / gain
+    # charts (EvalConfig.java:51 default 1000; ConfusionMatrix.java:290)
+    scoreScale: int = 1000
     _extras: Dict[str, Any] = field(default_factory=dict, repr=False)
 
+    # gbtConvertToProb stays OUT of KNOWN: it is read above but kept
+    # in _extras so legacy configs round-trip with the field intact
     KNOWN = ["name", "dataSet", "performanceBucketNum",
              "performanceScoreSelector", "scoreMetaColumnNameFile",
-             "customPaths", "gbtScoreConvertStrategy"]
+             "customPaths", "gbtScoreConvertStrategy", "scoreScale"]
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "EvalConfig":
         d = d or {}
+        strategy = d.get("gbtScoreConvertStrategy")
+        if strategy is None and d.get("gbtConvertToProb") is not None:
+            # pre-0.11 legacy bool (EvalConfig.java:64-73): true meant
+            # sigmoid conversion; only honored when the newer strategy
+            # field is absent
+            strategy = "SIGMOID" if d["gbtConvertToProb"] else "RAW"
         o = cls(
             name=d.get("name", "Eval1"),
             dataSet=ModelSourceDataConf.from_dict(d.get("dataSet")),
@@ -586,7 +597,8 @@ class EvalConfig:
             performanceScoreSelector=str(d.get("performanceScoreSelector", "mean")),
             scoreMetaColumnNameFile=d.get("scoreMetaColumnNameFile", "") or "",
             customPaths=d.get("customPaths") or {},
-            gbtScoreConvertStrategy=str(d.get("gbtScoreConvertStrategy", "RAW")),
+            gbtScoreConvertStrategy=str(strategy or "RAW"),
+            scoreScale=int(d.get("scoreScale", 1000) or 1000),
         )
         _extras_roundtrip(o, d, cls.KNOWN)
         return o
@@ -598,6 +610,7 @@ class EvalConfig:
                 "scoreMetaColumnNameFile": self.scoreMetaColumnNameFile,
                 "customPaths": self.customPaths,
                 "gbtScoreConvertStrategy": self.gbtScoreConvertStrategy,
+                "scoreScale": self.scoreScale,
                 **self._extras}
 
 

@@ -1,0 +1,43 @@
+"""DataSampler-style row sampling shared by the stats and norm steps
+(resident + streaming): stateless per-RAW-row uniforms (splitmix64,
+`processor/chunking.splitmix64_uniform`) so any chunking — and the
+resident whole-table read, which starts at row 0 — selects the
+identical row set; `sampleNegOnly` keeps every positive
+(reference: DataSampler.isNotSampled, used by the stats/norm jobs —
+`udf/NormalizeUDF.java:375-385`, `udf/CalculateStatsUDF`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["positive_tag_mask", "sample_flags"]
+
+
+def positive_tag_mask(mc, df) -> Optional[np.ndarray]:
+    """(n,) bool: rows whose primary-task tag is a posTag — the
+    keep-all-positives side of sampleNegOnly. None when the target
+    column is absent from this frame (caller then samples plainly)."""
+    from shifu_tpu.data.reader import simple_column_name
+    tgt_col = simple_column_name(mc.dataSet.targetColumnName.split("|")[0])
+    if tgt_col not in df.columns:
+        return None
+    tgt = df[tgt_col].astype(str).str.strip()
+    return tgt.isin(mc.pos_tags).to_numpy()
+
+
+def sample_flags(rate: float, seed: int, start_row: int, n: int,
+                 purpose: str,
+                 keep_pos: Optional[np.ndarray] = None) -> np.ndarray:
+    """(n,) bool sampling flags for raw rows start_row..start_row+n.
+    `purpose` salts the stream (stats vs norm sampling must be
+    independent draws). rate >= 1 keeps everything."""
+    if rate >= 1.0:
+        return np.ones(n, bool)
+    from shifu_tpu.processor.chunking import splitmix64_uniform
+    m = splitmix64_uniform(start_row, n, seed, purpose=purpose) < rate
+    if keep_pos is not None:
+        m |= keep_pos
+    return m

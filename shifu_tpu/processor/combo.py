@@ -375,7 +375,8 @@ def evaluate(ctx: ProcessorContext,
         scores = _sub_scores(ctx, combo, df)
         final = score_asm(scores)
         perf = performance_result(final, tags, weights,
-                                  n_buckets=ec.performanceBucketNum)
+                                  n_buckets=ec.performanceBucketNum,
+                                  score_scale=float(ec.scoreScale))
         out_dir = os.path.join(ctx.path_finder.root, "evals",
                                f"{ec.name}_combo")
         os.makedirs(out_dir, exist_ok=True)

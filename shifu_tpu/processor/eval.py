@@ -401,7 +401,8 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
         _ScoreCsvWriter(f).write(scores, tags, weights)
 
     perf = performance_result(final, tags, weights,
-                              n_buckets=ec.performanceBucketNum)
+                              n_buckets=ec.performanceBucketNum,
+                              score_scale=float(ec.scoreScale))
 
     # dynamic score capture — the reference harvests these from Pig/
     # Hadoop counters + a max-min side file during the scoring job
@@ -434,7 +435,8 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
             log.warning("champion column %r has no numeric scores", col)
             continue
         cperf = performance_result(vals[ok], tags[ok], weights[ok],
-                                   n_buckets=ec.performanceBucketNum)
+                                   n_buckets=ec.performanceBucketNum,
+                                   score_scale=float(ec.scoreScale))
         champions[col] = cperf
         cpath = _opath(os.path.join(base, f"EvalPerformance-{col}.json"))
         with open(cpath, "w") as f:
@@ -604,7 +606,8 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
     hist = _hist_from_dump(dump_path)
     if hist is None:
         raise ValueError(f"eval set {ec.name}: no finite model scores")
-    perf = hist.performance_result(n_buckets=ec.performanceBucketNum)
+    perf = hist.performance_result(n_buckets=ec.performanceBucketNum,
+                                   score_scale=float(ec.scoreScale))
     status["maxScore"] = float(status["maxScore"])
     status["minScore"] = float(status["minScore"])
     perf["scoreStatus"] = status
@@ -617,7 +620,8 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
         if ch is None:
             log.warning("champion column %r has no numeric scores", c)
             continue
-        cperf = ch.performance_result(n_buckets=ec.performanceBucketNum)
+        cperf = ch.performance_result(n_buckets=ec.performanceBucketNum,
+                                      score_scale=float(ec.scoreScale))
         champions[c] = cperf
         with open(_opath(os.path.join(base, f"EvalPerformance-{c}.json")),
                   "w") as f:
@@ -866,7 +870,8 @@ def run_perf(ctx: ProcessorContext,
     for ec in _eval_by_name(ctx, eval_name):
         final, tags, weights = _read_scores_csv(ctx, ec)
         perf = performance_result(final, tags, weights,
-                                  n_buckets=ec.performanceBucketNum)
+                                  n_buckets=ec.performanceBucketNum,
+                                  score_scale=float(ec.scoreScale))
         with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
                   "w") as f:
             json.dump(perf, f, indent=1)

@@ -60,17 +60,11 @@ def norm_sample_flags(mc: ModelConfig, df, seed: int,
         raise ValueError("normalize.sampleRate < 1 is not supported for "
                          "multi-task models (NormalizeUDF rejects norm "
                          "sampling under MTL)")
-    from shifu_tpu.processor.chunking import splitmix64_uniform
-    samp = splitmix64_uniform(start_row, len(df), seed,
-                              purpose="norm-sample") < rate
-    if mc.normalize.sampleNegOnly:
-        from shifu_tpu.data.reader import simple_column_name
-        tgt_col = simple_column_name(
-            mc.dataSet.targetColumnName.split("|")[0])
-        if tgt_col in df.columns:
-            tgt = df[tgt_col].astype(str).str.strip()
-            samp |= tgt.isin(mc.pos_tags).to_numpy()
-    return samp
+    from shifu_tpu.data.sampling import positive_tag_mask, sample_flags
+    keep_pos = positive_tag_mask(mc, df) if mc.normalize.sampleNegOnly \
+        else None
+    return sample_flags(rate, seed, start_row, len(df),
+                        purpose="norm-sample", keep_pos=keep_pos)
 
 
 def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],

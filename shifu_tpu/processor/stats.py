@@ -68,17 +68,16 @@ def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
             if c.is_candidate and not c.is_categorical and not c.is_segment])
         keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
         if mc.stats.sampleRate < 1.0:
-            rng = np.random.default_rng(seed)
-            samp = rng.random(len(df)) < mc.stats.sampleRate
-            if mc.stats.sampleNegOnly:
-                # sample only negatives, keep all positives (DataSampler);
-                # MTL: sample on the primary (task-0) tag
-                from shifu_tpu.data.reader import simple_column_name
-                tgt_col = simple_column_name(
-                    mc.dataSet.targetColumnName.split("|")[0])
-                tgt = df[tgt_col].astype(str).str.strip()
-                samp |= tgt.isin(mc.pos_tags).to_numpy()
-            keep &= samp
+            # stateless per-raw-row flags (data/sampling): the resident
+            # read starts at row 0, so the sampled set is IDENTICAL to
+            # the streaming stats path's for the same data
+            from shifu_tpu.data.sampling import (positive_tag_mask,
+                                                 sample_flags)
+            keep_pos = positive_tag_mask(mc, df) \
+                if mc.stats.sampleNegOnly else None
+            keep &= sample_flags(mc.stats.sampleRate, seed, 0, len(df),
+                                 purpose="stats-sample",
+                                 keep_pos=keep_pos)
         df = df[keep].reset_index(drop=True)
         dataset = build_columnar(mc, [c for c in ccs if not c.is_segment],
                                  df)

@@ -70,15 +70,11 @@ def stats_chunk_rows(ctx: ProcessorContext) -> int:
 def _sample_mask(rng_seed: int, start: int, n: int, rate: float,
                  keep_pos: Optional[np.ndarray]) -> np.ndarray:
     """Stateless per-GLOBAL-RAW-row-index sampling: identical for any
-    chunking (processor/chunking.splitmix64_uniform)."""
-    if rate >= 1.0:
-        return np.ones(n, bool)
-    from shifu_tpu.processor.chunking import splitmix64_uniform
-    m = splitmix64_uniform(start, n, rng_seed,
-                           purpose="stats-sample") < rate
-    if keep_pos is not None:
-        m |= keep_pos
-    return m
+    chunking AND for the resident stats read (data/sampling, shared
+    with processor/stats + the norm step's own salt)."""
+    from shifu_tpu.data.sampling import sample_flags
+    return sample_flags(rate, rng_seed, start, n,
+                        purpose="stats-sample", keep_pos=keep_pos)
 
 
 def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
@@ -89,9 +85,6 @@ def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
     purifier = DataPurifier(mc.dataSet.filterExpressions) \
         if mc.dataSet.filterExpressions else None
     global_row = 0
-    from shifu_tpu.data.reader import simple_column_name
-    tgt_col = simple_column_name(
-        mc.dataSet.targetColumnName.split("|")[0])
     from shifu_tpu.data.dataset import valid_tag_mask
     for df in iter_raw_table(mc, chunk_rows=chunk_rows):
         start = global_row
@@ -101,10 +94,9 @@ def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
         # filterExpressions configured
         keep = np.ones(len(df), bool)
         if mc.stats.sampleRate < 1.0:
-            keep_pos = None
-            if mc.stats.sampleNegOnly and tgt_col in df.columns:
-                tgt = df[tgt_col].astype(str).str.strip()
-                keep_pos = tgt.isin(mc.pos_tags).to_numpy()
+            from shifu_tpu.data.sampling import positive_tag_mask
+            keep_pos = positive_tag_mask(mc, df) \
+                if mc.stats.sampleNegOnly else None
             keep &= _sample_mask(seed, start, len(df),
                                  mc.stats.sampleRate, keep_pos)
         if purifier is not None:

@@ -81,6 +81,24 @@ def test_model_config_roundtrip(tmp_path):
     assert mc2.to_dict() == out
 
 
+def test_eval_score_scale_and_legacy_gbt_convert():
+    """scoreScale (EvalConfig.java:51, default 1000) parses and
+    round-trips; the pre-0.11 gbtConvertToProb bool maps to the
+    SIGMOID strategy only when the newer field is absent, and stays
+    in the JSON on round-trip."""
+    from shifu_tpu.config.model_config import EvalConfig
+    e = EvalConfig.from_dict({"name": "E", "scoreScale": 100,
+                              "gbtConvertToProb": True})
+    assert e.scoreScale == 100
+    assert e.gbtScoreConvertStrategy == "SIGMOID"
+    assert e.to_dict()["gbtConvertToProb"] is True   # legacy key kept
+    # explicit strategy wins over the legacy bool
+    e2 = EvalConfig.from_dict({"gbtConvertToProb": True,
+                               "gbtScoreConvertStrategy": "RAW"})
+    assert e2.gbtScoreConvertStrategy == "RAW"
+    assert EvalConfig.from_dict({}).scoreScale == 1000
+
+
 def test_unknown_keys_preserved():
     d = dict(REF_MODEL_CONFIG)
     d["somethingNew"] = {"x": 1}
