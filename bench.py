@@ -42,7 +42,10 @@ BASELINE_NOTE = (
     "(4-thread Encog backprop, ~2.0e6 row-epochs/s at the 32x64 "
     "flagship shape ~= 25 GFLOP/s, scaled by FLOPs/row per shape; "
     "the reference publishes no benchmark numbers — see BASELINE.md). "
-    "vs_baseline = chip row-epochs/s over that per-worker figure.")
+    "vs_baseline = chip row-epochs/s over that per-worker figure. "
+    "extra.cpu_denominator (when present) is a MEASURED same-host "
+    "JAX-CPU denominator for the same workloads, and "
+    "extra.*_vs_cpu_host_measured the chip:host ratios it implies.")
 
 
 def _flops_per_row(features, hidden_dims):
@@ -122,6 +125,14 @@ GBT_DEPTH = 6
 GBT_SMALL_ROWS = 2_000_000
 GBT_SMALL_TREES = 10
 
+# RF at-scale (VERDICT r4 next #7): the vmapped-independent-trees
+# story at HIGGS row count — all trees grow in lockstep, one histogram
+# collective per level covers the whole forest. 40 trees keeps the
+# (T, R) gradient planes + bins within one v5e's 16 GB HBM.
+RF_ROWS = int(os.environ.get("SHIFU_TPU_RF_ROWS", 11_000_000))
+RF_TREES = int(os.environ.get("SHIFU_TPU_RF_TREES", 40))
+RF_DEPTH = 6
+
 # LR + SE-sensitivity variable selection at HIGGS scale (BASELINE.md
 # measured-ladder step 2): train a logistic regression (0-hidden MLP,
 # the reference's LR trainer analog) on 11M×28, then rank every
@@ -162,6 +173,31 @@ STREAM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # v5e bf16 MXU peak; f32 runs at half rate. Used only for a utilization
 # *estimate* in extra.
 TPU_PEAK_FLOPS_BF16 = 394e12
+
+# Real product-path pipeline (VERDICT r4 next #1): the actual CLI
+# init→stats→norm→train→eval over host-generated raw text at a
+# tunnel-feasible scale (~250 MB raw), recording PER-PHASE wall-clocks
+# — the north-star "shifu train wall-clock + eval AUC" shape
+# (ShifuCLI.java:887-941 command surface). Unlike the model-layer
+# tasks, nothing bypasses the reader/processors here.
+PIPE_ROWS = int(os.environ.get("SHIFU_TPU_PIPE_ROWS", 1_000_000))
+PIPE_NUM = 28
+PIPE_CAT = 2
+PIPE_EPOCHS = int(os.environ.get("SHIFU_TPU_PIPE_EPOCHS", 30))
+PIPE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tmp", "bench_pipeline")
+
+# Measured same-host CPU denominator (VERDICT r4 next #4): the SAME
+# bench workloads on the JAX CPU backend of this host, so vs_baseline
+# carries one MEASURED denominator next to the estimated JVM figure.
+# Shapes match the TPU tasks; epoch counts are cut to CPU-feasible
+# lengths (rows/s is epoch-count-independent by construction of the
+# two-length delta).
+CPU_NN_EPOCHS = (1, 5)
+CPU_WIDE_ROWS = 100_000
+CPU_WIDE_EPOCHS = (1, 3)
+CPU_GBT_ROWS = 1_000_000
+CPU_GBT_TREES = 3
 
 
 def _log(msg):
@@ -254,7 +290,8 @@ def _delta_timed(measure, short_epochs: int, long_epochs: int):
     return res, walls, d_wall
 
 
-def _mlp_train_conf(epochs, hidden, act, lr, valid_rate):
+def _mlp_train_conf(epochs, hidden, act, lr, valid_rate,
+                    compute="float32"):
     """The MLP-bench ModelTrainConf shared by the nn/nn_wide/varsel/
     streaming tasks: fixed-length scan (no early stop) for clean
     timing, 1 bag."""
@@ -263,7 +300,8 @@ def _mlp_train_conf(epochs, hidden, act, lr, valid_rate):
     conf.params = {"NumHiddenLayers": len(hidden),
                    "NumHiddenNodes": list(hidden),
                    "ActivationFunc": [act] * len(hidden),
-                   "Propagation": "ADAM", "LearningRate": lr}
+                   "Propagation": "ADAM", "LearningRate": lr,
+                   "ComputeDtype": compute}
     conf.numTrainEpochs = epochs
     conf.baggingNum = 1
     conf.validSetRate = valid_rate
@@ -334,12 +372,15 @@ def task_nn():
     }))
 
 
-def task_nn_wide():
+def task_nn_wide(compute="float32"):
     """Utilization bench: reference-realistic width (600 features,
     512×256 hidden) through the same train_bags path. On TPU the f32
     matmuls run on the MXU at bf16 rate (DEFAULT precision truncates
     inputs, accumulates f32), so this measures how close the flagship
-    training path gets to the roofline.
+    training path gets to the roofline. compute="bfloat16" stores
+    activations/GEMM operands in bf16 with f32 master weights —
+    halving the HBM bytes streamed per epoch (the r4 record sat at
+    52% MXU / 46% HBM: memory pressure, not MXU saturation).
 
     Timing is a two-length delta: train the same shape for 2 and 102
     epochs and attribute wall(102) − wall(2) to 100 epochs of pure
@@ -364,7 +405,8 @@ def task_nn_wide():
 
     res, walls, d_wall = _delta_timed_train(
         x, y, w, WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG,
-        hidden=WIDE_HIDDEN, act="relu", lr=0.02, valid_rate=0.05)
+        hidden=WIDE_HIDDEN, act="relu", lr=0.02, valid_rate=0.05,
+        compute=compute)
     d_epochs = WIDE_EPOCHS_LONG - WIDE_EPOCHS_SHORT
     n_train = int(WIDE_ROWS * 0.95)
     row_epochs_per_sec = n_train * d_epochs / d_wall
@@ -380,10 +422,14 @@ def task_nn_wide():
     achieved = flops / d_wall
     # HBM traffic lower bound: x read once fwd + once bwd per epoch
     hbm_bytes = 2 * n_train * WIDE_FEATURES * 4 * d_epochs
+    # bf16 halves the activation/input bytes the epoch streams
+    if compute == "bfloat16":
+        hbm_bytes //= 2
     print(json.dumps({
         "row_epochs_per_sec": row_epochs_per_sec,
         "wall_s": d_wall, "wall_short_s": walls[WIDE_EPOCHS_SHORT],
         "wall_long_s": walls[WIDE_EPOCHS_LONG], "auc": a,
+        "compute": compute,
         "achieved_tflops": achieved / 1e12,
         "mxu_util": achieved / TPU_PEAK_FLOPS_BF16,
         "hbm_gbps_est": hbm_bytes / d_wall / 1e9,
@@ -808,6 +854,288 @@ def task_gbt(rows=None, trees=None):
     }))
 
 
+def task_rf():
+    """RF at HIGGS scale via the lockstep vmapped forest builder: all
+    RF_TREES trees grow level-by-level simultaneously (build_forest —
+    the vmapped analog of DTMaster RF training, dt/DTMaster.java:93).
+    Data is generated ON DEVICE like task_gbt (the tunnel cannot move
+    a GB-scale bin matrix)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import gbdt
+    from shifu_tpu.ops.metrics import auc
+
+    n_bins = 64
+    key = jax.random.PRNGKey(0)
+    kb, kbeta, kn, kw = jax.random.split(key, 4)
+    binsT = jax.random.randint(kb, (GBT_COLS, RF_ROWS), 0, n_bins - 1,
+                               dtype=jnp.int32)
+    beta = jax.random.normal(kbeta, (GBT_COLS,))
+    margin = (beta @ binsT.astype(jnp.float32)) / np.sqrt(GBT_COLS)
+    noise = jax.random.normal(kn, (RF_ROWS,)) * jnp.std(margin) * 0.5
+    y = (margin + noise > jnp.median(margin)).astype(jnp.float32)
+    w = jnp.ones(RF_ROWS, jnp.float32)
+    # per-tree Poisson bagging multiplicities, on device
+    inst_w = jax.random.poisson(kw, 1.0, (RF_TREES, RF_ROWS)) \
+        .astype(jnp.float32)
+    grad_T = -(y[None, :] * w[None, :] * inst_w)
+    hess_T = w[None, :] * inst_w
+    masks = jnp.ones((RF_TREES, GBT_COLS), jnp.float32)
+    # sync generation before the clock starts (fetch a scalar — the
+    # tunnel's block_until_ready is not a real sync)
+    float(grad_T[0, :8].sum())
+    cfg = gbdt.TreeConfig(max_depth=RF_DEPTH, n_bins=n_bins,
+                          learning_rate=1.0, loss="squared")
+    t0 = time.time()
+    built = gbdt.build_forest(cfg, binsT, grad_T, hess_T, masks,
+                              subtract=True)
+    built = jax.tree.map(np.asarray, built)   # host fetch = real sync
+    wall = time.time() - t0
+    probe = min(RF_ROWS, 500_000)
+    scores = np.asarray(gbdt.predict_trees(
+        jax.tree.map(jnp.asarray, built), binsT[:, :probe],
+        cfg.max_depth, cfg.n_bins)).mean(axis=0)   # RF = tree average
+    a = float(auc(jnp.asarray(scores), y[:probe]))
+    print(json.dumps({
+        "row_trees_per_sec": RF_ROWS * RF_TREES / wall,
+        "wall_s": wall, "auc": a, "rows": RF_ROWS, "trees": RF_TREES,
+        "depth": RF_DEPTH,
+    }))
+
+
+def _ensure_pipeline_set():
+    """Host-generate the pipeline model set once (deterministic seed;
+    ~250 MB raw pipe-delimited text + ModelConfig.json mirroring the
+    bundled tutorial layout). Re-runs reuse the data files and only
+    reset the derived state (ColumnConfig, models, eval outputs)."""
+    import shutil
+
+    import numpy as np
+    import pandas as pd
+
+    root = os.path.join(PIPE_DIR, "ModelSet")
+    data_dir = os.path.join(root, "data")
+    eval_dir = os.path.join(root, "evaldata")
+    stamp = os.path.join(root, ".stamp.json")
+    want = {"rows": PIPE_ROWS, "num": PIPE_NUM, "cat": PIPE_CAT, "gen": 3}
+    have = None
+    if os.path.exists(stamp):
+        try:
+            have = json.load(open(stamp))
+        except (OSError, json.JSONDecodeError):
+            have = None
+    if have != want:
+        shutil.rmtree(root, ignore_errors=True)
+        for d in (data_dir, eval_dir, os.path.join(root, "columns")):
+            os.makedirs(d, exist_ok=True)
+        rng = np.random.default_rng(20260731)
+        n = PIPE_ROWS + PIPE_ROWS // 10      # train + 10% eval
+        y = (rng.random(n) < 0.35).astype(np.int32)
+        cols = {}
+        for j in range(PIPE_NUM):
+            # weak per-column signal so the trained model lands at a
+            # realistic AUC (~0.9), not a degenerate 1.0
+            shift = 0.45 if j % 2 == 0 else 0.0
+            cols[f"num_{j}"] = np.round(
+                rng.normal(0, 1, n) + shift * y, 5)
+        cats = np.array(["aa", "bb", "cc", "dd"])
+        for j in range(PIPE_CAT):
+            p_pos = np.array([0.35, 0.3, 0.2, 0.15])
+            p_neg = np.array([0.2, 0.25, 0.27, 0.28])
+            cols[f"cat_{j}"] = np.where(
+                y == 1, rng.choice(cats, n, p=p_pos),
+                rng.choice(cats, n, p=p_neg))
+        cols["wgt"] = np.round(rng.uniform(0.5, 2.0, n), 4)
+        cols["rowid"] = np.arange(n)
+        cols["diagnosis"] = np.where(y == 1, "M", "B")
+        df = pd.DataFrame(cols)
+        header = "|".join(df.columns)
+        for d, sl in ((data_dir, slice(0, PIPE_ROWS)),
+                      (eval_dir, slice(PIPE_ROWS, n))):
+            with open(os.path.join(d, ".pig_header"), "w") as f:
+                f.write(header + "\n")
+            df.iloc[sl].to_csv(os.path.join(d, "part-00000"), sep="|",
+                               header=False, index=False)
+        with open(os.path.join(root, "columns", "meta.column.names"),
+                  "w") as f:
+            f.write("rowid\n")
+        with open(os.path.join(root, "columns",
+                               "categorical.column.names"), "w") as f:
+            f.write("".join(f"cat_{j}\n" for j in range(PIPE_CAT)))
+        mc = {
+            "basic": {"name": "BenchPipeline", "author": "bench",
+                      "description": "", "version": "0.1.0",
+                      "runMode": "LOCAL", "postTrainOn": False,
+                      "customPaths": {}},
+            "dataSet": {
+                "source": "LOCAL", "dataPath": data_dir,
+                "dataDelimiter": "|",
+                "headerPath": os.path.join(data_dir, ".pig_header"),
+                "headerDelimiter": "|", "filterExpressions": "",
+                "weightColumnName": "wgt",
+                "targetColumnName": "diagnosis",
+                "posTags": ["M"], "negTags": ["B"],
+                "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"],
+                "metaColumnNameFile": os.path.join(
+                    root, "columns", "meta.column.names"),
+                "categoricalColumnNameFile": os.path.join(
+                    root, "columns", "categorical.column.names")},
+            "stats": {"maxNumBin": 20, "binningMethod": "EqualPositive",
+                      "sampleRate": 1.0, "sampleNegOnly": False,
+                      "binningAlgorithm": "SPDTI", "psiColumnName": ""},
+            "varSelect": {"forceEnable": False,
+                          "forceSelectColumnNameFile": "",
+                          "forceRemoveColumnNameFile": "",
+                          "filterEnable": False, "filterNum": 200,
+                          "filterBy": "KS", "wrapperEnabled": False,
+                          "wrapperNum": 50, "wrapperRatio": 0.05,
+                          "wrapperBy": "S", "missingRateThreshold": 0.98,
+                          "filterBySE": True, "params": None},
+            "normalize": {"stdDevCutOff": 4.0, "sampleRate": 1.0,
+                          "sampleNegOnly": False, "normType": "ZSCALE"},
+            "train": {"baggingNum": 1, "baggingWithReplacement": False,
+                      "baggingSampleRate": 1.0, "validSetRate": 0.1,
+                      "numTrainEpochs": PIPE_EPOCHS,
+                      "epochsPerIteration": 1, "trainOnDisk": False,
+                      "isContinuous": False, "workerThreadCount": 4,
+                      "algorithm": "NN",
+                      "multiClassifyMethod": "NATIVE",
+                      "params": {"NumHiddenLayers": 1,
+                                 "ActivationFunc": ["tanh"],
+                                 "NumHiddenNodes": [64],
+                                 "RegularizedConstant": 0.0,
+                                 "LearningRate": 0.05,
+                                 "Propagation": "ADAM"},
+                      "customPaths": {}},
+            "evals": [{
+                "name": "Eval1",
+                "dataSet": {
+                    "source": "LOCAL", "dataPath": eval_dir,
+                    "dataDelimiter": "|",
+                    "headerPath": os.path.join(eval_dir, ".pig_header"),
+                    "headerDelimiter": "|", "filterExpressions": "",
+                    "weightColumnName": "wgt",
+                    "targetColumnName": "diagnosis",
+                    "posTags": ["M"], "negTags": ["B"],
+                    "missingOrInvalidValues": ["", "*", "#", "?",
+                                               "null", "~"]},
+                "performanceBucketNum": 10,
+                "performanceScoreSelector": "mean",
+                "scoreMetaColumnNameFile": "", "customPaths": {}}],
+        }
+        with open(os.path.join(root, "ModelConfig.json"), "w") as f:
+            json.dump(mc, f, indent=2)
+        with open(stamp, "w") as f:
+            json.dump(want, f)
+    # reset derived state so every run exercises the full pipeline
+    for p in ("ColumnConfig.json",):
+        fp = os.path.join(root, p)
+        if os.path.exists(fp):
+            os.remove(fp)
+    for d in ("models", "modelsBackup", "evals", "tmp"):
+        shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+    return root
+
+
+def task_pipeline():
+    """The REAL CLI product path at bench scale: per-phase wall-clocks
+    for init/stats/norm/train/eval through `shifu_tpu.cli.main`, the
+    exact command surface a reference user runs (`ShifuCLI.java:
+    887-941`). Raw data crosses the reader, the processors, and the
+    device — nothing is device-synthesized."""
+    import jax
+
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.processor.base import ProcessorContext
+
+    root = _ensure_pipeline_set()
+    raw_mb = sum(
+        os.path.getsize(os.path.join(d, "part-00000")) / 1e6
+        for d in (os.path.join(root, "data"), os.path.join(root, "evaldata")))
+    phases = {}
+    for cmd in ("init", "stats", "norm", "train", "eval"):
+        t0 = time.time()
+        rc = cli_main(["--dir", root, cmd])
+        phases[cmd] = round(time.time() - t0, 2)
+        _log(f"[pipeline] {cmd}: {phases[cmd]:.1f}s (rc={rc})")
+        if rc != 0:
+            raise RuntimeError(f"pipeline phase {cmd} exited {rc}")
+    ctx = ProcessorContext.load(root)
+    with open(ctx.path_finder.eval_performance_path("Eval1")) as f:
+        perf = json.load(f)
+    print(json.dumps({
+        "phases": phases, "total_s": round(sum(phases.values()), 2),
+        "auc": perf["areaUnderRoc"], "rows": PIPE_ROWS,
+        "cols": PIPE_NUM + PIPE_CAT, "raw_mb": round(raw_mb, 1),
+        "epochs": PIPE_EPOCHS, "backend": jax.default_backend(),
+    }))
+
+
+def task_cpu_denom():
+    """Measured same-host CPU denominator: nn / nn_wide / gbt bench
+    shapes on the JAX CPU backend (this host), giving vs_baseline a
+    measured denominator alongside the estimated JVM worker figure.
+    Caller forces JAX_PLATFORMS=cpu."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "cpu":
+        raise RuntimeError("cpu_denom must run on the cpu backend")
+    from shifu_tpu.models import gbdt
+
+    out = {"host": os.uname().nodename}
+
+    def mlp_shape(rows, feats, hidden, short, long_, act, lr):
+        rng = np.random.default_rng(0)
+        beta = rng.normal(0, 1, feats).astype(np.float32)
+        x = rng.normal(0, 1, (rows, feats)).astype(np.float32)
+        y = ((x @ beta) > 0).astype(np.float32)
+        w = np.ones(rows, np.float32)
+        _, _, d_wall = _delta_timed_train(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), short, long_,
+            hidden=hidden, act=act, lr=lr, valid_rate=VALID_RATE)
+        return int(rows * (1 - VALID_RATE)) * (long_ - short) / d_wall
+
+    out["nn_row_epochs_per_sec"] = mlp_shape(
+        N_ROWS, N_FEATURES, (HIDDEN,), *CPU_NN_EPOCHS, "tanh", 0.05)
+    _log(f"[cpu_denom] nn: {out['nn_row_epochs_per_sec']:.3g} rows/s")
+    out["nn_wide_row_epochs_per_sec"] = mlp_shape(
+        CPU_WIDE_ROWS, WIDE_FEATURES, WIDE_HIDDEN, *CPU_WIDE_EPOCHS,
+        "relu", 0.02)
+    _log(f"[cpu_denom] nn_wide: "
+         f"{out['nn_wide_row_epochs_per_sec']:.3g} rows/s")
+
+    n_bins = 64
+    rng = np.random.default_rng(0)
+    binsT = rng.integers(0, n_bins - 1,
+                         (GBT_COLS, CPU_GBT_ROWS)).astype(np.int32)
+    beta = rng.normal(0, 1, GBT_COLS)
+    margin = beta @ binsT.astype(np.float64) / np.sqrt(GBT_COLS)
+    y = (margin > np.median(margin)).astype(np.float32)
+    w = np.ones(CPU_GBT_ROWS, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=GBT_DEPTH, n_bins=n_bins,
+                          learning_rate=0.2, loss="log")
+    gbdt.build_gbt(cfg, jnp.asarray(binsT), jnp.asarray(y),
+                   jnp.asarray(w), n_trees=1)          # compile
+    t0 = time.time()
+    gbdt.build_gbt(cfg, jnp.asarray(binsT), jnp.asarray(y),
+                   jnp.asarray(w), n_trees=CPU_GBT_TREES)
+    wall = time.time() - t0
+    out["gbt_row_trees_per_sec"] = CPU_GBT_ROWS * CPU_GBT_TREES / wall
+    _log(f"[cpu_denom] gbt: {out['gbt_row_trees_per_sec']:.3g} "
+         "row-trees/s")
+    out["shapes"] = {
+        "nn": [N_ROWS, N_FEATURES, HIDDEN],
+        "nn_wide": [CPU_WIDE_ROWS, WIDE_FEATURES, list(WIDE_HIDDEN)],
+        "gbt": [CPU_GBT_ROWS, GBT_COLS, CPU_GBT_TREES, GBT_DEPTH]}
+    print(json.dumps(out))
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -857,6 +1185,10 @@ def _workload(task):
         "nn_wide": {"rows": WIDE_ROWS, "features": WIDE_FEATURES,
                     "hidden": list(WIDE_HIDDEN),
                     "epochs": [WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG]},
+        "nn_wide_bf16": {"rows": WIDE_ROWS, "features": WIDE_FEATURES,
+                         "hidden": list(WIDE_HIDDEN),
+                         "epochs": [WIDE_EPOCHS_SHORT, WIDE_EPOCHS_LONG],
+                         "compute": "bfloat16"},
         "wdl": {"rows": WDL_ROWS, "dense": WDL_DENSE, "cat": WDL_CAT,
                 "vocab": WDL_VOCAB, "embed": WDL_EMBED,
                 "epochs": [WDL_EPOCHS_SHORT, WDL_EPOCHS_LONG]},
@@ -875,6 +1207,15 @@ def _workload(task):
                       "hidden": list(STREAM_HIDDEN),
                       "chunk": STREAM_CHUNK_ROWS,
                       "epochs": STREAM_EPOCHS_LONG},
+        "pipeline": {"rows": PIPE_ROWS, "cols": PIPE_NUM + PIPE_CAT,
+                     "epochs": PIPE_EPOCHS},
+        "rf": {"rows": RF_ROWS, "cols": GBT_COLS, "trees": RF_TREES,
+               "depth": RF_DEPTH},
+        "cpu_denom": {"nn": [N_ROWS, N_FEATURES, HIDDEN],
+                      "nn_wide": [CPU_WIDE_ROWS, WIDE_FEATURES,
+                                  list(WIDE_HIDDEN)],
+                      "gbt": [CPU_GBT_ROWS, GBT_COLS, CPU_GBT_TREES,
+                              GBT_DEPTH]},
     }.get(task, {})
 
 
@@ -952,6 +1293,8 @@ def main():
         return task_nn()
     if args.task == "nn_wide":
         return task_nn_wide()
+    if args.task == "nn_wide_bf16":
+        return task_nn_wide("bfloat16")
     if args.task == "wdl":
         return task_wdl()
     if args.task == "varsel":
@@ -964,6 +1307,12 @@ def main():
         return task_gbt(rows=GBT_SMALL_ROWS, trees=GBT_SMALL_TREES)
     if args.task == "streaming":
         return task_streaming()
+    if args.task == "pipeline":
+        return task_pipeline()
+    if args.task == "rf":
+        return task_rf()
+    if args.task == "cpu_denom":
+        return task_cpu_denom()
 
     diags = []
     extra = {}
@@ -988,19 +1337,24 @@ def main():
 
         if backend == "tpu":
             # MISSING-evidence-first ordering: the tunnel can wedge at
-            # any point, and nn/hist_xla already have committed round-3
-            # records — the utilization stories (nn_wide MFU, wdl,
-            # pallas-vs-xla) have never produced a committed number,
-            # so they spend the window first. Streaming stays LAST
-            # (riskiest transfer pattern: the whole on-disk matrix
-            # crosses the tunnel as chunks every epoch).
+            # any point — tasks that have never produced a committed
+            # number spend the window first. Round 5: the CLI
+            # product-path pipeline has zero committed TPU evidence
+            # (every prior record drives model-layer APIs), so it
+            # leads. Streaming stays LAST (riskiest transfer pattern).
             # timeouts sized for a BAD tunnel day: each heavy task
-            # spends minutes in compile round-trips alone (observed
-            # 2026-07-31: nn_wide and wdl both exceeded 1200s before
-            # their first record); the compilation cache makes retries
-            # cheaper but a first capture still needs the headroom
+            # spends minutes in compile round-trips alone; the
+            # compilation cache makes retries cheaper but a first
+            # capture still needs the headroom
+            step("pipeline", f"CLI product-path bench ({PIPE_ROWS} rows "
+                 f"× {PIPE_NUM + PIPE_CAT} cols, init→stats→norm→"
+                 "train→eval)", timeout=3000)
+            step("rf", f"RF at-scale bench ({GBT_ROWS}x{GBT_COLS}, "
+                 "50 trees)", timeout=3000)
             step("nn_wide", f"wide-NN utilization bench ({WIDE_ROWS}x"
                  f"{WIDE_FEATURES}, {WIDE_HIDDEN})", timeout=2700)
+            step("nn_wide_bf16", "wide-NN bf16 mixed-precision bench",
+                 timeout=2700)
             step("wdl", f"WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
                  f"vocab {WDL_VOCAB})", timeout=2700)
             # Pallas interpret mode on CPU is not a perf path; only
@@ -1024,6 +1378,28 @@ def main():
             step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
                  f"{BENCH_EPOCHS} epochs)")
             step("hist_xla", "GBDT histogram bench (xla scatter)")
+
+        # measured same-host CPU denominator — runs on the CPU backend
+        # regardless of the ladder backend (no tunnel time consumed);
+        # a persisted same-workload record is reused (the host doesn't
+        # change mid-round)
+        _log("running cpu denominator bench...")
+        cached = _latest_persisted("cpu_denom")
+        if cached and cached.get("workload") == _workload("cpu_denom"):
+            res["cpu_denom"] = cached
+            diags.append(f"cpu_denom: reused persisted record "
+                         f"ts={cached.get('ts')}")
+        else:
+            out, err = _run_task("cpu_denom",
+                                 env_extra={"JAX_PLATFORMS": "cpu"},
+                                 timeout=2700)
+            if out:
+                _persist("cpu_denom", "cpu",
+                         {**out, "workload": _workload("cpu_denom")})
+                res["cpu_denom"] = out
+            else:
+                diags.append("cpu_denom failed: "
+                             + (err.splitlines()[-1] if err else "?"))
     except Exception as e:  # noqa: BLE001 — never crash the driver
         diags.append(f"{type(e).__name__}: {e}")
 
@@ -1105,6 +1481,46 @@ def main():
         extra["streaming_disk_gb"] = st["disk_gb"]
         extra["streaming_gbps"] = round(st["stream_gbps"], 2)
 
+    def _fill_pipeline(pl):
+        extra["pipeline_phase_walls_s"] = pl["phases"]
+        extra["pipeline_total_s"] = pl["total_s"]
+        extra["pipeline_auc"] = round(pl["auc"], 4)
+        extra["pipeline_shape"] = f"{pl['rows']}x{pl['cols']}"
+
+    def _fill_rf(rf_):
+        extra["rf_Mrow_trees_per_s"] = round(
+            rf_["row_trees_per_sec"] / 1e6, 3)
+        extra["rf_wall_s"] = round(rf_["wall_s"], 2)
+        extra["rf_auc"] = round(rf_["auc"], 4)
+
+    def _fill_cpu(cd):
+        # measured same-host denominators + the TPU:CPU ratios they
+        # imply — one MEASURED ratio next to the estimated JVM one
+        extra["cpu_denominator"] = {
+            k: cd[k] for k in ("nn_row_epochs_per_sec",
+                               "nn_wide_row_epochs_per_sec",
+                               "gbt_row_trees_per_sec") if k in cd}
+        pairs = (("nn", "nn_row_epochs_per_sec", "row_epochs_per_sec"),
+                 ("nn_wide", "nn_wide_row_epochs_per_sec",
+                  "row_epochs_per_sec"),
+                 ("gbt", "gbt_row_trees_per_sec", "row_trees_per_sec"))
+        for task, cpu_key, tpu_key in pairs:
+            t = res.get(task) or _latest_persisted(task,
+                                                   backend_filter="tpu")
+            if t and cd.get(cpu_key):
+                extra[f"{task}_vs_cpu_host_measured"] = round(
+                    t[tpu_key] / cd[cpu_key], 1)
+
+    def _fill_nn_wide_bf16(nb):
+        extra["nn_wide_bf16_Mrow_epochs_per_s"] = round(
+            nb["row_epochs_per_sec"] / 1e6, 3)
+        extra["nn_wide_bf16_mxu_util"] = round(nb["mxu_util"], 4)
+        extra["nn_wide_bf16_auc"] = round(nb["auc"], 4)
+
+    fill("pipeline", _fill_pipeline)
+    fill("nn_wide_bf16", _fill_nn_wide_bf16)
+    fill("rf", _fill_rf)
+    fill("cpu_denom", _fill_cpu)
     fill("nn", _fill_nn)
     fill("nn_wide", _fill_nn_wide)
     fill("wdl", _fill_wdl)

@@ -10,8 +10,8 @@ implementation; unknown keys are preserved on round-trip (the reference
 uses `@JsonIgnoreProperties(ignoreUnknown = true)`).
 
 This is plain-Python metadata — nothing here touches JAX. All device
-work is driven off these objects by the processors in
-`shifu_tpu/pipeline.py`.
+work is driven off these objects by the step processors under
+`shifu_tpu/processor/`.
 """
 
 from __future__ import annotations

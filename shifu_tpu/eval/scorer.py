@@ -12,6 +12,7 @@ per `gbtScoreConvertStrategy` (RAW/SIGMOID/MAXMIN_SCALE/CUTOFF) like
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -59,7 +60,57 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
     if kind == "mtl":
         from shifu_tpu.models import mtl
         return mtl.predict(meta, params, dense, index)
+    if kind == "tf":
+        import tensorflow as tf
+        fn = _saved_model_fn(meta["path"])
+        out = np.asarray(fn(tf.constant(np.asarray(dense, np.float32))))
+        # (N, 1) single-output heads flatten to the binary convention
+        if out.ndim == 2 and out.shape[1] == 1:
+            out = out[:, 0]
+        return out
     raise ValueError(f"unknown model kind {kind!r}")
+
+
+_TF_FN_CACHE: Dict[str, Any] = {}
+
+
+def _saved_model_fn(path: str):
+    """Lazily load a TF SavedModel's scoring function (cached per
+    path). Accepts this repo's `export -t tf` modules (a `f` tf.function
+    over the dense matrix) or any foreign SavedModel with a
+    single-input serving_default signature — the GenericModel
+    computation (`core/GenericModel.java`, `core/Scorer.java:108-242`)
+    on TPU-native terms."""
+    fn = _TF_FN_CACHE.get(path)
+    if fn is not None:
+        return fn
+    try:
+        import tensorflow as tf  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "scoring a TF SavedModel needs the optional tensorflow "
+            "package; native specs score without it") from e
+    mod = tf.saved_model.load(path)
+    if hasattr(mod, "f"):
+        fn = mod.f
+    elif getattr(mod, "signatures", None) and \
+            "serving_default" in mod.signatures:
+        sig = mod.signatures["serving_default"]
+        in_names = list(sig.structured_input_signature[1])
+        if len(in_names) != 1:
+            raise ValueError(
+                f"SavedModel {path} serving_default wants "
+                f"{in_names} — only single-input models can join the "
+                "ensemble")
+
+        def fn(x, _sig=sig, _name=in_names[0]):
+            out = _sig(**{_name: x})
+            return next(iter(out.values()))
+    else:
+        raise ValueError(f"SavedModel {path} exposes neither `f` nor a "
+                         "serving_default signature")
+    _TF_FN_CACHE[path] = fn
+    return fn
 
 
 def convert_tree_score(raw: np.ndarray, strategy: str) -> np.ndarray:
@@ -76,8 +127,28 @@ def convert_tree_score(raw: np.ndarray, strategy: str) -> np.ndarray:
     return raw
 
 
+def resolve_generic_models(path: str) -> List[str]:
+    """An eval `customPaths` modelsPath / genericModelsPath entry →
+    concrete model paths: a SavedModel dir scores as one model; a
+    directory is scanned for spec files AND SavedModel subdirectories;
+    a file is a spec. The `ModelSpecLoaderUtils.loadGenericModels`
+    analog."""
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "saved_model.pb")):
+            return [path]
+        out = list(list_models(path))
+        for name in sorted(os.listdir(path)):
+            sub = os.path.join(path, name)
+            if os.path.isdir(sub) and sub not in out and \
+                    os.path.exists(os.path.join(sub, "saved_model.pb")):
+                out.append(sub)
+        return out
+    return [path] if os.path.exists(path) else []
+
+
 class Scorer:
-    """Ensemble of the model specs under models/."""
+    """Ensemble of the model specs under models/ plus any external
+    (GenericModel-style) SavedModels."""
 
     def __init__(self, model_paths: List[str],
                  score_selector: str = "mean",
@@ -89,8 +160,9 @@ class Scorer:
             raise FileNotFoundError("no model specs to score with")
 
     @classmethod
-    def from_dir(cls, models_dir: str, **kw) -> "Scorer":
-        return cls(list_models(models_dir), **kw)
+    def from_dir(cls, models_dir: str, extra_paths: Optional[List[str]] = None,
+                 **kw) -> "Scorer":
+        return cls(list_models(models_dir) + list(extra_paths or []), **kw)
 
     def score(self, dense: np.ndarray,
               index: Optional[np.ndarray] = None,

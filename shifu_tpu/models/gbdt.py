@@ -691,18 +691,31 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
 
 def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
              weights: np.ndarray, n_trees: int, subset_strategy: str,
-             bagging_rate: float, seed: int):
+             bagging_rate: float, seed: int,
+             stratified: bool = False, neg_only: bool = False):
     """Random forest: all trees independent → ONE lockstep build
     (build_forest) with per-tree Poisson instance weights (DTWorker
     Poisson sampling) and Bernoulli feature-subset masks. The
     histograms go through the same explicit shard_map + psum collective
     as GBT — no GSPMD-partitioned scatter (silent-gather risk +
-    pathological compile time)."""
+    pathological compile time).
+
+    `stratified`/`neg_only` (train.stratifiedSample / sampleNegOnly)
+    shape the per-TREE draws — the reference DTWorker honors both for
+    RF (`dt/DTWorker.java:530,660,1390,1550`); per-class balancing
+    reuses the NN path's bagging_weights semantics."""
     from shifu_tpu.parallel import mesh as mesh_mod
     rng = np.random.default_rng(seed)
     r, c = bins.shape
-    inst_w = rng.poisson(max(bagging_rate, 1e-6),
-                         size=(n_trees, r)).astype(np.float32)
+    if stratified or neg_only:
+        from shifu_tpu.train.trainer import bagging_weights
+        inst_w = bagging_weights(r, n_trees, bagging_rate,
+                                 with_replacement=True, seed=seed,
+                                 labels=np.asarray(y, np.float32),
+                                 stratified=stratified, neg_only=neg_only)
+    else:
+        inst_w = rng.poisson(max(bagging_rate, 1e-6),
+                             size=(n_trees, r)).astype(np.float32)
     inst_w[inst_w.sum(axis=1) == 0] = 1.0
     k = feature_subset_count(subset_strategy, c)
     masks = np.zeros((n_trees, c), np.float32)

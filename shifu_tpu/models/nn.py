@@ -20,6 +20,7 @@ Activations mirror `core/dtrain/layer/activation/*`
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -118,6 +119,12 @@ class MLPSpec:
     l1: float = 0.0
     loss: str = "squared"  # squared | log | absolute (core/dtrain/loss/*)
     weight_init: str = "xavier"  # xavier | he | lecun | zero | default
+    # "bfloat16" runs the GEMMs/activations in bf16 while master
+    # weights, gradients and the optimizer stay f32 (mixed precision:
+    # halves the HBM bytes per epoch — the wide-net training path is
+    # memory-bound before it is MXU-bound). train#params ComputeDtype
+    # or SHIFU_TPU_NN_COMPUTE=bfloat16.
+    compute_dtype: str = "float32"
 
     @classmethod
     def from_train_params(cls, params: Dict[str, Any], input_dim: int,
@@ -126,6 +133,13 @@ class MLPSpec:
         nodes, acts = parse_arch_params(params)
         reg = float(get("RegularizedConstant", 0.0) or 0.0)
         l1orl2 = str(get("L1orL2", "L2") or "L2").upper()
+        cd = str(get("ComputeDtype",
+                     os.environ.get("SHIFU_TPU_NN_COMPUTE", "float32"))
+                 or "float32").lower()
+        if cd in ("bf16", "bfloat16"):
+            cd = "bfloat16"
+        else:
+            cd = "float32"
         return cls(
             input_dim=input_dim, hidden_dims=nodes,
             activations=acts, output_dim=output_dim,
@@ -134,6 +148,7 @@ class MLPSpec:
             l1=reg if l1orl2 == "L1" else 0.0,
             loss=str(get("Loss", "squared") or "squared").lower(),
             weight_init=str(get("WeightInitializer", "xavier") or "xavier").lower(),
+            compute_dtype=cd,
         )
 
     @property
@@ -233,15 +248,24 @@ def forward(spec: MLPSpec, params: Params, x: jax.Array,
     """Batched forward pass → (N,) score in (0,1) for binary output.
     Dropout (train-time only) mirrors NNMaster's per-iteration node
     sampling (`NNMaster.doCompute:323` dropout nodes)."""
-    h = x
+    # bfloat16 compute: activations and GEMM operands in bf16 (the MXU
+    # accumulates f32 internally either way), master params/grads stay
+    # f32 — autodiff through the casts yields f32 grads, so the
+    # optimizer and checkpoints are unchanged. Halves the HBM bytes
+    # the wide training shape streams per epoch.
+    bf16 = spec.compute_dtype == "bfloat16"
+    cast = (lambda a: a.astype(jnp.bfloat16)) if bf16 else (lambda a: a)
+    h = cast(x)
     for i, layer in enumerate(params[:-1]):
-        h = h @ layer["w"] + layer["b"]
+        h = h @ cast(layer["w"]) + cast(layer["b"])
         h = activation(spec.activations[i])(h)
         if dropout_key is not None and spec.dropout_rate > 0.0:
             dropout_key, sub = jax.random.split(dropout_key)
             keep = jax.random.bernoulli(sub, 1.0 - spec.dropout_rate, h.shape)
-            h = jnp.where(keep, h / (1.0 - spec.dropout_rate), 0.0)
-    out = h @ params[-1]["w"] + params[-1]["b"]
+            h = jnp.where(keep, h / (1.0 - spec.dropout_rate),
+                          jnp.zeros((), h.dtype))
+    out = (h @ cast(params[-1]["w"]) + cast(params[-1]["b"])) \
+        .astype(jnp.float32)
     if spec.output_activation == "softmax":
         # multi-class NATIVE head: one unit per flattened tag
         # (train#multiClassifyMethod NATIVE — the reference builds an

@@ -68,7 +68,19 @@ def save_model(path: str, kind: str, meta: Dict[str, Any], params: Any) -> None:
 
 
 def load_model(path: str) -> Tuple[str, Dict[str, Any], Any]:
-    """Read a model spec → (kind, meta, params pytree). numpy-only."""
+    """Read a model spec → (kind, meta, params pytree). numpy-only.
+
+    A directory containing `saved_model.pb` loads as an EXTERNAL
+    TensorFlow SavedModel (kind "tf", lazily deserialized at scoring
+    time) — the `core/GenericModel.java` analog: foreign TF models
+    (including this repo's own `export -t tf` jax2tf output) score
+    inside the ensemble next to native specs."""
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "saved_model.pb")):
+            return "tf", {"path": path}, None
+        raise ValueError(
+            f"{path} is a directory but not a TF SavedModel "
+            "(no saved_model.pb)")
     with np.load(path, allow_pickle=False) as z:
         header = json.loads(bytes(z["__header__"].tolist()).decode())
         flat = {k: z[k] for k in z.files if k != "__header__"}

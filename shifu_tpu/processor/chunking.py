@@ -124,15 +124,33 @@ def sampled_frame(mc, cap_rows: int, chunk_rows: int = 1_000_000,
     return out
 
 
+def analysis_chunk_rows(ctx) -> int:
+    """0 when the raw set fits resident; else the chunk size for the
+    EXACT streaming analysis passes (correlation / PSI / posttrain).
+    Unlike `analysis_frame` these steps do not sample: their
+    statistics (X^T X partial sums, per-cohort bin counts, bin score
+    sums) merge exactly across chunks, matching the reference's
+    full-data MR jobs (`core/correlation/CorrelationMapper.java:52`,
+    `udf/PSICalculatorUDF.java`, `core/posttrain/PostTrainMapper.java`)
+    without ever materializing the table."""
+    mc = ctx.model_config
+    return chunk_rows_for(ctx, ("shifu.analysis.chunkRows",
+                                "SHIFU_TPU_ANALYSIS_CHUNK_ROWS"),
+                          "SHIFU_TPU_ANALYSIS_STREAM_BYTES",
+                          mc.dataSet.dataPath, "analysis")
+
+
 def analysis_frame(ctx, log=None):
     """None for resident reads; a bounded uniform sample when the raw
-    set exceeds the streaming threshold (analysis steps — sensitivity
-    varselect, posttrain bin averages — are statistically stable on a
-    capped sample; reading a >RAM table resident would OOM).
+    set exceeds the streaming threshold. Since round 5 only SE/ST
+    sensitivity varselect still uses this (ablation deltas are
+    statistically stable on a capped sample and re-training the probe
+    NN per chunk would not be; correlation/PSI/posttrain moved to the
+    exact chunked accumulators — see `analysis_chunk_rows`).
     SHIFU_TPU_ANALYSIS_MAX_ROWS caps the sample (default 2M). The
     sample is cached on the ProcessorContext — the recursive varselect
-    path and posttrain must not each re-scan a multi-GB table for the
-    identical deterministic sample."""
+    path must not re-scan a multi-GB table for the identical
+    deterministic sample."""
     cached = getattr(ctx, "_analysis_frame", "unset")
     if cached != "unset":
         return cached

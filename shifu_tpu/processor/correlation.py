@@ -5,12 +5,16 @@ Replaces the reference's multithreaded correlation MapReduce job
 `CorrelationReducer`, 2k LoC): on TPU the full C×C Pearson matrix is
 one standardized X^T X matmul on the MXU — the all-pairs loop
 disappears entirely.
+
+Like the reference's mapper (which emits per-split partial sums merged
+exactly by the reducer), a >RAM dataset streams chunk-by-chunk: each
+chunk contributes its pairwise co-valid count / sum / sum-of-squares /
+cross-product matrices, which add exactly — no sampling anywhere.
 """
 
 from __future__ import annotations
 
 import logging
-import os
 import time
 
 import jax
@@ -24,9 +28,11 @@ log = logging.getLogger("shifu_tpu")
 
 
 @jax.jit
-def pearson_matrix(x: jax.Array) -> jax.Array:
-    """(R, C) with NaN missing → (C, C) Pearson correlations computed
-    over each pair's co-valid rows."""
+def pearson_moments(x: jax.Array):
+    """(R, C) with NaN missing → the four (C, C) pairwise co-valid
+    moment matrices (n, s, ss, p). Pure sums — chunks merge by
+    addition, so the streaming path is exact, like the
+    CorrelationMapper partial sums merged in CorrelationReducer."""
     valid = ~jnp.isnan(x)
     xv = jnp.where(valid, x, 0.0)
     v = valid.astype(jnp.float32)
@@ -34,27 +40,32 @@ def pearson_matrix(x: jax.Array) -> jax.Array:
     s = xv.T @ v                          # pairwise sums of x over co-valid
     ss = (xv * xv).T @ v                  # pairwise sums of x^2
     p = xv.T @ xv                         # pairwise cross products
-    n = jnp.maximum(n, 1.0)
+    return n, s, ss, p
+
+
+def pearson_from_moments(n, s, ss, p) -> np.ndarray:
+    """Finish the Pearson matrix from (summed) co-valid moments."""
+    n = np.maximum(np.asarray(n, np.float64), 1.0)
+    s = np.asarray(s, np.float64)
+    ss = np.asarray(ss, np.float64)
+    p = np.asarray(p, np.float64)
     mean_i = s / n
     mean_j = s.T / n
     cov = p / n - mean_i * mean_j
     var_i = ss / n - mean_i ** 2
     var_j = ss.T / n - mean_j ** 2
-    denom = jnp.sqrt(jnp.maximum(var_i, 1e-12) * jnp.maximum(var_j, 1e-12))
-    return jnp.clip(cov / denom, -1.0, 1.0)
+    denom = np.sqrt(np.maximum(var_i, 1e-12) * np.maximum(var_j, 1e-12))
+    return np.clip(cov / denom, -1.0, 1.0)
 
 
-def run(ctx: ProcessorContext) -> int:
-    t0 = time.time()
+def _feature_block(ctx, cols, df):
+    """(x, names): numeric raw values + categorical posRate encodings
+    (like NormPearson mode correlating normalized values) for one
+    resident frame / chunk. Categorical codes are pinned to the stats
+    vocabularies, so chunks encode identically."""
     mc = ctx.model_config
-    ctx.require_columns()
-    cols = norm_proc.selected_candidates(ctx.column_configs)
-    from shifu_tpu.processor.chunking import analysis_frame
     dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols,
-                                              df=analysis_frame(ctx, log=log))
-
-    # numeric raw values + categorical posRate encodings, like
-    # NormPearson mode correlating normalized values
+                                              df=df)
     blocks, names = [], []
     if dset.numeric.shape[1]:
         blocks.append(dset.numeric)
@@ -71,14 +82,43 @@ def run(ctx: ProcessorContext) -> int:
         blocks.append(pr)
         names.extend(dset.cat_names)
     x = np.concatenate(blocks, axis=1).astype(np.float32)
+    return x, names
+
+
+def run(ctx: ProcessorContext) -> int:
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.require_columns()
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    from shifu_tpu.processor.chunking import analysis_chunk_rows
+    chunk_rows = analysis_chunk_rows(ctx)
 
     # rows shard over the data mesh (the multithreaded CorrelationMapper
     # splits); NaN padding is excluded by the co-valid masks, so the
     # GEMMs reduce with a psum and stay exact
     from shifu_tpu.parallel import mesh as mesh_mod
     mesh = mesh_mod.default_mesh()
-    corr = np.asarray(pearson_matrix(
-        mesh_mod.shard_axis(mesh, x, 0, pad_value=np.nan)))
+
+    if chunk_rows:
+        log.info("correlation: dataset exceeds the resident threshold — "
+                 "exact streaming accumulation in %d-row chunks", chunk_rows)
+        from shifu_tpu.data.reader import iter_raw_table
+        frames = iter_raw_table(mc, chunk_rows=chunk_rows)
+    else:
+        frames = [None]      # one resident read through the same path
+
+    acc = None
+    names = None
+    for df in frames:
+        x, names = _feature_block(ctx, cols, df)
+        parts = pearson_moments(mesh_mod.shard_axis(mesh, x, 0,
+                                                    pad_value=np.nan))
+        # accumulate on host in f64: partial sums of f32 GEMMs merge
+        # without growing rounding error across many chunks
+        parts = [np.asarray(m, np.float64) for m in parts]
+        acc = parts if acc is None else [a + b for a, b in zip(acc, parts)]
+    corr = pearson_from_moments(*acc)
+
     out = ctx.path_finder.correlation_path()
     ctx.path_finder.ensure(out)
     from shifu_tpu.parallel import dist

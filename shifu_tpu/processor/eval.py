@@ -159,7 +159,22 @@ def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,
 
 
 def _make_scorer(ctx: ProcessorContext, ec: EvalConfig) -> Scorer:
+    # customPaths modelsPath / genericModelsPath pull external models
+    # (TF SavedModels or foreign spec files) into the ensemble — the
+    # GenericModel scoring half of the reference's TF bridge
+    # (EvalConfig#customPaths, core/GenericModel.java)
+    from shifu_tpu.eval.scorer import resolve_generic_models
+    extra: List[str] = []
+    for key in ("modelsPath", "genericModelsPath"):
+        p = (ec.customPaths or {}).get(key)
+        if p:
+            found = resolve_generic_models(ctx.model_config.resolve_path(p))
+            if not found:
+                log.warning("eval[%s]: customPaths.%s=%r matched no "
+                            "models", ec.name, key, p)
+            extra.extend(found)
     return Scorer.from_dir(ctx.path_finder.models_path(),
+                           extra_paths=extra,
                            score_selector=ec.performanceScoreSelector,
                            gbt_convert=ec.gbtScoreConvertStrategy)
 
@@ -380,13 +395,7 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     if chunk_rows and not mc.is_multi_classification:
         return _run_one_streaming(ctx, ec, chunk_rows, t0)
     if chunk_rows:
-        # multi-class has no chunked path (the CxC confusion matrix
-        # wants all rows); falling through to a resident read of a
-        # >threshold set can OOM — leave the operator a trace
-        log.warning("eval[%s]: multi-class eval has no streaming path — "
-                    "reading the whole set resident despite exceeding "
-                    "the streaming threshold (chunkRows=%d ignored)",
-                    ec.name, chunk_rows)
+        return _run_multiclass_streaming(ctx, ec, chunk_rows, t0)
     scores, tags, weights, dset = score_eval_set(ctx, ec)
     final = scores["final"]
 
@@ -674,6 +683,71 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
     # weighted C×C confusion matrix: rows = actual, cols = predicted
     cm = np.zeros((n_c, n_c), np.float64)
     np.add.at(cm, (true, pred), weights)
+    return _write_multiclass_outputs(ctx, ec, cm, int(len(pred)), t0)
+
+
+def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
+                              chunk_rows: int, t0: float) -> Dict:
+    """Bounded-memory multi-class eval: the weighted C×C confusion
+    matrix is a pure sum over rows, so chunks merge exactly and every
+    metric (accuracy, per-class precision/recall/F1) derives from the
+    merged matrix — the reference's sort-based streaming confusion
+    matrix (`ConfusionMatrix.java:255-284`) computes the same counts
+    for any class count. EvalScore.csv appends per chunk."""
+    from shifu_tpu.data.reader import iter_raw_table
+
+    mc = ctx.model_config
+    ds = effective_dataset_conf(mc, ec)
+    scorer = _make_scorer(ctx, ec)
+    base = ctx.path_finder.eval_base_path(ec.name)
+    os.makedirs(base, exist_ok=True)
+    classes = mc.class_tags
+    n_c = len(classes)
+    class_cols = [f"class{c}" for c in range(n_c)]
+
+    cm = np.zeros((n_c, n_c), np.float64)
+    records = 0
+    done = False
+    from shifu_tpu.eval import csv_out
+    score_f = open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w")
+    try:
+        score_f.write("tag,weight," + ",".join(class_cols)
+                      + ",predicted\n")
+        for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows):
+            dset, norm_cols = _build_eval_dataset(ctx, ec, df=df)
+            if not len(dset.tags):
+                continue
+            scores = _score_dataset(mc, scorer, dset, norm_cols)
+            pred = scores["final"].astype(np.int32)
+            true = dset.tags.astype(np.int32)
+            weights = dset.weights
+            csv_out.write_rows(
+                score_f,
+                [true, weights] + [scores[c] for c in class_cols] + [pred],
+                ["%d", "%.6g"] + ["%.6f"] * n_c + ["%d"])
+            np.add.at(cm, (true, pred), weights)
+            records += int(len(pred))
+        done = True
+    finally:
+        score_f.close()
+        if not done:
+            p = _opath(ctx.path_finder.eval_score_path(ec.name))
+            if p != os.devnull and os.path.exists(p):
+                os.remove(p)
+    log.info("eval[%s]: multi-class streamed in %d-row chunks", ec.name,
+             chunk_rows)
+    return _write_multiclass_outputs(ctx, ec, cm, records, t0)
+
+
+def _write_multiclass_outputs(ctx: ProcessorContext, ec: EvalConfig,
+                              cm: np.ndarray, records: int,
+                              t0: float) -> Dict:
+    """Confusion csv + performance json from the (summed) weighted C×C
+    matrix — shared by the resident and streaming paths so they agree
+    by construction."""
+    mc = ctx.model_config
+    classes = mc.class_tags
+    n_c = len(classes)
     with open(_opath(ctx.path_finder.eval_confusion_path(ec.name)),
               "w") as f:
         f.write("actual\\predicted," + ",".join(str(c) for c in classes) + "\n")
@@ -681,8 +755,8 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
             f.write(str(classes[a]) + ","
                     + ",".join(f"{v:.6g}" for v in cm[a]) + "\n")
 
-    total = float(weights.sum())
-    acc = float(np.sum((pred == true) * weights) / max(total, 1e-12))
+    total = float(cm.sum())
+    acc = float(np.trace(cm) / max(total, 1e-12))
     per_class = []
     for c in range(n_c):
         tp = float(cm[c, c])
@@ -694,13 +768,13 @@ def _finish_multiclass(ctx: ProcessorContext, ec: EvalConfig,
             "tag": str(classes[c]), "precision": prec, "recall": rec,
             "f1": 2 * prec * rec / max(prec + rec, 1e-12),
             "support": float(cm[c].sum())})
-    perf = {"accuracy": acc, "records": int(len(pred)),
+    perf = {"accuracy": acc, "records": records,
             "classes": [str(c) for c in classes], "perClass": per_class}
     with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
               "w") as f:
         json.dump(perf, f, indent=1)
     log.info("eval[%s]: %d rows, multi-class accuracy=%.4f in %.2fs",
-             ec.name, len(pred), acc, time.time() - t0)
+             ec.name, records, acc, time.time() - t0)
     return perf
 
 

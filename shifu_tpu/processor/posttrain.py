@@ -6,6 +6,10 @@ score the training data with the trained ensemble, average the score
 per (column, bin) → `columnBinning.binAvgScore` write-back, and rank
 features (tree models: split-gain usage counts; NN/LR: SE ablation
 deltas reused from varselect's kernel).
+
+Bin score sums/counts and squared ablation deltas are pure sums, so a
+>RAM dataset streams chunk-by-chunk and merges exactly — matching the
+reference's full-data PostTrainMapper semantics with no sampling.
 """
 
 from __future__ import annotations
@@ -32,107 +36,152 @@ def run(ctx: ProcessorContext) -> int:
     mc = ctx.model_config
     ctx.require_columns()
     cols = norm_proc.selected_candidates(ctx.column_configs)
-    from shifu_tpu.processor.chunking import analysis_frame
-    dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols,
-                                              df=analysis_frame(ctx, log=log))
-    result = norm_proc.normalize_columns(mc, cols, dset)
-
-    if dset.cat_codes.shape[1]:
-        vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
-        raw_codes = np.where(dset.cat_codes < 0, vlen[None, :],
-                             dset.cat_codes).astype(np.int32)
+    from shifu_tpu.processor.chunking import analysis_chunk_rows
+    chunk_rows = analysis_chunk_rows(ctx)
+    if chunk_rows:
+        log.info("posttrain: dataset exceeds the resident threshold — "
+                 "exact streaming accumulation in %d-row chunks",
+                 chunk_rows)
+        from shifu_tpu.data.reader import iter_raw_table
+        frames = iter_raw_table(mc, chunk_rows=chunk_rows)
     else:
-        raw_codes = dset.cat_codes
+        frames = [None]      # one resident read through the same path
+
     scorer = Scorer.from_dir(ctx.path_finder.models_path())
-    scores = scorer.score(result.dense,
-                          result.index if result.index.size else None,
-                          raw_dense=dset.numeric, raw_codes=raw_codes)
-    final = scores["final"]
-
     cc_by_num = {c.columnNum: c for c in ctx.column_configs}
-    # numeric: bin-average score via stored boundaries
-    if dset.numeric.shape[1]:
-        from shifu_tpu.ops.normalize import build_numeric_table
-        num_by = {c.columnNum: c for c in cols if c.is_numerical}
-        ordered = [num_by[int(n)] for n in dset.num_column_nums
-                   if int(n) in num_by]
-        tbl = build_numeric_table(ordered, mc.stats.maxNumBin)
-        bi = np.asarray(stats_ops.bin_index_numeric(
-            jnp.asarray(dset.numeric), jnp.asarray(tbl.cuts)))
-        for j, cn in enumerate(dset.num_column_nums):
-            cc = cc_by_num[int(cn)]
-            k = cc.columnBinning.length or 1
-            sums = np.bincount(np.minimum(bi[:, j], k), weights=final,
-                               minlength=k + 1)
-            cnts = np.bincount(np.minimum(bi[:, j], k), minlength=k + 1)
-            cc.columnBinning.binAvgScore = [
-                float(s / c) if c > 0 else 0.0 for s, c in zip(sums, cnts)]
-    if dset.cat_codes.shape[1]:
-        for j, cn in enumerate(dset.cat_column_nums):
-            cc = cc_by_num[int(cn)]
-            k = len(cc.columnBinning.binCategory or [])
-            codes = raw_codes[:, j]
-            sums = np.bincount(np.minimum(codes, k), weights=final,
-                               minlength=k + 1)
-            cnts = np.bincount(np.minimum(codes, k), minlength=k + 1)
-            cc.columnBinning.binAvgScore = [
-                float(s / c) if c > 0 else 0.0 for s, c in zip(sums, cnts)]
+    num_tbl = None
+    num_ordered = None
+    # (col_num → (score sums per bin, counts per bin)) — exact merges
+    bin_sums: Dict[int, np.ndarray] = {}
+    bin_cnts: Dict[int, np.ndarray] = {}
+    fi = _ImportanceAccumulator(scorer)
 
-    fi = _feature_importance(ctx, scorer, result, dset)
+    for df in frames:
+        dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs,
+                                                  cols, df=df)
+        result = norm_proc.normalize_columns(mc, cols, dset)
+        if dset.cat_codes.shape[1]:
+            vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+            raw_codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                                 dset.cat_codes).astype(np.int32)
+        else:
+            raw_codes = dset.cat_codes
+        scores = scorer.score(result.dense,
+                              result.index if result.index.size else None,
+                              raw_dense=dset.numeric, raw_codes=raw_codes)
+        final = scores["final"]
+
+        if dset.numeric.shape[1]:
+            if num_tbl is None:
+                from shifu_tpu.ops.normalize import build_numeric_table
+                num_by = {c.columnNum: c for c in cols if c.is_numerical}
+                num_ordered = [num_by[int(n)] for n in dset.num_column_nums
+                               if int(n) in num_by]
+                num_tbl = build_numeric_table(num_ordered, mc.stats.maxNumBin)
+            bi = np.asarray(stats_ops.bin_index_numeric(
+                jnp.asarray(dset.numeric), jnp.asarray(num_tbl.cuts)))
+            for j, cn in enumerate(dset.num_column_nums):
+                cc = cc_by_num[int(cn)]
+                k = cc.columnBinning.length or 1
+                idx = np.minimum(bi[:, j], k)
+                s = np.bincount(idx, weights=final, minlength=k + 1)
+                c = np.bincount(idx, minlength=k + 1)
+                bin_sums[int(cn)] = bin_sums.get(int(cn), 0) + s
+                bin_cnts[int(cn)] = bin_cnts.get(int(cn), 0) + c
+        if dset.cat_codes.shape[1]:
+            for j, cn in enumerate(dset.cat_column_nums):
+                cc = cc_by_num[int(cn)]
+                k = len(cc.columnBinning.binCategory or [])
+                idx = np.minimum(raw_codes[:, j], k)
+                s = np.bincount(idx, weights=final, minlength=k + 1)
+                c = np.bincount(idx, minlength=k + 1)
+                bin_sums[int(cn)] = bin_sums.get(int(cn), 0) + s
+                bin_cnts[int(cn)] = bin_cnts.get(int(cn), 0) + c
+        fi.add_chunk(result, dset)
+
+    for cn, sums in bin_sums.items():
+        cnts = bin_cnts[cn]
+        cc_by_num[cn].columnBinning.binAvgScore = [
+            float(s / c) if c > 0 else 0.0 for s, c in zip(sums, cnts)]
+
+    importance = fi.finalize()
     out = os.path.join(ctx.path_finder.root, "featureimportance.csv")
     with open(out, "w") as f:
         f.write("column,importance\n")
-        for name, v in sorted(fi.items(), key=lambda kv: -kv[1]):
+        for name, v in sorted(importance.items(), key=lambda kv: -kv[1]):
             f.write(f"{name},{v:.8g}\n")
 
     ctx.save_column_configs()
     log.info("posttrain: binAvgScore + feature importance (%d cols) in %.2fs",
-             len(fi), time.time() - t0)
+             len(importance), time.time() - t0)
     return 0
 
 
-def _feature_importance(ctx, scorer: Scorer, result, dset) -> Dict[str, float]:
+class _ImportanceAccumulator:
     """Tree models: gain-weighted split counts
-    (`CommonUtils.computeTreeModelFeatureImportance`); dense models:
-    SE ablation deltas."""
-    kind, meta, params = scorer.models[0]
-    if kind in ("gbt", "rf"):
-        names = meta["denseNames"] + meta["indexNames"]
-        feats = np.asarray(params["trees"]["feature"]).ravel()
-        counts = np.bincount(feats[feats >= 0], minlength=len(names))
-        total = max(counts.sum(), 1)
-        return {n: float(c) / total for n, c in zip(names, counts)}
-    if kind in ("nn", "lr"):
-        # dense models: reuse the varselect sensitivity kernel
-        from shifu_tpu.processor.varselect import _sensitivity_kernel
-        from shifu_tpu.models import nn as nn_mod
-        sd = dict(meta["spec"])
-        sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
-        sd["activations"] = tuple(sd.get("activations", ()))
-        spec = nn_mod.MLPSpec(**sd)
-        jparams = jax.tree.map(jnp.asarray, params)
-        jx = jnp.asarray(result.dense)
-        base = nn_mod.forward(spec, jparams, jx)
-        deltas = np.asarray(_sensitivity_kernel(spec, jparams, jx, base))
-        return {n: float(d) for n, d in zip(result.dense_names, deltas)}
-    # wdl/mtl: host-loop column ablation through the generic predictor
-    # (dense cols zeroed; index cols set to the missing slot)
-    from shifu_tpu.eval.scorer import score_matrix
-    dense = result.dense
-    index = result.index if result.index.size else None
-    base = score_matrix(kind, meta, params, dense, index)
-    out: Dict[str, float] = {}
-    for j, name in enumerate(result.dense_names):
-        wiped = dense.copy()
-        wiped[:, j] = 0.0
-        s = score_matrix(kind, meta, params, wiped, index)
-        out[name] = float(np.mean((s - base) ** 2))
-    if index is not None:
-        vocab_sizes = meta.get("indexVocabSizes") or \
-            [int(index[:, j].max()) + 1 for j in range(index.shape[1])]
-        for j, name in enumerate(result.index_names):
-            wiped = index.copy()
-            wiped[:, j] = vocab_sizes[j] - 1  # missing slot
-            s = score_matrix(kind, meta, params, dense, wiped)
-            out[name] = float(np.mean((s - base) ** 2))
-    return out
+    (`CommonUtils.computeTreeModelFeatureImportance`) — no data needed.
+    Dense models: SE ablation squared-delta sums, accumulated per chunk
+    and divided by the total row count at the end — identical to the
+    resident mean."""
+
+    def __init__(self, scorer: Scorer):
+        self.kind, self.meta, self.params = scorer.models[0]
+        self.sums: Dict[str, float] = {}
+        self.n = 0
+        self._spec = self._jparams = None
+        if self.kind in ("nn", "lr"):
+            from shifu_tpu.models import nn as nn_mod
+            sd = dict(self.meta["spec"])
+            sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
+            sd["activations"] = tuple(sd.get("activations", ()))
+            self._spec = nn_mod.MLPSpec(**sd)
+            self._jparams = jax.tree.map(jnp.asarray, self.params)
+
+    def add_chunk(self, result, dset) -> None:
+        if self.kind in ("gbt", "rf"):
+            return
+        if self.kind in ("nn", "lr"):
+            from shifu_tpu.models import nn as nn_mod
+            from shifu_tpu.processor.varselect import _sensitivity_kernel
+            jx = jnp.asarray(result.dense)
+            base = nn_mod.forward(self._spec, self._jparams, jx)
+            # n_real=1 → per-column SUMS of squared deltas, mergeable
+            deltas = np.asarray(_sensitivity_kernel(
+                self._spec, self._jparams, jx, base, n_real=1))
+            for name, d in zip(result.dense_names, deltas):
+                self.sums[name] = self.sums.get(name, 0.0) + float(d)
+            self.n += result.dense.shape[0]
+            return
+        # wdl/mtl: host-loop column ablation through the generic
+        # predictor (dense cols zeroed; index cols set to missing slot)
+        from shifu_tpu.eval.scorer import score_matrix
+        dense = result.dense
+        index = result.index if result.index.size else None
+        base = score_matrix(self.kind, self.meta, self.params, dense, index)
+        for j, name in enumerate(result.dense_names):
+            wiped = dense.copy()
+            wiped[:, j] = 0.0
+            s = score_matrix(self.kind, self.meta, self.params, wiped, index)
+            self.sums[name] = self.sums.get(name, 0.0) \
+                + float(np.sum((s - base) ** 2))
+        if index is not None:
+            vocab_sizes = self.meta.get("indexVocabSizes") or \
+                [int(index[:, j].max()) + 1 for j in range(index.shape[1])]
+            for j, name in enumerate(result.index_names):
+                wiped = index.copy()
+                wiped[:, j] = vocab_sizes[j] - 1  # missing slot
+                s = score_matrix(self.kind, self.meta, self.params,
+                                 dense, wiped)
+                self.sums[name] = self.sums.get(name, 0.0) \
+                    + float(np.sum((s - base) ** 2))
+        self.n += dense.shape[0]
+
+    def finalize(self) -> Dict[str, float]:
+        if self.kind in ("gbt", "rf"):
+            names = self.meta["denseNames"] + self.meta["indexNames"]
+            feats = np.asarray(self.params["trees"]["feature"]).ravel()
+            counts = np.bincount(feats[feats >= 0], minlength=len(names))
+            total = max(counts.sum(), 1)
+            return {n: float(c) / total for n, c in zip(names, counts)}
+        n = max(self.n, 1)
+        return {name: v / n for name, v in self.sums.items()}

@@ -6,6 +6,11 @@ month field); each column's per-cohort bin distribution is compared to
 its global distribution; psi = Σ (p_cohort − p_global)·ln(p_cohort /
 p_global) averaged over cohorts. Written back to
 `columnStats.psi` + `unitStats` (per-cohort values) and psi.csv.
+
+Per-cohort bin counts are pure sums, so a >RAM dataset streams
+chunk-by-chunk and merges exactly — the same semantics as the
+reference's full-data Pig group-by (`PSICalculatorUDF.java`), with no
+sampling.
 """
 
 from __future__ import annotations
@@ -17,7 +22,6 @@ from typing import Dict, List
 import jax.numpy as jnp
 import numpy as np
 
-from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.data.reader import read_raw_table, simple_column_name
 from shifu_tpu.ops import stats as stats_ops
 from shifu_tpu.processor import norm as norm_proc
@@ -36,69 +40,107 @@ def run(ctx: ProcessorContext) -> int:
                          "cohort column (e.g. a month field) to compute PSI")
 
     cols = norm_proc.selected_candidates(ctx.column_configs)
-    from shifu_tpu.processor.chunking import analysis_frame
-    df = analysis_frame(ctx, log=log)
-    if df is None:
-        df = read_raw_table(mc)
-    if mc.dataSet.filterExpressions:
-        from shifu_tpu.data.purifier import DataPurifier
-        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
-        df = df[keep].reset_index(drop=True)
-    if psi_col not in df.columns:
-        raise ValueError(f"psiColumnName {psi_col!r} not in data header")
-    cohorts = df[psi_col].astype(str).str.strip().to_numpy()
-    from shifu_tpu.data.dataset import build_columnar
+    from shifu_tpu.processor.chunking import analysis_chunk_rows
+    chunk_rows = analysis_chunk_rows(ctx)
+    if chunk_rows:
+        log.info("psi: dataset exceeds the resident threshold — exact "
+                 "streaming accumulation in %d-row chunks", chunk_rows)
+        from shifu_tpu.data.reader import iter_raw_table
+        frames = iter_raw_table(mc, chunk_rows=chunk_rows)
+    else:
+        frames = [read_raw_table(mc)]
+
+    from shifu_tpu.data.dataset import build_columnar, parse_tags
+    from shifu_tpu.ops.normalize import build_numeric_table
     vocabs = {c.columnNum: (c.columnBinning.binCategory or [])
               for c in cols if c.is_categorical}
-    dset = build_columnar(mc, norm_proc._restrict(ctx.column_configs, cols),
-                          df, vocabs=vocabs)
-    # row filter may drop rows — rebuild cohorts aligned (build_columnar
-    # only drops invalid-tag rows; replicate its mask)
-    from shifu_tpu.data.dataset import parse_tags
-    tgt = simple_column_name(mc.dataSet.targetColumnName.split("|")[0])
-    tags_all = parse_tags(df[tgt].astype(str).str.strip().to_numpy(),
-                          mc.pos_tags, mc.neg_tags)
-    cohorts = cohorts[~np.isnan(tags_all)]
-
-    uniq = sorted(set(cohorts.tolist()))
-    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
-    max_bins = mc.stats.maxNumBin
-
-    # numeric: bin with stored boundaries; categorical: codes
-    from shifu_tpu.ops.normalize import build_numeric_table
     num_by = {c.columnNum: c for c in cols if c.is_numerical}
-    num_ordered = [num_by[int(n)] for n in dset.num_column_nums
-                   if int(n) in num_by]
-    rows: List[str] = []
-    results: Dict[int, List[float]] = {}
+    max_bins = mc.stats.maxNumBin
+    tgt = simple_column_name(mc.dataSet.targetColumnName.split("|")[0])
 
-    def accumulate(bin_idx: np.ndarray, col_nums, n_slots):
+    # cohort → [numeric (C_num, S_num) counts, cat (C_cat, S_cat) counts];
+    # pure sums merge exactly across chunks
+    counts: Dict[str, List[np.ndarray]] = {}
+    num_tbl = None
+    num_slots = cat_slots = 0
+    num_column_nums = cat_column_nums = None
+
+    for df in frames:
+        if mc.dataSet.filterExpressions:
+            from shifu_tpu.data.purifier import DataPurifier
+            keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+            df = df[keep].reset_index(drop=True)
+        if psi_col not in df.columns:
+            raise ValueError(f"psiColumnName {psi_col!r} not in data header")
+        cohorts = df[psi_col].astype(str).str.strip().to_numpy()
+        dset = build_columnar(mc, norm_proc._restrict(ctx.column_configs,
+                                                      cols),
+                              df, vocabs=vocabs)
+        # row filter may drop rows — rebuild cohorts aligned
+        # (build_columnar only drops invalid-tag rows; replicate its mask)
+        tags_all = parse_tags(df[tgt].astype(str).str.strip().to_numpy(),
+                              mc.pos_tags, mc.neg_tags)
+        cohorts = cohorts[~np.isnan(tags_all)]
+        if not len(cohorts):
+            continue
+
+        chunk_uniq = sorted(set(cohorts.tolist()))
+        blocks = []
+        if dset.numeric.shape[1]:
+            if num_tbl is None:
+                ordered = [num_by[int(n)] for n in dset.num_column_nums
+                           if int(n) in num_by]
+                num_tbl = build_numeric_table(ordered, max_bins)
+                num_slots = num_tbl.cuts.shape[0] + 2
+                num_column_nums = dset.num_column_nums
+            bi = np.asarray(stats_ops.bin_index_numeric(
+                jnp.asarray(dset.numeric), jnp.asarray(num_tbl.cuts)))
+            blocks.append((0, bi, num_slots))
+        if dset.cat_codes.shape[1]:
+            vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+            if not cat_slots:
+                cat_slots = int(vlen.max()) + 2
+                cat_column_nums = dset.cat_column_nums
+            codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                             dset.cat_codes)
+            blocks.append((1, codes, cat_slots))
+        for u in chunk_uniq:
+            m = cohorts == u
+            slot = counts.setdefault(u, [None, None])
+            for which, bin_idx, n_slots in blocks:
+                c = np.stack([np.bincount(bin_idx[m, j], minlength=n_slots)
+                              for j in range(bin_idx.shape[1])])
+                slot[which] = c if slot[which] is None else slot[which] + c
+
+    uniq = sorted(counts.keys())
+    cc_by_num = {c.columnNum: c for c in ctx.column_configs}
+    rows: List[str] = []
+
+    def finalize(which, col_nums):
+        per_cohort = [counts[u][which] for u in uniq]   # (C, S) each
+        if not per_cohort or per_cohort[0] is None:
+            return
+        # global distribution = sum over cohorts (every kept row has a
+        # cohort value), exactly the resident all-rows bincount
+        glob = np.sum(per_cohort, axis=0)
         for j, cn in enumerate(col_nums):
             cc = cc_by_num[int(cn)]
-            global_counts = np.bincount(bin_idx[:, j], minlength=n_slots)
-            g = global_counts / max(global_counts.sum(), 1)
+            g = glob[j] / max(glob[j].sum(), 1)
             unit = []
-            for u in uniq:
-                m = cohorts == u
-                c_counts = np.bincount(bin_idx[m, j], minlength=n_slots)
+            for ui in range(len(uniq)):
+                c_counts = per_cohort[ui][j]
                 c_dist = c_counts / max(c_counts.sum(), 1)
                 unit.append(stats_ops.psi_metric(c_dist, g))
             cc.columnStats.psi = float(np.mean(unit)) if unit else 0.0
             cc.columnStats.unitStats = [f"{u}:{v:.6f}"
                                         for u, v in zip(uniq, unit)]
-            results[int(cn)] = unit
             rows.append(f"{cc.columnName},{cc.columnStats.psi:.6f}," +
                         ",".join(f"{v:.6f}" for v in unit))
 
-    if dset.numeric.shape[1]:
-        tbl = build_numeric_table(num_ordered, max_bins)
-        bi = np.asarray(stats_ops.bin_index_numeric(
-            jnp.asarray(dset.numeric), jnp.asarray(tbl.cuts)))
-        accumulate(bi, dset.num_column_nums, tbl.cuts.shape[0] + 2)
-    if dset.cat_codes.shape[1]:
-        vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
-        codes = np.where(dset.cat_codes < 0, vlen[None, :], dset.cat_codes)
-        accumulate(codes, dset.cat_column_nums, int(vlen.max()) + 2)
+    if num_column_nums is not None:
+        finalize(0, num_column_nums)
+    if cat_column_nums is not None:
+        finalize(1, cat_column_nums)
 
     out = ctx.path_finder.psi_path()
     ctx.path_finder.ensure(out)

@@ -135,18 +135,23 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     # resample per bag (mirrors _run_tree_streaming's n_bags==1 skip) —
     # UNLESS sampleNegOnly/stratifiedSample ask for an explicit
     # single-model rebalance. RF/DT sample per TREE inside build_rf;
-    # layering bag sampling on top would double-sample, so the flags
-    # warn-and-ignore there.
+    # the flags thread into those draws instead of bag-level weights
+    # (layering both would double-sample).
     _neg, _strat = mc.train.sampleNegOnly, mc.train.stratifiedSample
-    if (_neg or _strat) and alg is not Algorithm.GBT:
-        log.warning("sampleNegOnly/stratifiedSample shape GBT bag "
-                    "sampling; RF/DT per-tree Poisson sampling ignores "
-                    "them")
-        _neg = _strat = False
+    if _neg and cfg.loss == "squared":
+        # reference applies sampleNegOnly only to binary/one-vs-all
+        # (DTWorker isRegression/isOneVsAll checks); a continuous
+        # target has no "negatives" to drop — mirror train_nn's
+        # multi-class warn-and-ignore
+        log.warning("train.sampleNegOnly ignored: continuous-target "
+                    "(squared-loss) trees have no negative class")
+        _neg = False
     # rate>=1 without replacement makes flag-driven sampling a no-op —
-    # don't construct weights just to multiply by 1
-    explicit = (_neg or _strat) and (mc.train.baggingSampleRate < 1.0
-                                     or mc.train.baggingWithReplacement)
+    # don't construct weights just to multiply by 1. Bag-level flag
+    # weights are GBT-only (RF/DT thread the flags per tree below).
+    explicit = (_neg or _strat) and alg is Algorithm.GBT \
+        and (mc.train.baggingSampleRate < 1.0
+             or mc.train.baggingWithReplacement)
     bag_w = None if (n_bags == 1 and not explicit) else bagging_weights(
         int(tr_mask.sum()), n_bags, mc.train.baggingSampleRate,
         mc.train.baggingWithReplacement, seed,
@@ -165,9 +170,12 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
             )
             kind = "gbt"
         else:
+            # RF/DT sample per TREE inside build_rf; the flags thread
+            # into those draws (DTWorker.java:530,660 honors both)
             trees = gbdt.build_rf(cfg, bins[tr_mask], y[tr_mask], w[tr_mask],
                                   n_trees, subset,
-                                  mc.train.baggingSampleRate, seed + bag)
+                                  mc.train.baggingSampleRate, seed + bag,
+                                  stratified=_strat, neg_only=_neg)
             val_errs = []
             kind = "rf"
         path = ctx.path_finder.model_path(bag, kind)
@@ -188,8 +196,9 @@ class _BaggedWeights:
     Poisson/Bernoulli bag multiplicities (same Philox scheme as
     train/streaming._chunk_bag_weights: global row counter ⇒ identical
     membership every pass). `labels` (a row-aligned sliceable) enables
-    train.sampleNegOnly: positives keep multiplicity 1, only negatives
-    sample at the rate."""
+    train.sampleNegOnly: positives are force-kept (multiplicity
+    clamped to ≥1 under Poisson bagging), only negatives sample at the
+    rate."""
 
     def __init__(self, base, rate: float, with_replacement: bool, key: int,
                  labels=None, neg_only: bool = False):
@@ -213,8 +222,13 @@ class _BaggedWeights:
             m = (gen.random(len(w)) < self._rate).astype(np.float32)
         if self._labels is not None:
             lab = np.asarray(self._labels[sl], np.float32)
-            # keep positives and NaN labels, like the resident path
-            m = np.where(np.isnan(lab) | (lab > 0.5), np.float32(1.0), m)
+            # keep positives and NaN labels, like the resident path;
+            # Poisson multiplicities >1 survive the force-keep clamp
+            keep = np.isnan(lab) | (lab > 0.5)
+            if self._repl:
+                m = np.where(keep, np.maximum(m, 1.0), m)
+            else:
+                m = np.where(keep, np.float32(1.0), m)
         return w * m
 
 

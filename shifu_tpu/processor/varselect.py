@@ -280,6 +280,15 @@ def _filter_by_sensitivity(ctx: ProcessorContext,
     ctx.path_finder.ensure(se_path)
     ranked = sorted(per_col.items(), key=lambda kv: -kv[1])
     with open(se_path, "w") as f:
+        samp = getattr(ctx, "_analysis_frame", None)
+        if samp is not None:
+            # the one analysis step still allowed to sample (ablation
+            # deltas are stable on a capped sample; correlation/PSI/
+            # posttrain stream exactly) — mark it so the ranking is
+            # never mistaken for a full-data pass
+            f.write(f"# sensitivity computed on a {len(samp)}-row "
+                    "uniform sample of a >RAM dataset "
+                    "(SHIFU_TPU_ANALYSIS_MAX_ROWS)\n")
         for name, d in ranked:
             f.write(f"{name}\t{d:.8g}\n")
 

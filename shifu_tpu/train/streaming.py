@@ -93,9 +93,14 @@ def _chunk_bag_weights(n_bags: int, sample_rate: float,
         if neg_only:
             lab = np.asarray(labels)
             # keep positives AND NaN-labeled rows (resident
-            # bagging_weights: `lab < 0.5` is False for NaN)
-            out[b] = np.where(np.isnan(lab) | (lab > 0.5),
-                              np.float32(1.0), out[b])
+            # bagging_weights: `lab < 0.5` is False for NaN); under
+            # Poisson bagging kept rows clamp to ≥1 — multiplicities
+            # >1 survive, matching the resident path
+            keep = np.isnan(lab) | (lab > 0.5)
+            if with_replacement:
+                out[b] = np.where(keep, np.maximum(out[b], 1.0), out[b])
+            else:
+                out[b] = np.where(keep, np.float32(1.0), out[b])
     return out
 
 

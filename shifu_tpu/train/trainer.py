@@ -102,10 +102,17 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
         # (_chunk_bag_weights) mirrors this
         neg = lab < 0.5
         n_neg = int(neg.sum())
-        w = np.ones((n_bags, n), np.float32)
         if with_replacement:
-            w[:, neg] = rng.poisson(sample_rate, size=(n_bags, n_neg))
+            # Poisson bagging still applies to positives in the
+            # reference (sampleNegOnly only DROPS negatives;
+            # AbstractNNWorker keeps Poisson multiplicities for kept
+            # rows) — force-keep clamps positives to ≥1 rather than
+            # pinning them to exactly 1
+            w = rng.poisson(sample_rate, size=(n_bags, n)) \
+                .astype(np.float32)
+            w[:, ~neg] = np.maximum(w[:, ~neg], 1.0)
         else:
+            w = np.ones((n_bags, n), np.float32)
             w[:, neg] = rng.random((n_bags, n_neg)) < sample_rate
         return _rescue_empty_bags(w)
     if stratified and labels is not None and sample_rate < 1.0:
@@ -469,6 +476,14 @@ def train_nn(train_conf: ModelTrainConf, x: np.ndarray, y: np.ndarray,
                                              seed)
         x_tr, y_tr, w_tr = x[tr_mask], y[tr_mask], w[tr_mask]
         x_v, y_v, w_v = x[val_mask], y[val_mask], w[val_mask]
+
+    if spec.compute_dtype == "bfloat16":
+        # store the feature matrix itself in bf16: forward would cast
+        # on-chip anyway, but a bf16-resident x halves the HBM bytes
+        # every epoch actually streams (labels/weights stay f32 — they
+        # feed the f32 loss reduction)
+        x_tr = x_tr.astype(jnp.bfloat16)
+        x_v = x_v.astype(jnp.bfloat16)
 
     neg_only = train_conf.sampleNegOnly
     if neg_only and spec.output_dim > 1:

@@ -124,6 +124,65 @@ def test_psi(model_set):
     assert len(num0.columnStats.unitStats) == 2
 
 
+def test_analysis_steps_chunked_parity(model_set, monkeypatch):
+    """Correlation / PSI / posttrain streamed in forced tiny chunks
+    must reproduce the resident results exactly (the accumulators are
+    pure sums) — the analog of the reference's exact full-data MR jobs
+    (CorrelationMapper.java:52, PSICalculatorUDF, PostTrainMapper)."""
+    import pandas as pd
+    # add a cohort column for PSI (same surgery as test_psi)
+    dpath = os.path.join(model_set, "data", "part-00000")
+    hpath = os.path.join(model_set, "data", ".pig_header")
+    header = open(hpath).read().strip().split("|")
+    df = pd.read_csv(dpath, sep="|", names=header, dtype=str)
+    df["month"] = np.where(np.arange(len(df)) % 2 == 0, "m1", "m2")
+    df.to_csv(dpath, sep="|", header=False, index=False)
+    with open(hpath, "w") as f:
+        f.write("|".join(header + ["month"]) + "\n")
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = json.load(open(mc_path))
+    mc["stats"]["psiColumnName"] = "month"
+    with open(mc["dataSet"]["metaColumnNameFile"], "a") as f:
+        f.write("month\n")
+    json.dump(mc, open(mc_path, "w"))
+
+    for cmd in (["init"], ["stats"], ["norm"], ["train"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+
+    def run_steps():
+        for cmd in (["stats", "-correlation"], ["stats", "-psi"],
+                    ["posttrain"]):
+            assert cli_main(["--dir", model_set] + cmd) == 0
+        ctx = ProcessorContext.load(model_set)
+        corr = open(ctx.path_finder.correlation_path()).read()
+        psi = open(ctx.path_finder.psi_path()).read()
+        fi = open(os.path.join(model_set, "featureimportance.csv")).read()
+        ccs = load_column_configs(
+            os.path.join(model_set, "ColumnConfig.json"))
+        avg = {c.columnName: c.columnBinning.binAvgScore for c in ccs
+               if c.columnBinning.binAvgScore}
+        return corr, psi, fi, avg
+
+    res_corr, res_psi, res_fi, res_avg = run_steps()
+    monkeypatch.setenv("SHIFU_TPU_ANALYSIS_CHUNK_ROWS", "157")
+    chk_corr, chk_psi, chk_fi, chk_avg = run_steps()
+
+    assert chk_psi == res_psi          # integer bin counts: exact
+    # f32 GEMM partial sums: near-exact
+    for res_txt, chk_txt in ((res_corr, chk_corr),):
+        for lr, lc in zip(res_txt.splitlines()[1:], chk_txt.splitlines()[1:]):
+            rv = np.array(lr.split(",")[1:], float)
+            cv = np.array(lc.split(",")[1:], float)
+            np.testing.assert_allclose(cv, rv, atol=2e-4)
+    assert set(res_avg) == set(chk_avg)
+    for k in res_avg:
+        np.testing.assert_allclose(chk_avg[k], res_avg[k], atol=1e-4)
+    # feature-importance ranking preserved
+    def ranks(txt):
+        return [ln.split(",")[0] for ln in txt.strip().splitlines()[1:]]
+    assert ranks(chk_fi) == ranks(res_fi)
+
+
 def test_export_woemapping(model_set):
     for cmd in (["init"], ["stats"]):
         assert cli_main(["--dir", model_set] + cmd) == 0
@@ -338,6 +397,32 @@ def test_tf_export_savedmodel(model_set):
     want = np.asarray(nn_mod.forward(spec, params, jnp.asarray(x)))
     got = mod.f(tf.constant(x)).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_generic_savedmodel_scores_in_eval(model_set):
+    """GenericModel round-trip (core/GenericModel.java analog): the
+    repo's own jax2tf SavedModel export joins the eval ensemble via
+    customPaths.genericModelsPath and its scores match the native
+    spec's ≤1e-5 column-for-column."""
+    pytest.importorskip("tensorflow")
+    from shifu_tpu.processor import export as export_proc
+
+    for cmd in (["init"], ["stats"], ["norm"], ["train"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    ctx = ProcessorContext.load(model_set)
+    assert export_proc.run(ctx, "tf") == 0
+
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = json.load(open(mc_path))
+    mc["evals"][0]["customPaths"] = {"genericModelsPath": "tfmodel"}
+    json.dump(mc, open(mc_path, "w"))
+    assert cli_main(["--dir", model_set, "eval"]) == 0
+
+    ctx = ProcessorContext.load(model_set)
+    import pandas as pd
+    df = pd.read_csv(ctx.path_finder.eval_score_path("Eval1"))
+    assert {"model0", "model1"} <= set(df.columns)  # native + SavedModel
+    np.testing.assert_allclose(df["model1"], df["model0"], atol=1e-5)
 
 
 def test_step_metrics_and_profile(model_set):

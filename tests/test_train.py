@@ -113,6 +113,64 @@ def test_bagging_weights_neg_only_keeps_positives():
     assert 0.2 < frac_neg < 0.4                    # negatives ~rate
 
 
+def test_bf16_compute_trains_and_scores(rng):
+    """ComputeDtype=bfloat16 runs GEMMs/activations in bf16 with f32
+    master weights: the model still learns, params and scores stay
+    f32, and the saved spec round-trips through the scorer."""
+    import jax.numpy as jnp
+    from shifu_tpu.models import nn as nn_mod
+    n = 2000
+    x = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    beta = rng.normal(0, 1, 8).astype(np.float32)
+    y = ((x @ beta) > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    conf = ModelTrainConf.from_dict({
+        "numTrainEpochs": 40, "baggingNum": 1, "validSetRate": 0.2,
+        "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                   "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                   "Propagation": "ADAM", "ComputeDtype": "bfloat16"}})
+    res = train_nn(conf, x, y, w, seed=3)
+    assert res.spec.compute_dtype == "bfloat16"
+    p = res.params_per_bag[0]
+    assert all(np.asarray(l["w"]).dtype == np.float32 for l in p)
+    scores = nn_mod.forward(res.spec, p, jnp.asarray(x))
+    assert scores.dtype == jnp.float32
+    from shifu_tpu.ops.metrics import auc
+    assert float(auc(scores, jnp.asarray(y))) > 0.9
+
+
+def test_bagging_weights_neg_only_poisson_positives():
+    """Under baggingWithReplacement, sampleNegOnly force-keeps
+    positives (multiplicity ≥1) but leaves them in Poisson bagging —
+    multiplicities >1 must occur (reference: only negatives are
+    dropped; Poisson applies to kept rows)."""
+    labels = np.array([0] * 500 + [1] * 500, np.float32)
+    w = bagging_weights(1000, 2, 1.0, with_replacement=True, seed=11,
+                        labels=labels, neg_only=True)
+    pos = w[:, 500:]
+    assert (pos >= 1.0).all()                      # force-keep
+    assert (pos > 1.0).any()                       # Poisson, not pinned
+    assert (w[:, :500] == 0.0).any()               # negatives can drop
+
+
+def test_rf_stratified_sampling_threads_per_tree(rng):
+    """RF honors stratifiedSample per tree (DTWorker.java:530,660):
+    with a tiny positive class, stratified draws keep positives in
+    every tree's bag at the class rate instead of Poisson noise."""
+    from shifu_tpu.models.gbdt import TreeConfig, build_rf
+    n = 800
+    x = rng.normal(0, 1, (n, 4)).astype(np.float32)
+    y = (rng.random(n) < 0.05).astype(np.float32)
+    bins = np.clip((x * 8 + 32).astype(np.int32), 0, 63)
+    cfg = TreeConfig(max_depth=3, n_bins=64, learning_rate=0.1,
+                     loss="squared")
+    w = np.ones(n, np.float32)
+    for flags in ({"stratified": True}, {"neg_only": True}):
+        trees = build_rf(cfg, bins, y, w, 4, "ALL", 0.5, seed=3, **flags)
+        assert trees["feature"].shape[0] == 4
+        assert np.isfinite(np.asarray(trees["leaf_value"])).all()
+
+
 def test_chunk_bag_weights_neg_only_matches_semantics():
     """Streaming counter-based bag weights honor sampleNegOnly the
     same way the resident path does: positives multiplicity 1, only
@@ -289,6 +347,48 @@ def test_full_pipeline_multiclass(tmp_path, rng, method):
         header = f.readline().strip().split(",")
     assert header == ["tag", "weight", "class0", "class1", "class2",
                       "predicted"]
+
+
+def test_multiclass_eval_streaming_parity(tmp_path, rng, monkeypatch):
+    """>RAM multi-class eval streams the C×C confusion matrix chunk by
+    chunk (counts merge exactly); forced tiny chunks must reproduce the
+    resident outputs byte-for-byte — the reference's sort-based
+    ConfusionMatrix (ConfusionMatrix.java:255-284) streams for any
+    class count."""
+    from tests.synth import make_model_set
+    root = make_model_set(tmp_path, rng, n_rows=1200, n_classes=3,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [12],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM"})
+    ctx = run_pipeline(root)
+
+    def outputs():
+        return (open(ctx.path_finder.eval_performance_path("Eval1")).read(),
+                open(ctx.path_finder.eval_confusion_path("Eval1")).read(),
+                open(ctx.path_finder.eval_score_path("Eval1")).read())
+
+    res = outputs()
+    from shifu_tpu.processor import eval as eval_proc
+    monkeypatch.setenv("SHIFU_TPU_EVAL_CHUNK_ROWS", "111")
+    assert eval_proc.run(ctx) == 0
+    chk = outputs()
+    assert chk[0] == res[0]      # performance json: exact counts
+    assert chk[1] == res[1]      # confusion matrix
+    # EvalScore.csv: same rows; scores numerically equal (scoring a
+    # chunk vs the whole matrix can differ in the last printed ulp —
+    # padding changes the GEMM tiling)
+    import io
+    import pandas as pd
+    df_r = pd.read_csv(io.StringIO(res[2]))
+    df_c = pd.read_csv(io.StringIO(chk[2]))
+    assert list(df_r.columns) == list(df_c.columns)
+    np.testing.assert_array_equal(df_c["tag"], df_r["tag"])
+    np.testing.assert_array_equal(df_c["predicted"], df_r["predicted"])
+    for col in df_r.columns:
+        if col.startswith("class"):
+            np.testing.assert_allclose(df_c[col], df_r[col], atol=2e-6)
 
 
 def test_champion_challenger_eval(tmp_path, rng):

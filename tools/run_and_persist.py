@@ -7,10 +7,11 @@ Usage: python tools/run_and_persist.py <task> [timeout_s]
 Exits 0 only when the task produced a JSON record on a TPU backend.
 """
 import json
+import os
 import subprocess
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 
