@@ -61,8 +61,10 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
         from shifu_tpu.models import mtl
         return mtl.predict(meta, params, dense, index)
     if kind == "tf":
-        import tensorflow as tf
+        # _saved_model_fn first: it owns the friendly missing-
+        # tensorflow gating error; a bare import here would preempt it
         fn = _saved_model_fn(meta["path"])
+        import tensorflow as tf
         out = np.asarray(fn(tf.constant(np.asarray(dense, np.float32))))
         # (N, 1) single-output heads flatten to the binary convention
         if out.ndim == 2 and out.shape[1] == 1:
