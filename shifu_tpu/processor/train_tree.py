@@ -138,14 +138,18 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
     # the flags thread into those draws instead of bag-level weights
     # (layering both would double-sample).
     _neg, _strat = mc.train.sampleNegOnly, mc.train.stratifiedSample
-    if _neg and cfg.loss == "squared":
+    if _neg:
         # reference applies sampleNegOnly only to binary/one-vs-all
-        # (DTWorker isRegression/isOneVsAll checks); a continuous
-        # target has no "negatives" to drop — mirror train_nn's
-        # multi-class warn-and-ignore
-        log.warning("train.sampleNegOnly ignored: continuous-target "
-                    "(squared-loss) trees have no negative class")
-        _neg = False
+        # (DTWorker isRegression/isOneVsAll checks). The signal is the
+        # LABELS, not the loss — squared is the default tree loss for
+        # binary-tag models here, so gate on actually-continuous y
+        # (mirroring train_nn's multi-class warn-and-ignore)
+        lab = np.asarray(y, np.float32)
+        lab = lab[~np.isnan(lab)]
+        if lab.size and not np.isin(lab, (0.0, 1.0)).all():
+            log.warning("train.sampleNegOnly ignored: continuous-"
+                        "target trees have no negative class")
+            _neg = False
     # rate>=1 without replacement makes flag-driven sampling a no-op —
     # don't construct weights just to multiply by 1. Bag-level flag
     # weights are GBT-only (RF/DT thread the flags per tree below).
