@@ -1349,8 +1349,8 @@ def main():
             step("pipeline", f"CLI product-path bench ({PIPE_ROWS} rows "
                  f"× {PIPE_NUM + PIPE_CAT} cols, init→stats→norm→"
                  "train→eval)", timeout=3000)
-            step("rf", f"RF at-scale bench ({GBT_ROWS}x{GBT_COLS}, "
-                 "50 trees)", timeout=3000)
+            step("rf", f"RF at-scale bench ({RF_ROWS}x{GBT_COLS}, "
+                 f"{RF_TREES} trees)", timeout=3000)
             step("nn_wide", f"wide-NN utilization bench ({WIDE_ROWS}x"
                  f"{WIDE_FEATURES}, {WIDE_HIDDEN})", timeout=2700)
             step("nn_wide_bf16", "wide-NN bf16 mixed-precision bench",
