@@ -352,6 +352,17 @@ def _final_leaves(cfg: TreeConfig, tree, g_hist, h_hist):
     return tree
 
 
+def _route_mode() -> str:
+    """SHIFU_TPU_GBT_ROUTE = gather | onehot. The per-row split-feature
+    lookup can lower as a cross-sublane gather (take_along_axis) or as
+    a one-hot multiply-reduce over the feature axis (C·R f32 FMA on
+    the VPU, fusable, no gather). tools/profile_gbt.py A/Bs both on
+    the real backend. Read at TRACE time — set it before the first
+    build in a process (an env flip later hits the jit cache)."""
+    import os
+    return os.environ.get("SHIFU_TPU_GBT_ROUTE", "gather").lower()
+
+
 def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
     """Advance rows one level: bin <= split_bin → left child (2i+1);
     missing uses the node's default direction. binsT: (C, R)."""
@@ -360,8 +371,17 @@ def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
     node_feat = tree["feature"][node_of_row]               # (R,)
     node_bin = tree["bin"][node_of_row]
     node_dl = tree["default_left"][node_of_row]
-    row_bin = jnp.take_along_axis(
-        binsT, jnp.maximum(node_feat, 0)[None, :], axis=0)[0]
+    feat_idx = jnp.maximum(node_feat, 0)
+    if _route_mode() == "onehot":
+        # (C, R) one-hot × bins, reduced over C: bin ids ≤ 2^24 are
+        # exact in f32, and XLA fuses the product into the reduction
+        sel = jax.nn.one_hot(feat_idx, binsT.shape[0],
+                             dtype=jnp.float32, axis=0)
+        row_bin = jnp.sum(sel * binsT.astype(jnp.float32),
+                          axis=0).astype(jnp.int32)
+    else:
+        row_bin = jnp.take_along_axis(binsT, feat_idx[None, :],
+                                      axis=0)[0]
     miss = row_bin == (cfg.n_bins - 1)
     go_left = jnp.where(miss, node_dl, row_bin <= node_bin)
     active = (node_feat >= 0) & (node_of_row >= level_offset) & \

@@ -108,6 +108,28 @@ def test_gbt_missing_direction(rng):
     assert p[miss].mean() > 0.8  # learned that missing → positive
 
 
+def test_route_level_onehot_matches_gather(rng, monkeypatch):
+    """SHIFU_TPU_GBT_ROUTE=onehot (one-hot multiply-reduce feature
+    lookup) must route every row exactly like the gather formulation
+    — same child ids for any tree state."""
+    import jax.numpy as jnp
+    from shifu_tpu.models.gbdt import TreeConfig, _route_level
+    cfg = TreeConfig(max_depth=4, n_bins=64, learning_rate=0.1,
+                     loss="log")
+    c, r = 7, 5000
+    binsT = jnp.asarray(rng.integers(0, 64, (c, r)).astype(np.int32))
+    tree = {"feature": jnp.asarray(
+                rng.integers(-1, c, 31).astype(np.int32)),
+            "bin": jnp.asarray(rng.integers(0, 63, 31).astype(np.int32)),
+            "default_left": jnp.asarray(rng.random(31) < 0.5)}
+    node = jnp.asarray(rng.integers(3, 7, r).astype(np.int32))
+    monkeypatch.setenv("SHIFU_TPU_GBT_ROUTE", "gather")
+    a = np.asarray(_route_level(cfg, tree, binsT, node, 2))
+    monkeypatch.setenv("SHIFU_TPU_GBT_ROUTE", "onehot")
+    b = np.asarray(_route_level(cfg, tree, binsT, node, 2))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_rf_vmapped_forest(rng):
     bins, y = _binned(rng)
     cfg = TreeConfig(max_depth=4, n_bins=17)

@@ -13,16 +13,25 @@ if ! timeout 90 python -c "import jax; print('backend:', jax.default_backend())"
 fi
 
 before=$(wc -l < BENCH_LOCAL.jsonl 2>/dev/null || echo 0)
+prof_before=$(wc -l < tools/profile_gbt.jsonl 2>/dev/null || echo 0)
 echo "[capture] running bench ladder (records persist as they land)..."
 python bench.py || true
 after=$(wc -l < BENCH_LOCAL.jsonl 2>/dev/null || echo 0)
 
-if [ "$after" -gt "$before" ]; then
-    echo "[capture] $((after - before)) new record(s) — committing"
+echo "[capture] GBT component attribution (tools/profile_gbt.py)..."
+timeout 2400 python tools/profile_gbt.py 11000000 5 || true
+prof_after=$(wc -l < tools/profile_gbt.jsonl 2>/dev/null || echo 0)
+
+new_files=""
+if [ "$prof_after" -gt "$prof_before" ]; then
+    new_files="tools/profile_gbt.jsonl"
+fi
+if [ "$after" -gt "$before" ] || [ -n "$new_files" ]; then
+    echo "[capture] committing new measurement data"
     git commit -m "Capture TPU bench records ($((after - before)) new in BENCH_LOCAL.jsonl)
 
-No-Verification-Needed: measurement-data-only commit (BENCH_LOCAL.jsonl)" -- BENCH_LOCAL.jsonl
-else
-    echo "[capture] no new records persisted"
-    exit 1
+No-Verification-Needed: measurement-data-only commit" -- BENCH_LOCAL.jsonl $new_files || true
+    exit 0
 fi
+echo "[capture] nothing new persisted"
+exit 1

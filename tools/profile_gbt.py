@@ -98,16 +98,28 @@ def main():
           lambda: hists_all_levels(binsT, grad, hess),
           lambda: float(hists_all_levels(binsT, grad, hess)))
 
-    # (c) routing: all levels' row advancement
-    @jax.jit
-    def route_all(b):
-        n = jnp.zeros(rows, jnp.int32)
-        for d in range(depth):
-            n = gbdt._route_level(cfg, tree0, b, n, d)
-        return n.sum()
+    # (c) routing: all levels' row advancement — both formulations
+    # (env is read at trace time; tracing two distinct jits here keeps
+    # the A/B inside one process)
+    caller_route = os.environ.get("SHIFU_TPU_GBT_ROUTE")
+    for mode in ("gather", "onehot"):
+        os.environ["SHIFU_TPU_GBT_ROUTE"] = mode
 
-    timed("route_levels_s", lambda: route_all(binsT),
-          lambda: float(route_all(binsT)))
+        # fresh function object per mode → its own jit cache; the env
+        # is read at trace time inside _route_level
+        @jax.jit
+        def route_all(b):
+            n = jnp.zeros(rows, jnp.int32)
+            for d in range(depth):
+                n = gbdt._route_level(cfg, tree0, b, n, d)
+            return n.sum()
+
+        timed(f"route_levels_{mode}_s", lambda: route_all(binsT),
+              lambda: float(route_all(binsT)))
+    if caller_route is None:
+        os.environ.pop("SHIFU_TPU_GBT_ROUTE", None)
+    else:
+        os.environ["SHIFU_TPU_GBT_ROUTE"] = caller_route
 
     # (d) split selection on depth-6-sized histograms (64 slots)
     g64 = jax.random.normal(key, (64, cols, n_bins))
