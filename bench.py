@@ -1242,6 +1242,28 @@ def _run_or_reuse(task, backend, diags, env_extra, timeout=1200):
     return out, err
 
 
+def _run_cpu_denom(res, diags):
+    """Measure (or reuse) the same-host CPU denominator into
+    res['cpu_denom']. A separate seam so the orchestrator tests can
+    stub the ~20-minute full-shape CPU run."""
+    _log("running cpu denominator bench...")
+    cached = _latest_persisted("cpu_denom")
+    if cached and cached.get("workload") == _workload("cpu_denom"):
+        res["cpu_denom"] = cached
+        diags.append(f"cpu_denom: reused persisted record "
+                     f"ts={cached.get('ts')}")
+        return
+    out, err = _run_task("cpu_denom", env_extra={"JAX_PLATFORMS": "cpu"},
+                         timeout=2700)
+    if out:
+        _persist("cpu_denom", "cpu",
+                 {**out, "workload": _workload("cpu_denom")})
+        res["cpu_denom"] = out
+    else:
+        diags.append("cpu_denom failed: "
+                     + (err.splitlines()[-1] if err else "?"))
+
+
 def _resolve_backend(diags):
     """Probe the default backend in a subprocess; retry a flaky TPU
     init; fall back to CPU. A user-pinned JAX_PLATFORMS is honored:
@@ -1383,23 +1405,7 @@ def main():
         # regardless of the ladder backend (no tunnel time consumed);
         # a persisted same-workload record is reused (the host doesn't
         # change mid-round)
-        _log("running cpu denominator bench...")
-        cached = _latest_persisted("cpu_denom")
-        if cached and cached.get("workload") == _workload("cpu_denom"):
-            res["cpu_denom"] = cached
-            diags.append(f"cpu_denom: reused persisted record "
-                         f"ts={cached.get('ts')}")
-        else:
-            out, err = _run_task("cpu_denom",
-                                 env_extra={"JAX_PLATFORMS": "cpu"},
-                                 timeout=2700)
-            if out:
-                _persist("cpu_denom", "cpu",
-                         {**out, "workload": _workload("cpu_denom")})
-                res["cpu_denom"] = out
-            else:
-                diags.append("cpu_denom failed: "
-                             + (err.splitlines()[-1] if err else "?"))
+        _run_cpu_denom(res, diags)
     except Exception as e:  # noqa: BLE001 — never crash the driver
         diags.append(f"{type(e).__name__}: {e}")
 

@@ -144,8 +144,74 @@ def _run_main(monkeypatch, capsys, results):
         bench, "_run_or_reuse",
         lambda task, backend, diags, env_extra, timeout=1200:
         (results.get(task), None if task in results else "stubbed out"))
+    # the real cpu_denom run is a ~20-minute full-shape CPU measure
+    monkeypatch.setattr(
+        bench, "_run_cpu_denom",
+        lambda res, diags: res.update(
+            {"cpu_denom": results["cpu_denom"]})
+        if "cpu_denom" in results else None)
     bench.main()
     return _last_json(capsys)
+
+
+def test_task_rf(monkeypatch, capsys):
+    """RF at-scale ladder task at toy shape: lockstep vmapped forest
+    with on-device Poisson bagging."""
+    monkeypatch.setattr(bench, "RF_ROWS", 20_000)
+    monkeypatch.setattr(bench, "RF_TREES", 4)
+    monkeypatch.setattr(bench, "GBT_COLS", 8)
+    bench.task_rf()
+    rec = _last_json(capsys)
+    assert rec["row_trees_per_sec"] > 0
+    assert rec["trees"] == 4
+    assert rec["auc"] > 0.6
+
+
+def test_task_nn_wide_bf16(monkeypatch, capsys):
+    """bf16 mixed-precision variant of the wide utilization task: the
+    model still learns and the record is labeled."""
+    monkeypatch.setattr(bench, "WIDE_ROWS", 4_000)
+    monkeypatch.setattr(bench, "WIDE_FEATURES", 24)
+    monkeypatch.setattr(bench, "WIDE_HIDDEN", (16, 8))
+    monkeypatch.setattr(bench, "WIDE_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "WIDE_EPOCHS_LONG", 40)
+    bench.task_nn_wide("bfloat16")
+    rec = _last_json(capsys)
+    assert rec["compute"] == "bfloat16"
+    assert rec["row_epochs_per_sec"] > 0
+
+
+def test_task_pipeline(monkeypatch, capsys, tmp_path):
+    """The CLI product-path task drives the real init→stats→norm→
+    train→eval surface and records per-phase wall-clocks."""
+    monkeypatch.setattr(bench, "PIPE_DIR", str(tmp_path / "pipe"))
+    monkeypatch.setattr(bench, "PIPE_ROWS", 4_000)
+    monkeypatch.setattr(bench, "PIPE_EPOCHS", 5)
+    bench.task_pipeline()
+    rec = _last_json(capsys)
+    assert set(rec["phases"]) == {"init", "stats", "norm", "train",
+                                  "eval"}
+    assert all(v >= 0 for v in rec["phases"].values())
+    assert rec["auc"] > 0.75
+    assert rec["rows"] == 4_000
+
+
+def test_headline_carries_cpu_denominator(monkeypatch, tmp_path, capsys):
+    """The measured same-host denominator lands in extra with the
+    TPU:CPU ratio for every task that has both sides."""
+    monkeypatch.setattr(bench, "BENCH_LOCAL", str(tmp_path / "b.jsonl"))
+    rec = _run_main(monkeypatch, capsys, {
+        "nn_wide": {"row_epochs_per_sec": 4.0e5, "auc": 0.9,
+                    "wall_s": 2.0, "achieved_tflops": 50.0,
+                    "mxu_util": 0.12, "hbm_util_est": 0.3,
+                    "hbm_gbps_est": 250.0},
+        "cpu_denom": {"nn_wide_row_epochs_per_sec": 1.0e4,
+                      "gbt_row_trees_per_sec": 1.0e5},
+    })
+    assert rec["extra"]["cpu_denominator"][
+        "nn_wide_row_epochs_per_sec"] == 1.0e4
+    assert rec["extra"]["nn_wide_vs_cpu_host_measured"] == 40.0
+    assert "MEASURED same-host" in rec["baseline"]
 
 
 def test_headline_prefers_wide_and_labels_baseline(monkeypatch, tmp_path,
