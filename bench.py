@@ -1511,9 +1511,15 @@ def main():
                   "row_epochs_per_sec"),
                  ("gbt", "gbt_row_trees_per_sec", "row_trees_per_sec"))
         for task, cpu_key, tpu_key in pairs:
-            t = res.get(task) or _latest_persisted(task,
-                                                   backend_filter="tpu")
-            if t and cd.get(cpu_key):
+            # the measured ratio is chip:host — a live record from a
+            # CPU-fallback ladder run (backend != tpu) must not serve
+            # as the numerator, or a ~1.0 ratio gets mislabeled as a
+            # TPU speedup; fall back to the last PERSISTED tpu record
+            t = res.get(task)
+            live_backend = (t or {}).get("backend") or extra.get("backend")
+            if not t or live_backend != "tpu":
+                t = _latest_persisted(task, backend_filter="tpu")
+            if t and t.get(tpu_key) and cd.get(cpu_key):
                 extra[f"{task}_vs_cpu_host_measured"] = round(
                     t[tpu_key] / cd[cpu_key], 1)
 

@@ -346,8 +346,9 @@ def load_column_configs(path: str) -> List[ColumnConfig]:
 
 
 def save_column_configs(configs: List[ColumnConfig], path: str) -> None:
+    from shifu_tpu.resilience import atomic_write
     if os.path.isdir(path):
         path = os.path.join(path, "ColumnConfig.json")
-    with open(path, "w") as f:
+    with atomic_write(path) as f:
         json.dump([c.to_dict() for c in configs], f, indent=1)
         f.write("\n")

@@ -669,9 +669,10 @@ class ModelConfig:
         return o
 
     def save(self, path: str) -> None:
+        from shifu_tpu.resilience import atomic_write
         if os.path.isdir(path):
             path = os.path.join(path, "ModelConfig.json")
-        with open(path, "w") as f:
+        with atomic_write(path) as f:
             json.dump(self.to_dict(), f, indent=2)
             f.write("\n")
 

@@ -24,6 +24,7 @@ class PathFinder:
     EVALS_DIR = "evals"
     VARSEL_DIR = "varsel"
     CHECKPOINT_DIR = "tmp/checkpoints"
+    MANIFEST_DIR = "tmp/manifests"
 
     def __init__(self, model_config: ModelConfig, root: Optional[str] = None):
         self.mc = model_config
@@ -43,6 +44,10 @@ class PathFinder:
         """`PathFinder.getMTLColumnConfigPath` — per-task ColumnConfig for
         multi-task modeling."""
         return self._p("mtlcolumnconfig", f"ColumnConfig.json.{task_index}")
+
+    def manifest_path(self, step: str) -> str:
+        """Per-step completion manifest (processor.base.step_guard)."""
+        return self._p(self.MANIFEST_DIR, f"{step}.json")
 
     # -- data products ------------------------------------------------------
     def normalized_data_path(self) -> str:

@@ -18,6 +18,8 @@ from __future__ import annotations
 import re
 from typing import List
 
+from ..resilience import retrying
+
 _SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.\-]*://")
 
 
@@ -48,12 +50,16 @@ def _fs_and_path(path: str):
 def open_text(path: str, mode: str = "rt"):
     """Open a (possibly remote, possibly compressed) file for reading."""
     import fsspec
-    return fsspec.open(path, mode, compression="infer").open()
+
+    def _open():
+        return fsspec.open(path, mode, compression="infer").open()
+
+    return retrying("fs.open", _open)
 
 
 def exists(path: str) -> bool:
     fs, p = _fs_and_path(path)
-    return fs.exists(p)
+    return retrying("fs.exists", fs.exists, p)
 
 
 def size(path: str) -> int:
@@ -61,7 +67,7 @@ def size(path: str) -> int:
     backend cannot stat it."""
     fs, p = _fs_and_path(path)
     try:
-        return int(fs.size(p) or 0)
+        return int(retrying("fs.size", fs.size, p) or 0)
     except (OSError, FileNotFoundError):
         return 0
 
@@ -76,16 +82,19 @@ def list_data_files(path: str, skip_basenames, strip_url=False) -> List[str]:
     def url(q: str) -> str:
         return q if has_scheme(q) else f"{proto}://{q.lstrip('/') if proto == 'memory' else q}"
 
-    if fs.isdir(p):
-        names = sorted(fs.ls(p, detail=False))
-        out = []
-        for q in names:
-            base = q.rstrip("/").rsplit("/", 1)[-1]
-            if base in skip_basenames or base.startswith((".", "_")):
-                continue
-            if fs.isfile(q):
-                out.append(url(q))
-        return out
-    if fs.isfile(p):
-        return [url(p)]
-    return [url(q) for q in sorted(fs.glob(p)) if fs.isfile(q)]
+    def _list() -> List[str]:
+        if fs.isdir(p):
+            names = sorted(fs.ls(p, detail=False))
+            out = []
+            for q in names:
+                base = q.rstrip("/").rsplit("/", 1)[-1]
+                if base in skip_basenames or base.startswith((".", "_")):
+                    continue
+                if fs.isfile(q):
+                    out.append(url(q))
+            return out
+        if fs.isfile(p):
+            return [url(p)]
+        return [url(q) for q in sorted(fs.glob(p)) if fs.isfile(q)]
+
+    return retrying("fs.list", _list)

@@ -17,6 +17,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pandas as pd
 
+from shifu_tpu.resilience import retrying
+
 log = logging.getLogger("shifu_tpu")
 
 
@@ -79,7 +81,10 @@ def read_files_native(files: Sequence[str], header: List[str], delim: str,
     per_file: List[Tuple[np.ndarray, Dict[str, np.ndarray]]] = []
     for path in files:
         skip = 1 if path == skip_first_row_of else 0
-        n_rows = int(lib.ft_count_file_rows(path.encode(), skip))
+        # retried: the count+parse is idempotent per file, and NFS-style
+        # mounts can flake mid-read just like scheme'd remotes
+        n_rows = int(retrying("reader.native", lib.ft_count_file_rows,
+                              path.encode(), skip))
         if n_rows < 0:
             return None
         if n_rows == 0:

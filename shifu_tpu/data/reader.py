@@ -20,8 +20,17 @@ import pandas as pd
 
 from shifu_tpu.config.model_config import ModelConfig, ModelSourceDataConf
 from shifu_tpu.data import fs as fs_mod
+from shifu_tpu.resilience import retrying
 
 _SKIP_BASENAMES = {"_SUCCESS", ".pig_header", ".pig_schema"}
+
+
+def _read_csv(path: str, **kw) -> pd.DataFrame:
+    """pd.read_csv with remote reads retried (local reads go straight
+    through — a local parse error is never transient)."""
+    if fs_mod.has_scheme(path):
+        return retrying("reader.read", pd.read_csv, path, **kw)
+    return pd.read_csv(path, **kw)
 
 
 def expand_data_files(data_path: str) -> List[str]:
@@ -202,7 +211,7 @@ def read_raw_table(mc: ModelConfig,
             df = _table_to_contract(tbl, header, simple, pq_numeric)
         else:
             skip = 1 if (has_header_line and path == first_file) else 0
-            df = pd.read_csv(
+            df = _read_csv(
                 path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
                 names=header, skiprows=skip, na_filter=False,
                 engine="c", compression="infer", quoting=3,
@@ -264,7 +273,10 @@ def iter_raw_table(mc: ModelConfig,
                 yield df.reset_index(drop=True)
             continue
         skip = 1 if (has_header_line and path == first_file) else 0
-        reader = pd.read_csv(
+        # retry covers the remote open; a failure mid-chunk-iteration
+        # surfaces to the caller (restarting a half-consumed stream
+        # would double-count rows)
+        reader = _read_csv(
             path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
             names=header, skiprows=skip, na_filter=False,
             engine="c", compression="infer", quoting=3,

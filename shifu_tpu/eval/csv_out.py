@@ -57,6 +57,7 @@ def write_rows(f: IO[str], columns: Sequence[np.ndarray],
 def write_csv(path: str, header: Sequence[str],
               columns: Sequence[np.ndarray], fmts: Sequence[str],
               chunk_rows: int = 1_000_000) -> None:
-    with open(path, "w") as f:
+    from shifu_tpu.resilience import atomic_write
+    with atomic_write(path) as f:
         f.write(",".join(header) + "\n")
         write_rows(f, columns, fmts, chunk_rows=chunk_rows)

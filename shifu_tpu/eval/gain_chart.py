@@ -13,12 +13,14 @@ import json
 import os
 from typing import Dict, List
 
+from shifu_tpu.resilience import atomic_write
+
 
 def write_csv(path: str, perf: Dict) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     cols = ["actionRate", "recall", "weightedRecall", "liftUnit",
             "liftWeight", "binLowestScore"]
-    with open(path, "w") as f:
+    with atomic_write(path) as f:
         f.write(",".join(cols) + "\n")
         for row in perf["gains"]:
             f.write(",".join(f"{row.get(c, 0.0):.6f}" for c in cols) + "\n")
@@ -61,5 +63,5 @@ document.getElementById("charts").innerHTML =
 
 def write_html(path: str, perf: Dict, title: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
+    with atomic_write(path) as f:
         f.write(_HTML.format(title=title, perf_json=json.dumps(perf)))

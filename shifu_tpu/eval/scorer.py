@@ -69,6 +69,19 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
         # (N, 1) single-output heads flatten to the binary convention
         if out.ndim == 2 and out.shape[1] == 1:
             out = out[:, 0]
+        # external models join an ensemble that np.stack's (N,) score
+        # vectors — a multi-output or oddly-shaped head must fail HERE
+        # with its shape, not as an opaque stack mismatch later.
+        # Restriction (documented in _saved_model_fn): dense-input,
+        # single-output-per-record SavedModels only.
+        n = np.asarray(dense).shape[0]
+        if out.ndim != 1 or out.shape[0] != n:
+            raise ValueError(
+                f"SavedModel {meta.get('path', '?')} returned output "
+                f"shape {tuple(out.shape)} for {n} input rows; the "
+                "ensemble needs one score per record — (N,) or (N, 1). "
+                "Multi-output/multi-class SavedModels are not supported "
+                "as external ensemble members")
         return out
     raise ValueError(f"unknown model kind {kind!r}")
 
@@ -82,7 +95,12 @@ def _saved_model_fn(path: str):
     over the dense matrix) or any foreign SavedModel with a
     single-input serving_default signature — the GenericModel
     computation (`core/GenericModel.java`, `core/Scorer.java:108-242`)
-    on TPU-native terms."""
+    on TPU-native terms.
+
+    Restrictions: the model must take ONE dense float matrix input and
+    return ONE score per record ((N,) or (N, 1)); multi-input and
+    multi-output SavedModels are rejected with a descriptive error
+    (here for inputs, in score_matrix for outputs)."""
     fn = _TF_FN_CACHE.get(path)
     if fn is not None:
         return fn

@@ -40,6 +40,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+if hasattr(jax, "shard_map"):
+    def _shard_map(*, mesh, in_specs, out_specs, check_vma=False):
+        return partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check_vma)
+else:  # jax < 0.6: experimental module, replication check spelled check_rep
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(*, mesh, in_specs, out_specs, check_vma=False):
+        return partial(_exp_shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_vma)
+
 
 @dataclass(frozen=True)
 class TreeConfig:
@@ -145,9 +156,10 @@ def _level_histograms(binsT, node_of_row, grad, hess, level_offset,
     if mesh is not None and mesh.shape.get("data", 1) > 1:
         from jax.sharding import PartitionSpec as P
 
-        @partial(jax.shard_map, mesh=mesh,
-                 in_specs=(P(None, "data"), P("data"), P("data"), P("data")),
-                 out_specs=(P(), P()), check_vma=False)
+        @_shard_map(mesh=mesh,
+                    in_specs=(P(None, "data"), P("data"), P("data"),
+                              P("data")),
+                    out_specs=(P(), P()), check_vma=False)
         def sharded(b, s, g, h):
             gh_, hh_ = _local_level_histograms(b, s, g, h, n_level_nodes,
                                                n_bins)
@@ -185,10 +197,10 @@ def _forest_level_histograms(binsT, node_T, grad_T, hess_T, level_offset,
     if mesh is not None and mesh.shape.get("data", 1) > 1:
         from jax.sharding import PartitionSpec as P
 
-        @partial(jax.shard_map, mesh=mesh,
-                 in_specs=(P(None, "data"), P(None, "data"),
-                           P(None, "data"), P(None, "data")),
-                 out_specs=(P(), P()), check_vma=False)
+        @_shard_map(mesh=mesh,
+                    in_specs=(P(None, "data"), P(None, "data"),
+                              P(None, "data"), P(None, "data")),
+                    out_specs=(P(), P()), check_vma=False)
         def sharded(b, s, g, h):
             gh_, hh_ = local_hists(b, s, g, h)
             return (jax.lax.psum(gh_, "data"), jax.lax.psum(hh_, "data"))

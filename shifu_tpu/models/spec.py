@@ -56,15 +56,23 @@ def _unflatten(flat: Dict[str, np.ndarray], prefix: str = "p") -> Any:
 
 
 def save_model(path: str, kind: str, meta: Dict[str, Any], params: Any) -> None:
-    """Write a model spec: npz of arrays + embedded JSON header."""
+    """Write a model spec: npz of arrays + embedded JSON header. Staged
+    through a dot-prefixed temp + atomic rename for EVERY target name
+    (previously only extensionless names were staged — a kill while
+    writing `model0.npz` could publish a truncated archive)."""
+    from shifu_tpu.resilience import atomic_path
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     flat = _flatten(params)
     header = json.dumps({"format": FORMAT_VERSION, "kind": kind, "meta": meta})
-    np.savez_compressed(path if path.endswith(".npz") else path + ".tmp.npz",
-                        __header__=np.frombuffer(header.encode(), np.uint8),
-                        **flat)
-    if not path.endswith(".npz"):
-        os.replace(path + ".tmp.npz", path)
+    with atomic_path(path) as tmp:
+        # the temp name keeps the basename's extension, so savez does
+        # not append a second ".npz" and the rename target is exact
+        np.savez_compressed(tmp if path.endswith(".npz") else tmp + ".npz",
+                            __header__=np.frombuffer(header.encode(),
+                                                     np.uint8),
+                            **flat)
+        if not path.endswith(".npz"):
+            os.replace(tmp + ".npz", tmp)
 
 
 def load_model(path: str) -> Tuple[str, Dict[str, Any], Any]:

@@ -28,7 +28,12 @@ def initialize(coordinator_address: Optional[str] = None,
     """Bring up the multi-host runtime. No-op when single-process or
     already initialized. Env fallbacks: SHIFU_TPU_COORDINATOR,
     SHIFU_TPU_NUM_PROCESSES, SHIFU_TPU_PROCESS_ID (on Cloud TPU these
-    resolve automatically from the metadata server)."""
+    resolve automatically from the metadata server).
+
+    SHIFU_TPU_INIT_TIMEOUT_S bounds the coordinator handshake (default:
+    JAX's own, ~300s) — a wrong coordinator address or a dead peer then
+    surfaces as a clear error naming the address instead of an
+    indefinite hang."""
     coordinator_address = coordinator_address or \
         os.environ.get("SHIFU_TPU_COORDINATOR")
     if num_processes is None and "SHIFU_TPU_NUM_PROCESSES" in os.environ:
@@ -37,9 +42,23 @@ def initialize(coordinator_address: Optional[str] = None,
         process_id = int(os.environ["SHIFU_TPU_PROCESS_ID"])
     if num_processes in (None, 1) and coordinator_address is None:
         return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    kwargs = {}
+    timeout_s = os.environ.get("SHIFU_TPU_INIT_TIMEOUT_S")
+    if timeout_s:
+        kwargs["initialization_timeout"] = int(float(timeout_s))
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except Exception as e:
+        raise RuntimeError(
+            f"distributed initialize failed (coordinator="
+            f"{coordinator_address!r}, num_processes={num_processes}, "
+            f"process_id={process_id}"
+            + (f", timeout={timeout_s}s" if timeout_s else "")
+            + f"): {e} — check SHIFU_TPU_COORDINATOR reachability and "
+            "that every process was launched; set "
+            "SHIFU_TPU_INIT_TIMEOUT_S to bound the wait") from e
     log.info("distributed: process %d/%d, %d global devices",
              jax.process_index(), jax.process_count(), jax.device_count())
 

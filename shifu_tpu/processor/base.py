@@ -3,21 +3,30 @@
 Load ModelConfig/ColumnConfig, validate for the step
 (`ModelInspector.probe`), run, write ColumnConfig back. The reference
 also syncs configs to HDFS here; with a single filesystem namespace
-that step disappears.
+that step disappears — what remains of its crash story is the per-step
+MANIFEST (`step_guard`): a completion marker + inputs fingerprint under
+`tmp/manifests/`, written atomically after a step finishes and removed
+before it starts, so a re-run after a kill can tell a completed step
+(skippable with SHIFU_TPU_RESUME=1) from an interrupted one (restarted
+cleanly; its outputs were staged via atomic rename and never published).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
+from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from shifu_tpu.config.column_config import (ColumnConfig, load_column_configs,
                                             save_column_configs)
 from shifu_tpu.config.inspector import ModelStep, probe
 from shifu_tpu.config.model_config import ModelConfig
 from shifu_tpu.config.path_finder import PathFinder
+from shifu_tpu.resilience import atomic_write, fault_point
 
 log = logging.getLogger("shifu_tpu")
 
@@ -64,3 +73,90 @@ class ProcessorContext:
             raise FileNotFoundError(
                 f"ColumnConfig.json not found under {self.path_finder.root}; "
                 "run `init` first")
+
+
+# ---------------------------------------------------------------------------
+# per-step completion manifests
+# ---------------------------------------------------------------------------
+
+def _inputs_fingerprint(ctx: ProcessorContext) -> str:
+    """Content hash of the step's config inputs plus a cheap identity of
+    the raw data (file list + sizes, not contents — hashing TBs of part
+    files to decide a skip would cost more than the step). A changed
+    ModelConfig/ColumnConfig or data layout invalidates the manifest."""
+    h = hashlib.sha256()
+    for path in (ctx.path_finder.model_config_path(),
+                 ctx.path_finder.column_config_path()):
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<missing>")
+        h.update(b"\x00")
+    try:
+        from shifu_tpu.data import fs as fs_mod, reader
+        dp = ctx.model_config.resolve_path(ctx.model_config.dataSet.dataPath)
+        for p in reader.expand_data_files(dp):
+            sz = fs_mod.size(p) if fs_mod.has_scheme(p) else \
+                os.path.getsize(p)
+            h.update(f"{p}:{sz}".encode())
+    except Exception:  # noqa: BLE001 - data identity is best-effort
+        h.update(b"<no-data-stat>")
+    return h.hexdigest()
+
+
+@contextmanager
+def step_guard(ctx: ProcessorContext, step: str,
+               outputs: Sequence[str] = ()):
+    """Crash-safe step bracketing (the single-filesystem analog of the
+    reference's HDFS config sync + re-run semantics).
+
+    Entry: removes the step's manifest — a kill mid-step leaves no
+    completion marker, so the next run restarts the step cleanly.
+    Yields True when the step should RUN; False (skip) only when
+    SHIFU_TPU_RESUME=1, a manifest from a previous run matches the
+    current inputs fingerprint, and every recorded output still exists.
+    Exit without error: writes the manifest atomically (fingerprint +
+    outputs), marking the step complete.
+    """
+    pf = ctx.path_finder
+    mpath = pf.manifest_path(step)
+    fp = _inputs_fingerprint(ctx)
+    if os.environ.get("SHIFU_TPU_RESUME", "0") == "1" \
+            and os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            man = None
+        if man and man.get("fingerprint") == fp \
+                and all(os.path.exists(p) for p in man.get("outputs", [])):
+            log.info("step %s: complete (manifest matches inputs and all "
+                     "%d output(s) present) — skipping; unset "
+                     "SHIFU_TPU_RESUME to force a re-run", step,
+                     len(man.get("outputs", [])))
+            yield False
+            return
+        log.info("step %s: stale/mismatched manifest — re-running", step)
+    from shifu_tpu.parallel import dist
+    if dist.is_writer():
+        if os.path.exists(mpath):
+            os.remove(mpath)
+        fault_point(f"step.{step}")
+    yield True
+    # reaching here means the step body finished without raising
+    if dist.is_writer():
+        os.makedirs(os.path.dirname(mpath), exist_ok=True)
+        missing = [p for p in outputs if not os.path.exists(p)]
+        if missing:
+            log.warning("step %s: declared output(s) missing after run "
+                        "(%s) — manifest not written", step,
+                        ", ".join(missing))
+            return
+        # fingerprint AFTER the body: steps that rewrite their own
+        # inputs (stats fills ColumnConfig.json) must record the state
+        # a clean re-run would see at entry, or no manifest ever matches
+        with atomic_write(mpath) as f:
+            json.dump({"step": step,
+                       "fingerprint": _inputs_fingerprint(ctx),
+                       "outputs": list(outputs)}, f, indent=1)

@@ -124,7 +124,8 @@ def run(ctx: ProcessorContext) -> int:
     from shifu_tpu.parallel import dist
     with dist.single_writer("correlation") as w:
         if w:   # all hosts computed via psum; one writes
-            with open(out, "w") as f:
+            from shifu_tpu.resilience import atomic_write
+            with atomic_write(out) as f:
                 f.write("column," + ",".join(names) + "\n")
                 for i, n in enumerate(names):
                     f.write(n + ","

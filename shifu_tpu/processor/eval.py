@@ -29,7 +29,8 @@ from shifu_tpu.eval import gain_chart
 from shifu_tpu.eval.scorer import Scorer
 from shifu_tpu.ops.metrics import confusion_matrix_table, performance_result
 from shifu_tpu.processor import norm as norm_proc
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
+from shifu_tpu.resilience import AtomicFile, atomic_write
 
 log = logging.getLogger("shifu_tpu")
 
@@ -75,7 +76,10 @@ def run(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
     ctx.validate(ModelStep.EVAL)
     ctx.require_columns()
     for ec in _eval_by_name(ctx, eval_name):
-        run_one(ctx, ec)
+        with step_guard(ctx, f"eval.{ec.name}", outputs=[
+                ctx.path_finder.eval_performance_path(ec.name)]) as go:
+            if go:
+                run_one(ctx, ec)
     return 0
 
 
@@ -248,7 +252,7 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
             csv_out.write_rows(f, columns, fmts)
             return len(dset.tags)
 
-        with open(out, "w") as f:
+        with atomic_write(out) as f:
             if not chunk:
                 # resident fast path (native mmap reader) for sets
                 # under the streaming threshold
@@ -335,7 +339,7 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
             tmp_dir, f"{mc.model_set_name}_{ec.name}_audit.data"))
         var_names = list(dset.num_names) + list(dset.cat_names)
         meta_names = sorted(dset.meta.keys())
-        with open(out, "w") as f:
+        with atomic_write(out) as f:
             f.write("|".join(["tag", "weight"] + var_names + meta_names
                              + score_cols + ["finalScore"]) + "\n")
             for i in range(n):
@@ -406,7 +410,7 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     os.makedirs(base, exist_ok=True)
 
     # EvalScore.csv: tag | weight | per-model scores | ensemble
-    with open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w") as f:
+    with atomic_write(_opath(ctx.path_finder.eval_score_path(ec.name))) as f:
         _ScoreCsvWriter(f).write(scores, tags, weights)
 
     perf = performance_result(final, tags, weights,
@@ -448,7 +452,7 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
                                    score_scale=float(ec.scoreScale))
         champions[col] = cperf
         cpath = _opath(os.path.join(base, f"EvalPerformance-{col}.json"))
-        with open(cpath, "w") as f:
+        with atomic_write(cpath) as f:
             json.dump(cperf, f, indent=1)
         log.info("eval[%s] champion %s: AUC=%.4f (challenger %.4f)",
                  ec.name, col, cperf["areaUnderRoc"],
@@ -457,8 +461,8 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
         perf["championAuc"] = {c: p["areaUnderRoc"]
                                for c, p in champions.items()}
 
-    with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
-              "w") as f:
+    with atomic_write(
+            _opath(ctx.path_finder.eval_performance_path(ec.name))) as f:
         json.dump(perf, f, indent=1)
 
     cm = confusion_matrix_table(final, tags, weights)
@@ -476,7 +480,7 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
 
 def _write_confusion_csv(path: str, cm: np.ndarray) -> None:
     from shifu_tpu.eval import csv_out
-    with open(path, "w") as f:
+    with atomic_write(path) as f:
         f.write("threshold,tp,fp,tn,fn,weightedTp,weightedFp,weightedTn,"
                 "weightedFn\n")
         if len(cm):
@@ -516,7 +520,10 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
               "maxScore": -np.inf, "minScore": np.inf}
     n_chunks = 0
     done = False
-    score_f = open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w")
+    # AtomicFile: the chunked CSV accumulates under a dot-prefixed temp
+    # and publishes only on commit — a kill mid-stream leaves nothing
+    # under the real name (not even a truncated file to clean up)
+    score_f = AtomicFile(_opath(ctx.path_finder.eval_score_path(ec.name)))
     score_w = _ScoreCsvWriter(score_f)
     dump_f = open(dump_path, "wb")
     champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}
@@ -556,15 +563,14 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
             n_chunks += 1
         done = True
     finally:
-        score_f.close()
+        score_f.close(commit=done)  # uncommitted temp vanishes
         dump_f.close()
         for fh in champ_fs.values():
             fh.close()
         if not done:
-            # failure mid-stream: the multi-GB side dumps (and the
-            # truncated EvalScore.csv) must not linger in the eval dir
-            for p in [dump_path, *champ_dumps.values(),
-                      _opath(ctx.path_finder.eval_score_path(ec.name))]:
+            # failure mid-stream: the multi-GB side dumps must not
+            # linger in the eval dir
+            for p in [dump_path, *champ_dumps.values()]:
                 if p != os.devnull and os.path.exists(p):
                     os.remove(p)
     try:
@@ -632,8 +638,8 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
         cperf = ch.performance_result(n_buckets=ec.performanceBucketNum,
                                       score_scale=float(ec.scoreScale))
         champions[c] = cperf
-        with open(_opath(os.path.join(base, f"EvalPerformance-{c}.json")),
-                  "w") as f:
+        with atomic_write(_opath(os.path.join(
+                base, f"EvalPerformance-{c}.json"))) as f:
             json.dump(cperf, f, indent=1)
         log.info("eval[%s] champion %s: AUC=%.4f (challenger %.4f)",
                  ec.name, c, cperf["areaUnderRoc"], perf["areaUnderRoc"])
@@ -641,8 +647,8 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
         perf["championAuc"] = {c: p["areaUnderRoc"]
                                for c, p in champions.items()}
 
-    with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
-              "w") as f:
+    with atomic_write(
+            _opath(ctx.path_finder.eval_performance_path(ec.name))) as f:
         json.dump(perf, f, indent=1)
     _write_confusion_csv(_opath(ctx.path_finder.eval_confusion_path(ec.name)),
                          hist.confusion_table())
@@ -709,7 +715,7 @@ def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
     records = 0
     done = False
     from shifu_tpu.eval import csv_out
-    score_f = open(_opath(ctx.path_finder.eval_score_path(ec.name)), "w")
+    score_f = AtomicFile(_opath(ctx.path_finder.eval_score_path(ec.name)))
     try:
         score_f.write("tag,weight," + ",".join(class_cols)
                       + ",predicted\n")
@@ -729,11 +735,7 @@ def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
             records += int(len(pred))
         done = True
     finally:
-        score_f.close()
-        if not done:
-            p = _opath(ctx.path_finder.eval_score_path(ec.name))
-            if p != os.devnull and os.path.exists(p):
-                os.remove(p)
+        score_f.close(commit=done)  # uncommitted temp vanishes
     log.info("eval[%s]: multi-class streamed in %d-row chunks", ec.name,
              chunk_rows)
     return _write_multiclass_outputs(ctx, ec, cm, records, t0)
@@ -748,8 +750,8 @@ def _write_multiclass_outputs(ctx: ProcessorContext, ec: EvalConfig,
     mc = ctx.model_config
     classes = mc.class_tags
     n_c = len(classes)
-    with open(_opath(ctx.path_finder.eval_confusion_path(ec.name)),
-              "w") as f:
+    with atomic_write(
+            _opath(ctx.path_finder.eval_confusion_path(ec.name))) as f:
         f.write("actual\\predicted," + ",".join(str(c) for c in classes) + "\n")
         for a in range(n_c):
             f.write(str(classes[a]) + ","
@@ -770,8 +772,8 @@ def _write_multiclass_outputs(ctx: ProcessorContext, ec: EvalConfig,
             "support": float(cm[c].sum())})
     perf = {"accuracy": acc, "records": records,
             "classes": [str(c) for c in classes], "perClass": per_class}
-    with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
-              "w") as f:
+    with atomic_write(
+            _opath(ctx.path_finder.eval_performance_path(ec.name))) as f:
         json.dump(perf, f, indent=1)
     log.info("eval[%s]: %d rows, multi-class accuracy=%.4f in %.2fs",
              ec.name, records, acc, time.time() - t0)
@@ -874,7 +876,7 @@ def run_score(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
                      len(pred))
             continue
         n = 0
-        with open(out_path, "w") as f:
+        with atomic_write(out_path) as f:
             w = _ScoreCsvWriter(f)
             if chunk_rows and not mc.is_multi_classification:
                 from shifu_tpu.data.reader import iter_raw_table
@@ -946,8 +948,8 @@ def run_perf(ctx: ProcessorContext,
         perf = performance_result(final, tags, weights,
                                   n_buckets=ec.performanceBucketNum,
                                   score_scale=float(ec.scoreScale))
-        with open(_opath(ctx.path_finder.eval_performance_path(ec.name)),
-                  "w") as f:
+        with atomic_write(_opath(
+                ctx.path_finder.eval_performance_path(ec.name))) as f:
             json.dump(perf, f, indent=1)
         gain_chart.write_html(
             _opath(ctx.path_finder.gain_chart_path(ec.name, "html")),

@@ -15,6 +15,8 @@ import time
 
 from shifu_tpu.processor.base import ProcessorContext
 
+from shifu_tpu.resilience import atomic_write
+
 log = logging.getLogger("shifu_tpu")
 
 COLUMNSTATS_FIELDS = [
@@ -77,7 +79,7 @@ def _run_writer(ctx: ProcessorContext, et: str, export_type: str,
 def _export_columnstats(ctx: ProcessorContext) -> str:
     out = ctx.path_finder.column_stats_export_path()
     ctx.path_finder.ensure(out)
-    with open(out, "w") as f:
+    with atomic_write(out) as f:
         f.write(",".join(COLUMNSTATS_FIELDS) + "\n")
         for cc in ctx.column_configs:
             st = cc.columnStats
@@ -116,7 +118,7 @@ def _export_pmml(ctx: ProcessorContext) -> str:
         out = ctx.path_finder.pmml_path(i)
         ctx.path_finder.ensure(out)
         out_dir = os.path.dirname(out)
-        with open(out, "w") as f:
+        with atomic_write(out) as f:
             f.write(pmml_mod.to_string(root))
         log.info("pmml: %s → %s", os.path.basename(p), out)
     return out_dir
@@ -177,7 +179,7 @@ def _export_bagging_pmml(ctx: ProcessorContext) -> str:
     out = os.path.join(ctx.path_finder.root, "pmmls",
                        f"{ctx.model_config.model_set_name}.pmml")
     ctx.path_finder.ensure(out)
-    with open(out, "w") as f:
+    with atomic_write(out) as f:
         f.write(pmml_mod.to_string(root))
     log.info("baggingpmml: %d bag(s) → %s", len(members), out)
     return out
@@ -212,7 +214,7 @@ def _export_woe_info(ctx: ProcessorContext) -> str:
         lines.append(f"MISSING\t{woes[-1]}")
         lines.append("")
     out = os.path.join(ctx.path_finder.root, "varwoe_info.txt")
-    with open(out, "w") as f:
+    with atomic_write(out) as f:
         f.write("\n".join(lines) + ("\n" if lines else ""))
     return out
 
@@ -251,7 +253,7 @@ def _export_ume(ctx: ProcessorContext, et: str) -> int:
 
 def _export_woemapping(ctx: ProcessorContext) -> str:
     out = os.path.join(ctx.path_finder.root, "woemapping.csv")
-    with open(out, "w") as f:
+    with atomic_write(out) as f:
         f.write("columnName,binIndex,binLow/category,binCountWoe,"
                 "binWeightedWoe\n")
         for cc in ctx.column_configs:

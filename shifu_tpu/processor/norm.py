@@ -30,7 +30,8 @@ from shifu_tpu.data.reader import read_raw_table
 from shifu_tpu.ops.normalize import (build_categorical_table,
                                      build_numeric_table, normalize_dataset,
                                      NormResult)
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
+from shifu_tpu.resilience import atomic_path, atomic_write
 
 log = logging.getLogger("shifu_tpu")
 
@@ -235,31 +236,37 @@ def save_normalized(path: str, result: NormResult, tags: np.ndarray,
 
 def _write_normalized(path, result, dense, index, tags, weights,
                       task_tags, extra, ptype, streaming, shuffle_seed):
-    np.savez_compressed(
-        os.path.join(path, "data.npz"),
-        dense=dense, index=index,
-        tags=tags.astype(np.float32), weights=weights.astype(np.float32),
-        **extra)
+    # every block stages through a dot-prefixed temp + atomic rename,
+    # and meta.json (the file every reader opens first) publishes LAST
+    # — a kill mid-write leaves either the complete old layout or no
+    # readable layout, never a meta that points at truncated blocks
+    with atomic_path(os.path.join(path, "data.npz")) as tmp:
+        np.savez_compressed(
+            tmp, dense=dense, index=index,
+            tags=tags.astype(np.float32),
+            weights=weights.astype(np.float32), **extra)
     if streaming:
         # FLOAT16 stores the streaming block as REAL f16: dense was
         # already rounded through half precision, so the bytes halve
         # (disk AND host→device chunk transfer) with zero value change;
         # the streaming trainer widens to f32 on device
-        np.save(os.path.join(path, "dense.npy"),
-                np.ascontiguousarray(dense.astype(np.float16)
-                                     if ptype == "FLOAT16" else dense))
-        np.save(os.path.join(path, "tags.npy"), tags.astype(np.float32))
-        np.save(os.path.join(path, "weights.npy"),
-                weights.astype(np.float32))
+        with atomic_path(os.path.join(path, "dense.npy")) as tmp:
+            np.save(tmp, np.ascontiguousarray(
+                dense.astype(np.float16) if ptype == "FLOAT16" else dense))
+        with atomic_path(os.path.join(path, "tags.npy")) as tmp:
+            np.save(tmp, tags.astype(np.float32))
+        with atomic_path(os.path.join(path, "weights.npy")) as tmp:
+            np.save(tmp, weights.astype(np.float32))
         if index.size:
             # tree trainers also stream the categorical code block
-            np.save(os.path.join(path, "index.npy"),
-                    np.ascontiguousarray(index.astype(np.int32)))
+            with atomic_path(os.path.join(path, "index.npy")) as tmp:
+                np.save(tmp, np.ascontiguousarray(index.astype(np.int32)))
         if task_tags is not None and task_tags.size:
             # MTL streams its (R, T) per-task tag block too
-            np.save(os.path.join(path, "task_tags.npy"),
-                    np.ascontiguousarray(task_tags.astype(np.float32)))
-    with open(os.path.join(path, "meta.json"), "w") as f:
+            with atomic_path(os.path.join(path, "task_tags.npy")) as tmp:
+                np.save(tmp, np.ascontiguousarray(
+                    task_tags.astype(np.float32)))
+    with atomic_write(os.path.join(path, "meta.json")) as f:
         json.dump({"denseNames": result.dense_names,
                    "indexNames": result.index_names,
                    "indexVocabSizes": result.index_vocab_sizes,
@@ -284,6 +291,16 @@ def load_normalized(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
 
 def run(ctx: ProcessorContext,
         dataset: Optional[ColumnarDataset] = None) -> int:
+    with step_guard(ctx, "norm", outputs=[
+            os.path.join(ctx.path_finder.normalized_data_path(),
+                         "meta.json")]) as go:
+        if not go:
+            return 0
+        return _run(ctx, dataset)
+
+
+def _run(ctx: ProcessorContext,
+         dataset: Optional[ColumnarDataset] = None) -> int:
     t0 = time.time()
     mc = ctx.model_config
     ctx.validate(ModelStep.NORMALIZE)

@@ -253,7 +253,8 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
             (clean_dir, (probe.num_names, probe.cat_names,
                          [int(v) + 1 for v in vlen]), None)):
         dn, ixn, ivs = names
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        from shifu_tpu.resilience import atomic_write
+        with atomic_write(os.path.join(path, "meta.json")) as f:
             json.dump({"denseNames": list(dn), "indexNames": list(ixn),
                        "indexVocabSizes": list(ivs),
                        "precisionType": ptype, "streaming": True,

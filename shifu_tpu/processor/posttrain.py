@@ -106,7 +106,8 @@ def run(ctx: ProcessorContext) -> int:
 
     importance = fi.finalize()
     out = os.path.join(ctx.path_finder.root, "featureimportance.csv")
-    with open(out, "w") as f:
+    from shifu_tpu.resilience import atomic_write
+    with atomic_write(out) as f:
         f.write("column,importance\n")
         for name, v in sorted(importance.items(), key=lambda kv: -kv[1]):
             f.write(f"{name},{v:.8g}\n")

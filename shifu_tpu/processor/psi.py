@@ -147,7 +147,8 @@ def run(ctx: ProcessorContext) -> int:
     from shifu_tpu.parallel import dist
     with dist.single_writer("psi") as w:
         if w:   # identical rows on every host; one pen
-            with open(out, "w") as f:
+            from shifu_tpu.resilience import atomic_write
+            with atomic_write(out) as f:
                 f.write("column,psi," + ",".join(uniq) + "\n")
                 f.write("\n".join(rows) + "\n")
     ctx.save_column_configs()

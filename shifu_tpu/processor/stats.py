@@ -31,13 +31,22 @@ from shifu_tpu.data.purifier import DataPurifier
 from shifu_tpu.data.reader import read_raw_table
 from shifu_tpu.ops import stats as stats_ops
 from shifu_tpu.ops.binning import (cap_categories, compute_numeric_binning)
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
 
 log = logging.getLogger("shifu_tpu")
 
 
 def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
         seed: int = 12306) -> int:
+    with step_guard(ctx, "stats", outputs=[
+            ctx.path_finder.column_config_path()]) as go:
+        if not go:
+            return 0
+        return _run(ctx, dataset, seed)
+
+
+def _run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
+         seed: int = 12306) -> int:
     t0 = time.time()
     mc = ctx.model_config
     ctx.validate(ModelStep.STATS)

@@ -420,7 +420,8 @@ def _train_dense_ovr(ctx: ProcessorContext, x: np.ndarray, y: np.ndarray,
     # per-class validation curves, one entry per class model
     vpath = ctx.path_finder.val_error_path()
     ctx.path_finder.ensure(vpath)
-    with open(vpath, "w") as f:
+    from shifu_tpu.resilience import atomic_write
+    with atomic_write(vpath) as f:
         json.dump({"bestValError": [float(r.best_val.min()) for r in results],
                    "bestEpoch": [int(r.best_epoch[0]) for r in results],
                    "wallSeconds": sum(r.wall_seconds for r in results),
@@ -431,7 +432,8 @@ def _train_dense_ovr(ctx: ProcessorContext, x: np.ndarray, y: np.ndarray,
 def _write_val_errors(ctx: ProcessorContext, res: TrainResult) -> None:
     path = ctx.path_finder.val_error_path()
     ctx.path_finder.ensure(path)
-    with open(path, "w") as f:
+    from shifu_tpu.resilience import atomic_write
+    with atomic_write(path) as f:
         json.dump({"bestValError": [float(v) for v in res.best_val],
                    "bestEpoch": [int(e) for e in res.best_epoch],
                    "wallSeconds": res.wall_seconds}, f, indent=1)

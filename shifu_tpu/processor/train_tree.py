@@ -340,7 +340,8 @@ def _run_tree_streaming(ctx: ProcessorContext, seed: int):
             bins_mm[a:b] = gbdt.bin_dataset(tables, d_c, c_c,
                                             n_bins).astype(dtype)
         bins_mm.flush()
-        with open(bins_meta_path, "w") as f:
+        from shifu_tpu.resilience import atomic_write
+        with atomic_write(bins_meta_path) as f:
             json.dump({"key": bins_key, "rows": n_rows, "cols": n_cols,
                        "nBins": n_bins, "dtype": str(np.dtype(dtype))},
                       f)

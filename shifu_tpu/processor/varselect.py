@@ -276,10 +276,11 @@ def _filter_by_sensitivity(ctx: ProcessorContext,
         var = float(np.var(np.asarray(base)[:n_real])) or 1.0
         per_col = {k: v / var for k, v in per_col.items()}
 
+    from shifu_tpu.resilience import atomic_write
     se_path = ctx.path_finder.se_path(0)
     ctx.path_finder.ensure(se_path)
     ranked = sorted(per_col.items(), key=lambda kv: -kv[1])
-    with open(se_path, "w") as f:
+    with atomic_write(se_path) as f:
         samp = getattr(ctx, "_analysis_frame", None)
         if samp is not None:
             # the one analysis step still allowed to sample (ablation

@@ -1,0 +1,334 @@
+"""Resilience layer — retrying remote I/O, atomic publication, faults.
+
+The reference gets fault tolerance from its substrate: YARN reschedules
+failed containers, Guagua masters recover iteration state, and every
+step syncs configs to HDFS so a re-run picks up where it left off
+(`NNMaster.initOrRecoverParams`, `DTMaster` checkpoints). The JAX SPMD
+rebuild has no such substrate, so this module supplies the three
+primitives every layer threads through:
+
+1. **Bounded retry with backoff** (`retrying` / `retry`): remote-FS
+   operations (`data/fs.py`, remote reads in `data/reader.py`) survive
+   transient flakes. Errors are classified transient vs permanent —
+   a missing fsspec backend, a missing file, or a permission error is
+   NOT retried. Knobs (defaults keep behavior unchanged when no
+   faults occur):
+
+   - ``SHIFU_TPU_RETRY_ATTEMPTS`` (default 4) — max attempts per call
+   - ``SHIFU_TPU_RETRY_BASE_S``   (default 0.05) — first backoff delay
+   - ``SHIFU_TPU_RETRY_MAX_S``    (default 2.0) — backoff cap
+
+   Each retry logs the site, attempt count and delay; exhausting the
+   budget re-raises the last error.
+
+2. **Atomic publication** (`atomic_write` / `atomic_path`): step
+   outputs are written to a dot-prefixed temp name in the target
+   directory and ``os.replace``d into place, so a kill mid-write never
+   leaves a half-written file under the real name (part-file listers
+   skip dot-prefixed names by convention). The single-filesystem
+   analog of the reference's write-to-tmp-then-HDFS-rename.
+
+3. **Deterministic fault injection** (`fault_point`): the env spec
+
+       SHIFU_TPU_FAULT=<site>:<kind>:<nth>[;<site>:<kind>:<nth>...]
+
+   makes an instrumented site misbehave on specific calls. ``kind`` is
+   ``oserror`` | ``timeout`` (raise OSError / TimeoutError) or
+   ``kill`` (SIGKILL the process — a real mid-step crash). ``nth`` is
+   a 1-based per-site call counter: ``2`` fires on exactly the 2nd
+   call, ``1-3`` on calls 1..3, ``2+`` on every call from the 2nd on.
+   Instrumented sites: ``fs.exists``, ``fs.size``, ``fs.list``,
+   ``fs.open``, ``reader.read``, ``reader.native``, ``ckpt.save``,
+   ``ckpt.saved``, ``ckpt.restore``, ``atomic.commit``, and
+   ``step.<name>`` at each processor step's start. Fault points sit
+   INSIDE the retry loop, so an injected transient fault exercises the
+   real retry path. Unset (the default) this is dead code.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import logging
+import os
+import random
+import re
+import shutil
+import signal
+import time
+from contextlib import contextmanager
+from typing import Callable, Iterable, List, NamedTuple, Optional
+
+log = logging.getLogger("shifu_tpu")
+
+# ---------------------------------------------------------------------------
+# transient-vs-permanent classification
+# ---------------------------------------------------------------------------
+
+# OSError subclasses that signal a durable condition a retry cannot fix
+_PERMANENT_OSERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                       NotADirectoryError, FileExistsError)
+
+# non-stdlib exception type names treated as transient without importing
+# their (optional) packages: fsspec/aiohttp/botocore timeouts and
+# throttles surface under these names
+_TRANSIENT_NAMES = frozenset({
+    "FSTimeoutError", "ServerTimeoutError", "ClientError",
+    "ClientConnectorError", "ClientOSError", "ReadTimeoutError",
+    "ConnectTimeoutError", "IncompleteReadError", "EndpointConnectionError",
+    "SlowDown", "ThrottlingException",
+})
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether a retry could plausibly succeed. Permanent conditions —
+    missing file/backend, bad permissions, value errors — return False
+    and propagate immediately."""
+    if isinstance(exc, _PERMANENT_OSERRORS):
+        return False
+    if isinstance(exc, (TimeoutError, ConnectionError, InterruptedError,
+                        OSError)):
+        return True
+    return type(exc).__name__ in _TRANSIENT_NAMES
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class _FaultRule(NamedTuple):
+    site: str
+    kind: str       # oserror | timeout | kill
+    lo: int
+    hi: float       # inclusive; inf for "N+"
+
+
+_NTH_RE = re.compile(r"^(\d+)(\+|-(\d+))?$")
+_rules_cache: tuple = ("", [])
+# per-site call counters — process-wide so the Nth call is the Nth call
+# across retries too (an injected fault on call 1 is gone by call 2,
+# which is exactly a transient flake)
+_counts: collections.Counter = collections.Counter()
+
+
+def reset_faults() -> None:
+    """Reset per-site call counters (test isolation)."""
+    _counts.clear()
+
+
+def _parse_fault_spec(raw: str) -> List[_FaultRule]:
+    rules = []
+    for part in re.split(r"[;,]", raw):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) != 3:
+            raise ValueError(
+                f"bad SHIFU_TPU_FAULT entry {part!r}: want "
+                "<site>:<kind>:<nth> (nth = N | N-M | N+)")
+        site, kind, nth = bits
+        kind = kind.lower()
+        if kind not in ("oserror", "timeout", "kill"):
+            raise ValueError(f"bad SHIFU_TPU_FAULT kind {kind!r}: want "
+                             "oserror | timeout | kill")
+        m = _NTH_RE.match(nth.strip())
+        if not m:
+            raise ValueError(f"bad SHIFU_TPU_FAULT nth {nth!r}: want "
+                             "N | N-M | N+")
+        lo = int(m.group(1))
+        hi = float("inf") if m.group(2) == "+" else \
+            int(m.group(3)) if m.group(3) else lo
+        rules.append(_FaultRule(site.strip(), kind, lo, hi))
+    return rules
+
+
+def fault_point(site: str) -> None:
+    """Instrumentation seam: no-op unless SHIFU_TPU_FAULT names `site`."""
+    global _rules_cache
+    raw = os.environ.get("SHIFU_TPU_FAULT", "")
+    if not raw:
+        return
+    if _rules_cache[0] != raw:
+        _rules_cache = (raw, _parse_fault_spec(raw))
+    rules = [r for r in _rules_cache[1] if r.site == site]
+    if not rules:
+        return
+    _counts[site] += 1
+    n = _counts[site]
+    for r in rules:
+        if r.lo <= n <= r.hi:
+            if r.kind == "kill":
+                log.error("fault injection: SIGKILL at %s (call %d)",
+                          site, n)
+                os.kill(os.getpid(), signal.SIGKILL)
+            exc = TimeoutError if r.kind == "timeout" else OSError
+            raise exc(f"injected {r.kind} at {site} (call {n})")
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def retrying(site: str, fn: Callable, *args, **kwargs):
+    """Call `fn(*args, **kwargs)` with bounded exponential-backoff
+    retries on transient errors. The site's fault point fires before
+    every attempt, so injected faults go through the real loop."""
+    attempts = max(_env_int("SHIFU_TPU_RETRY_ATTEMPTS", 4), 1)
+    base = _env_float("SHIFU_TPU_RETRY_BASE_S", 0.05)
+    cap = _env_float("SHIFU_TPU_RETRY_MAX_S", 2.0)
+    for attempt in range(1, attempts + 1):
+        try:
+            fault_point(site)
+            return fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if attempt >= attempts or not is_transient(e):
+                raise
+            delay = min(cap, base * 2 ** (attempt - 1))
+            delay *= 0.5 + random.random()  # jitter: 0.5x..1.5x
+            log.warning("%s: transient %s (attempt %d/%d), retrying in "
+                        "%.2fs: %s", site, type(e).__name__, attempt,
+                        attempts, delay, e)
+            time.sleep(delay)
+
+
+def retry(site: str):
+    """Decorator form of `retrying`."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retrying(site, fn, *args, **kwargs)
+        return wrapped
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# atomic publication
+# ---------------------------------------------------------------------------
+
+def _tmp_name(path: str) -> str:
+    """Dot-prefixed sibling temp name that PRESERVES the extension
+    (np.save/np.savez append .npy/.npz to names missing it, and
+    part-file listers skip dot-prefixed basenames)."""
+    d, base = os.path.split(path)
+    return os.path.join(d, f".tmp.{os.getpid()}.{base}")
+
+
+def _scrub(tmp: str) -> None:
+    try:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        elif os.path.exists(tmp):
+            os.remove(tmp)
+    except OSError:  # pragma: no cover - best-effort cleanup
+        pass
+
+
+@contextmanager
+def atomic_path(path: str):
+    """Yield a temp path; on clean exit, ``os.replace`` it onto `path`
+    (after removing a same-named directory, which replace can't
+    overwrite). On error the temp is scrubbed and nothing under the
+    real name changes."""
+    tmp = _tmp_name(path)
+    _scrub(tmp)
+    try:
+        yield tmp
+        fault_point("atomic.commit")
+        if os.path.isdir(path) and os.path.isdir(tmp):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+    except BaseException:
+        _scrub(tmp)
+        raise
+
+
+@contextmanager
+def atomic_write(path: str, mode: str = "w", **open_kwargs):
+    """``open()``-shaped atomic file write: the handle points at a temp
+    file that is fsynced and renamed onto `path` only on clean exit.
+    ``os.devnull`` (multi-host non-writer outputs) passes through."""
+    if path == os.devnull:
+        with open(path, mode, **open_kwargs) as f:
+            yield f
+        return
+    tmp = _tmp_name(path)
+    _scrub(tmp)
+    f = open(tmp, mode, **open_kwargs)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        fault_point("atomic.commit")
+        os.replace(tmp, path)
+    except BaseException:
+        if not f.closed:
+            f.close()
+        _scrub(tmp)
+        raise
+
+
+class AtomicFile:
+    """Atomic write with EXPLICIT commit — for writers whose lifetime
+    spans a streaming loop (`eval`'s chunked EvalScore.csv): the caller
+    closes with ``commit=False`` on failure and the temp vanishes, so a
+    killed step never leaves a truncated file under the real name."""
+
+    def __init__(self, path: str, mode: str = "w"):
+        self.path = path
+        self._passthrough = path == os.devnull
+        self._tmp = path if self._passthrough else _tmp_name(path)
+        if not self._passthrough:
+            _scrub(self._tmp)
+        self._f = open(self._tmp, mode)
+
+    def write(self, data):
+        return self._f.write(data)
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self, commit: bool = True) -> None:
+        if self._f.closed:
+            return
+        self._f.flush()
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:  # devnull/odd FDs
+            pass
+        self._f.close()
+        if self._passthrough:
+            return
+        if commit:
+            fault_point("atomic.commit")
+            os.replace(self._tmp, self.path)
+        else:
+            _scrub(self._tmp)
+
+
+def sweep_stale_tmp(directory: str) -> int:
+    """Remove leftover ``.tmp.*`` files/dirs from killed earlier runs
+    (they are invisible to readers but accumulate). Returns count."""
+    n = 0
+    if not os.path.isdir(directory):
+        return 0
+    for name in os.listdir(directory):
+        if name.startswith(".tmp."):
+            _scrub(os.path.join(directory, name))
+            n += 1
+    return n

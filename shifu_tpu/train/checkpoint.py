@@ -9,6 +9,15 @@ counters, epoch cursor — is one pytree saved with orbax every
 `checkpoint_interval` epochs. A restarted run restores it and continues
 the epoch scan exactly where it stopped; there is no separate master /
 worker recovery because the SPMD program has no master.
+
+Crash safety: saves stage to a `.tmp` sibling and `os.replace` into the
+`step_N` name, so a kill mid-save never corrupts the published
+checkpoint; `restore_latest` walks steps newest-first and falls back
+past any truncated/unreadable `step_N` (a kill can still land between
+orbax's internal file writes on filesystems without atomic dir rename).
+Fault-injection sites: `ckpt.save` (before staging — a kill here loses
+nothing), `ckpt.saved` (after publication — a kill here is the
+"crash right after checkpoint N" case), `ckpt.restore`.
 """
 
 from __future__ import annotations
@@ -16,10 +25,12 @@ from __future__ import annotations
 import logging
 import os
 import shutil
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
+
+from shifu_tpu.resilience import fault_point, sweep_stale_tmp
 
 log = logging.getLogger("shifu_tpu")
 
@@ -33,8 +44,10 @@ except Exception:  # pragma: no cover - orbax is in the base image
 def save_state(ckpt_dir: str, step: int, state: Any) -> None:
     """Write training state for `step` (epoch count done), replacing any
     older checkpoint (the reference keeps only the latest tmp model)."""
+    fault_point("ckpt.save")
     ckpt_dir = os.path.abspath(ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
+    sweep_stale_tmp(ckpt_dir)
     path = os.path.join(ckpt_dir, f"step_{step}")
     if _HAVE_ORBAX:
         ckptr = ocp.PyTreeCheckpointer()
@@ -54,24 +67,36 @@ def save_state(ckpt_dir: str, step: int, state: Any) -> None:
             full = os.path.join(ckpt_dir, old)
             shutil.rmtree(full, ignore_errors=True) if os.path.isdir(full) \
                 else os.remove(full)
+    fault_point("ckpt.saved")
+
+
+def _step_names(ckpt_dir: str) -> List[Tuple[int, str]]:
+    """(step, name) for every published step_* entry, `.tmp` staging and
+    dot-prefixed temp files excluded."""
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if not name.startswith("step_") or name.endswith(".tmp"):
+            continue
+        try:
+            out.append((int(name.split("_")[1].split(".")[0]), name))
+        except ValueError:
+            pass
+    return out
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
-    steps = []
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("step_") and not name.endswith(".tmp"):
-            try:
-                steps.append(int(name.split("_")[1].split(".")[0]))
-            except ValueError:
-                pass
+    steps = [s for s, _ in _step_names(ckpt_dir)]
     return max(steps) if steps else None
 
 
 def restore_state(ckpt_dir: str, step: int, like: Any) -> Any:
     """Restore the state pytree saved at `step`; `like` provides the
-    target structure/dtypes."""
+    target structure/dtypes. Raises (FileNotFoundError or the backend's
+    error) when the checkpoint is missing or unreadable — use
+    `restore_latest` to fall back to an earlier one."""
+    fault_point("ckpt.restore")
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
     if _HAVE_ORBAX and os.path.isdir(path):
         ckptr = ocp.PyTreeCheckpointer()
@@ -79,3 +104,35 @@ def restore_state(ckpt_dir: str, step: int, like: Any) -> Any:
     from shifu_tpu.models.spec import load_model
     _, _, state = load_model(path + ".npz")
     return state
+
+
+def restore_latest(ckpt_dir: str, like: Union[Any, Callable[[int], Any]],
+                   max_step: Optional[int] = None
+                   ) -> Optional[Tuple[int, Any]]:
+    """Restore the newest usable checkpoint, skipping truncated/corrupt
+    `step_N` entries with a warning instead of crashing the resume.
+
+    `like` is the target pytree, or a callable `step -> pytree` when the
+    restored shapes depend on the step (streaming's per-epoch error
+    logs). Steps outside `0 < step <= max_step` are ignored (a stale
+    checkpoint from a longer previous run must not skip training).
+    Returns `(step, state)` or None when nothing usable exists."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    candidates = sorted({s for s, _ in _step_names(ckpt_dir)
+                         if s > 0 and (max_step is None or s <= max_step)},
+                        reverse=True)
+    for step in candidates:
+        want = like(step) if callable(like) else like
+        try:
+            return step, restore_state(ckpt_dir, step, want)
+        except Exception as e:  # noqa: BLE001 - any unreadable ckpt
+            log.warning(
+                "checkpoint step_%d in %s unreadable (%s: %s); falling "
+                "back to the previous checkpoint", step, ckpt_dir,
+                type(e).__name__, e)
+    if candidates:
+        log.warning("no usable checkpoint in %s (%d candidate(s) all "
+                    "unreadable); starting from scratch", ckpt_dir,
+                    len(candidates))
+    return None

@@ -401,46 +401,45 @@ def train_streaming_core(train_conf: ModelTrainConf,
     # NUMBER, so a restored run replays the exact schedule
     if checkpoint_dir and checkpoint_interval > 0:
         from shifu_tpu.train import checkpoint as ckpt_mod
-        step = ckpt_mod.latest_step(checkpoint_dir)
-        if n_proc > 1:
-            # every process must agree on the resume epoch or they
-            # issue different collective counts and deadlock — host 0
-            # (the writer) decides (non-shared checkpoint dirs leave
-            # other hosts empty-handed)
-            from jax.experimental import multihost_utils
-            step = int(multihost_utils.broadcast_one_to_all(
-                np.int64(step if step is not None else -1)))
-            if step < 0:
-                step = None
-        if step is not None and step > train_conf.numTrainEpochs:
-            # a larger previous epoch budget: state beyond this run's
-            # schedule — start fresh (resident guard: 0 < last <= n)
-            log.warning("streaming train: ignoring checkpoint at epoch "
-                        "%d beyond numTrainEpochs=%d", step,
-                        train_conf.numTrainEpochs)
-            step = None
-        if step is not None and step > 0:
-            like = {"stacked": stacked, "opt_state": opt_state,
+
+        def _like(step):
+            # restored shapes depend on the resume epoch (per-epoch
+            # error logs); checkpoints beyond numTrainEpochs are
+            # filtered by max_step (a larger previous epoch budget
+            # must not skip this run's training)
+            return {"stacked": stacked, "opt_state": opt_state,
                     "best": best, "best_val": best_val,
                     "best_epoch": best_epoch, "bad": bad,
                     "stopped": stopped,
                     "train_errs": np.zeros((step, n_bags), np.float32),
                     "val_errs": np.zeros((step, n_bags), np.float32)}
-            if n_proc > 1:
-                # only host 0 ever WRITES checkpoints, so only its
-                # files are authoritative — a matching step number on
-                # another host can only be a stale leftover from an
-                # earlier run (non-shared dirs), and restoring it
-                # per-host would silently diverge the replicated
-                # state. Host 0 restores; everyone gets its pytree via
-                # a one-time startup broadcast.
-                from jax.experimental import multihost_utils
-                st = (ckpt_mod.restore_state(checkpoint_dir, step, like)
-                      if proc == 0
-                      else jax.tree.map(np.asarray, like))
+
+        if n_proc > 1:
+            # only host 0 ever WRITES checkpoints, so only its files
+            # are authoritative (a matching step on another host can
+            # only be a stale leftover, and restoring it per-host
+            # would silently diverge the replicated state) — host 0
+            # picks the newest USABLE step (skipping truncated ones),
+            # then every process must agree on the resume epoch or
+            # they issue different collective counts and deadlock:
+            # broadcast the resolved step, then the restored pytree.
+            from jax.experimental import multihost_utils
+            restored = ckpt_mod.restore_latest(
+                checkpoint_dir, _like,
+                max_step=train_conf.numTrainEpochs) if proc == 0 else None
+            step = int(multihost_utils.broadcast_one_to_all(
+                np.int64(restored[0] if restored else -1)))
+            st = None
+            if step > 0:
+                st = restored[1] if proc == 0 \
+                    else jax.tree.map(np.asarray, _like(step))
                 st = multihost_utils.broadcast_one_to_all(st)
-            else:
-                st = ckpt_mod.restore_state(checkpoint_dir, step, like)
+        else:
+            restored = ckpt_mod.restore_latest(
+                checkpoint_dir, _like,
+                max_step=train_conf.numTrainEpochs)
+            step, st = restored if restored is not None else (-1, None)
+        if st is not None:
             stacked = mesh_mod.place_replicated(
                 mesh, jax.tree.map(jnp.asarray, st["stacked"]))
             opt_state = mesh_mod.place_replicated(

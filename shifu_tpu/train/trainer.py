@@ -115,7 +115,20 @@ def bagging_weights(n: int, n_bags: int, sample_rate: float,
             w = np.ones((n_bags, n), np.float32)
             w[:, neg] = rng.random((n_bags, n_neg)) < sample_rate
         return _rescue_empty_bags(w)
-    if stratified and labels is not None and sample_rate < 1.0:
+    if stratified and labels is not None:
+        if sample_rate >= 1.0 and not with_replacement:
+            if n_bags == 1:
+                # keep-all IS the perfect stratified sample at rate 1.0
+                return np.ones((1, n), np.float32)
+            # N identical full-data bags are useless (same degrade as
+            # the unstratified branch below) — use a BALANCED bootstrap:
+            # per-class draws with replacement keep each bag's class mix
+            # fixed instead of silently dropping stratification
+            log.warning(
+                "stratifiedSample with baggingSampleRate >= 1.0 and "
+                "%d bags: using per-class balanced bootstrap (draw with "
+                "replacement within each class)", n_bags)
+            with_replacement = True
         lab = np.asarray(labels)
         w = np.zeros((n_bags, n), np.float32)
         valid = ~np.isnan(lab)
@@ -408,9 +421,10 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
     tr_chunks, va_chunks = [], []
     if checkpoint_dir and checkpoint_interval > 0:
         from shifu_tpu.train import checkpoint as ckpt
-        last = ckpt.latest_step(checkpoint_dir)
-        if last is not None and 0 < last <= n_epochs:
-            carry = ckpt.restore_state(checkpoint_dir, last, carry)
+        restored = ckpt.restore_latest(checkpoint_dir, carry,
+                                       max_step=n_epochs)
+        if restored is not None:
+            last, carry = restored
             carry = jax.tree.map(jnp.asarray, carry)
             done = last
             log.info("checkpoint: resumed at epoch %d from %s", last,

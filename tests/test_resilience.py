@@ -1,0 +1,367 @@
+"""Resilience layer tests — retry/backoff classification, deterministic
+fault injection (SHIFU_TPU_FAULT), atomic publication, per-step
+manifests, and the crash/resume story end to end (SIGKILL a real
+subprocess mid-step, restart, verify nothing corrupt and results match
+an uninterrupted run)."""
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shifu_tpu import resilience
+from shifu_tpu.data import fs as fs_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_counters():
+    resilience.reset_faults()
+    yield
+    resilience.reset_faults()
+
+
+# ---------------------------------------------------------------------------
+# classification + fault-spec parsing units
+# ---------------------------------------------------------------------------
+
+def test_is_transient_classification():
+    assert not resilience.is_transient(FileNotFoundError("gone"))
+    assert not resilience.is_transient(PermissionError("denied"))
+    assert not resilience.is_transient(IsADirectoryError("dir"))
+    assert not resilience.is_transient(ValueError("bad value"))
+    assert not resilience.is_transient(RuntimeError("no backend"))
+    assert resilience.is_transient(TimeoutError("slow"))
+    assert resilience.is_transient(ConnectionError("reset"))
+    assert resilience.is_transient(OSError("flake"))
+
+    class FSTimeoutError(Exception):  # fsspec's name, matched by name
+        pass
+
+    assert resilience.is_transient(FSTimeoutError("remote timeout"))
+
+
+def test_fault_spec_parsing():
+    rules = resilience._parse_fault_spec(
+        "a.b:oserror:1; c:timeout:2-5,d:kill:3+")
+    assert [(r.site, r.kind, r.lo, r.hi) for r in rules] == [
+        ("a.b", "oserror", 1, 1),
+        ("c", "timeout", 2, 5),
+        ("d", "kill", 3, float("inf")),
+    ]
+
+
+@pytest.mark.parametrize("bad", ["a:oserror", "a:frobnicate:1",
+                                 "a:oserror:x", "a:oserror:1-"])
+def test_fault_spec_parsing_rejects(bad):
+    with pytest.raises(ValueError, match="SHIFU_TPU_FAULT"):
+        resilience._parse_fault_spec(bad)
+
+
+def test_fault_point_counts_per_site(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "u.two:oserror:2;u.rng:timeout:1-2")
+    resilience.fault_point("u.two")                       # call 1: ok
+    with pytest.raises(OSError, match="injected oserror at u.two"):
+        resilience.fault_point("u.two")                   # call 2: boom
+    resilience.fault_point("u.two")                       # call 3: ok again
+    for _ in range(2):                                    # range form
+        with pytest.raises(TimeoutError, match="injected timeout"):
+            resilience.fault_point("u.rng")
+    resilience.fault_point("u.rng")                       # call 3: past range
+    resilience.fault_point("u.unlisted")                  # other sites: no-op
+
+
+# ---------------------------------------------------------------------------
+# retry loop
+# ---------------------------------------------------------------------------
+
+def test_retry_recovers_from_injected_transient(monkeypatch, caplog):
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "u.once:oserror:1")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.001")
+    calls = {"n": 0}
+
+    def work():
+        calls["n"] += 1
+        return "ok"
+
+    with caplog.at_level(logging.WARNING, logger="shifu_tpu"):
+        assert resilience.retrying("u.once", work) == "ok"
+    assert calls["n"] == 1  # fault fired before attempt 1 reached work
+    assert any("u.once" in r.getMessage() and "retrying" in r.getMessage()
+               for r in caplog.records)
+
+
+def test_retry_gives_up_after_budget(monkeypatch, caplog):
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "u.always:timeout:1+")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.001")
+    with caplog.at_level(logging.WARNING, logger="shifu_tpu"):
+        with pytest.raises(TimeoutError, match="injected timeout"):
+            resilience.retrying("u.always", lambda: "never")
+    # observable: attempts-1 retry warnings, then the re-raise
+    retries = [r for r in caplog.records if "retrying" in r.getMessage()]
+    assert len(retries) == 2
+
+
+def test_permanent_errors_not_retried(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.001")
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("really gone")
+
+    with pytest.raises(FileNotFoundError):
+        resilience.retrying("u.perm", missing)
+    assert calls["n"] == 1
+
+
+def test_missing_backend_is_permanent():
+    # unknown scheme → RuntimeError naming the missing backend, raised
+    # immediately (no retry sleeps — the test finishing fast IS the
+    # assertion that nothing backed off)
+    with pytest.raises(RuntimeError, match="backend"):
+        fs_mod.exists("no-such-scheme-zz://bucket/key")
+
+
+def test_remote_fs_flake_retried_through_real_call(monkeypatch, caplog):
+    """An injected flake on the instrumented fs.exists site is retried
+    and the memory:// call then succeeds — the end-to-end remote-FS
+    retry path."""
+    fsspec = pytest.importorskip("fsspec")
+    mem = fsspec.filesystem("memory")
+    with mem.open("/resil/a.txt", "w") as f:
+        f.write("hi")
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "fs.exists:oserror:1")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.001")
+    with caplog.at_level(logging.WARNING, logger="shifu_tpu"):
+        assert fs_mod.exists("memory://resil/a.txt")
+    assert any("fs.exists" in r.getMessage() and "retrying" in r.getMessage()
+               for r in caplog.records)
+    # and a permanently-missing file still reports False, not an error
+    assert not fs_mod.exists("memory://resil/never-written.txt")
+
+
+# ---------------------------------------------------------------------------
+# atomic publication
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_publishes_on_success(tmp_path):
+    p = str(tmp_path / "out.json")
+    with resilience.atomic_write(p) as f:
+        json.dump({"ok": 1}, f)
+    assert json.load(open(p)) == {"ok": 1}
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp.")]
+
+
+def test_atomic_write_failure_preserves_old_content(tmp_path):
+    p = str(tmp_path / "out.json")
+    with open(p, "w") as f:
+        f.write('{"old": true}')
+    with pytest.raises(RuntimeError):
+        with resilience.atomic_write(p) as f:
+            f.write('{"new": tru')  # partial...
+            raise RuntimeError("writer died")
+    assert json.load(open(p)) == {"old": True}
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp.")]
+
+
+def test_atomic_path_keeps_extension_for_numpy(tmp_path):
+    p = str(tmp_path / "arr.npz")
+    with resilience.atomic_path(p) as tmp:
+        assert tmp.endswith(".npz")  # savez must not append a 2nd one
+        np.savez(tmp, a=np.arange(3))
+    with np.load(p) as z:
+        np.testing.assert_array_equal(z["a"], np.arange(3))
+
+
+def test_atomic_path_replaces_directory_target(tmp_path):
+    target = tmp_path / "bundle"
+    target.mkdir()
+    (target / "stale.txt").write_text("old")
+    with resilience.atomic_path(str(target)) as tmp:
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "fresh.txt"), "w") as f:
+            f.write("new")
+    assert sorted(os.listdir(target)) == ["fresh.txt"]
+
+
+def test_atomic_file_explicit_commit(tmp_path):
+    p = str(tmp_path / "scores.csv")
+    f = resilience.AtomicFile(p)
+    f.write("a,b\n")
+    f.close(commit=False)  # failed streaming run: nothing published
+    assert not os.path.exists(p)
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp.")]
+    f = resilience.AtomicFile(p)
+    f.write("a,b\n1,2\n")
+    f.close(commit=True)
+    assert open(p).read() == "a,b\n1,2\n"
+
+
+def test_sweep_stale_tmp(tmp_path):
+    (tmp_path / ".tmp.123.dead.npz").write_text("junk")
+    os.makedirs(tmp_path / ".tmp.456.deaddir")
+    (tmp_path / "keep.txt").write_text("keep")
+    assert resilience.sweep_stale_tmp(str(tmp_path)) == 2
+    assert sorted(os.listdir(tmp_path)) == ["keep.txt"]
+
+
+# ---------------------------------------------------------------------------
+# step manifests: resume-skip + invalidation
+# ---------------------------------------------------------------------------
+
+def test_step_manifest_skip_and_invalidation(tmp_path, rng, monkeypatch,
+                                             caplog):
+    from shifu_tpu.cli import main as cli_main
+    from tests.synth import make_model_set
+
+    root = make_model_set(tmp_path, rng, n_rows=300)
+    assert cli_main(["--dir", root, "init"]) == 0
+    assert cli_main(["--dir", root, "stats"]) == 0
+    man = os.path.join(root, "tmp", "manifests", "stats.json")
+    assert os.path.exists(man), "completed step must leave a manifest"
+    cc_path = os.path.join(root, "ColumnConfig.json")
+    cc_before = open(cc_path).read()
+
+    # default (no SHIFU_TPU_RESUME): a re-run recomputes — manifest is
+    # removed at entry and rewritten at exit, never consulted
+    # opt-in resume: matching manifest + outputs present → skip
+    monkeypatch.setenv("SHIFU_TPU_RESUME", "1")
+    with caplog.at_level(logging.INFO, logger="shifu_tpu"):
+        assert cli_main(["--dir", root, "stats"]) == 0
+    assert any("skipping" in r.getMessage() for r in caplog.records)
+    assert open(cc_path).read() == cc_before
+
+    # changing an input invalidates the fingerprint → step re-runs
+    mc_path = os.path.join(root, "ModelConfig.json")
+    with open(mc_path, "a") as f:
+        f.write("\n")  # still valid JSON, different bytes
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="shifu_tpu"):
+        assert cli_main(["--dir", root, "stats"]) == 0
+    assert any("re-running" in r.getMessage() for r in caplog.records)
+    assert not any("skipping" in r.getMessage() for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# kill tests — a real SIGKILL in a subprocess, then verify no corruption
+# ---------------------------------------------------------------------------
+
+def _run_cli(root, cmd, extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env.pop("SHIFU_TPU_FAULT", None)
+    env.update(extra_env or {})
+    code = ("import sys; from shifu_tpu.cli import main; "
+            f"sys.exit(main(['--dir', {root!r}, {cmd!r}]))")
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd="/root/repo", timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def test_killed_step_leaves_no_corrupt_output(tmp_path, rng):
+    """SIGKILL inside stats (mid ColumnConfig publish) and inside norm
+    (mid output write): the prior outputs stay intact byte-for-byte, no
+    completion manifest appears, and a clean re-run succeeds."""
+    from shifu_tpu.cli import main as cli_main
+    from tests.synth import make_model_set
+
+    root = make_model_set(tmp_path, rng, n_rows=300)
+    assert cli_main(["--dir", root, "init"]) == 0
+    cc_path = os.path.join(root, "ColumnConfig.json")
+    cc_init = open(cc_path).read()
+
+    # stats killed at its first atomic commit (the ColumnConfig write)
+    r = _run_cli(root, "stats",
+                 extra_env={"SHIFU_TPU_FAULT": "atomic.commit:kill:1"})
+    assert r.returncode == -signal.SIGKILL, r.stderr[-2000:]
+    assert open(cc_path).read() == cc_init, \
+        "killed stats step must not touch the published ColumnConfig"
+    assert not os.path.exists(
+        os.path.join(root, "tmp", "manifests", "stats.json"))
+    assert cli_main(["--dir", root, "stats"]) == 0  # clean restart
+
+    # norm killed at its first atomic commit (normalized block write) —
+    # meta.json is written LAST, so readers never see a half layout
+    norm_dir = os.path.join(root, "tmp", "NormalizedData")
+    r = _run_cli(root, "norm",
+                 extra_env={"SHIFU_TPU_FAULT": "atomic.commit:kill:1"})
+    assert r.returncode == -signal.SIGKILL, r.stderr[-2000:]
+    assert not os.path.exists(os.path.join(norm_dir, "meta.json"))
+    assert not os.path.exists(
+        os.path.join(root, "tmp", "manifests", "norm.json"))
+    assert cli_main(["--dir", root, "norm"]) == 0
+    assert os.path.exists(os.path.join(norm_dir, "meta.json"))
+    with np.load(os.path.join(norm_dir, "data.npz")) as z:
+        assert z.files  # published archive is readable
+
+
+_TRAIN_SCRIPT = """\
+import sys
+import numpy as np
+from shifu_tpu.config.model_config import ModelTrainConf
+from shifu_tpu.train.trainer import train_nn
+
+rng = np.random.default_rng(5)
+x = rng.normal(0, 1, (400, 4)).astype(np.float32)
+y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+w = np.ones(400, np.float32)
+conf = ModelTrainConf.from_dict({
+    "numTrainEpochs": 12, "baggingNum": 1, "validSetRate": 0.2,
+    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [6],
+               "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+               "Propagation": "ADAM"}})
+res = train_nn(conf, x, y, w, seed=7, checkpoint_dir=sys.argv[1],
+               checkpoint_interval=4)
+print("BEST_VAL", ",".join(repr(float(v)) for v in np.ravel(res.best_val)))
+"""
+
+
+def test_train_sigkill_then_resume_matches_uninterrupted(tmp_path):
+    """Kill training with SIGKILL right after the 2nd checkpoint lands
+    (SHIFU_TPU_FAULT=ckpt.saved:kill:2), restart, and the resumed run
+    finishes with the same final validation metric as an uninterrupted
+    run — the orbax-checkpoint crash/resume contract end to end."""
+    from shifu_tpu.config.model_config import ModelTrainConf
+    from shifu_tpu.train import checkpoint as ckpt
+    from shifu_tpu.train.trainer import train_nn
+
+    ckdir = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env.pop("SHIFU_TPU_FAULT", None)
+
+    killed = subprocess.run(
+        [sys.executable, "-c", _TRAIN_SCRIPT, ckdir],
+        env={**env, "SHIFU_TPU_FAULT": "ckpt.saved:kill:2"},
+        cwd="/root/repo", timeout=600, capture_output=True, text=True)
+    assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
+    assert ckpt.latest_step(ckdir) == 8, \
+        "2nd published checkpoint (epoch 8) should have survived the kill"
+
+    resumed = subprocess.run(
+        [sys.executable, "-c", _TRAIN_SCRIPT, ckdir],
+        env=env, cwd="/root/repo", timeout=600,
+        capture_output=True, text=True)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    line = [ln for ln in resumed.stdout.splitlines()
+            if ln.startswith("BEST_VAL ")][0]
+    resumed_best = np.array([float(v) for v in line.split(" ", 1)[1]
+                             .split(",")])
+
+    # uninterrupted reference run with the same data/conf/seed
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (400, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    w = np.ones(400, np.float32)
+    conf = ModelTrainConf.from_dict({
+        "numTrainEpochs": 12, "baggingNum": 1, "validSetRate": 0.2,
+        "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [6],
+                   "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                   "Propagation": "ADAM"}})
+    straight = train_nn(conf, x, y, w, seed=7)
+    np.testing.assert_allclose(resumed_best, np.ravel(straight.best_val),
+                               rtol=1e-4)

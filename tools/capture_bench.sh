@@ -28,10 +28,13 @@ if [ "$prof_after" -gt "$prof_before" ]; then
 fi
 if [ "$after" -gt "$before" ] || [ -n "$new_files" ]; then
     echo "[capture] committing new measurement data"
+    # propagate git's exit code: a failed commit (hook, lock, identity)
+    # must not report capture success — the records would sit
+    # uncommitted while callers believe they landed
     git commit -m "Capture TPU bench records ($((after - before)) new in BENCH_LOCAL.jsonl)
 
-No-Verification-Needed: measurement-data-only commit" -- BENCH_LOCAL.jsonl $new_files || true
-    exit 0
+No-Verification-Needed: measurement-data-only commit" -- BENCH_LOCAL.jsonl $new_files
+    exit $?
 fi
 echo "[capture] nothing new persisted"
 exit 1
