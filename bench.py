@@ -690,10 +690,15 @@ def task_streaming():
     # STREAM_ROWS override can't slice the mmap past its end.
     run(1, n_rows=min(3 * STREAM_CHUNK_ROWS, STREAM_ROWS))
 
+    from shifu_tpu.data import pipeline as pipe
+    pipe.drain_stage_timers()    # the measured run owns the interval
     t0 = time.time()
     res = run(STREAM_EPOCHS_LONG)
     d_wall = time.time() - t0
-    _log(f"[stream] {STREAM_EPOCHS_LONG} epochs in {d_wall:.0f}s")
+    stages = pipe.drain_stage_timers()
+    stall_frac = min(stages.get("input_stall_s", 0.0) / d_wall, 1.0)
+    _log(f"[stream] {STREAM_EPOCHS_LONG} epochs in {d_wall:.0f}s "
+         f"(input stall {100 * stall_frac:.1f}%)")
     d_epochs = STREAM_EPOCHS_LONG
     n_train = STREAM_ROWS - int(STREAM_ROWS * STREAM_VALID_RATE)
     # AUC probe on a 200k sample via the returned model
@@ -711,6 +716,9 @@ def task_streaming():
     gb = STREAM_GB
     print(json.dumps({
         "row_epochs_per_sec": n_train * d_epochs / d_wall,
+        "stream_train_rows_per_s": n_train * d_epochs / d_wall,
+        "input_stall_frac": round(stall_frac, 4),
+        "input_stage_s": {k: round(v, 2) for k, v in stages.items()},
         "wall_s": d_wall, "epochs": d_epochs, "auc": a,
         "disk_gb": round(gb, 1),
         "stream_gbps": gb * d_epochs / d_wall,
@@ -1486,6 +1494,11 @@ def main():
         extra["streaming_auc"] = round(st["auc"], 4)
         extra["streaming_disk_gb"] = st["disk_gb"]
         extra["streaming_gbps"] = round(st["stream_gbps"], 2)
+        if "stream_train_rows_per_s" in st:
+            extra["stream_train_rows_per_s"] = round(
+                st["stream_train_rows_per_s"], 1)
+        if "input_stall_frac" in st:
+            extra["streaming_input_stall_frac"] = st["input_stall_frac"]
 
     def _fill_pipeline(pl):
         extra["pipeline_phase_walls_s"] = pl["phases"]

@@ -142,15 +142,25 @@ def cmd_export(args) -> int:
 
 def cmd_test(args) -> int:
     """Dry-run the dataSet filterExpressions on N records
-    (ShifuTestProcessor / DataPurifier)."""
+    (ShifuTestProcessor / DataPurifier), and report I/O health: any
+    resilience retries the sampled read needed (site, attempts, last
+    error)."""
     from shifu_tpu.data.purifier import DataPurifier
     from shifu_tpu.data.reader import read_raw_table
+    from shifu_tpu.resilience import retry_stats
     ctx = _ctx(args)
     mc = ctx.model_config
     df = read_raw_table(mc, max_rows=args.n)
     keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
     log.info("filter %r keeps %d / %d sampled records",
              mc.dataSet.filterExpressions, int(keep.sum()), len(df))
+    retries = retry_stats()
+    if retries:
+        for site, d in sorted(retries.items()):
+            log.warning("resilience: %s retried %d time(s), last error: "
+                        "%s", site, d["attempts"], d["lastError"])
+    else:
+        log.info("resilience: no I/O retries")
     return 0
 
 

@@ -83,12 +83,13 @@ def sampled_frame(mc, cap_rows: int, chunk_rows: int = 1_000_000,
     thinned by a second independent hash, staying uniform."""
     import pandas as pd
 
+    from shifu_tpu.data.pipeline import prefetch
     from shifu_tpu.data.reader import iter_raw_table
 
     frames = []
     rate = None
     start = 0
-    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
         if rate is None:
             # estimate total rows from bytes/row of the first chunk
             # (compressed parts at the same ~6× text expansion the

@@ -102,8 +102,9 @@ def run(ctx: ProcessorContext) -> int:
     if chunk_rows:
         log.info("correlation: dataset exceeds the resident threshold — "
                  "exact streaming accumulation in %d-row chunks", chunk_rows)
+        from shifu_tpu.data.pipeline import prefetch
         from shifu_tpu.data.reader import iter_raw_table
-        frames = iter_raw_table(mc, chunk_rows=chunk_rows)
+        frames = prefetch(iter_raw_table(mc, chunk_rows=chunk_rows))
     else:
         frames = [None]      # one resident read through the same path
 

@@ -24,6 +24,7 @@ from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import EvalConfig, ModelConfig
 from shifu_tpu.data.dataset import build_columnar
 from shifu_tpu.data.purifier import DataPurifier
+from shifu_tpu.data.pipeline import prefetch
 from shifu_tpu.data.reader import read_raw_table
 from shifu_tpu.eval import gain_chart
 from shifu_tpu.eval.scorer import Scorer
@@ -259,7 +260,7 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
                 dset, cols = _build_eval_dataset(ctx, ec, want_meta=False)
                 n_rows = _write_chunk(f, dset, cols, True)
             else:
-                for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk):
+                for df in prefetch(iter_raw_table(mc, ds=ds, chunk_rows=chunk)):
                     dset, cols = _build_eval_dataset(ctx, ec, df=df,
                                                      want_meta=False)
                     if not len(dset.tags):
@@ -311,8 +312,8 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
         eval_mc = copy.copy(mc)
         eval_mc.dataSet = ds
         frames, have = [], 0
-        for df in iter_raw_table(mc, ds=ds,
-                                 chunk_rows=max(4 * n_records, 4096)):
+        for df in prefetch(iter_raw_table(
+                mc, ds=ds, chunk_rows=max(4 * n_records, 4096))):
             if purifier is not None:
                 df = df[purifier.apply(df)].reset_index(drop=True)
             frames.append(df)
@@ -528,7 +529,8 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     dump_f = open(dump_path, "wb")
     champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}
     try:
-        for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows):
+        for df in prefetch(iter_raw_table(mc, ds=ds,
+                                               chunk_rows=chunk_rows)):
             dset, norm_cols = _build_eval_dataset(ctx, ec, df=df)
             if not len(dset.tags):
                 continue
@@ -719,7 +721,8 @@ def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
     try:
         score_f.write("tag,weight," + ",".join(class_cols)
                       + ",predicted\n")
-        for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows):
+        for df in prefetch(iter_raw_table(mc, ds=ds,
+                                               chunk_rows=chunk_rows)):
             dset, norm_cols = _build_eval_dataset(ctx, ec, df=df)
             if not len(dset.tags):
                 continue
@@ -881,7 +884,8 @@ def run_score(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
             if chunk_rows and not mc.is_multi_classification:
                 from shifu_tpu.data.reader import iter_raw_table
                 ds = effective_dataset_conf(mc, ec)
-                for df in iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows):
+                for df in prefetch(iter_raw_table(mc, ds=ds,
+                                               chunk_rows=chunk_rows)):
                     dset, cols = _build_eval_dataset(ctx, ec, df=df,
                                                      want_meta=False)
                     if not len(dset.tags):

@@ -13,7 +13,7 @@ import logging
 import os
 import time
 
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
 
 from shifu_tpu.resilience import atomic_write
 
@@ -38,15 +38,23 @@ def run(ctx: ProcessorContext, export_type: str = "columnstats") -> int:
         # validate on EVERY host before anyone parks at the barrier —
         # a writer-only ValueError would hang the other processes
         raise ValueError(f"unknown export type {export_type!r}")
+    outs = []
+    if et == "columnstats":
+        outs = [ctx.path_finder.column_stats_export_path()]
+    elif et == "correlation":
+        outs = [ctx.path_finder.correlation_path()]
     from shifu_tpu.parallel import dist
-    with dist.single_writer("export") as w:
-        # exports other than correlation are host-side file conversions
-        # with no collectives — multi-host processes >= 1 have nothing
-        # to compute and must not race host 0's writes (correlation
-        # computes via psum, so every host runs it; its own
-        # single_writer guards the CSV)
-        if w or et == "correlation":
-            return _run_writer(ctx, et, export_type, t0)
+    with step_guard(ctx, f"export.{et}", outputs=outs) as go:
+        if not go:
+            return 0
+        with dist.single_writer("export") as w:
+            # exports other than correlation are host-side file
+            # conversions with no collectives — multi-host processes
+            # >= 1 have nothing to compute and must not race host 0's
+            # writes (correlation computes via psum, so every host runs
+            # it; its own single_writer guards the CSV)
+            if w or et == "correlation":
+                return _run_writer(ctx, et, export_type, t0)
     return 0
 
 

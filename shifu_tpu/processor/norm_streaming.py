@@ -35,6 +35,7 @@ import numpy as np
 
 from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.data.dataset import valid_tag_mask
+from shifu_tpu.data.pipeline import prefetch
 from shifu_tpu.data.purifier import DataPurifier
 from shifu_tpu.data.reader import iter_raw_table
 from shifu_tpu.processor.base import ProcessorContext
@@ -116,7 +117,7 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
     # ---- pass 1: exact region sizes -----------------------------------
     n_train = n_val = 0
     raw_row = 0
-    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
         start = raw_row
         raw_row += len(df)
         keep = np.ones(len(df), bool)
@@ -193,7 +194,7 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
 
     # ---- pass 2: normalize + write ------------------------------------
     raw_row = 0
-    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
         start = raw_row
         raw_row += len(df)
         keep = np.ones(len(df), bool)

@@ -40,6 +40,7 @@ import numpy as np
 from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import BinningMethod
 from shifu_tpu.data.dataset import build_columnar
+from shifu_tpu.data.pipeline import prefetch
 from shifu_tpu.data.purifier import DataPurifier
 from shifu_tpu.data.reader import expand_data_files, iter_raw_table
 from shifu_tpu.ops import stats as stats_ops
@@ -86,7 +87,7 @@ def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
         if mc.dataSet.filterExpressions else None
     global_row = 0
     from shifu_tpu.data.dataset import valid_tag_mask
-    for df in iter_raw_table(mc, chunk_rows=chunk_rows):
+    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
         start = global_row
         global_row += len(df)
         # sample on the RAW global row index BEFORE filtering, so the

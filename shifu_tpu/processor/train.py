@@ -24,7 +24,7 @@ from shifu_tpu.config.model_config import Algorithm, ModelConfig
 from shifu_tpu.models import nn as nn_mod
 from shifu_tpu.models.spec import load_model, save_model
 from shifu_tpu.processor import norm as norm_proc
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
 from shifu_tpu.train import grid_search
 from shifu_tpu.train.trainer import TrainResult, train_nn
 
@@ -45,28 +45,37 @@ def run(ctx: ProcessorContext, seed: int = 12306) -> int:
             f"{alg.value}; the reference likewise restricts "
             f"multiClassifyMethod to its NN-family trainers")
 
-    if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
-        result = _train_dense(ctx, seed)
-    elif alg.is_tree:
-        from shifu_tpu.processor import train_tree
-        result = train_tree.run_tree(ctx, seed)
-    elif alg in (Algorithm.WDL,):
-        from shifu_tpu.processor import train_wdl
-        result = train_wdl.run_wdl(ctx, seed)
-    elif alg in (Algorithm.MTL,):
-        from shifu_tpu.processor import train_mtl
-        result = train_mtl.run_mtl(ctx, seed)
-    elif alg is Algorithm.TENSORFLOW:
-        # the reference's TF bridge spawns distributed-TF python
-        # training (TrainModelProcessor.java:472-527); here the same
-        # network trains natively in JAX and `export -t tf` emits a
-        # SavedModel via jax2tf when tensorflow is importable
-        log.info("TENSORFLOW algorithm: training the network natively "
-                 "in JAX (use `export -t tf` for a SavedModel)")
-        result = _train_dense(ctx, seed)
-    else:
-        raise ValueError(f"unsupported algorithm {alg}")
-    log.info("train[%s] done in %.2fs", alg.value, time.time() - t0)
+    # only the dense family writes val_error_path; the others record a
+    # fingerprint-only manifest (skip still requires matching inputs)
+    outs = [ctx.path_finder.val_error_path()] \
+        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM,
+                   Algorithm.TENSORFLOW) else []
+    with step_guard(ctx, "train", outputs=outs) as go:
+        if not go:
+            return 0
+        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
+            result = _train_dense(ctx, seed)
+        elif alg.is_tree:
+            from shifu_tpu.processor import train_tree
+            result = train_tree.run_tree(ctx, seed)
+        elif alg in (Algorithm.WDL,):
+            from shifu_tpu.processor import train_wdl
+            result = train_wdl.run_wdl(ctx, seed)
+        elif alg in (Algorithm.MTL,):
+            from shifu_tpu.processor import train_mtl
+            result = train_mtl.run_mtl(ctx, seed)
+        elif alg is Algorithm.TENSORFLOW:
+            # the reference's TF bridge spawns distributed-TF python
+            # training (TrainModelProcessor.java:472-527); here the same
+            # network trains natively in JAX and `export -t tf` emits a
+            # SavedModel via jax2tf when tensorflow is importable
+            log.info("TENSORFLOW algorithm: training the network "
+                     "natively in JAX (use `export -t tf` for a "
+                     "SavedModel)")
+            result = _train_dense(ctx, seed)
+        else:
+            raise ValueError(f"unsupported algorithm {alg}")
+        log.info("train[%s] done in %.2fs", alg.value, time.time() - t0)
     return 0
 
 

@@ -40,7 +40,7 @@ from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import ModelConfig
 from shifu_tpu.models import nn as nn_mod
 from shifu_tpu.processor import norm as norm_proc
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
 from shifu_tpu.train.trainer import train_nn
 
 log = logging.getLogger("shifu_tpu")
@@ -106,36 +106,43 @@ def run(ctx: ProcessorContext, recursive: int = 0, seed: int = 12306,
                  select_file)
         return 0
 
-    candidates = _apply_pre_filters(ctx)
-    if not vs.filterEnable:
-        for cc in candidates:
-            cc.finalSelect = True
+    # manifest bracketing covers only the SELECTION path — the -reset/
+    # -list/-f modes above are explicit user edits, never skippable
+    with step_guard(ctx, "varselect",
+                    outputs=[ctx.path_finder.column_config_path()]) as go:
+        if not go:
+            return 0
+        candidates = _apply_pre_filters(ctx)
+        if not vs.filterEnable:
+            for cc in candidates:
+                cc.finalSelect = True
+            ctx.save_column_configs()
+            return 0
+
+        by = vs.filterBy.upper()
+        if by in ("KS", "IV", "MIX", "PARETO"):
+            _filter_by_stats(ctx, candidates, by)
+        elif by in ("SE", "ST", "SC"):
+            # SC differs from SE only in output sort order in the
+            # reference (VarSelectModelProcessor.java:302-312); ranking
+            # here is already by delta
+            _filter_by_sensitivity(ctx, candidates,
+                                   "ST" if by == "ST" else "SE", seed)
+            for _ in range(recursive):
+                survivors = [c for c in candidates if c.finalSelect]
+                _filter_by_sensitivity(ctx, survivors, by, seed)
+        elif by == "V":
+            _filter_by_voted_wrapper(ctx, candidates, seed)
+        elif by == "FI":
+            _filter_by_feature_importance(ctx, candidates, seed)
+        else:
+            raise ValueError(
+                f"varSelect#filterBy {vs.filterBy!r} not supported")
+
+        n_sel = sum(1 for c in ctx.column_configs if c.finalSelect)
         ctx.save_column_configs()
-        return 0
-
-    by = vs.filterBy.upper()
-    if by in ("KS", "IV", "MIX", "PARETO"):
-        _filter_by_stats(ctx, candidates, by)
-    elif by in ("SE", "ST", "SC"):
-        # SC differs from SE only in output sort order in the reference
-        # (VarSelectModelProcessor.java:302-312); ranking here is
-        # already by delta
-        _filter_by_sensitivity(ctx, candidates, "ST" if by == "ST" else "SE",
-                               seed)
-        for _ in range(recursive):
-            survivors = [c for c in candidates if c.finalSelect]
-            _filter_by_sensitivity(ctx, survivors, by, seed)
-    elif by == "V":
-        _filter_by_voted_wrapper(ctx, candidates, seed)
-    elif by == "FI":
-        _filter_by_feature_importance(ctx, candidates, seed)
-    else:
-        raise ValueError(f"varSelect#filterBy {vs.filterBy!r} not supported")
-
-    n_sel = sum(1 for c in ctx.column_configs if c.finalSelect)
-    ctx.save_column_configs()
-    log.info("varsel[%s]: %d/%d columns selected in %.2fs", by, n_sel,
-             len(candidates), time.time() - t0)
+        log.info("varsel[%s]: %d/%d columns selected in %.2fs", by, n_sel,
+                 len(candidates), time.time() - t0)
     return 0
 
 

@@ -66,16 +66,40 @@ def device_stats() -> Dict:
 @contextlib.contextmanager
 def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
     """Record one step's structured metrics to tmp/metrics/steps.jsonl.
-    Yields a dict the caller may enrich (e.g. rows=, rc=)."""
+    Yields a dict the caller may enrich (e.g. rows=, rc=). Each record
+    also carries the input-pipeline stage timers (host_parse_s,
+    host_assemble_s, h2d_s, device_step_s, input_stall_s — see
+    data/pipeline.py) and any resilience retry counters accrued while
+    the step ran."""
     rec: Dict = {"step": step, "startedAt": round(time.time(), 3)}
     if extra:
         rec.update(extra)
+    try:
+        # the interval belongs to THIS step: drop whatever an earlier
+        # caller in the same process left behind
+        from shifu_tpu.data.pipeline import drain_stage_timers
+        drain_stage_timers()
+        from shifu_tpu.resilience import retry_stats
+        retry_stats(reset=True)
+    except Exception:  # noqa: BLE001 — metrics must never fail a run
+        pass
     t0 = time.time()
     try:
         yield rec
     finally:
         rec["wallSeconds"] = round(time.time() - t0, 3)
         rec.update(device_stats())
+        try:
+            from shifu_tpu.data.pipeline import drain_stage_timers
+            stages = drain_stage_timers()
+            if stages:
+                rec["inputPipeline"] = stages
+            from shifu_tpu.resilience import retry_stats
+            retries = retry_stats(reset=True)
+            if retries:
+                rec["retries"] = retries
+        except Exception:  # noqa: BLE001 — metrics must never fail a run
+            pass
         try:
             mdir = os.path.join(root, "tmp", "metrics")
             os.makedirs(mdir, exist_ok=True)

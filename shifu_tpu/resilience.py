@@ -55,6 +55,7 @@ import random
 import re
 import shutil
 import signal
+import threading
 import time
 from contextlib import contextmanager
 from typing import Callable, Iterable, List, NamedTuple, Optional
@@ -184,6 +185,36 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+# per-site retry accounting (surfaced in `shifu test` output and each
+# step's tmp/metrics/steps.jsonl line) — thread-safe: retried I/O can
+# run on pipeline prefetch workers
+_retry_lock = threading.Lock()
+_retry_stats: dict = {}
+
+
+def _note_retry(site: str, exc: BaseException) -> None:
+    with _retry_lock:
+        d = _retry_stats.setdefault(site, {"attempts": 0, "lastError": ""})
+        d["attempts"] += 1
+        d["lastError"] = f"{type(exc).__name__}: {exc}"
+
+
+def retry_stats(reset: bool = False) -> dict:
+    """{site: {attempts, lastError}} for every retried call since the
+    last reset. `attempts` counts RETRIED failures — zero means every
+    remote call succeeded first try (the dict is then empty)."""
+    with _retry_lock:
+        out = {k: dict(v) for k, v in _retry_stats.items()}
+        if reset:
+            _retry_stats.clear()
+    return out
+
+
+def reset_retry_stats() -> None:
+    with _retry_lock:
+        _retry_stats.clear()
+
+
 def retrying(site: str, fn: Callable, *args, **kwargs):
     """Call `fn(*args, **kwargs)` with bounded exponential-backoff
     retries on transient errors. The site's fault point fires before
@@ -198,6 +229,7 @@ def retrying(site: str, fn: Callable, *args, **kwargs):
         except BaseException as e:  # noqa: BLE001 — classified below
             if attempt >= attempts or not is_transient(e):
                 raise
+            _note_retry(site, e)
             delay = min(cap, base * 2 ** (attempt - 1))
             delay *= 0.5 + random.random()  # jitter: 0.5x..1.5x
             log.warning("%s: transient %s (attempt %d/%d), retrying in "
@@ -257,13 +289,58 @@ def atomic_path(path: str):
         raise
 
 
+# scheme detection duplicated from data/fs.py (which imports this
+# module — a top-level import back would cycle)
+_SCHEME_RE = re.compile(r"^[A-Za-z][A-Za-z0-9+.\-]*://")
+
+
+def _remote_tmp_name(path: str) -> str:
+    d, _, base = path.rpartition("/")
+    return f"{d}/.tmp.{os.getpid()}.{base}"
+
+
+@contextmanager
+def _remote_atomic_write(path: str, mode: str, **open_kwargs):
+    """fsspec twin of `atomic_write` for `gs://`/`s3://`-rooted model
+    sets: write to a dot-prefixed sibling key, then commit with a
+    server-side rename (copy+delete on object stores, a true rename
+    where the backend has one). Readers skip dot-prefixed keys by the
+    same convention as local part-file listers, so a kill mid-upload
+    never leaves a half-written object under the real name."""
+    import fsspec
+    tmp = _remote_tmp_name(path)
+    fs, tmp_key = fsspec.core.url_to_fs(tmp)
+    _, real_key = fsspec.core.url_to_fs(path)
+    f = fsspec.open(tmp, mode, **open_kwargs).open()
+    try:
+        yield f
+        f.flush()
+        f.close()
+        fault_point("atomic.commit")
+        fs.mv(tmp_key, real_key)
+    except BaseException:
+        if not f.closed:
+            f.close()
+        try:
+            fs.rm(tmp_key)
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+        raise
+
+
 @contextmanager
 def atomic_write(path: str, mode: str = "w", **open_kwargs):
     """``open()``-shaped atomic file write: the handle points at a temp
     file that is fsynced and renamed onto `path` only on clean exit.
-    ``os.devnull`` (multi-host non-writer outputs) passes through."""
+    ``os.devnull`` (multi-host non-writer outputs) passes through;
+    remote (``scheme://``) paths stage through a dot-prefixed remote
+    temp key and rename/copy-commit (`_remote_atomic_write`)."""
     if path == os.devnull:
         with open(path, mode, **open_kwargs) as f:
+            yield f
+        return
+    if _SCHEME_RE.match(path):
+        with _remote_atomic_write(path, mode, **open_kwargs) as f:
             yield f
         return
     tmp = _tmp_name(path)

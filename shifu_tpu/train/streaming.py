@@ -30,6 +30,17 @@ Round-2 upgrades over the single-device round-1 loop:
   same bag membership — AbstractNNWorker's Poisson bagging without
   materializing a (bags, N) matrix).
 
+Round-3: the HOST half of every chunk fetch (mmap materialization,
+`ascontiguousarray`, tail padding, Philox bag weights) runs on
+`data/pipeline.map_prefetch` worker threads with a bounded depth, so
+chunk k+1's assembly overlaps chunk k's device step — only the JAX
+placement (`make_array_from_process_local_data`/`device_put`, not
+thread-safe across the multi-host layer) stays on the consumer thread.
+`SHIFU_TPU_PREFETCH_WORKERS=0` restores the fully synchronous path.
+On accelerator backends the update/val jits donate the params,
+optimizer state and chunk buffers, so streaming never holds two copies
+of either in HBM.
+
 Activated by `train#trainOnDisk` (the reference's knob for the same
 situation). `norm` then stores the matrix as raw .npy files so chunks
 memory-map from disk without loading the whole table
@@ -48,6 +59,7 @@ import numpy as np
 import optax
 
 from shifu_tpu.config.model_config import ModelTrainConf
+from shifu_tpu.data import pipeline as pipe
 from shifu_tpu.models import nn as nn_mod
 from shifu_tpu.parallel import mesh as mesh_mod
 from shifu_tpu.train.optimizers import optimizer_from_params
@@ -285,8 +297,7 @@ def train_streaming_core(train_conf: ModelTrainConf,
         return t.astype(jnp.float32) \
             if t.dtype in (jnp.float16, jnp.bfloat16) else t
 
-    @jax.jit
-    def update(stacked, opt_state, *chunk_and_key):
+    def _update_impl(stacked, opt_state, *chunk_and_key):
         """One chunk's SGD step for every bag at once (vmap over the
         bag axis = the reference's ≤5 parallel bagging jobs)."""
         *inputs, w_bags, key_ = chunk_and_key
@@ -308,14 +319,42 @@ def train_streaming_core(train_conf: ModelTrainConf,
         def metric_mass_fn(inputs, w):
             return jnp.sum(w)
 
-    @jax.jit
-    def val_chunk_err(stacked, *chunk):
+    def _val_impl(stacked, *chunk):
         *inputs, w = chunk
         inputs = tuple(jax.tree.map(_upcast, t) for t in inputs)
 
         def one(params):
             return metric_sum_fn(params, inputs, w)
         return jax.vmap(one)(stacked), metric_mass_fn(inputs, w)
+
+    # donation is a no-op (plus a warning) on the CPU backend, so only
+    # accelerators opt in; values are identical either way
+    donate = jax.default_backend() not in ("cpu",)
+    _jits: dict = {}
+
+    def update(stacked, opt_state, *chunk_and_key):
+        """Jitted per arity: donate the params, optimizer state and
+        chunk buffers (each is re-emitted as an output or dead after
+        this step) so HBM holds one copy — but NOT the trailing PRNG
+        key, which the epoch reuses across chunks."""
+        n = len(chunk_and_key)
+        fn = _jits.get(("update", n))
+        if fn is None:
+            dn = tuple(range(2 + n - 1)) if donate else ()
+            fn = jax.jit(_update_impl, donate_argnums=dn)
+            _jits[("update", n)] = fn
+        return fn(stacked, opt_state, *chunk_and_key)
+
+    def val_chunk_err(stacked, *chunk):
+        """Donates only the chunk buffers — `stacked` is reused across
+        every validation chunk of the epoch."""
+        n = len(chunk)
+        fn = _jits.get(("val", n))
+        if fn is None:
+            dn = tuple(range(1, 1 + n)) if donate else ()
+            fn = jax.jit(_val_impl, donate_argnums=dn)
+            _jits[("val", n)] = fn
+        return fn(stacked, *chunk)
 
     def chunk_bounds(lo, hi):
         starts = list(range(lo, hi, chunk_rows))
@@ -340,22 +379,23 @@ def train_streaming_core(train_conf: ModelTrainConf,
         widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
         return np.pad(arr, widths)
 
-    def put(bounds, with_bags: bool):
-        """Fetch this process's slice of the chunk and place it
-        row-sharded on the mesh; device transfer is dispatched
-        immediately so it overlaps the previous chunk's compute.
-        get_chunk returns (*inputs, w): every array row-aligned,
-        weights last."""
+    ld = jax.local_device_count()
+
+    def host_assemble(bounds, with_bags: bool):
+        """Worker-thread half of a chunk fetch: this process's slice of
+        the chunk materialized from the mmap, made contiguous, tail-
+        padded, with Philox bag weights applied — numpy only, no JAX
+        calls (the map_prefetch contract; device placement is not
+        thread-safe across the multi-host layer). get_chunk returns
+        (*inputs, w): every array row-aligned, weights last."""
         a, b = bounds
         rows = b - a
         if n_proc > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
             # every process contributes an identical-shape block (the
             # assembled global array needs equal per-process slices,
             # each divisible over that process's local devices); the
             # tail pads with zero-weight rows, which every loss/metric
             # ignores
-            ld = jax.local_device_count()
             per = -(-rows // n_proc)
             per = -(-per // ld) * ld
             lo = min(a + proc * per, b)
@@ -364,6 +404,25 @@ def train_streaming_core(train_conf: ModelTrainConf,
             pad = per - (hi - lo)
             inputs = [_pad_rows(x, pad) for x in inputs]
             w = _pad_rows(w, pad)
+            if with_bags:
+                bw = chunk_bags(a, b)[:, lo - a:hi - a]
+                return inputs, np.pad(bw, ((0, 0), (0, pad))) * w[None, :]
+            return inputs, w
+        *inputs, w = get_chunk(a, b)
+        inputs = [np.ascontiguousarray(x) for x in inputs]
+        w = np.ascontiguousarray(w)
+        if with_bags:
+            return inputs, chunk_bags(a, b) * w[None, :]
+        return inputs, w
+
+    def place(assembled, with_bags: bool):
+        """Consumer-thread half: dispatch the chunk's async host→HBM
+        transfer row-sharded over the mesh, so it overlaps the previous
+        chunk's compute (JAX dispatch is async)."""
+        inputs, tail = assembled
+        t0 = time.monotonic()
+        if n_proc > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
             def assemble(arr, spec):
                 return jax.make_array_from_process_local_data(
@@ -371,21 +430,19 @@ def train_streaming_core(train_conf: ModelTrainConf,
 
             placed = [assemble(x, P("data", *([None] * (x.ndim - 1))))
                       for x in inputs]
-            if with_bags:
-                bw = chunk_bags(a, b)[:, lo - a:hi - a]
-                bw = np.pad(bw, ((0, 0), (0, pad))) * w[None, :]
-                return (*placed, assemble(bw, P(None, "data")))
-            return (*placed, assemble(w, P("data")))
-        *inputs, w = get_chunk(a, b)
-        inputs = [np.ascontiguousarray(x) for x in inputs]
-        w = np.ascontiguousarray(w)
-        placed = [mesh_mod.shard_axis(mesh, x, 0) for x in inputs]
-        if with_bags:
-            bw = chunk_bags(a, b) * w[None, :]
-            return (*placed, mesh_mod.shard_axis(mesh, bw, axis=1))
-        return (*placed, mesh_mod.shard_axis(mesh, w, 0))
+            tail_p = assemble(tail, P(None, "data") if with_bags
+                              else P("data"))
+        else:
+            placed = [mesh_mod.shard_axis(mesh, x, 0) for x in inputs]
+            tail_p = mesh_mod.shard_axis(mesh, tail,
+                                         axis=1 if with_bags else 0)
+        pipe.add_stage_time("h2d_s", time.monotonic() - t0)
+        return (*placed, tail_p)
 
-    best = jax.tree.map(lambda p: p, stacked)
+    # a REAL copy, not an alias: with buffer donation the first update
+    # consumes `stacked`'s initial buffers, so an alias would die with
+    # them (NaN val errors can keep `best` at its initial value forever)
+    best = jax.tree.map(jnp.copy, stacked)
     best_val = np.full(n_bags, np.inf, np.float32)
     best_epoch = np.zeros(n_bags, np.int64)
     bad = np.zeros(n_bags, np.int32)
@@ -472,18 +529,25 @@ def train_streaming_core(train_conf: ModelTrainConf,
             (seed ^ 0x5EED) + epoch).permutation(len(train_chunks))
         epoch_loss = np.zeros(n_bags, np.float64)
         epoch_w = np.zeros(n_bags, np.float64)
-        nxt = put(train_chunks[order[0]], True)
-        prev_stacked = jax.tree.map(lambda p: p, stacked) \
-            if stopped.any() else None
+        # host assembly of upcoming chunks runs on pipeline workers;
+        # only the (async) device placement happens here, one chunk
+        # ahead of the update consuming it
+        chunks = pipe.map_prefetch(lambda bnd: host_assemble(bnd, True),
+                                   [train_chunks[i] for i in order])
+        nxt = place(next(chunks), True)
+        prev_stacked = jax.tree.map(jnp.copy, stacked) \
+            if stopped.any() else None   # copy: donation-safe
         for ci in range(len(order)):
             cur = nxt
             if ci + 1 < len(order):
-                nxt = put(train_chunks[order[ci + 1]], True)  # prefetch
+                nxt = place(next(chunks), True)  # prefetch
+            t_dev = time.monotonic()
             stacked, opt_state, loss, sw = update(stacked, opt_state,
                                                   *cur, sub)
             sw = np.asarray(sw, np.float64)
             epoch_loss += np.asarray(loss, np.float64) * sw
             epoch_w += sw
+            pipe.add_stage_time("device_step_s", time.monotonic() - t_dev)
         if prev_stacked is not None:
             # stopped bags freeze: restore their params after the epoch
             keep = jnp.asarray(stopped)
@@ -496,14 +560,19 @@ def train_streaming_core(train_conf: ModelTrainConf,
         if val_chunks:
             se = np.zeros(n_bags, np.float64)
             sw = 0.0
-            nxt = put(val_chunks[0], False)
+            vchunks = pipe.map_prefetch(
+                lambda bnd: host_assemble(bnd, False), val_chunks)
+            nxt = place(next(vchunks), False)
             for ci in range(len(val_chunks)):
                 cur = nxt
                 if ci + 1 < len(val_chunks):
-                    nxt = put(val_chunks[ci + 1], False)
+                    nxt = place(next(vchunks), False)
+                t_dev = time.monotonic()
                 e, w_ = val_chunk_err(stacked, *cur)
                 se += np.asarray(e, np.float64)
                 sw += float(w_)
+                pipe.add_stage_time("device_step_s",
+                                    time.monotonic() - t_dev)
             val_err = se / max(sw, 1e-12)
         else:
             val_err = train_err

@@ -1,0 +1,444 @@
+"""Async host input pipeline (`data/pipeline.py`) — ordering, queue
+bounding, fault propagation, the `SHIFU_TPU_PREFETCH_WORKERS=0`
+sequential fallback, and byte-identical async-vs-sync end-to-end runs
+of the streaming stats/norm/train/eval paths. Plus the satellites that
+ride on the same PR: retry counters surfaced per site, the remote
+(fsspec) twin of `atomic_write`, and RESUME manifests for
+varselect/train/export."""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu import resilience
+from shifu_tpu.data import pipeline as pipe
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pipeline(monkeypatch):
+    """Each test owns the process-wide fault counters, stage timers and
+    retry stats; none may leak into the tier-1 neighbours."""
+    monkeypatch.delenv("SHIFU_TPU_FAULT", raising=False)
+    monkeypatch.delenv("SHIFU_TPU_PREFETCH_DEPTH", raising=False)
+    monkeypatch.delenv("SHIFU_TPU_PREFETCH_WORKERS", raising=False)
+    resilience.reset_faults()
+    resilience.reset_retry_stats()
+    pipe.drain_stage_timers()
+    yield
+    resilience.reset_faults()
+    resilience.reset_retry_stats()
+    pipe.drain_stage_timers()
+
+
+def _pipeline_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("shifu-prefetch", "shifu-pipeline"))
+            and t.is_alive()]
+
+
+def _wait_no_pipeline_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _pipeline_threads():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"pipeline threads still alive: "
+                         f"{_pipeline_threads()}")
+
+
+# ---------------------------------------------------------------------------
+# prefetch(iterable)
+# ---------------------------------------------------------------------------
+
+def test_prefetch_preserves_order_and_identity():
+    items = [np.arange(i + 1) for i in range(11)]
+    out = list(pipe.prefetch(iter(items), depth=2))
+    assert len(out) == len(items)
+    for got, want in zip(out, items):
+        assert got is want  # same objects, exact source order
+
+
+def test_prefetch_stays_bounded_depth_ahead():
+    produced = []
+
+    def src():
+        for i in range(20):
+            produced.append(i)
+            yield i
+
+    depth = 2
+    max_ahead = 0
+    consumed = 0
+    for item in pipe.prefetch(src(), depth=depth):
+        assert item == consumed
+        time.sleep(0.02)  # give the producer every chance to run ahead
+        # consumer holds 1 (current), queue holds <= depth, producer
+        # may hold 1 more it is waiting to enqueue
+        max_ahead = max(max_ahead, len(produced) - consumed)
+        consumed += 1
+    assert consumed == 20
+    assert max_ahead <= depth + 2
+    assert max_ahead < 20  # it did NOT slurp the whole source eagerly
+
+
+def test_prefetch_workers_zero_restores_sequential_path(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_WORKERS", "0")
+    produced = []
+
+    def src():
+        for i in range(6):
+            produced.append(i)
+            yield i
+
+    consumed = 0
+    for item in pipe.prefetch(src()):
+        assert not _pipeline_threads(), "sync path must not spawn threads"
+        consumed += 1
+        # strictly lazy: nothing is fetched ahead of the consumer
+        assert len(produced) == consumed
+    assert consumed == 6
+
+
+def test_prefetch_fault_propagates_without_deadlock(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "pipeline.fetch:oserror:3")
+    resilience.reset_faults()
+    got = []
+    with pytest.raises(OSError):
+        for item in pipe.prefetch(iter(range(10)), depth=2):
+            got.append(item)
+    assert got == [0, 1]  # chunks before the injected 3rd fetch arrive
+    _wait_no_pipeline_threads()
+
+
+def test_prefetch_early_close_shuts_worker_down():
+    def src():
+        for i in range(1000):
+            yield i
+
+    for item in pipe.prefetch(src(), depth=2):
+        if item == 3:
+            break
+    _wait_no_pipeline_threads()
+
+
+def test_prefetch_overlap_stall_below_parse():
+    """The acceptance number: with real overlap, consumer stall must sit
+    strictly below total producer parse time."""
+    pipe.drain_stage_timers()
+
+    def slow_src():
+        for i in range(8):
+            time.sleep(0.02)  # "parse"
+            yield i
+
+    n = 0
+    for _ in pipe.prefetch(slow_src(), depth=2):
+        time.sleep(0.025)  # "device step" the parse should hide behind
+        n += 1
+    assert n == 8
+    stages = pipe.drain_stage_timers()
+    assert stages["chunks"] == 8
+    assert stages["input_stall_s"] < stages["host_parse_s"]
+
+
+def test_sync_fallback_counts_fetch_as_stall():
+    pipe.drain_stage_timers()
+    list(pipe.prefetch(iter(range(5)), depth=0))
+    stages = pipe.drain_stage_timers()
+    # all fetch time is on the critical path by definition
+    assert stages["input_stall_s"] == stages["host_parse_s"]
+    assert stages["chunks"] == 5
+
+
+# ---------------------------------------------------------------------------
+# map_prefetch(fn, items)
+# ---------------------------------------------------------------------------
+
+def test_map_prefetch_order_and_inflight_bound():
+    lock = threading.Lock()
+    inflight = {"now": 0, "max": 0}
+
+    def fn(i):
+        with lock:
+            inflight["now"] += 1
+            inflight["max"] = max(inflight["max"], inflight["now"])
+        time.sleep(0.01)
+        with lock:
+            inflight["now"] -= 1
+        return i * i
+
+    depth = 3
+    out = list(pipe.map_prefetch(fn, range(12), depth=depth, workers=3))
+    assert out == [i * i for i in range(12)]
+    assert inflight["max"] <= depth
+    _wait_no_pipeline_threads()
+
+
+def test_map_prefetch_error_at_position():
+    def fn(i):
+        if i == 3:
+            raise ValueError("bad item")
+        return i
+
+    got = []
+    with pytest.raises(ValueError, match="bad item"):
+        for x in pipe.map_prefetch(fn, range(8), depth=2, workers=2):
+            got.append(x)
+    assert got == [0, 1, 2]  # error surfaces at the failed item's slot
+
+
+def test_map_prefetch_workers_zero_sequential(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_WORKERS", "0")
+    seen_threads = []
+    out = []
+    for x in pipe.map_prefetch(lambda i: i + 100, range(5)):
+        seen_threads.extend(_pipeline_threads())
+        out.append(x)
+    assert out == [100, 101, 102, 103, 104]
+    assert not seen_threads
+
+
+def test_map_prefetch_fault_injection(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "pipeline.fetch:oserror:2")
+    resilience.reset_faults()
+    with pytest.raises(OSError):
+        list(pipe.map_prefetch(lambda i: i, range(6), depth=2, workers=2))
+    _wait_no_pipeline_threads()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: async run is byte-identical to the sequential run
+# ---------------------------------------------------------------------------
+
+def _build_root(tmp_path, name, seed):
+    """Two roots built from the same seed carry identical raw bytes."""
+    from tests.synth import make_model_set
+    rng = np.random.default_rng(seed)
+    sub = tmp_path / name
+    sub.mkdir()
+    root = make_model_set(sub, rng, n_rows=2000,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [8],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM",
+                                        "ChunkRows": 250})
+    mc_path = os.path.join(root, "ModelConfig.json")
+    with open(mc_path) as f:
+        mc = json.load(f)
+    mc["train"]["trainOnDisk"] = True
+    mc["train"]["numTrainEpochs"] = 5
+    with open(mc_path, "w") as f:
+        json.dump(mc, f, indent=2)
+    return root
+
+
+def _run_flow(root):
+    from shifu_tpu.cli import main as cli_main
+    for cmd in (["init"], ["stats"], ["norm"], ["train"], ["eval"]):
+        assert cli_main(["--dir", root] + cmd) == 0, f"{cmd} failed"
+
+
+def _dir_file_bytes(path):
+    out = {}
+    for dirpath, _dirs, files in os.walk(path):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, path)] = f.read()
+    return out
+
+
+def test_async_pipeline_byte_identical_to_sync(tmp_path, monkeypatch):
+    """2000 rows at 250-row chunks = 8 chunks through every streaming
+    stage. A full init/stats/norm/train/eval flow with the background
+    pipeline on must produce byte-identical artifacts to the
+    WORKERS=0 sequential flow on identically-seeded data."""
+    from shifu_tpu.config.path_finder import PathFinder  # noqa: F401
+    from shifu_tpu.processor.base import ProcessorContext
+
+    for var in ("SHIFU_TPU_STATS_CHUNK_ROWS", "SHIFU_TPU_NORM_CHUNK_ROWS",
+                "SHIFU_TPU_EVAL_CHUNK_ROWS",
+                "SHIFU_TPU_ANALYSIS_CHUNK_ROWS"):
+        monkeypatch.setenv(var, "250")
+
+    root_sync = _build_root(tmp_path, "sync", seed=20260806)
+    root_async = _build_root(tmp_path, "async", seed=20260806)
+
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_WORKERS", "0")
+    _run_flow(root_sync)
+
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_WORKERS", "2")
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_DEPTH", "2")
+    _run_flow(root_async)
+
+    ctx_s = ProcessorContext.load(root_sync)
+    ctx_a = ProcessorContext.load(root_async)
+
+    # stats + binning → ColumnConfig bytes
+    with open(os.path.join(root_sync, "ColumnConfig.json"), "rb") as f:
+        cc_s = f.read()
+    with open(os.path.join(root_async, "ColumnConfig.json"), "rb") as f:
+        cc_a = f.read()
+    assert cc_s == cc_a
+
+    # normalized on-disk layout (dense.npy & friends) byte for byte
+    norm_s = _dir_file_bytes(ctx_s.path_finder.normalized_data_path())
+    norm_a = _dir_file_bytes(ctx_a.path_finder.normalized_data_path())
+    assert sorted(norm_s) == sorted(norm_a)
+    for rel in norm_s:
+        assert norm_s[rel] == norm_a[rel], f"norm artifact differs: {rel}"
+
+    # streaming trainer → identical parameters (npz containers embed
+    # archive metadata, so compare the arrays, not the zip bytes)
+    from shifu_tpu.models.spec import load_model
+    kind_s, meta_s, p_s = load_model(ctx_s.path_finder.model_path(0, "nn"))
+    kind_a, meta_a, p_a = load_model(ctx_a.path_finder.model_path(0, "nn"))
+    assert (kind_s, meta_s) == (kind_a, meta_a)
+    import jax
+    leaves_s = jax.tree_util.tree_leaves(p_s)
+    leaves_a = jax.tree_util.tree_leaves(p_a)
+    assert len(leaves_s) == len(leaves_a)
+    for ls, la in zip(leaves_s, leaves_a):
+        np.testing.assert_array_equal(np.asarray(ls), np.asarray(la))
+
+    # streaming eval → EvalScore.csv bytes
+    with open(ctx_s.path_finder.eval_score_path("Eval1"), "rb") as f:
+        es_s = f.read()
+    with open(ctx_a.path_finder.eval_score_path("Eval1"), "rb") as f:
+        es_a = f.read()
+    assert es_s == es_a
+
+    # observability: the async run's steps.jsonl carries inputPipeline
+    # stage timers, and total stall sits strictly below total host
+    # parse+assembly time (the overlap actually bought something)
+    steps_path = os.path.join(root_async, "tmp", "metrics", "steps.jsonl")
+    with open(steps_path) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    staged = [r["inputPipeline"] for r in recs if "inputPipeline" in r]
+    assert staged, "async run must report pipeline stage timers"
+    total_stall = sum(s.get("input_stall_s", 0.0) for s in staged)
+    total_parse = sum(s.get("host_parse_s", 0.0)
+                      + s.get("host_assemble_s", 0.0) for s in staged)
+    assert total_parse > 0
+    assert total_stall < total_parse
+    assert sum(s.get("chunks", 0) for s in staged) >= 8
+
+
+# ---------------------------------------------------------------------------
+# satellites: retry counters, remote atomic_write, RESUME manifests
+# ---------------------------------------------------------------------------
+
+def test_retry_stats_record_site_attempts_and_error(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "unit.flaky:oserror:1-2")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.001")
+    resilience.reset_faults()
+    resilience.reset_retry_stats()
+    assert resilience.retrying("unit.flaky", lambda: "ok") == "ok"
+    stats = resilience.retry_stats()
+    assert stats["unit.flaky"]["attempts"] == 2
+    assert "OSError" in stats["unit.flaky"]["lastError"]
+    # reset=True drains (what step_metrics does per record)
+    assert resilience.retry_stats(reset=True)["unit.flaky"]["attempts"] == 2
+    assert resilience.retry_stats() == {}
+
+
+def test_shifu_test_reports_retry_counters(model_set, monkeypatch, caplog):
+    from shifu_tpu.cli import main as cli_main
+    assert cli_main(["--dir", model_set, "init"]) == 0
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "fs.exists:oserror:1")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.001")
+    resilience.reset_faults()
+    resilience.reset_retry_stats()
+    with caplog.at_level(logging.INFO, logger="shifu_tpu"):
+        assert cli_main(["--dir", model_set, "test"]) == 0
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("resilience:" in m and ("retried" in m or "no I/O" in m)
+               for m in msgs)
+
+
+def test_remote_atomic_write_commit_and_abort():
+    fsspec = pytest.importorskip("fsspec")
+    fs = fsspec.filesystem("memory")
+    base = "memory://pipe-aw-test"
+    if fs.exists("/pipe-aw-test"):
+        fs.rm("/pipe-aw-test", recursive=True)
+
+    with resilience.atomic_write(f"{base}/out.txt", "w") as f:
+        f.write("hello")
+    assert fs.cat("/pipe-aw-test/out.txt") == b"hello"
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with resilience.atomic_write(f"{base}/fail.txt", "w") as f:
+            f.write("partial")
+            raise RuntimeError("boom")
+    assert not fs.exists("/pipe-aw-test/fail.txt")
+    # no dot-prefixed temp keys linger after commit or abort
+    leftovers = [p for p in fs.ls("/pipe-aw-test")
+                 if os.path.basename(str(p)).startswith(".")]
+    assert leftovers == []
+
+
+def test_remote_atomic_write_injected_commit_fault(monkeypatch):
+    fsspec = pytest.importorskip("fsspec")
+    fs = fsspec.filesystem("memory")
+    if fs.exists("/pipe-aw-fault"):
+        fs.rm("/pipe-aw-fault", recursive=True)
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "atomic.commit:oserror:1")
+    resilience.reset_faults()
+    with pytest.raises(OSError):
+        with resilience.atomic_write("memory://pipe-aw-fault/x.txt",
+                                     "w") as f:
+            f.write("data")
+    assert not fs.exists("/pipe-aw-fault/x.txt")
+
+
+def test_resume_manifests_varselect_train_export(tmp_path, rng,
+                                                 monkeypatch, caplog):
+    from shifu_tpu.cli import main as cli_main
+    from tests.synth import make_model_set
+
+    root = make_model_set(tmp_path, rng, n_rows=600)
+    mc_path = os.path.join(root, "ModelConfig.json")
+    with open(mc_path) as f:
+        mc = json.load(f)
+    mc["train"]["numTrainEpochs"] = 4
+    with open(mc_path, "w") as f:
+        json.dump(mc, f, indent=2)
+
+    for cmd in (["init"], ["stats"], ["varsel"], ["norm"], ["train"],
+                ["export", "-t", "columnstats"]):
+        assert cli_main(["--dir", root] + cmd) == 0
+
+    for step in ("varselect", "train", "export.columnstats"):
+        man = os.path.join(root, "tmp", "manifests", f"{step}.json")
+        assert os.path.exists(man), f"{step} must leave a manifest"
+
+    monkeypatch.setenv("SHIFU_TPU_RESUME", "1")
+    from shifu_tpu.processor.base import ProcessorContext
+    ctx = ProcessorContext.load(root)
+    model_file = ctx.path_finder.model_path(0, "nn")
+    mtime_before = os.path.getmtime(model_file)
+
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="shifu_tpu"):
+        assert cli_main(["--dir", root, "varsel"]) == 0
+        assert cli_main(["--dir", root, "train"]) == 0
+        assert cli_main(["--dir", root, "export", "-t",
+                         "columnstats"]) == 0
+    skip_msgs = [r.getMessage() for r in caplog.records
+                 if "skipping" in r.getMessage()]
+    assert len(skip_msgs) >= 3, f"expected 3 skips, got: {skip_msgs}"
+    # the skipped train really did not rewrite the model
+    assert os.path.getmtime(model_file) == mtime_before
+
+    # varselect -reset is an explicit user edit: never skipped
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="shifu_tpu"):
+        assert cli_main(["--dir", root, "varsel", "-reset"]) == 0
+    assert not any("skipping" in r.getMessage() for r in caplog.records)
