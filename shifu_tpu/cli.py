@@ -377,12 +377,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     # master iteration logs / Hadoop counters / TailThread
     from shifu_tpu.profiling import maybe_profile, step_metrics
     root = getattr(args, "dir", ".") or "."
+    from shifu_tpu import resilience
     try:
         with step_metrics(root, args.command) as rec, \
                 maybe_profile(root, args.command,
                               getattr(args, "profile", False)):
             rc = args.fn(args)
             rec["rc"] = int(rc or 0)
+    except resilience.Preempted as e:
+        # checkpointed preemption shutdown: distinct rc so a
+        # supervisor (systemd, a shell loop, k8s) knows to rerun with
+        # SHIFU_TPU_RESUME=1 — the run resumes at the saved step
+        log.warning("preempted: %s — exiting rc=%d; rerun with "
+                    "SHIFU_TPU_RESUME=1 to resume", e,
+                    resilience.PREEMPT_RC)
+        return resilience.PREEMPT_RC
     except (FileNotFoundError, ValueError, NotImplementedError) as e:
         log.error("%s", e)
         return 1

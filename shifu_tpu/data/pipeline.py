@@ -23,6 +23,12 @@ Two entry points:
   consumer thread keeps all JAX device placement to itself —
   ``jax.make_array_from_process_local_data``/``device_put`` are not
   thread-safe across the multi-host coordination layer.
+- ``map_stream(fn, iterable)`` — `map_prefetch` for an UNSIZED source:
+  a producer thread pulls chunks sequentially (``next()`` time counts
+  as parse) and farms ``fn`` out to the assembly pool, with results
+  yielded in order. This is the eval scorer's shape — `iter_raw_table`
+  streams an unknown number of chunks, each needing a pandas/numpy
+  matrix build (`_build_eval_dataset`) before the device scores it.
 
 Knobs (both read per call, so tests can flip them):
 
@@ -296,4 +302,116 @@ def map_prefetch(fn: Callable[[T], U], items: Sequence[T],
             fut.cancel()
         # running assemblies finish on their own; nothing ever blocks
         # on the consumer, so shutdown cannot deadlock
+        ex.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# map_stream(fn, iterable) — ordered background assembly of a stream
+# ---------------------------------------------------------------------------
+
+def map_stream(fn: Callable[[T], U], iterable: Iterable[T],
+               depth: int | None = None, workers: int | None = None,
+               site: str = FETCH_SITE,
+               stage: str = "host_assemble_s") -> Iterator[U]:
+    """`map_prefetch` over an UNSIZED source: yield ``fn(item)`` for
+    each item of `iterable` IN ORDER, with a producer thread pulling
+    ``next()`` sequentially and up to `depth` assemblies in flight on
+    `workers` pool threads. ``next()`` wall time accrues to
+    ``host_parse_s`` and ``fn`` time to `stage`, exactly like
+    prefetch + map_prefetch. With ``workers=0`` or ``depth=0`` this is
+    a plain sequential map (the pre-pipeline code path). `fn` must be
+    thread-safe and numpy/pandas-only — the caller keeps JAX device
+    work on its own thread. Producer and worker errors re-raise at the
+    failed item's position in the yield order; closing the generator
+    early shuts everything down without blocking."""
+    if depth is None:
+        depth = prefetch_depth()
+    if workers is None:
+        workers = prefetch_workers()
+
+    if depth <= 0 or workers <= 0:
+        for item in _sync_fetch(iterable, site):
+            t0 = time.monotonic()
+            try:
+                out = fn(item)
+            finally:
+                dt = time.monotonic() - t0
+                add_stage_time(stage, dt)
+                # synchronous: assembly time IS stall time
+                add_stage_time("input_stall_s", dt)
+            yield out
+        return
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _timed(item: T) -> U:
+        t0 = time.monotonic()
+        try:
+            return fn(item)
+        finally:
+            add_stage_time(stage, time.monotonic() - t0)
+
+    # futures travel through a bounded queue so the producer stays at
+    # most `depth` chunks ahead of the consumer (same memory cap as
+    # prefetch: depth+1 live chunks)
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    ex = ThreadPoolExecutor(max_workers=min(workers, depth),
+                            thread_name_prefix="shifu-pipeline")
+
+    def _offer(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce() -> None:
+        it = iter(iterable)
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                fault_point(site)
+                item = next(it)
+            except StopIteration:
+                _offer(_DONE)
+                return
+            except BaseException as e:  # noqa: BLE001 — carried across
+                _offer(_Raised(e))
+                return
+            add_stage_time("host_parse_s", time.monotonic() - t0)
+            if not _offer(ex.submit(_timed, item)):
+                return
+
+    producer = threading.Thread(target=_produce, daemon=True,
+                                name="shifu-map-stream")
+    producer.start()
+    try:
+        while True:
+            t0 = time.monotonic()
+            got = q.get()
+            if got is _DONE:
+                add_stage_time("input_stall_s", time.monotonic() - t0)
+                return
+            if isinstance(got, _Raised):
+                add_stage_time("input_stall_s", time.monotonic() - t0)
+                raise got.exc
+            try:
+                out = got.result()
+            finally:
+                add_stage_time("input_stall_s", time.monotonic() - t0)
+            add_stage_count("chunks")
+            yield out
+    finally:
+        stop.set()
+        while True:  # unblock a producer waiting on a full queue
+            try:
+                got = q.get_nowait()
+                if hasattr(got, "cancel"):
+                    got.cancel()
+            except queue.Empty:
+                break
+        producer.join(timeout=5.0)
         ex.shutdown(wait=False)

@@ -7,19 +7,123 @@ hosts, ICI within), and each process reads a disjoint subset of the
 part files (`read_raw_table(file_shard=(process_index, process_count))`)
 before placing its rows into the global row-sharded array via
 `jax.make_array_from_process_local_data`.
+
+Hang-proofing: every blocking collective (`writer_barrier`,
+`single_writer`'s release barrier, `global_row_array`) runs under a
+watchdog when ``SHIFU_TPU_BARRIER_TIMEOUT_S`` is set — the collective
+itself moves to a daemon thread (a blocked C call cannot be
+interrupted) while the caller polls a deadline and the shared abort
+marker (`resilience.check_abort`). On deadline expiry the watchdog
+dumps every Python thread's stack to stderr + ``steps.jsonl`` and
+raises `DistTimeout`; on a peer's abort marker it raises `DistAborted`
+carrying the peer's original error. `single_writer` publishes that
+marker when its body raises, so one host's exception becomes a clean
+same-error abort on every host instead of a pod-wide deadlock. Fault
+sites ``dist.init``, ``dist.barrier``, ``dist.allgather`` make all of
+this testable single-process.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
+import time
 from contextlib import contextmanager
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 
+from shifu_tpu.resilience import fault_point
+
 log = logging.getLogger("shifu_tpu")
+
+
+class DistTimeout(TimeoutError):
+    """A collective did not complete within SHIFU_TPU_BARRIER_TIMEOUT_S
+    — a peer host likely died or fell far behind."""
+
+
+class DistAborted(RuntimeError):
+    """A peer host published an abort marker while this host waited at
+    a collective; the message carries the peer's original error."""
+
+
+def barrier_timeout_s() -> Optional[float]:
+    """SHIFU_TPU_BARRIER_TIMEOUT_S as seconds, or None (no deadline —
+    the pre-watchdog behavior: block forever)."""
+    raw = os.environ.get("SHIFU_TPU_BARRIER_TIMEOUT_S", "").strip()
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        log.warning("ignoring bad SHIFU_TPU_BARRIER_TIMEOUT_S=%r", raw)
+        return None
+    return v if v > 0 else None
+
+
+def _my_index() -> int:
+    try:
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 — no backend yet
+        return -1
+
+
+def _abort_error(tag: str, ab: dict) -> "DistAborted":
+    return DistAborted(
+        f"peer process {ab.get('process')} aborted at "
+        f"{ab.get('site')!r}: {ab.get('error')} — this host stops with "
+        f"the same error instead of hanging at {tag!r}")
+
+
+def _watched(tag: str, fn: Callable):
+    """Run a blocking collective on a daemon thread while this thread
+    polls (a) completion, (b) the shared abort marker, (c) the
+    SHIFU_TPU_BARRIER_TIMEOUT_S deadline. Exceptions from the
+    collective re-raise here; an expired deadline dumps all thread
+    stacks and raises `DistTimeout`; a peer's abort marker raises
+    `DistAborted`. With no timeout set the deadline check is off but
+    abort polling still runs — a poisoned barrier never needs the
+    timeout to fail cleanly."""
+    from shifu_tpu import resilience
+    timeout = barrier_timeout_s()
+    box: dict = {}
+    done = threading.Event()
+
+    def _call() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — carried across
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_call, daemon=True,
+                         name=f"shifu-collective-{tag}")
+    t.start()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    last_abort_check = 0.0
+    while not done.wait(0.1):
+        now = time.monotonic()
+        if now - last_abort_check >= 0.5:
+            last_abort_check = now
+            ab = resilience.check_abort()
+            if ab and ab.get("process") != _my_index():
+                raise _abort_error(tag, ab)
+        if deadline is not None and now > deadline:
+            resilience.dump_thread_stacks(
+                f"collective {tag!r} timed out after "
+                f"SHIFU_TPU_BARRIER_TIMEOUT_S={timeout}s")
+            raise DistTimeout(
+                f"collective {tag!r} did not complete within "
+                f"SHIFU_TPU_BARRIER_TIMEOUT_S={timeout}s — a peer host "
+                "likely died or fell behind; thread stacks dumped to "
+                "stderr and steps.jsonl")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -34,6 +138,7 @@ def initialize(coordinator_address: Optional[str] = None,
     JAX's own, ~300s) — a wrong coordinator address or a dead peer then
     surfaces as a clear error naming the address instead of an
     indefinite hang."""
+    fault_point("dist.init")
     coordinator_address = coordinator_address or \
         os.environ.get("SHIFU_TPU_COORDINATOR")
     if num_processes is None and "SHIFU_TPU_NUM_PROCESSES" in os.environ:
@@ -109,10 +214,21 @@ def is_writer() -> bool:
 def writer_barrier(tag: str) -> None:
     """Block until every process reaches this point — hosts must not
     read a shared output file the writer is still producing. No-op
-    single-process."""
+    single-process. Under the watchdog (`_watched`) the wait is
+    bounded by SHIFU_TPU_BARRIER_TIMEOUT_S and poisoned by a peer's
+    abort marker — a dead or failed peer surfaces as `DistTimeout` /
+    `DistAborted` instead of a hang."""
+    fault_point("dist.barrier")
     if _multi_process() and jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(tag)
+        _watched(tag, lambda: multihost_utils.sync_global_devices(tag))
+        # the barrier itself released: a peer may still have published
+        # an abort between our poll ticks — one last check so every
+        # host leaves with the same verdict
+        from shifu_tpu import resilience
+        ab = resilience.check_abort()
+        if ab and ab.get("process") != _my_index():
+            raise _abort_error(tag, ab)
 
 
 @contextmanager
@@ -122,16 +238,35 @@ def single_writer(tag: str):
     exit EVEN WHEN THE WRITER RAISES: hosts >= 1 are already parked at
     the barrier, and an unreleased barrier turns one host's error into
     a pod-wide hang (the error itself still propagates on the
-    writer)."""
+    writer). A raising participant first publishes an abort marker so
+    blocked peers poison out with the same error (`DistAborted`)
+    rather than waiting for the timeout."""
     try:
         yield is_writer()
+    except BaseException as e:
+        if _multi_process() and jax.process_count() > 1:
+            from shifu_tpu import resilience
+            resilience.publish_abort(tag, e, process=_my_index())
+        raise
     finally:
         writer_barrier(tag)
 
 
-def global_row_array(mesh, local_rows: np.ndarray):
-    """Assemble a process-local row block into the global row-sharded
-    array (each host contributes its file shard's rows)."""
+def global_row_array(mesh, local_rows: np.ndarray, spec=None):
+    """Assemble a process-local row block into the global sharded
+    array (each host contributes its file shard's rows). `spec`
+    overrides the default rows-on-"data" PartitionSpec. Multi-process,
+    the assembly is a collective (every host must call it with the
+    same shapes) and runs under the watchdog."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    sharding = NamedSharding(mesh, P("data", *([None] * (local_rows.ndim - 1))))
-    return jax.make_array_from_process_local_data(sharding, local_rows)
+    if spec is None:
+        spec = P("data", *([None] * (local_rows.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    fault_point("dist.allgather")
+
+    def _make():
+        return jax.make_array_from_process_local_data(sharding, local_rows)
+
+    if _multi_process() and jax.process_count() > 1:
+        return _watched("global_row_array", _make)
+    return _make()

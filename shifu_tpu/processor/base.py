@@ -139,9 +139,19 @@ def step_guard(ctx: ProcessorContext, step: str,
             return
         log.info("step %s: stale/mismatched manifest — re-running", step)
     from shifu_tpu.parallel import dist
+    from shifu_tpu import resilience
+    # the poison-barrier / watchdog machinery needs a shared-storage
+    # anchor every host agrees on: the model set's tmp/ dir
+    resilience.set_abort_scope(os.path.join(pf.root, "tmp"))
     if dist.is_writer():
         if os.path.exists(mpath):
             os.remove(mpath)
+        # a fresh step invalidates any abort marker from an earlier
+        # failed run, and sweeps temp residue from aborted atomic
+        # writes — local dirs and their remote (scheme://) twins alike
+        resilience.clear_abort()
+        for d in {os.path.dirname(p) for p in outputs if p}:
+            resilience.sweep_stale(d)
         fault_point(f"step.{step}")
     yield True
     # reaching here means the step body finished without raising

@@ -24,7 +24,7 @@ from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import EvalConfig, ModelConfig
 from shifu_tpu.data.dataset import build_columnar
 from shifu_tpu.data.purifier import DataPurifier
-from shifu_tpu.data.pipeline import prefetch
+from shifu_tpu.data.pipeline import map_stream, prefetch
 from shifu_tpu.data.reader import read_raw_table
 from shifu_tpu.eval import gain_chart
 from shifu_tpu.eval.scorer import Scorer
@@ -260,9 +260,13 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
                 dset, cols = _build_eval_dataset(ctx, ec, want_meta=False)
                 n_rows = _write_chunk(f, dset, cols, True)
             else:
-                for df in prefetch(iter_raw_table(mc, ds=ds, chunk_rows=chunk)):
-                    dset, cols = _build_eval_dataset(ctx, ec, df=df,
-                                                     want_meta=False)
+                # matrix build (pandas/numpy) on pipeline workers,
+                # CSV write on this thread — map_prefetch's unsized-
+                # stream twin (data/pipeline.map_stream)
+                for dset, cols in map_stream(
+                        lambda df: _build_eval_dataset(
+                            ctx, ec, df=df, want_meta=False),
+                        iter_raw_table(mc, ds=ds, chunk_rows=chunk)):
                     if not len(dset.tags):
                         continue
                     n_rows += _write_chunk(f, dset, cols, n_rows == 0)
@@ -529,9 +533,12 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     dump_f = open(dump_path, "wb")
     champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}
     try:
-        for df in prefetch(iter_raw_table(mc, ds=ds,
-                                               chunk_rows=chunk_rows)):
-            dset, norm_cols = _build_eval_dataset(ctx, ec, df=df)
+        # per-chunk matrix build on pipeline workers; scoring (JAX)
+        # stays on this thread — the eval twin of the streaming
+        # trainer's map_prefetch host assembly
+        for dset, norm_cols in map_stream(
+                lambda df: _build_eval_dataset(ctx, ec, df=df),
+                iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)):
             if not len(dset.tags):
                 continue
             scores = _score_dataset(mc, scorer, dset, norm_cols)
@@ -721,9 +728,9 @@ def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
     try:
         score_f.write("tag,weight," + ",".join(class_cols)
                       + ",predicted\n")
-        for df in prefetch(iter_raw_table(mc, ds=ds,
-                                               chunk_rows=chunk_rows)):
-            dset, norm_cols = _build_eval_dataset(ctx, ec, df=df)
+        for dset, norm_cols in map_stream(
+                lambda df: _build_eval_dataset(ctx, ec, df=df),
+                iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)):
             if not len(dset.tags):
                 continue
             scores = _score_dataset(mc, scorer, dset, norm_cols)
@@ -884,10 +891,10 @@ def run_score(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
             if chunk_rows and not mc.is_multi_classification:
                 from shifu_tpu.data.reader import iter_raw_table
                 ds = effective_dataset_conf(mc, ec)
-                for df in prefetch(iter_raw_table(mc, ds=ds,
-                                               chunk_rows=chunk_rows)):
-                    dset, cols = _build_eval_dataset(ctx, ec, df=df,
-                                                     want_meta=False)
+                for dset, cols in map_stream(
+                        lambda df: _build_eval_dataset(
+                            ctx, ec, df=df, want_meta=False),
+                        iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)):
                     if not len(dset.tags):
                         continue
                     scores = _score_dataset(mc, scorer, dset, cols)

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu import resilience
 from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import Algorithm, ModelConfig
 from shifu_tpu.models import nn as nn_mod
@@ -53,28 +54,36 @@ def run(ctx: ProcessorContext, seed: int = 12306) -> int:
     with step_guard(ctx, "train", outputs=outs) as go:
         if not go:
             return 0
-        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
-            result = _train_dense(ctx, seed)
-        elif alg.is_tree:
-            from shifu_tpu.processor import train_tree
-            result = train_tree.run_tree(ctx, seed)
-        elif alg in (Algorithm.WDL,):
-            from shifu_tpu.processor import train_wdl
-            result = train_wdl.run_wdl(ctx, seed)
-        elif alg in (Algorithm.MTL,):
-            from shifu_tpu.processor import train_mtl
-            result = train_mtl.run_mtl(ctx, seed)
-        elif alg is Algorithm.TENSORFLOW:
-            # the reference's TF bridge spawns distributed-TF python
-            # training (TrainModelProcessor.java:472-527); here the same
-            # network trains natively in JAX and `export -t tf` emits a
-            # SavedModel via jax2tf when tensorflow is importable
-            log.info("TENSORFLOW algorithm: training the network "
-                     "natively in JAX (use `export -t tf` for a "
-                     "SavedModel)")
-            result = _train_dense(ctx, seed)
-        else:
+
+        def _attempt():
+            if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
+                return _train_dense(ctx, seed)
+            if alg.is_tree:
+                from shifu_tpu.processor import train_tree
+                return train_tree.run_tree(ctx, seed)
+            if alg in (Algorithm.WDL,):
+                from shifu_tpu.processor import train_wdl
+                return train_wdl.run_wdl(ctx, seed)
+            if alg in (Algorithm.MTL,):
+                from shifu_tpu.processor import train_mtl
+                return train_mtl.run_mtl(ctx, seed)
+            if alg is Algorithm.TENSORFLOW:
+                # the reference's TF bridge spawns distributed-TF python
+                # training (TrainModelProcessor.java:472-527); here the
+                # same network trains natively in JAX and `export -t tf`
+                # emits a SavedModel via jax2tf when tensorflow is
+                # importable
+                log.info("TENSORFLOW algorithm: training the network "
+                         "natively in JAX (use `export -t tf` for a "
+                         "SavedModel)")
+                return _train_dense(ctx, seed)
             raise ValueError(f"unsupported algorithm {alg}")
+
+        # supervised restart loop: with SHIFU_TPU_MAX_RESTARTS > 0, a
+        # preemption or transient failure re-invokes the trainer, which
+        # restores from its checkpoint dir and resumes mid-run (the
+        # single-process stand-in for YARN re-dispatching containers)
+        result = resilience.supervise(_attempt, step="train")
         log.info("train[%s] done in %.2fs", alg.value, time.time() - t0)
     return 0
 

@@ -79,8 +79,9 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
         # caller in the same process left behind
         from shifu_tpu.data.pipeline import drain_stage_timers
         drain_stage_timers()
-        from shifu_tpu.resilience import retry_stats
-        retry_stats(reset=True)
+        from shifu_tpu import resilience
+        resilience.retry_stats(reset=True)
+        resilience.drain_events()
     except Exception:  # noqa: BLE001 — metrics must never fail a run
         pass
     t0 = time.time()
@@ -94,10 +95,22 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
             stages = drain_stage_timers()
             if stages:
                 rec["inputPipeline"] = stages
-            from shifu_tpu.resilience import retry_stats
-            retries = retry_stats(reset=True)
+            from shifu_tpu import resilience
+            retries = resilience.retry_stats(reset=True)
             if retries:
                 rec["retries"] = retries
+            # watchdog stack dumps + supervised-restart records accrued
+            # while the step ran (each also lands as its own durable
+            # steps.jsonl line the moment it happens)
+            events = resilience.drain_events()
+            if events:
+                rec["events"] = events
+                restarts = [e.get("restart", 0) for e in events
+                            if e.get("event") == "restart"]
+                if restarts:
+                    rec["restarts"] = max(restarts)
+            if resilience.preempt_requested():
+                rec["preempted"] = True
         except Exception:  # noqa: BLE001 — metrics must never fail a run
             pass
         try:

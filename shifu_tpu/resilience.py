@@ -33,30 +33,54 @@ primitives every layer threads through:
        SHIFU_TPU_FAULT=<site>:<kind>:<nth>[;<site>:<kind>:<nth>...]
 
    makes an instrumented site misbehave on specific calls. ``kind`` is
-   ``oserror`` | ``timeout`` (raise OSError / TimeoutError) or
-   ``kill`` (SIGKILL the process — a real mid-step crash). ``nth`` is
-   a 1-based per-site call counter: ``2`` fires on exactly the 2nd
+   ``oserror`` | ``timeout`` (raise OSError / TimeoutError),
+   ``kill`` (SIGKILL the process — a real mid-step crash) or
+   ``preempt`` (set the graceful-shutdown flag, exactly what the
+   SIGTERM handler does — a deterministic TPU-VM preemption). ``nth``
+   is a 1-based per-site call counter: ``2`` fires on exactly the 2nd
    call, ``1-3`` on calls 1..3, ``2+`` on every call from the 2nd on.
-   Instrumented sites: ``fs.exists``, ``fs.size``, ``fs.list``,
-   ``fs.open``, ``reader.read``, ``reader.native``, ``ckpt.save``,
-   ``ckpt.saved``, ``ckpt.restore``, ``atomic.commit``, and
-   ``step.<name>`` at each processor step's start. Fault points sit
+   Instrumented sites are listed in ``FAULT_SITES`` (plus the dynamic
+   ``step.<name>`` at each processor step's start). Fault points sit
    INSIDE the retry loop, so an injected transient fault exercises the
    real retry path. Unset (the default) this is dead code.
+
+Distributed-failure additions (see also `parallel/dist.py`):
+
+4. **Poison abort markers** (`publish_abort` / `check_abort`): when one
+   host fails inside a `single_writer` section, it atomically publishes
+   an ``abort.marker`` under the model set's ``tmp/`` (local file or
+   remote key — `atomic_write` handles both), which peers blocked at
+   the matching barrier poll, converting one host's exception into a
+   clean same-error `DistAborted` on every host instead of a deadlock.
+
+5. **Preemption-safe shutdown** (`graceful_shutdown` /
+   `preempt_requested` / `Preempted`): SIGTERM/SIGINT set a flag the
+   epoch loops check at step boundaries; the trainer saves a final
+   checkpoint and raises `Preempted`, which the CLI converts to
+   ``PREEMPT_RC`` (75, EX_TEMPFAIL) — rerunning with
+   ``SHIFU_TPU_RESUME=1`` picks up at the saved step.
+
+6. **Supervised restarts** (`supervise`): re-invoke a training step on
+   preemption or a transient failure up to ``SHIFU_TPU_MAX_RESTARTS``
+   times (default 0 = off) with exponential backoff, resuming from
+   `restore_latest` each time; restart records land in ``steps.jsonl``.
 """
 
 from __future__ import annotations
 
 import collections
 import functools
+import json
 import logging
 import os
 import random
 import re
 import shutil
 import signal
+import sys
 import threading
 import time
+import traceback
 from contextlib import contextmanager
 from typing import Callable, Iterable, List, NamedTuple, Optional
 
@@ -99,9 +123,20 @@ def is_transient(exc: BaseException) -> bool:
 
 class _FaultRule(NamedTuple):
     site: str
-    kind: str       # oserror | timeout | kill
+    kind: str       # oserror | timeout | kill | preempt
     lo: int
     hi: float       # inclusive; inf for "N+"
+
+
+# every static fault site in the tree, for chaos sweeps
+# (tools/chaos_sweep.sh iterates this; `step.<name>` sites are dynamic)
+FAULT_SITES = (
+    "fs.exists", "fs.size", "fs.list", "fs.open",
+    "reader.read", "reader.native",
+    "ckpt.save", "ckpt.saved", "ckpt.restore",
+    "atomic.commit", "pipeline.fetch",
+    "dist.init", "dist.barrier", "dist.allgather",
+)
 
 
 _NTH_RE = re.compile(r"^(\d+)(\+|-(\d+))?$")
@@ -130,9 +165,9 @@ def _parse_fault_spec(raw: str) -> List[_FaultRule]:
                 "<site>:<kind>:<nth> (nth = N | N-M | N+)")
         site, kind, nth = bits
         kind = kind.lower()
-        if kind not in ("oserror", "timeout", "kill"):
+        if kind not in ("oserror", "timeout", "kill", "preempt"):
             raise ValueError(f"bad SHIFU_TPU_FAULT kind {kind!r}: want "
-                             "oserror | timeout | kill")
+                             "oserror | timeout | kill | preempt")
         m = _NTH_RE.match(nth.strip())
         if not m:
             raise ValueError(f"bad SHIFU_TPU_FAULT nth {nth!r}: want "
@@ -163,6 +198,14 @@ def fault_point(site: str) -> None:
                 log.error("fault injection: SIGKILL at %s (call %d)",
                           site, n)
                 os.kill(os.getpid(), signal.SIGKILL)
+            if r.kind == "preempt":
+                # simulated preemption notice: set the same flag the
+                # SIGTERM handler sets and keep going — the epoch loop
+                # notices at its next step boundary
+                log.warning("fault injection: preempt at %s (call %d)",
+                            site, n)
+                request_preempt()
+                return
             exc = TimeoutError if r.kind == "timeout" else OSError
             raise exc(f"injected {r.kind} at {site} (call {n})")
 
@@ -409,3 +452,311 @@ def sweep_stale_tmp(directory: str) -> int:
             _scrub(os.path.join(directory, name))
             n += 1
     return n
+
+
+def sweep_stale_tmp_remote(directory: str) -> int:
+    """Remote twin of `sweep_stale_tmp`: delete orphaned dot-prefixed
+    temp keys under a ``scheme://`` directory — the residue of a
+    `_remote_atomic_write` whose process died between upload and
+    rename-commit. Returns the count removed (0 when the directory
+    does not exist yet)."""
+    import fsspec
+    fs, key = fsspec.core.url_to_fs(directory.rstrip("/"))
+    try:
+        names = fs.ls(key, detail=False)
+    except FileNotFoundError:
+        return 0
+    n = 0
+    for full in names:
+        base = full.rstrip("/").rpartition("/")[2]
+        if base.startswith(".tmp."):
+            try:
+                fs.rm(full, recursive=True)
+                n += 1
+            except FileNotFoundError:  # raced with another sweeper
+                pass
+    return n
+
+
+def sweep_stale(directory: str) -> int:
+    """Sweep stale atomic-write temps, local or remote, best-effort —
+    startup hygiene must never fail a step."""
+    try:
+        if _SCHEME_RE.match(directory):
+            return sweep_stale_tmp_remote(directory)
+        return sweep_stale_tmp(directory)
+    except Exception as e:  # noqa: BLE001 — best-effort
+        log.warning("sweep_stale: could not sweep %s: %s", directory, e)
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# abort markers (poison barriers) + durable event records
+# ---------------------------------------------------------------------------
+
+# the model set's tmp/ dir (local path or scheme:// URL) — set by
+# step_guard on entry so dist/watchdog code deep in the stack can reach
+# shared storage without threading a root argument everywhere
+_abort_scope: Optional[str] = None
+_ABORT_NAME = "abort.marker"
+
+
+def set_abort_scope(tmp_dir: Optional[str]) -> None:
+    """Point the abort marker (and durable event records) at the model
+    set's ``tmp/`` directory — shared storage every host can read."""
+    global _abort_scope
+    _abort_scope = tmp_dir
+    if tmp_dir is None:
+        os.environ.pop("SHIFU_TPU_ABORT_DIR", None)
+
+
+def _abort_dir() -> Optional[str]:
+    return _abort_scope or os.environ.get("SHIFU_TPU_ABORT_DIR")
+
+
+def _abort_path() -> Optional[str]:
+    d = _abort_dir()
+    if not d:
+        return None
+    if _SCHEME_RE.match(d):
+        return d.rstrip("/") + "/" + _ABORT_NAME
+    return os.path.join(d, _ABORT_NAME)
+
+
+def publish_abort(site: str, exc: BaseException,
+                  process: Optional[int] = None) -> None:
+    """Atomically publish an abort marker so peers blocked at a barrier
+    fail with THIS host's error instead of hanging. Best-effort: a
+    failure to publish must never mask the original exception."""
+    path = _abort_path()
+    if not path:
+        return
+    if process is None:
+        try:
+            import jax
+            process = jax.process_index()
+        except Exception:  # noqa: BLE001
+            process = -1
+    rec = {"site": site, "process": process,
+           "error": f"{type(exc).__name__}: {exc}",
+           "time": round(time.time(), 3)}
+    try:
+        d = _abort_dir()
+        if d and not _SCHEME_RE.match(d):
+            os.makedirs(d, exist_ok=True)
+        with atomic_write(path, "w") as f:
+            f.write(json.dumps(rec))
+        log.error("abort marker published at %s (site=%s): %s",
+                  path, site, rec["error"])
+    except Exception as e:  # noqa: BLE001 — never mask the original
+        log.warning("could not publish abort marker %s: %s", path, e)
+
+
+def check_abort() -> Optional[dict]:
+    """Read the abort marker if one exists. Returns its record dict or
+    None; unreadable/corrupt markers count as aborts too (a peer died
+    mid-publish is still a peer that died)."""
+    path = _abort_path()
+    if not path:
+        return None
+    try:
+        if _SCHEME_RE.match(path):
+            import fsspec
+            fs, key = fsspec.core.url_to_fs(path)
+            if not fs.exists(key):
+                return None
+            with fs.open(key, "r") as f:
+                raw = f.read()
+        else:
+            if not os.path.exists(path):
+                return None
+            with open(path) as f:
+                raw = f.read()
+        return json.loads(raw)
+    except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — corrupt marker = abort
+        return {"site": "unknown", "process": -1,
+                "error": f"unreadable abort marker: {e}"}
+
+
+def clear_abort() -> None:
+    """Remove a stale abort marker (step startup / restart attempt)."""
+    path = _abort_path()
+    if not path:
+        return
+    try:
+        if _SCHEME_RE.match(path):
+            import fsspec
+            fs, key = fsspec.core.url_to_fs(path)
+            if fs.exists(key):
+                fs.rm(key)
+        elif os.path.exists(path):
+            os.remove(path)
+    except Exception as e:  # noqa: BLE001 — best-effort
+        log.warning("could not clear abort marker %s: %s", path, e)
+
+
+# resilience events (watchdog stack dumps, supervised restarts) —
+# buffered for the step's steps.jsonl record, which profiling.
+# step_metrics drains; dump_thread_stacks ALSO appends a standalone
+# line immediately, because a hung/killed process may never reach the
+# step record
+_events_lock = threading.Lock()
+_events: List[dict] = []
+
+
+def note_event(rec: dict) -> None:
+    with _events_lock:
+        _events.append(rec)
+
+
+def drain_events() -> List[dict]:
+    """Snapshot AND clear buffered resilience events (step_metrics)."""
+    with _events_lock:
+        out = list(_events)
+        _events.clear()
+    return out
+
+
+def _append_steps_jsonl(rec: dict) -> None:
+    """Durable append to the scope's tmp/metrics/steps.jsonl (local
+    scopes only — remote scopes keep the in-memory event instead)."""
+    d = _abort_dir()
+    if not d or _SCHEME_RE.match(d):
+        return
+    try:
+        mdir = os.path.join(d, "metrics")
+        os.makedirs(mdir, exist_ok=True)
+        with open(os.path.join(mdir, "steps.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        log.warning("could not append event to steps.jsonl: %s", e)
+
+
+def dump_thread_stacks(reason: str) -> str:
+    """Dump every Python thread's stack to stderr (and, scope
+    permitting, a steps.jsonl line) — the watchdog calls this on a
+    collective timeout so a hung pod leaves a diagnosable trace."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = [f"==== thread stacks: {reason} ===="]
+    for ident, frame in sys._current_frames().items():
+        parts.append(f"--- thread {names.get(ident, '?')} (ident {ident}) ---")
+        parts.append("".join(traceback.format_stack(frame)).rstrip())
+    text = "\n".join(parts)
+    print(text, file=sys.stderr, flush=True)
+    rec = {"step": "watchdog", "event": "threadStacks", "reason": reason,
+           "time": round(time.time(), 3), "stacks": text[:8000]}
+    note_event({k: v for k, v in rec.items() if k != "stacks"})
+    _append_steps_jsonl(rec)
+    return text
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+#: distinct exit code for "preempted but checkpointed" (EX_TEMPFAIL) —
+#: a supervisor that sees it should rerun with SHIFU_TPU_RESUME=1
+PREEMPT_RC = 75
+
+
+class Preempted(RuntimeError):
+    """Raised at a step boundary after a SIGTERM/SIGINT (or injected
+    ``preempt`` fault) once the final checkpoint is saved. Carries
+    ``rc`` so callers exit with the distinct preemption code."""
+    rc = PREEMPT_RC
+
+
+_preempt_flag = threading.Event()
+
+
+def request_preempt() -> None:
+    _preempt_flag.set()
+
+
+def preempt_requested() -> bool:
+    return _preempt_flag.is_set()
+
+
+def clear_preempt() -> None:
+    _preempt_flag.clear()
+
+
+@contextmanager
+def graceful_shutdown(note: str = "training"):
+    """Install SIGTERM/SIGINT handlers for the duration of a
+    checkpointed epoch loop: the first signal sets the preempt flag
+    (checked at step boundaries — the loop finishes the current step,
+    checkpoints, and raises `Preempted`); a second signal restores the
+    default handler and raises KeyboardInterrupt immediately. No-op
+    off the main thread (signal.signal would raise)."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    prev = {}
+
+    def _handler(signum, frame):  # noqa: ARG001 — signal API
+        if _preempt_flag.is_set():
+            for s, h in prev.items():
+                signal.signal(s, h)
+            raise KeyboardInterrupt(f"second signal {signum} during {note}")
+        log.warning("signal %d: preempting %s — finishing the current "
+                    "step, checkpointing, then exiting rc=%d (rerun "
+                    "with SHIFU_TPU_RESUME=1 to resume)",
+                    signum, note, PREEMPT_RC)
+        request_preempt()
+
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev[s] = signal.signal(s, _handler)
+    except ValueError:  # raced off the main thread
+        yield
+        return
+    try:
+        yield
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+# ---------------------------------------------------------------------------
+# supervised restart loop
+# ---------------------------------------------------------------------------
+
+def supervise(fn: Callable[[], "object"], step: str = "train",
+              max_restarts: Optional[int] = None):
+    """Run `fn()` under a restart supervisor: on `Preempted` or a
+    transient failure, re-invoke up to ``SHIFU_TPU_MAX_RESTARTS`` times
+    (default 0 — supervision off, behavior unchanged) with exponential
+    backoff. The trainers restore from their checkpoint dir on entry,
+    so each re-invocation resumes at the last saved step rather than
+    starting over — the single-process analog of YARN re-dispatching a
+    failed Guagua container. Restart records are buffered for the
+    step's ``steps.jsonl`` line and appended durably when a scope is
+    set. Permanent errors and exhausted budgets re-raise."""
+    if max_restarts is None:
+        max_restarts = max(_env_int("SHIFU_TPU_MAX_RESTARTS", 0), 0)
+    base = _env_float("SHIFU_TPU_RETRY_BASE_S", 0.05)
+    cap = _env_float("SHIFU_TPU_RETRY_MAX_S", 2.0)
+    restarts = 0
+    while True:
+        clear_preempt()
+        clear_abort()
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            restartable = isinstance(e, Preempted) or is_transient(e)
+            if restarts >= max_restarts or not restartable:
+                raise
+            restarts += 1
+            delay = min(cap, base * 2 ** (restarts - 1))
+            err = f"{type(e).__name__}: {e}"
+            log.warning("supervise[%s]: restart %d/%d in %.2fs after %s",
+                        step, restarts, max_restarts, delay, err)
+            rec = {"step": step, "event": "restart", "restart": restarts,
+                   "maxRestarts": max_restarts, "error": err,
+                   "time": round(time.time(), 3)}
+            note_event(rec)
+            _append_steps_jsonl(rec)
+            time.sleep(delay)

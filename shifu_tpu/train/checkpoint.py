@@ -70,6 +70,16 @@ def save_state(ckpt_dir: str, step: int, state: Any) -> None:
     fault_point("ckpt.saved")
 
 
+def save_interrupt(ckpt_dir: str, step: int, state: Any) -> None:
+    """Preemption-shutdown checkpoint: identical atomic `save_state`,
+    logged distinctly so a resumed run's logs show where the preempt
+    landed (off-interval steps are legal — `restore_latest` just takes
+    the newest usable one)."""
+    log.warning("preempt: saving shutdown checkpoint at step %d to %s "
+                "(resume with SHIFU_TPU_RESUME=1)", step, ckpt_dir)
+    save_state(ckpt_dir, step, state)
+
+
 def _step_names(ckpt_dir: str) -> List[Tuple[int, str]]:
     """(step, name) for every published step_* entry, `.tmp` staging and
     dot-prefixed temp files excluded."""

@@ -49,6 +49,7 @@ memory-map from disk without loading the whole table
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from typing import Callable, Optional, Tuple
@@ -58,9 +59,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from shifu_tpu import resilience
 from shifu_tpu.config.model_config import ModelTrainConf
 from shifu_tpu.data import pipeline as pipe
 from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.parallel import dist
 from shifu_tpu.parallel import mesh as mesh_mod
 from shifu_tpu.train.optimizers import optimizer_from_params
 from shifu_tpu.train.trainer import TrainResult
@@ -422,11 +425,14 @@ def train_streaming_core(train_conf: ModelTrainConf,
         inputs, tail = assembled
         t0 = time.monotonic()
         if n_proc > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
+            # dist.global_row_array = the same
+            # make_array_from_process_local_data, run under the
+            # collective watchdog: a dead peer mid-epoch surfaces as
+            # DistTimeout/DistAborted instead of hanging this host
             def assemble(arr, spec):
-                return jax.make_array_from_process_local_data(
-                    NamedSharding(mesh, spec), arr)
+                return dist.global_row_array(mesh, arr, spec=spec)
 
             placed = [assemble(x, P("data", *([None] * (x.ndim - 1))))
                       for x in inputs]
@@ -519,93 +525,128 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 # compute and append an extra error row
                 start_epoch = train_conf.numTrainEpochs
 
-    for epoch in range(start_epoch, train_conf.numTrainEpochs):
-        sub = jax.random.fold_in(key, epoch)
-        # per-epoch chunk-order reshuffle: chunked SGD sees a new data
-        # order every epoch (the shuffle the reference runs as a
-        # one-time MR job, done for free at the access layer); the
-        # order derives from (seed, epoch) so resumes replay it
-        order = np.random.default_rng(
-            (seed ^ 0x5EED) + epoch).permutation(len(train_chunks))
-        epoch_loss = np.zeros(n_bags, np.float64)
-        epoch_w = np.zeros(n_bags, np.float64)
-        # host assembly of upcoming chunks runs on pipeline workers;
-        # only the (async) device placement happens here, one chunk
-        # ahead of the update consuming it
-        chunks = pipe.map_prefetch(lambda bnd: host_assemble(bnd, True),
-                                   [train_chunks[i] for i in order])
-        nxt = place(next(chunks), True)
-        prev_stacked = jax.tree.map(jnp.copy, stacked) \
-            if stopped.any() else None   # copy: donation-safe
-        for ci in range(len(order)):
-            cur = nxt
-            if ci + 1 < len(order):
-                nxt = place(next(chunks), True)  # prefetch
-            t_dev = time.monotonic()
-            stacked, opt_state, loss, sw = update(stacked, opt_state,
-                                                  *cur, sub)
-            sw = np.asarray(sw, np.float64)
-            epoch_loss += np.asarray(loss, np.float64) * sw
-            epoch_w += sw
-            pipe.add_stage_time("device_step_s", time.monotonic() - t_dev)
-        if prev_stacked is not None:
-            # stopped bags freeze: restore their params after the epoch
-            keep = jnp.asarray(stopped)
-            stacked = jax.tree.map(
-                lambda new, old: jnp.where(
-                    keep.reshape((-1,) + (1,) * (new.ndim - 1)), old, new),
-                stacked, prev_stacked)
-        train_err = epoch_loss / np.maximum(epoch_w, 1e-12)
-
-        if val_chunks:
-            se = np.zeros(n_bags, np.float64)
-            sw = 0.0
-            vchunks = pipe.map_prefetch(
-                lambda bnd: host_assemble(bnd, False), val_chunks)
-            nxt = place(next(vchunks), False)
-            for ci in range(len(val_chunks)):
+    checkpointing = bool(checkpoint_dir) and checkpoint_interval > 0
+    with contextlib.ExitStack() as _sig:
+        if checkpointing:
+            # SIGTERM/SIGINT → finish the current epoch, save a final
+            # checkpoint, raise Preempted (rc 75). Without a checkpoint
+            # dir there is nothing durable to save, so signals keep
+            # their default behavior.
+            _sig.enter_context(
+                resilience.graceful_shutdown("streaming train"))
+        for epoch in range(start_epoch, train_conf.numTrainEpochs):
+            sub = jax.random.fold_in(key, epoch)
+            # per-epoch chunk-order reshuffle: chunked SGD sees a new
+            # data order every epoch (the shuffle the reference runs as
+            # a one-time MR job, done for free at the access layer);
+            # the order derives from (seed, epoch) so resumes replay it
+            order = np.random.default_rng(
+                (seed ^ 0x5EED) + epoch).permutation(len(train_chunks))
+            epoch_loss = np.zeros(n_bags, np.float64)
+            epoch_w = np.zeros(n_bags, np.float64)
+            # host assembly of upcoming chunks runs on pipeline
+            # workers; only the (async) device placement happens here,
+            # one chunk ahead of the update consuming it
+            chunks = pipe.map_prefetch(
+                lambda bnd: host_assemble(bnd, True),
+                [train_chunks[i] for i in order])
+            nxt = place(next(chunks), True)
+            prev_stacked = jax.tree.map(jnp.copy, stacked) \
+                if stopped.any() else None   # copy: donation-safe
+            for ci in range(len(order)):
                 cur = nxt
-                if ci + 1 < len(val_chunks):
-                    nxt = place(next(vchunks), False)
+                if ci + 1 < len(order):
+                    nxt = place(next(chunks), True)  # prefetch
                 t_dev = time.monotonic()
-                e, w_ = val_chunk_err(stacked, *cur)
-                se += np.asarray(e, np.float64)
-                sw += float(w_)
+                stacked, opt_state, loss, sw = update(stacked, opt_state,
+                                                      *cur, sub)
+                sw = np.asarray(sw, np.float64)
+                epoch_loss += np.asarray(loss, np.float64) * sw
+                epoch_w += sw
                 pipe.add_stage_time("device_step_s",
                                     time.monotonic() - t_dev)
-            val_err = se / max(sw, 1e-12)
-        else:
-            val_err = train_err
+            if prev_stacked is not None:
+                # stopped bags freeze: restore their params post-epoch
+                keep = jnp.asarray(stopped)
+                stacked = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        keep.reshape((-1,) + (1,) * (new.ndim - 1)),
+                        old, new),
+                    stacked, prev_stacked)
+            train_err = epoch_loss / np.maximum(epoch_w, 1e-12)
 
-        train_errs.append(train_err.astype(np.float32))
-        val_errs.append(val_err.astype(np.float32))
-        improved = (val_err < best_val) & ~stopped
-        if improved.any():
-            imp = jnp.asarray(improved)
-            best = jax.tree.map(
-                lambda b, p: jnp.where(
-                    imp.reshape((-1,) + (1,) * (p.ndim - 1)), p, b),
-                best, stacked)
-            best_val = np.where(improved, val_err, best_val).astype(np.float32)
-            best_epoch = np.where(improved, epoch, best_epoch)
-        bad = np.where(stopped, bad, np.where(improved, 0, bad + 1))
-        stopped |= (window > 0) & (bad >= window)
-        stopped |= (conv > 0) & (train_err <= conv)
-        if checkpoint_dir and checkpoint_interval > 0 and \
-                (epoch + 1) % checkpoint_interval == 0 and proc == 0:
-            # host-0 only: every process holds identical (replicated)
-            # state, and concurrent rmtree/os.replace on a shared
-            # checkpoint dir would race
-            from shifu_tpu.train import checkpoint as ckpt_mod
-            ckpt_mod.save_state(checkpoint_dir, epoch + 1, {
-                "stacked": stacked, "opt_state": opt_state, "best": best,
-                "best_val": best_val, "best_epoch": best_epoch,
-                "bad": bad, "stopped": stopped,
-                "train_errs": np.stack(train_errs),
-                "val_errs": np.stack(val_errs)})
-        if stopped.all():
-            log.info("streaming train: all bags stopped at epoch %d", epoch)
-            break
+            if val_chunks:
+                se = np.zeros(n_bags, np.float64)
+                sw = 0.0
+                vchunks = pipe.map_prefetch(
+                    lambda bnd: host_assemble(bnd, False), val_chunks)
+                nxt = place(next(vchunks), False)
+                for ci in range(len(val_chunks)):
+                    cur = nxt
+                    if ci + 1 < len(val_chunks):
+                        nxt = place(next(vchunks), False)
+                    t_dev = time.monotonic()
+                    e, w_ = val_chunk_err(stacked, *cur)
+                    se += np.asarray(e, np.float64)
+                    sw += float(w_)
+                    pipe.add_stage_time("device_step_s",
+                                        time.monotonic() - t_dev)
+                val_err = se / max(sw, 1e-12)
+            else:
+                val_err = train_err
+
+            train_errs.append(train_err.astype(np.float32))
+            val_errs.append(val_err.astype(np.float32))
+            improved = (val_err < best_val) & ~stopped
+            if improved.any():
+                imp = jnp.asarray(improved)
+                best = jax.tree.map(
+                    lambda b, p: jnp.where(
+                        imp.reshape((-1,) + (1,) * (p.ndim - 1)), p, b),
+                    best, stacked)
+                best_val = np.where(improved, val_err,
+                                    best_val).astype(np.float32)
+                best_epoch = np.where(improved, epoch, best_epoch)
+            bad = np.where(stopped, bad, np.where(improved, 0, bad + 1))
+            stopped |= (window > 0) & (bad >= window)
+            stopped |= (conv > 0) & (train_err <= conv)
+
+            def _ckpt_state():
+                return {"stacked": stacked, "opt_state": opt_state,
+                        "best": best, "best_val": best_val,
+                        "best_epoch": best_epoch, "bad": bad,
+                        "stopped": stopped,
+                        "train_errs": np.stack(train_errs),
+                        "val_errs": np.stack(val_errs)}
+
+            saved = False
+            if checkpointing and \
+                    (epoch + 1) % checkpoint_interval == 0 and proc == 0:
+                # host-0 only: every process holds identical
+                # (replicated) state, and concurrent rmtree/os.replace
+                # on a shared checkpoint dir would race
+                from shifu_tpu.train import checkpoint as ckpt_mod
+                ckpt_mod.save_state(checkpoint_dir, epoch + 1,
+                                    _ckpt_state())
+                saved = True
+            if checkpointing and resilience.preempt_requested():
+                # preemption notice (SIGTERM/SIGINT or injected
+                # `preempt` fault): save off-interval so nothing past
+                # the last interval is lost, then stop with the
+                # distinct rc — SHIFU_TPU_RESUME=1 (or the supervisor)
+                # resumes at exactly this epoch
+                from shifu_tpu.train import checkpoint as ckpt_mod
+                if proc == 0 and not saved:
+                    ckpt_mod.save_interrupt(checkpoint_dir, epoch + 1,
+                                            _ckpt_state())
+                raise resilience.Preempted(
+                    f"streaming train preempted after epoch "
+                    f"{epoch + 1}/{train_conf.numTrainEpochs}; "
+                    "checkpoint saved")
+            if stopped.all():
+                log.info("streaming train: all bags stopped at "
+                         "epoch %d", epoch)
+                break
 
     # NB the checkpoint dir is NOT deleted here: the caller removes it
     # only after the trained models are persisted (a crash between the

@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import numpy as np
 import optax
 
+from shifu_tpu import resilience
 from shifu_tpu.config.model_config import ModelTrainConf
 from shifu_tpu.models import nn as nn_mod
 from shifu_tpu.parallel import mesh as mesh_mod
@@ -429,16 +430,25 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
             done = last
             log.info("checkpoint: resumed at epoch %d from %s", last,
                      checkpoint_dir)
-        while done < n_epochs:
-            chunk = min(checkpoint_interval, n_epochs - done)
-            carry, tr, va = train_bags_carry(
-                loss_fn, metric_fn, optimizer, chunk, early_stop_window,
-                convergence_threshold, carry, train_inputs, w_train_bags,
-                val_inputs, w_val, grad_mask, n_batches)
-            tr_chunks.append(np.asarray(tr))
-            va_chunks.append(np.asarray(va))
-            done += chunk
-            ckpt.save_state(checkpoint_dir, done, carry)
+        # SIGTERM/SIGINT → finish the current chunk, keep its
+        # checkpoint, raise Preempted (rc 75); SHIFU_TPU_RESUME=1 (or
+        # resilience.supervise) resumes at `done`
+        with resilience.graceful_shutdown("train"):
+            while done < n_epochs:
+                chunk = min(checkpoint_interval, n_epochs - done)
+                carry, tr, va = train_bags_carry(
+                    loss_fn, metric_fn, optimizer, chunk,
+                    early_stop_window, convergence_threshold, carry,
+                    train_inputs, w_train_bags, val_inputs, w_val,
+                    grad_mask, n_batches)
+                tr_chunks.append(np.asarray(tr))
+                va_chunks.append(np.asarray(va))
+                done += chunk
+                ckpt.save_state(checkpoint_dir, done, carry)
+                if resilience.preempt_requested() and done < n_epochs:
+                    raise resilience.Preempted(
+                        f"train preempted after epoch {done}/{n_epochs};"
+                        " checkpoint saved")
         if tr_chunks:
             train_errs = np.concatenate(tr_chunks, axis=1)
             val_errs = np.concatenate(va_chunks, axis=1)

@@ -1,13 +1,28 @@
-"""Subprocess worker for the 2-process DCN scale-out test
+"""Subprocess worker for the 2-process DCN scale-out tests
 (tests/test_multihost.py). Not a test module.
 
 Each process: jax.distributed.initialize over localhost (gloo CPU
-collectives = the test-rig stand-in for DCN), build the SAME synthetic
-table deterministically, run the streaming trainer end-to-end (each
-process serves only its own slice of every chunk —
-train/streaming.py put()), and have process 0 dump the result. The
-single-process reference run uses the identical script with
---nproc 1 so both sides share one code path and one device count.
+collectives = the test-rig stand-in for DCN), then one of two modes:
+
+- ``--mode train`` (default): build the SAME synthetic table
+  deterministically, run the streaming trainer end-to-end (each
+  process serves only its own slice of every chunk —
+  train/streaming.py put()), and have process 0 dump the result. The
+  single-process reference run uses the identical script with
+  --nproc 1 so both sides share one code path and one device count.
+- ``--mode barrier-kill``: the dead-peer drill. Both processes meet at
+  a first barrier; process 1 then SIGKILLs itself and process 0 walks
+  into a second barrier its peer will never reach. With
+  SHIFU_TPU_BARRIER_TIMEOUT_S set, the survivor must exit — rc 17 for
+  the watchdog's DistTimeout, rc 18 for any other fast failure (e.g.
+  the collective itself erroring on the dead connection) — instead of
+  hanging. Exits via os._exit: the distributed runtime's atexit
+  teardown would itself block on the dead peer.
+- ``--mode barrier-stall``: the stuck-peer drill. Process 1 stays
+  ALIVE (sockets open, nothing errors) but never enters the second
+  barrier — the case only the watchdog can catch: the survivor's
+  collective blocks indefinitely until the SHIFU_TPU_BARRIER_TIMEOUT_S
+  deadline dumps thread stacks and raises DistTimeout (rc 17).
 
 Usage: python multihost_worker.py --port P --nproc N --pid I --out F
 """
@@ -22,6 +37,9 @@ ap.add_argument("--nproc", type=int, required=True)
 ap.add_argument("--pid", type=int, required=True)
 ap.add_argument("--out", required=True)
 ap.add_argument("--local-devices", type=int, default=2)
+ap.add_argument("--mode",
+                choices=("train", "barrier-kill", "barrier-stall"),
+                default="train")
 args = ap.parse_args()
 
 # environment must be set before jax import
@@ -37,6 +55,36 @@ if args.nproc > 1:
     jax.distributed.initialize(
         coordinator_address=f"localhost:{args.port}",
         num_processes=args.nproc, process_id=args.pid)
+
+if args.mode in ("barrier-kill", "barrier-stall"):
+    import signal
+    import time
+
+    from shifu_tpu.parallel import dist
+
+    dist.writer_barrier("chaos-ready")   # both processes fully up
+    if args.pid == 1:
+        if args.mode == "barrier-kill":
+            print("victim: SIGKILL self", file=sys.stderr, flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        print("victim: stalling (alive, never reaching the barrier)",
+              file=sys.stderr, flush=True)
+        time.sleep(300)   # the test kills us once the survivor exits
+        os._exit(0)
+    t0 = time.monotonic()
+    try:
+        dist.writer_barrier("chaos-after-kill")
+    except dist.DistTimeout as e:
+        print(f"DIST_TIMEOUT after {time.monotonic() - t0:.1f}s: {e}",
+              file=sys.stderr, flush=True)
+        os._exit(17)
+    except BaseException as e:  # noqa: BLE001 — any fast failure is a pass
+        print(f"DIST_FAIL after {time.monotonic() - t0:.1f}s "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        os._exit(18)
+    print("barrier with a dead peer unexpectedly succeeded",
+          file=sys.stderr, flush=True)
+    os._exit(19)
 
 import numpy as np  # noqa: E402
 

@@ -86,6 +86,100 @@ def test_two_process_streaming_matches_single_process(tmp_path):
     np.testing.assert_allclose(a["row_sum"], b["row_sum"], rtol=1e-5)
 
 
+def test_two_process_survivor_escapes_dead_peer(tmp_path):
+    """Dead-peer drill (no @slow: this is the hang-proofing acceptance
+    test). Two processes rendezvous; process 1 SIGKILLs itself; the
+    survivor walks into a barrier its peer will never reach. With
+    SHIFU_TPU_BARRIER_TIMEOUT_S set it must EXIT — DistTimeout from
+    the watchdog (rc 17) or a fast collective error on the dead
+    connection (rc 18) — well inside the subprocess timeout, never
+    hanging until the test harness kills it."""
+    import signal
+    import time
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHIFU_TPU_BARRIER_TIMEOUT_S"] = "6"
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--port", str(port),
+             "--nproc", "2", "--pid", str(i),
+             "--out", str(tmp_path / "unused.npz"),
+             "--local-devices", "1", "--mode", "barrier-kill"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            so, se = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("survivor hung past the barrier timeout "
+                        "(watchdog failed)")
+        outs.append((p.returncode, so, se))
+    elapsed = time.monotonic() - t0
+    rc1, _, se1 = outs[1]
+    assert rc1 == -signal.SIGKILL, f"victim rc={rc1}:\n{se1[-2000:]}"
+    rc0, _, se0 = outs[0]
+    assert rc0 in (17, 18), f"survivor rc={rc0}:\n{se0[-3000:]}"
+    assert "DIST_TIMEOUT" in se0 or "DIST_FAIL" in se0, se0[-3000:]
+    if rc0 == 17:
+        # the watchdog path: DistTimeout raised and thread stacks dumped
+        assert "thread stacks" in se0, se0[-3000:]
+    # generous wall bound: startup + 6s barrier timeout, nowhere near
+    # an indefinite hang
+    assert elapsed < 150, f"took {elapsed:.0f}s — watchdog too slow"
+
+
+def test_two_process_survivor_times_out_on_stuck_peer(tmp_path):
+    """Stuck-peer drill: the peer stays ALIVE (sockets open, nothing
+    errors fast) but never reaches the barrier — the hang class only
+    the watchdog can catch. The survivor must raise DistTimeout (rc
+    17) with thread stacks dumped once SHIFU_TPU_BARRIER_TIMEOUT_S
+    expires."""
+    import time
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHIFU_TPU_BARRIER_TIMEOUT_S"] = "6"
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--port", str(port),
+             "--nproc", "2", "--pid", str(i),
+             "--out", str(tmp_path / "unused.npz"),
+             "--local-devices", "1", "--mode", "barrier-stall"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(2)
+    ]
+    try:
+        try:
+            _, se0 = procs[0].communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            pytest.fail("survivor hung past the barrier timeout "
+                        "(watchdog failed)")
+        elapsed = time.monotonic() - t0
+        rc0 = procs[0].returncode
+        assert rc0 == 17, f"survivor rc={rc0}:\n{se0[-3000:]}"
+        assert "DIST_TIMEOUT" in se0, se0[-3000:]
+        assert "thread stacks" in se0, se0[-3000:]
+        assert elapsed < 150, f"took {elapsed:.0f}s — watchdog too slow"
+    finally:
+        for p in procs:
+            p.kill()
+
+
 def test_writer_guard_never_initializes_backend(monkeypatch):
     """is_writer/writer_barrier are called from pure FILE operations
     (shifu init writing ColumnConfig.json); they must not lazily

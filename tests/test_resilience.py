@@ -21,8 +21,12 @@ from shifu_tpu.data import fs as fs_mod
 @pytest.fixture(autouse=True)
 def _fresh_fault_counters():
     resilience.reset_faults()
+    resilience.clear_preempt()
+    resilience.set_abort_scope(None)
     yield
     resilience.reset_faults()
+    resilience.clear_preempt()
+    resilience.set_abort_scope(None)
 
 
 # ---------------------------------------------------------------------------
@@ -365,3 +369,268 @@ def test_train_sigkill_then_resume_matches_uninterrupted(tmp_path):
     straight = train_nn(conf, x, y, w, seed=7)
     np.testing.assert_allclose(resumed_best, np.ravel(straight.best_val),
                                rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown + supervised restarts
+# ---------------------------------------------------------------------------
+
+def test_preempt_fault_kind_sets_flag(monkeypatch):
+    """kind=preempt does NOT raise — it sets the graceful-shutdown flag
+    exactly like the SIGTERM handler, so epoch loops stop at their next
+    step boundary."""
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "x.site:preempt:2")
+    resilience.fault_point("x.site")
+    assert not resilience.preempt_requested()
+    resilience.fault_point("x.site")
+    assert resilience.preempt_requested()
+
+
+def test_graceful_shutdown_signal_flow():
+    """First SIGTERM sets the flag (no exception mid-step); a second
+    signal escalates to KeyboardInterrupt; handlers restore on exit."""
+    import time
+
+    prev_term = signal.getsignal(signal.SIGTERM)
+    with resilience.graceful_shutdown("test"):
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.01)   # let the interpreter deliver it
+        assert resilience.preempt_requested()
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+    resilience.clear_preempt()
+
+
+def test_supervise_restarts_on_preempt_and_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise resilience.Preempted("p")
+        if len(calls) == 2:
+            raise TimeoutError("transient")
+        return "ok"
+
+    os.environ["SHIFU_TPU_MAX_RESTARTS"] = "3"
+    try:
+        assert resilience.supervise(flaky, step="t") == "ok"
+    finally:
+        del os.environ["SHIFU_TPU_MAX_RESTARTS"]
+    assert len(calls) == 3
+
+
+def test_supervise_permanent_error_and_exhausted_budget():
+    def bad():
+        raise ValueError("permanent")
+
+    os.environ["SHIFU_TPU_MAX_RESTARTS"] = "5"
+    try:
+        with pytest.raises(ValueError):
+            resilience.supervise(bad, step="t")
+
+        n = []
+
+        def always_preempted():
+            n.append(1)
+            raise resilience.Preempted("again")
+
+        with pytest.raises(resilience.Preempted):
+            os.environ["SHIFU_TPU_MAX_RESTARTS"] = "2"
+            resilience.supervise(always_preempted, step="t")
+        assert len(n) == 3   # 1 try + 2 restarts
+    finally:
+        del os.environ["SHIFU_TPU_MAX_RESTARTS"]
+
+
+def test_supervise_off_by_default():
+    n = []
+
+    def once():
+        n.append(1)
+        raise resilience.Preempted("p")
+
+    with pytest.raises(resilience.Preempted):
+        resilience.supervise(once, step="t")
+    assert len(n) == 1
+
+
+def test_preempt_supervised_resume_matches_uninterrupted(tmp_path,
+                                                         monkeypatch):
+    """The acceptance run: inject a preemption notice right after the
+    first checkpoint lands; training raises Preempted, the supervisor
+    re-invokes, the trainer restores at epoch 4 and finishes — with the
+    SAME final validation metric as an uninterrupted run."""
+    from shifu_tpu.config.model_config import ModelTrainConf
+    from shifu_tpu.train.trainer import train_nn
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (400, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    w = np.ones(400, np.float32)
+    conf = ModelTrainConf.from_dict({
+        "numTrainEpochs": 12, "baggingNum": 1, "validSetRate": 0.2,
+        "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [6],
+                   "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                   "Propagation": "ADAM"}})
+    ckdir = str(tmp_path / "ck")
+
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "ckpt.saved:preempt:1")
+    monkeypatch.setenv("SHIFU_TPU_MAX_RESTARTS", "2")
+    resilience.reset_faults()
+    attempts = []
+
+    def attempt():
+        attempts.append(1)
+        return train_nn(conf, x, y, w, seed=7, checkpoint_dir=ckdir,
+                        checkpoint_interval=4)
+
+    res = resilience.supervise(attempt, step="train")
+    assert len(attempts) == 2, "preemption should trigger one restart"
+
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    straight = train_nn(conf, x, y, w, seed=7)
+    np.testing.assert_allclose(np.ravel(res.best_val),
+                               np.ravel(straight.best_val), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# abort markers (poison barriers) + collective watchdog
+# ---------------------------------------------------------------------------
+
+def test_abort_marker_roundtrip(tmp_path):
+    resilience.set_abort_scope(str(tmp_path))
+    assert resilience.check_abort() is None
+    resilience.publish_abort("psi", RuntimeError("boom"), process=2)
+    ab = resilience.check_abort()
+    assert ab["site"] == "psi" and ab["process"] == 2
+    assert "RuntimeError: boom" in ab["error"]
+    resilience.clear_abort()
+    assert resilience.check_abort() is None
+
+
+def test_abort_marker_remote_twin(tmp_path):
+    fsspec = pytest.importorskip("fsspec")
+    from fsspec.implementations.memory import MemoryFileSystem
+
+    MemoryFileSystem.store.clear()
+    resilience.set_abort_scope("memory://abortscope")
+    try:
+        assert resilience.check_abort() is None
+        resilience.publish_abort("norm", OSError("remote boom"), process=1)
+        ab = resilience.check_abort()
+        assert ab["process"] == 1 and "remote boom" in ab["error"]
+        # the marker committed via the atomic remote twin: no dot-temp
+        # residue under the scope
+        fs = fsspec.filesystem("memory")
+        names = [n.rpartition("/")[2] for n in fs.ls("/abortscope", detail=False)]
+        assert not [n for n in names if n.startswith(".tmp.")]
+        resilience.clear_abort()
+        assert resilience.check_abort() is None
+    finally:
+        MemoryFileSystem.store.clear()
+
+
+def test_watchdog_times_out_and_dumps_stacks(tmp_path, monkeypatch,
+                                             capsys):
+    import time
+
+    from shifu_tpu.parallel import dist
+
+    monkeypatch.setenv("SHIFU_TPU_BARRIER_TIMEOUT_S", "0.4")
+    t0 = time.monotonic()
+    with pytest.raises(dist.DistTimeout):
+        dist._watched("unit", lambda: time.sleep(60))
+    assert time.monotonic() - t0 < 10
+    err = capsys.readouterr().err
+    assert "thread stacks" in err and "unit" in err
+
+
+def test_watchdog_poisoned_by_peer_abort(tmp_path, monkeypatch):
+    import time
+
+    from shifu_tpu.parallel import dist
+
+    resilience.set_abort_scope(str(tmp_path))
+    resilience.publish_abort("stats", RuntimeError("peer died"),
+                             process=1)
+    # no timeout set: the abort marker alone must unblock the wait
+    monkeypatch.delenv("SHIFU_TPU_BARRIER_TIMEOUT_S", raising=False)
+    with pytest.raises(dist.DistAborted, match="peer died"):
+        dist._watched("unit", lambda: time.sleep(60))
+
+
+def test_watchdog_passes_value_and_error_through(monkeypatch):
+    from shifu_tpu.parallel import dist
+
+    monkeypatch.setenv("SHIFU_TPU_BARRIER_TIMEOUT_S", "5")
+    assert dist._watched("v", lambda: 41 + 1) == 42
+    with pytest.raises(RuntimeError, match="organic"):
+        dist._watched("e", _raise_organic)
+
+
+def _raise_organic():
+    raise RuntimeError("organic")
+
+
+def test_single_writer_publishes_abort_when_multiprocess(tmp_path,
+                                                         monkeypatch):
+    """When a single_writer body raises in a (simulated) multi-process
+    run, an abort marker lands under the scope before the error
+    propagates."""
+    from shifu_tpu.parallel import dist
+
+    resilience.set_abort_scope(str(tmp_path))
+    monkeypatch.setattr(dist, "_multi_process", lambda: True)
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(dist.jax, "process_index", lambda: 0)
+    # the release barrier would block on sync_global_devices; the
+    # marker from OUR OWN process must not poison it, so stub the
+    # collective itself
+    monkeypatch.setattr(dist, "_watched", lambda tag, fn: None)
+    with pytest.raises(RuntimeError, match="writer exploded"):
+        with dist.single_writer("unit") as w:
+            assert w
+            raise RuntimeError("writer exploded")
+    ab = resilience.check_abort()
+    assert ab is not None and "writer exploded" in ab["error"]
+    # ...and a DIFFERENT process polling the same scope aborts with
+    # that error
+    monkeypatch.setattr(dist.jax, "process_index", lambda: 1)
+    with pytest.raises(dist.DistAborted, match="writer exploded"):
+        dist.writer_barrier("unit")
+
+
+# ---------------------------------------------------------------------------
+# remote sweep twin
+# ---------------------------------------------------------------------------
+
+def test_sweep_stale_tmp_remote(tmp_path):
+    fsspec = pytest.importorskip("fsspec")
+    from fsspec.implementations.memory import MemoryFileSystem
+
+    MemoryFileSystem.store.clear()
+    fs = fsspec.filesystem("memory")
+    try:
+        with fs.open("/out/.tmp.123.part-0.csv", "w") as f:
+            f.write("orphaned")
+        with fs.open("/out/.tmp.456.meta.json", "w") as f:
+            f.write("orphaned")
+        with fs.open("/out/part-0.csv", "w") as f:
+            f.write("real")
+        assert resilience.sweep_stale_tmp_remote("memory://out") == 2
+        names = [n.rpartition("/")[2] for n in fs.ls("/out", detail=False)]
+        assert names == ["part-0.csv"]
+        # idempotent + missing dir tolerated
+        assert resilience.sweep_stale_tmp_remote("memory://out") == 0
+        assert resilience.sweep_stale_tmp_remote("memory://nothere") == 0
+        # the dispatcher routes by scheme
+        assert resilience.sweep_stale("memory://out") == 0
+        local = tmp_path / "d"
+        local.mkdir()
+        (local / ".tmp.9.x").write_text("junk")
+        assert resilience.sweep_stale(str(local)) == 1
+    finally:
+        MemoryFileSystem.store.clear()

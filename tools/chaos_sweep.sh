@@ -1,0 +1,79 @@
+#!/usr/bin/env bash
+# Full chaos sweep: for EVERY registered fault site (resilience.
+# FAULT_SITES), build a fresh tiny model set, inject one fault at that
+# site (SHIFU_TPU_FAULT=<site>:<kind>:1) and drive the real pipeline
+# (init -> stats -> norm -> train -> eval) under a hard timeout.
+#
+# The hang-proofing contract checked per site:
+#   - the pipeline either SUCCEEDS (retry layer absorbed the fault), or
+#   - fails PROMPTLY with output that NAMES the injected site, and
+#   - NEVER trips the per-site wall-clock timeout (a hang is the one
+#     unforgivable outcome).
+#
+# tests/test_chaos.py is the fast in-tree subset of this matrix wired
+# into tier-1; run this script for the exhaustive sweep.
+#
+# Usage: tools/chaos_sweep.sh [kind]        (kind: oserror|timeout, default oserror)
+
+set -u -o pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+KIND="${1:-oserror}"
+PER_SITE_TIMEOUT="${CHAOS_TIMEOUT_S:-300}"
+WORK="$(mktemp -d /tmp/chaos_sweep.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS=cpu
+export SHIFU_TPU_RETRY_BASE_S=0.01
+
+SITES=$(python -c \
+  "from shifu_tpu.resilience import FAULT_SITES; print('\n'.join(FAULT_SITES))")
+
+build_model_set() {  # $1 = dest dir
+  python - "$1" <<'PYEOF'
+import sys
+import numpy as np
+from tests.synth import make_model_set
+print(make_model_set(sys.argv[1], np.random.default_rng(7), n_rows=300))
+PYEOF
+}
+
+pass=0 fail=0 hang=0
+declare -a HUNG BROKE
+
+for site in $SITES; do
+  dest="$WORK/$site"
+  mkdir -p "$dest"
+  ms="$(build_model_set "$dest")" || { echo "FATAL: model-set build failed"; exit 2; }
+
+  log="$WORK/$site.log"
+  rc=0
+  for cmd in init stats norm train eval; do
+    SHIFU_TPU_FAULT="$site:$KIND:1" \
+      timeout -k 10 "$PER_SITE_TIMEOUT" \
+      python -m shifu_tpu.cli --dir "$ms" "$cmd" >>"$log" 2>&1
+    rc=$?
+    [ "$rc" -ne 0 ] && break
+  done
+
+  if [ "$rc" -eq 0 ]; then
+    echo "PASS  $site (fault absorbed, pipeline succeeded)"
+    pass=$((pass+1))
+  elif [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "HANG  $site (timed out after ${PER_SITE_TIMEOUT}s)"
+    hang=$((hang+1)); HUNG+=("$site")
+  elif grep -q "injected $KIND at $site" "$log"; then
+    echo "PASS  $site (failed fast, error names the site, rc=$rc)"
+    pass=$((pass+1))
+  else
+    echo "FAIL  $site (rc=$rc but error does not name the site; see $log)"
+    fail=$((fail+1)); BROKE+=("$site")
+  fi
+done
+
+echo
+echo "chaos sweep ($KIND): $pass pass, $fail contract-fail, $hang hang"
+[ "$hang" -gt 0 ] && echo "  hung sites: ${HUNG[*]}"
+[ "$fail" -gt 0 ] && echo "  broken sites: ${BROKE[*]}"
+[ $((fail + hang)) -eq 0 ]
