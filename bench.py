@@ -29,6 +29,8 @@ import subprocess
 import sys
 import time
 
+from shifu_tpu.config.environment import knob_bool, knob_int
+
 REFERENCE_WORKER_ROW_EPOCHS_PER_SEC = 2.0e6  # see module docstring
 
 # The denominator, made explicit IN the record (VERDICT r3 weak #6):
@@ -129,8 +131,8 @@ GBT_SMALL_TREES = 10
 # story at HIGGS row count — all trees grow in lockstep, one histogram
 # collective per level covers the whole forest. 40 trees keeps the
 # (T, R) gradient planes + bins within one v5e's 16 GB HBM.
-RF_ROWS = int(os.environ.get("SHIFU_TPU_RF_ROWS", 11_000_000))
-RF_TREES = int(os.environ.get("SHIFU_TPU_RF_TREES", 40))
+RF_ROWS = knob_int("SHIFU_TPU_RF_ROWS")
+RF_TREES = knob_int("SHIFU_TPU_RF_TREES")
 RF_DEPTH = 6
 
 # LR + SE-sensitivity variable selection at HIGGS scale (BASELINE.md
@@ -159,12 +161,11 @@ VARSEL_EPOCHS_LONG = 22
 # window while still exceeding HBM. Rows stay a multiple of the 1M
 # generation chunk so a larger on-disk layout can serve by prefix
 # slice (see _ensure_stream_layout).
-STREAM_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_ROWS", 15_000_000))
-STREAM_FEATURES = int(os.environ.get("SHIFU_TPU_STREAM_FEATURES", 300))
+STREAM_ROWS = knob_int("SHIFU_TPU_STREAM_ROWS")
+STREAM_FEATURES = knob_int("SHIFU_TPU_STREAM_FEATURES")
 STREAM_GB = STREAM_ROWS * STREAM_FEATURES * 4 / 1e9   # f32 on disk
 STREAM_HIDDEN = (256,)
-STREAM_CHUNK_ROWS = int(os.environ.get("SHIFU_TPU_STREAM_CHUNK_ROWS",
-                                       262_144))
+STREAM_CHUNK_ROWS = knob_int("SHIFU_TPU_STREAM_CHUNK_ROWS")
 STREAM_VALID_RATE = 0.02
 STREAM_EPOCHS_LONG = 2
 STREAM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -180,10 +181,10 @@ TPU_PEAK_FLOPS_BF16 = 394e12
 # — the north-star "shifu train wall-clock + eval AUC" shape
 # (ShifuCLI.java:887-941 command surface). Unlike the model-layer
 # tasks, nothing bypasses the reader/processors here.
-PIPE_ROWS = int(os.environ.get("SHIFU_TPU_PIPE_ROWS", 1_000_000))
+PIPE_ROWS = knob_int("SHIFU_TPU_PIPE_ROWS")
 PIPE_NUM = 28
 PIPE_CAT = 2
-PIPE_EPOCHS = int(os.environ.get("SHIFU_TPU_PIPE_EPOCHS", 30))
+PIPE_EPOCHS = knob_int("SHIFU_TPU_PIPE_EPOCHS")
 PIPE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tmp", "bench_pipeline")
 
@@ -267,7 +268,7 @@ def _delta_timed(measure, short_epochs: int, long_epochs: int):
     two lengths for real (the short run descheduled behind another
     suite), while on TPU two attempts is the right guard — a surviving
     inversion there means the sample is unusable."""
-    attempts = max(1, int(os.environ.get("SHIFU_TPU_BENCH_ATTEMPTS", "2")))
+    attempts = max(1, knob_int("SHIFU_TPU_BENCH_ATTEMPTS"))
     walls = {}
     res = None
     for attempt in range(attempts):
@@ -551,7 +552,7 @@ def task_hist(mode):
         # force a real device sync each rep: block_until_ready is a
         # no-op under the axon TPU tunnel (measured: 0.3 ms "wall" for
         # a 100 s computation), a scalar fetch is not
-        _ = float(jnp.sum(h))
+        _ = float(jnp.sum(h))  # lint: disable=host-sync-in-hot-loop -- the sync IS the measurement boundary
     wall = time.time() - t0
     # one histogram update = one (row, col) cell into G and H
     cells_per_sec = HIST_ROWS * HIST_COLS * reps / wall
@@ -1235,7 +1236,7 @@ def _run_or_reuse(task, backend, diags, env_extra, timeout=1200):
     holds while other tasks have nothing. Reuse is recorded in `diags`
     (→ extra["diagnostics"]) so the headline JSON carries provenance."""
     if backend == "tpu" and \
-            os.environ.get("SHIFU_TPU_BENCH_REFRESH", "0") != "1":
+            not knob_bool("SHIFU_TPU_BENCH_REFRESH"):
         cached = _latest_persisted(task, backend_filter="tpu")
         if cached and cached.get("workload") == _workload(task):
             diags.append(f"{task}: value reused from persisted TPU "
@@ -1399,7 +1400,7 @@ def main():
                  f"{BENCH_EPOCHS} epochs)", timeout=2400)
             step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
                  f"{GBT_COLS}, {GBT_TREES} trees)", timeout=3000)
-            if os.environ.get("SHIFU_TPU_BENCH_STREAMING", "1") != "0":
+            if knob_bool("SHIFU_TPU_BENCH_STREAMING"):
                 step("streaming", f">HBM streaming bench ({STREAM_ROWS}"
                      f"x{STREAM_FEATURES}, "
                      f"{STREAM_GB:.0f} GB on disk)",

@@ -1,0 +1,14 @@
+"""Repo-native static analysis + runtime lock checking.
+
+`python -m shifu_tpu.analysis [paths...]` runs the AST lint engine
+(`engine.py`) with the repo-specific rules under `rules/`;
+`analysis.lockcheck` is the opt-in (`SHIFU_TPU_LOCKCHECK=1`)
+instrumented-lock shim the threaded runtime modules build their locks
+through.
+
+This module stays import-light on purpose: `resilience.py`,
+`data/pipeline.py` and `parallel/dist.py` import
+`shifu_tpu.analysis.lockcheck` at module load, so nothing here may
+import them back (the lint rules that need `resilience.FAULT_SITES`
+import it lazily inside their check functions).
+"""

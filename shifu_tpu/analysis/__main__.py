@@ -1,0 +1,49 @@
+"""CLI: `python -m shifu_tpu.analysis [paths...] [--json] [--rule R]
+[--knobs-md]`. Exit code 1 when any finding is active, 0 when clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m shifu_tpu.analysis",
+        description="shifu_tpu repo-native static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the shifu_tpu "
+                         "package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule names and exit")
+    ap.add_argument("--knobs-md", action="store_true",
+                    help="print the knob registry as markdown and exit")
+    args = ap.parse_args(argv)
+
+    if args.knobs_md:
+        from shifu_tpu.config.environment import knobs_markdown
+        sys.stdout.write(knobs_markdown())
+        return 0
+    if args.list_rules:
+        from shifu_tpu.analysis.rules import ALL_RULES
+        print("\n".join(ALL_RULES))
+        return 0
+
+    from shifu_tpu.analysis import engine
+    paths = args.paths or [os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
+    report = engine.run(paths, rules=args.rule)
+    out = engine.render_json(report) if args.json \
+        else engine.render_human(report)
+    print(out)
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""AST lint engine: file walking, suppressions, rule dispatch, output.
+
+A rule module (see `rules/`) exposes:
+
+    RULES: tuple of rule-name strings it can emit
+    check(tree, path, ctx) -> list[Finding]     # per file
+    finalize(ctx) -> list[Finding]              # optional, cross-file
+
+`ctx` is a plain dict shared across the whole run; rules stash
+cross-file state in it under their own keys (e.g. every knob-name
+string constant seen, so `finalize` can flag dead registry entries).
+
+Suppressions are same-line trailing comments:
+
+    x = float(loss)  # lint: disable=host-sync-in-hot-loop -- reason
+
+`disable=all` silences every rule on that line. Cross-file findings
+from `finalize` hooks point at registries, not code lines, and cannot
+be suppressed inline — fix the registry instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, NamedTuple, Set, Tuple
+
+
+class Finding(NamedTuple):
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+class Report(NamedTuple):
+    findings: List[Finding]        # active (unsuppressed) findings
+    suppressed: List[Finding]      # findings silenced by inline comments
+    suppression_lines: int         # lint-disable comments in scanned code
+    files: int
+
+
+# rule list ends at the first whitespace so a trailing free-form
+# reason ("-- why") never merges into the last rule name
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+# never descend into these directory names
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "tmp",
+              ".ipynb_checkpoints", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+def collect_suppressions(source: str) -> Dict[int, Set[str]]:
+    """line -> set of rule names disabled on that line (via trailing
+    `# lint: disable=a,b` comments). Uses tokenize so a disable-looking
+    string literal doesn't count."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _rule_modules():
+    # lazy so `import shifu_tpu.analysis` stays cheap and cycle-free
+    from shifu_tpu.analysis.rules import RULE_MODULES
+    return RULE_MODULES
+
+
+def run(paths: Iterable[str], rules: Iterable[str] = None) -> Report:
+    """Lint every .py under `paths`. `rules` optionally restricts to a
+    subset of rule names (finalize hooks still run for selected rules)."""
+    modules = _rule_modules()
+    selected = set(rules) if rules is not None else None
+    ctx: dict = {"paths": list(paths)}
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    suppression_lines = 0
+    files = iter_py_files(paths)
+
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            active.append(Finding("parse-error", path, 1, 0, str(e)))
+            continue
+        sup = collect_suppressions(source)
+        suppression_lines += len(sup)
+        found: List[Finding] = []
+        for mod in modules:
+            if selected is not None and not (set(mod.RULES) & selected):
+                continue
+            found.extend(mod.check(tree, path, ctx))
+        for f in found:
+            if selected is not None and f.rule not in selected:
+                continue
+            disabled = sup.get(f.line, set())
+            if f.rule in disabled or "all" in disabled:
+                suppressed.append(f)
+            else:
+                active.append(f)
+
+    for mod in modules:
+        if selected is not None and not (set(mod.RULES) & selected):
+            continue
+        fin = getattr(mod, "finalize", None)
+        if fin is not None:
+            for f in fin(ctx):
+                if selected is None or f.rule in selected:
+                    active.append(f)
+
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(active, suppressed, suppression_lines, len(files))
+
+
+def render_human(report: Report) -> str:
+    lines = [f.format() for f in report.findings]
+    lines.append(
+        f"{len(report.findings)} finding(s) in {report.files} file(s) "
+        f"({len(report.suppressed)} suppressed inline)")
+    return "\n".join(lines)
+
+
+def render_json(report: Report) -> str:
+    return json.dumps({
+        "findings": [f._asdict() for f in report.findings],
+        "suppressed": [f._asdict() for f in report.suppressed],
+        "files": report.files,
+        "suppressionLines": report.suppression_lines,
+    }, indent=2, sort_keys=True)
+
+
+# --- shared AST helpers used by several rule modules -----------------------
+
+def dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains; '' when not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> Tuple[bool, str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True, node.value
+    return False, ""

@@ -1,0 +1,144 @@
+"""Opt-in runtime lock-order race detector.
+
+`make_lock("module.purpose")` is how the threaded runtime modules
+(`resilience.py`, `data/pipeline.py`, `parallel/dist.py`) create
+their locks. With `SHIFU_TPU_LOCKCHECK` unset/0 it returns a plain
+`threading.Lock` — zero overhead. With `SHIFU_TPU_LOCKCHECK=1` it
+returns an instrumented lock that, on every acquire:
+
+  * records an edge held-lock -> acquiring-lock in a global,
+    name-keyed lock graph (per-thread held stack in a
+    `threading.local`);
+  * raises `LockOrderError` the moment the new edge closes a cycle —
+    i.e. some thread has ever taken these locks in the opposite
+    order, which is a latent deadlock even if this run got lucky;
+  * raises on same-thread re-acquire of the same (non-reentrant) lock
+    instance, which would self-deadlock for real.
+
+Detection is on the ACQUIRE path and keyed by lock *name*, so a
+single instrumented run of the chaos/multihost drills certifies an
+ordering discipline for every pair of lock classes the run touched —
+the cross-thread interleaving itself doesn't need to happen. Two
+instances sharing a name are distinct for the re-acquire check (keyed
+by id) but merged in the graph.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from shifu_tpu.config.environment import knob_bool
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition closed a cycle in the lock-order graph."""
+
+
+_graph_lock = threading.Lock()
+# edge a -> b: some thread held a while acquiring b; value = one
+# (thread-name, stack-of-held-names) witness for the error message
+_edges: Dict[str, Dict[str, str]] = {}
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return knob_bool("SHIFU_TPU_LOCKCHECK")
+
+
+def reset() -> None:
+    """Drop all recorded ordering state (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+
+
+def _held() -> List[Tuple[str, int]]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """A path src -> ... -> dst in the edge graph (caller holds
+    _graph_lock), or None."""
+    seen: Set[str] = {src}
+    stack: List[Tuple[str, List[str]]] = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+class CheckedLock:
+    """`threading.Lock` wrapper that participates in order checking."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def _before_acquire(self) -> None:
+        held = _held()
+        if any(i == id(self) for _, i in held):
+            raise LockOrderError(
+                f"thread {threading.current_thread().name!r} "
+                f"re-acquired non-reentrant lock '{self.name}' it "
+                "already holds — guaranteed self-deadlock")
+        held_names = [n for n, _ in held if n != self.name]
+        if not held_names:
+            return
+        with _graph_lock:
+            for h in held_names:
+                _edges.setdefault(h, {}).setdefault(
+                    self.name, threading.current_thread().name)
+            # cycle iff self.name already reaches any held lock
+            for h in held_names:
+                path = _find_path(self.name, h)
+                if path is not None:
+                    order = " -> ".join([h] + path)
+                    raise LockOrderError(
+                        "lock-order cycle: thread "
+                        f"{threading.current_thread().name!r} holds "
+                        f"'{h}' while acquiring '{self.name}', but the "
+                        f"opposite order {order} was also recorded — "
+                        "latent deadlock; pick one global order for "
+                        "these locks")
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        self._before_acquire()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held().append((self.name, id(self)))
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == id(self):
+                del held[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def make_lock(name: str, force: Optional[bool] = None):
+    """A lock for the runtime modules: plain `threading.Lock` unless
+    SHIFU_TPU_LOCKCHECK=1 (or `force=True`), then a `CheckedLock`
+    registered in the global order graph under `name`."""
+    use = enabled() if force is None else force
+    return CheckedLock(name) if use else threading.Lock()

@@ -1,0 +1,12 @@
+"""Rule plugin registry for the lint engine.
+
+A rule module exposes `RULES` (names it emits), `check(tree, path,
+ctx)` and optionally `finalize(ctx)`. Add new modules to
+`RULE_MODULES` to register them.
+"""
+
+from shifu_tpu.analysis.rules import faults, hotloop, knobs, locks
+
+RULE_MODULES = (hotloop, knobs, faults, locks)
+
+ALL_RULES = tuple(r for m in RULE_MODULES for r in m.RULES)

@@ -1,0 +1,119 @@
+"""unregistered-fault-site: fault-injection site strings and
+`resilience.FAULT_SITES` must agree, both ways.
+
+Per file: a literal site string passed to `fault_point(...)` /
+`maybe_fault(...)` / `retrying(...)` / `retry(...)` that is not in
+`FAULT_SITES` means `SHIFU_TPU_FAULT=<site>:...` and the chaos matrix
+(tools/chaos_sweep.sh) silently never exercise that path. Dynamic
+`f"step.{...}"` sites are the step_guard namespace and are allowed by
+design (one per pipeline step, enumerated at runtime).
+
+Cross-file (finalize): a FAULT_SITES entry no scanned file references
+as a string constant is a stale registry row — the chaos matrix burns
+a sweep slot on a site nothing can trigger.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from shifu_tpu.analysis.engine import Finding, const_str, dotted
+
+RULES = ("unregistered-fault-site",)
+
+# call names whose first string argument is a fault-site string
+_SITE_FUNCS = {"fault_point", "maybe_fault", "retrying", "retry"}
+_DYNAMIC_PREFIX = "step."
+
+
+def _sites() -> Set[str]:
+    from shifu_tpu import resilience
+    return set(resilience.FAULT_SITES)
+
+
+def _site_arg(call: ast.Call):
+    """The site argument node of a registered-site call, else None."""
+    d = dotted(call.func)
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf not in _SITE_FUNCS or not call.args:
+        return None
+    return call.args[0]
+
+
+def _fstring_prefix(node: ast.AST) -> str:
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and \
+                isinstance(first.value, str):
+            return first.value
+    return ""
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = _sites()
+    seen: Set[str] = ctx.setdefault("fault-site-refs", set())
+    if path.replace(os.sep, "/").endswith("shifu_tpu/resilience.py"):
+        # stale-entry sweep only fires when the scan covered the
+        # registry's home module (i.e. a package-wide scan)
+        ctx["fault-registry-scanned"] = True
+
+    # constants inside the FAULT_SITES definition itself don't count
+    # as references, nor do docstrings
+    skip_ids: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                for t in node.targets):
+            skip_ids.update(id(c) for c in ast.walk(node.value))
+        if isinstance(node, ast.Expr) and \
+                isinstance(node.value, ast.Constant):
+            skip_ids.add(id(node.value))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value in sites and id(node) not in skip_ids:
+            seen.add(node.value)
+        if not isinstance(node, ast.Call):
+            continue
+        arg = _site_arg(node)
+        if arg is None:
+            continue
+        ok, lit = const_str(arg)
+        if ok:
+            if lit not in sites and not lit.startswith(_DYNAMIC_PREFIX):
+                findings.append(Finding(
+                    "unregistered-fault-site", path, node.lineno,
+                    node.col_offset,
+                    f"fault site '{lit}' is not in "
+                    "resilience.FAULT_SITES — register it there so "
+                    "SHIFU_TPU_FAULT and the chaos matrix can reach "
+                    "this path"))
+        elif isinstance(arg, ast.JoinedStr):
+            if not _fstring_prefix(arg).startswith(_DYNAMIC_PREFIX):
+                findings.append(Finding(
+                    "unregistered-fault-site", path, node.lineno,
+                    node.col_offset,
+                    "dynamic fault-site string must live in the "
+                    f"'{_DYNAMIC_PREFIX}*' namespace (step_guard); "
+                    "any other site must be a FAULT_SITES literal"))
+    return findings
+
+
+def finalize(ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.get("fault-registry-scanned"):
+        return findings
+    seen: Set[str] = ctx.get("fault-site-refs", set())
+    for site in sorted(_sites()):
+        if site not in seen:
+            findings.append(Finding(
+                "unregistered-fault-site", "shifu_tpu/resilience.py",
+                0, 0,
+                f"FAULT_SITES entry '{site}' is never referenced by "
+                "any scanned file — remove the stale entry or restore "
+                "the fault_point call"))
+    return findings

@@ -1,0 +1,446 @@
+"""JAX hot-path rules: host syncs and jit construction inside loops,
+and reads of donated buffers after the donating call.
+
+host-sync-in-hot-loop
+    `float(x)` / `int(x)` / `bool(x)` / `x.item()` /
+    `np.asarray(x)` / `np.array(x)` where `x` is (transitively) a JAX
+    array, lexically inside a `for`/`while` body. Each one blocks the
+    host on device compute and collapses the async dispatch pipeline
+    to one step in flight. Use `data.pipeline.host_fetch` for an
+    intentional, timed sync point, or accumulate device values and
+    convert once after the loop.
+
+jit-in-loop
+    `jax.jit` / `jax.pmap` / `shard_map` constructed inside a loop
+    body: every iteration builds (and usually retraces) a fresh
+    compiled callable. Hoist it, or cache it the way
+    `train/streaming.py` caches its lazily-jitted update fns.
+
+donation-aliasing
+    a Name passed at a `donate_argnums` position of a jitted call and
+    read again afterwards without an intervening re-assignment — the
+    donated buffer is dead on return, so the read sees garbage (or
+    crashes) on TPU even though it works on CPU.
+
+Taintedness is a per-function, line-ordered dataflow pass: a name is
+tainted when assigned from a `jax.*`/`jnp.*` call, from a call to a
+known device function (jit-decorated, returned by `jax.jit`, or a
+local function whose return value is tainted), or from another
+tainted name. `np.*` results, `host_fetch(...)` results and function
+parameters are untainted. Loop bodies are walked twice so
+loop-carried taint (a value assigned late in iteration N and read
+early in iteration N+1) is seen; findings are only recorded on the
+final pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("host-sync-in-hot-loop", "jit-in-loop", "donation-aliasing")
+
+# jax entry points that RETURN a compiled/wrapped callable rather than
+# an array — assigning one makes the target a "device function"
+_DEVICE_FACTORIES = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+    "jax.experimental.shard_map.shard_map", "shard_map.shard_map",
+    "jax.vmap", "vmap", "jax.grad", "jax.value_and_grad",
+}
+
+# the subset whose construction in a loop implies per-iteration
+# retrace/recompile (vmap/grad are cheap wrappers; traced once under
+# the enclosing jit, building them in a host loop is idiomatic)
+_RETRACE_FACTORIES = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+    "jax.experimental.shard_map.shard_map", "shard_map.shard_map",
+}
+
+# call roots whose results are host values, never device arrays
+_HOST_ROOTS = ("np.", "numpy.", "math.", "os.", "time.", "re.", "json.")
+_HOST_CALLS = {"host_fetch", "len", "range", "enumerate", "zip", "list",
+               "tuple", "dict", "set", "sorted", "min", "max", "sum",
+               "abs", "str", "repr", "print", "isinstance", "getattr",
+               "hasattr", "float", "int", "bool"}
+
+_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_device_factory_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    if d in _DEVICE_FACTORIES:
+        return True
+    # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
+    if d in ("partial", "functools.partial") and node.args:
+        return dotted(node.args[0]) in _DEVICE_FACTORIES
+    return False
+
+
+def _is_retrace_factory_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    if d in _RETRACE_FACTORIES:
+        return True
+    if d in ("partial", "functools.partial") and node.args:
+        return dotted(node.args[0]) in _RETRACE_FACTORIES
+    return False
+
+
+def _decorated_device(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        if dotted(dec) in _DEVICE_FACTORIES:
+            return True
+        if _is_device_factory_call(dec):
+            return True
+    return False
+
+
+class _Scope:
+    """Mutable taint state for one function (or module) body."""
+
+    def __init__(self, device: Set[str]):
+        self.tainted: Set[str] = set()
+        self.device: Set[str] = set(device)   # device-function names
+        self.returns_tainted = False
+
+
+class _Walker:
+    """Line-ordered statement walk with taint propagation. `record` is
+    False on the warm-up pass over loop bodies."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    # -- expression taint --------------------------------------------------
+
+    def tainted(self, node: ast.AST, s: _Scope) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in s.tainted
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.tainted(node.value, s)
+        if isinstance(node, ast.Call):
+            return self.call_tainted(node, s)
+        if isinstance(node, ast.BinOp):
+            return self.tainted(node.left, s) or self.tainted(node.right, s)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand, s)
+        if isinstance(node, ast.Compare):
+            return self.tainted(node.left, s) or \
+                any(self.tainted(c, s) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body, s) or \
+                self.tainted(node.orelse, s)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.tainted(e, s) for e in node.elts)
+        if isinstance(node, ast.NamedExpr):
+            return self.tainted(node.value, s)
+        return False
+
+    def call_tainted(self, node: ast.Call, s: _Scope) -> bool:
+        d = dotted(node.func)
+        if d:
+            if d in _HOST_CALLS or d.startswith(_HOST_ROOTS):
+                return False
+            if d in _DEVICE_FACTORIES:
+                return False          # a function object, not an array
+            root = d.split(".", 1)[0]
+            if root in ("jnp", "jax", "lax"):
+                return True
+            if d in s.device:
+                return True
+            if isinstance(node.func, ast.Name) and d in s.tainted:
+                return True           # calling a cached jitted fn
+            # method on a tainted object (x.sum(), x.astype(...))
+            if isinstance(node.func, ast.Attribute) and \
+                    self.tainted(node.func.value, s):
+                return True
+            return False
+        # direct call of a factory product: jax.jit(f)(x)
+        if _is_device_factory_call(node.func):
+            return True
+        if isinstance(node.func, ast.Call):
+            return self.call_tainted(node.func, s)
+        return False
+
+    # -- statement walk ----------------------------------------------------
+
+    def walk(self, stmts, s: _Scope, in_loop: bool, record: bool):
+        for st in stmts:
+            self.stmt(st, s, in_loop, record)
+
+    def stmt(self, st: ast.stmt, s: _Scope, in_loop: bool, record: bool):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: its own scope; decide device-ness so
+            # calls to it from this scope taint correctly
+            if _function_is_device(st, s.device, self):
+                s.device.add(st.name)
+            return
+        if isinstance(st, ast.ClassDef):
+            for sub in st.body:
+                self.stmt(sub, s, in_loop, record)
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            if value is not None:
+                self.scan_exprs(value, s, in_loop, record)
+            self.assign(st, s)
+            return
+        if isinstance(st, ast.Expr):
+            self.scan_exprs(st.value, s, in_loop, record)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self.scan_exprs(st.value, s, in_loop, record)
+                if self.tainted(st.value, s):
+                    s.returns_tainted = True
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.scan_exprs(st.iter, s, in_loop, record)
+            if self.tainted(st.iter, s):
+                self.bind_target(st.target, s, True)
+            self.walk(st.body, s, True, False)      # warm-up pass
+            if self.tainted(st.iter, s):
+                self.bind_target(st.target, s, True)
+            self.walk(st.body, s, True, record)
+            self.walk(st.orelse, s, in_loop, record)
+            return
+        if isinstance(st, ast.While):
+            self.scan_exprs(st.test, s, in_loop, record)
+            self.walk(st.body, s, True, False)      # warm-up pass
+            self.walk(st.body, s, True, record)
+            self.walk(st.orelse, s, in_loop, record)
+            return
+        if isinstance(st, ast.If):
+            self.scan_exprs(st.test, s, in_loop, record)
+            self.walk(st.body, s, in_loop, record)
+            self.walk(st.orelse, s, in_loop, record)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.scan_exprs(item.context_expr, s, in_loop, record)
+            self.walk(st.body, s, in_loop, record)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, s, in_loop, record)
+            for h in st.handlers:
+                self.walk(h.body, s, in_loop, record)
+            self.walk(st.orelse, s, in_loop, record)
+            self.walk(st.finalbody, s, in_loop, record)
+            return
+        # pass/break/continue/raise/import/global/... — scan any exprs
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.scan_exprs(child, s, in_loop, record)
+
+    def bind_target(self, target: ast.AST, s: _Scope, taint: bool):
+        if isinstance(target, ast.Name):
+            if taint:
+                s.tainted.add(target.id)
+            else:
+                s.tainted.discard(target.id)
+                s.device.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.bind_target(e, s, taint)
+        elif isinstance(target, ast.Starred):
+            self.bind_target(target.value, s, taint)
+        # Attribute/Subscript stores don't change name taint
+
+    def assign(self, st, s: _Scope):
+        value = st.value
+        if isinstance(st, ast.AugAssign):
+            if value is not None and isinstance(st.target, ast.Name) and \
+                    self.tainted(value, s):
+                s.tainted.add(st.target.id)
+            return
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        if value is None:
+            return
+        if _is_device_factory_call(value):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    s.device.add(t.id)
+                    s.tainted.discard(t.id)
+            return
+        taint = self.tainted(value, s)
+        for t in targets:
+            self.bind_target(t, s, taint)
+
+    # -- finding detection -------------------------------------------------
+
+    def scan_exprs(self, node: ast.AST, s: _Scope, in_loop: bool,
+                   record: bool):
+        """Find sync calls / jit construction in an expression tree."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            if in_loop and record and _is_retrace_factory_call(call):
+                self.findings.append(Finding(
+                    "jit-in-loop", self.path, call.lineno,
+                    call.col_offset,
+                    f"`{ast.unparse(call.func)}` constructed inside a "
+                    "loop body retraces/recompiles every iteration; "
+                    "hoist or cache the compiled callable"))
+                continue
+            if not (in_loop and record):
+                continue
+            d = dotted(call.func)
+            arg0 = call.args[0] if call.args else None
+            if d in _SYNC_BUILTINS and arg0 is not None and \
+                    self.tainted(arg0, s):
+                self.findings.append(Finding(
+                    "host-sync-in-hot-loop", self.path, call.lineno,
+                    call.col_offset,
+                    f"`{d}(...)` on a JAX array inside a loop blocks "
+                    "the host on device compute; accumulate on device "
+                    "and convert after the loop, or use "
+                    "data.pipeline.host_fetch for an intentional, "
+                    "timed sync"))
+            elif d in _SYNC_NP and arg0 is not None and \
+                    self.tainted(arg0, s):
+                self.findings.append(Finding(
+                    "host-sync-in-hot-loop", self.path, call.lineno,
+                    call.col_offset,
+                    f"`{d}(...)` on a JAX array inside a loop forces a "
+                    "device->host transfer per iteration; keep values "
+                    "on device and fetch once after the loop "
+                    "(data.pipeline.host_fetch)"))
+            elif isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "item" and not call.args and \
+                    self.tainted(call.func.value, s):
+                self.findings.append(Finding(
+                    "host-sync-in-hot-loop", self.path, call.lineno,
+                    call.col_offset,
+                    "`.item()` on a JAX array inside a loop blocks the "
+                    "host on device compute; defer the read to after "
+                    "the loop"))
+
+
+def _function_is_device(fn, outer_device: Set[str],
+                        walker: _Walker) -> bool:
+    """Does calling `fn` produce a device array? True when jit-decorated
+    or when its return value is tainted under the taint walk."""
+    if _decorated_device(fn):
+        return True
+    scope = _Scope(outer_device)
+    probe = _Walker(walker.path, [])      # discard findings in probe
+    probe.walk(fn.body, scope, False, False)
+    return scope.returns_tainted
+
+
+# --- donation-aliasing ------------------------------------------------------
+
+def _donated_positions(call: ast.Call) -> Optional[List[int]]:
+    """Literal donate_argnums of a jax.jit(...) call, else None."""
+    if dotted(call.func) not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return [e.value for e in v.elts]
+        return None                        # dynamic — can't reason
+    return None
+
+
+def _walk_scope(body):
+    """Every node lexically in this scope — does NOT descend into
+    nested function definitions (their names are their own scope)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.append(child)
+
+
+def _check_donation(fn_body, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted: Dict[str, List[int]] = {}
+    donated: List[Tuple[str, int, ast.Call]] = []   # (name, call line)
+    loads: Dict[str, List[int]] = {}
+    stores: Dict[str, List[int]] = {}
+
+    for node in _walk_scope(fn_body):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted[t.id] = pos
+        if isinstance(node, ast.Name):
+            book = loads if isinstance(node.ctx, ast.Load) else stores
+            book.setdefault(node.id, []).append(node.lineno)
+
+    for node in _walk_scope(fn_body):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in jitted:
+            for pos in jitted[node.func.id]:
+                if pos < len(node.args) and \
+                        isinstance(node.args[pos], ast.Name):
+                    donated.append((node.args[pos].id, node.lineno,
+                                    node))
+
+    for name, call_line, call in donated:
+        kills = sorted(l for l in stores.get(name, ())
+                       if l >= call_line)
+        for load_line in sorted(loads.get(name, ())):
+            if load_line <= call_line:
+                continue
+            if kills and kills[0] <= load_line:
+                break                     # re-assigned before this read
+            findings.append(Finding(
+                "donation-aliasing", path, load_line, 0,
+                f"`{name}` was donated to a jitted call on line "
+                f"{call_line} (donate_argnums) and is read again here "
+                "without re-assignment; the donated buffer is invalid "
+                "after the call — rebind the name to the call result "
+                "or jnp.copy before donating"))
+            break                          # one finding per donation
+    return findings
+
+
+# --- entry point ------------------------------------------------------------
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    walker = _Walker(path, findings)
+
+    # module-level device functions, to fixpoint (a fn returning the
+    # result of another device fn defined later in the file)
+    device: Set[str] = set()
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            if fn.name in device:
+                continue
+            if _function_is_device(fn, device, walker):
+                device.add(fn.name)
+                changed = True
+
+    # walk the module body and every function body as its own scope
+    walker.walk(tree.body, _Scope(device), False, True)
+    for fn in fns:
+        walker.walk(fn.body, _Scope(device), False, True)
+        findings.extend(_check_donation(fn.body, path))
+    findings.extend(_check_donation(tree.body, path))
+    return findings

@@ -1,0 +1,91 @@
+"""blocking-under-lock: a call that can block indefinitely made while
+lexically holding a lock (`with <something named *lock*>:`) is a
+deadlock seed — every other thread needing that lock stalls behind a
+barrier/queue/sleep it has no part in, and on multi-host any peer in
+the same collective hangs too.
+
+Blocking calls: `time.sleep`, distributed collectives/barriers
+(`writer_barrier`, `sync_global_devices`, `broadcast_one_to_all`,
+`global_row_array`, `barrier`, `allgather`, `psum`), and `.get`/
+`.put`/`.join` on queue-shaped receivers (name contains "queue"/"q").
+Calls inside a nested function definition are not "under" the lock —
+they run whenever the closure runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("blocking-under-lock",)
+
+_BLOCKING_LEAVES = {
+    "sleep", "writer_barrier", "sync_global_devices",
+    "broadcast_one_to_all", "global_row_array", "barrier", "allgather",
+    "psum",
+}
+_QUEUE_METHODS = {"get", "put", "join"}
+_QUEUE_RE = re.compile(r"(^|_)(q|queue|jobs|results|inbox|outbox)"
+                       r"(_|$|\d)", re.IGNORECASE)
+_LOCK_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+
+
+def _lock_name(expr: ast.AST) -> str:
+    """The lock-ish identifier a with-item guards on, '' if none."""
+    node = expr
+    if isinstance(node, ast.Call):       # with make_lock(...)-style
+        node = node.func
+    d = dotted(node)
+    leaf = d.rsplit(".", 1)[-1] if d else ""
+    return leaf if _LOCK_RE.search(leaf) else ""
+
+
+def _blocking(call: ast.Call) -> str:
+    d = dotted(call.func)
+    if not d:
+        return ""
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf in _BLOCKING_LEAVES:
+        return d
+    if leaf in _QUEUE_METHODS and isinstance(call.func, ast.Attribute):
+        recv = dotted(call.func.value)
+        recv_leaf = recv.rsplit(".", 1)[-1] if recv else ""
+        if recv_leaf and _QUEUE_RE.search(recv_leaf):
+            return d
+    return ""
+
+
+def _scan_body(body, lock: str, path: str,
+               findings: List[Finding]) -> None:
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue                      # closure body runs later
+        if isinstance(node, ast.Call):
+            name = _blocking(node)
+            if name:
+                findings.append(Finding(
+                    "blocking-under-lock", path, node.lineno,
+                    node.col_offset,
+                    f"`{name}(...)` can block indefinitely while "
+                    f"`{lock}` is held; move the blocking call "
+                    "outside the with-block or snapshot state "
+                    "under the lock and act on it after release"))
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            lock = _lock_name(item.context_expr)
+            if lock:
+                _scan_body(node.body, lock, path, findings)
+                break
+    return findings

@@ -218,6 +218,39 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_knobs(args) -> int:
+    """Print the SHIFU_TPU_* knob registry: every tunable the codebase
+    reads, with type, documented default, current value and doc (the
+    static analyzer guarantees the list is complete — an undeclared
+    read is a lint failure)."""
+    from shifu_tpu.config.environment import knobs_markdown, knobs_rows
+    try:
+        if getattr(args, "markdown", False):
+            print(knobs_markdown(), end="")
+            return 0
+        rows = knobs_rows()
+        if not getattr(args, "all", False):
+            rows = [r for r in rows
+                    if r["scope"] == "package" or r["current"]]
+        name_w = max(len(r["name"]) for r in rows)
+        type_w = max(len(r["type"]) for r in rows)
+        dflt_w = max(max(len(r["default"]) for r in rows), len("default"))
+        cur_w = max(max(len(r["current"]) for r in rows), len("current"))
+        print(f"{'knob':<{name_w}}  {'type':<{type_w}}  "
+              f"{'default':<{dflt_w}}  {'current':<{cur_w}}  doc")
+        for r in rows:
+            cur = r["current"] or "-"
+            dflt = r["default"] or "-"
+            print(f"{r['name']:<{name_w}}  {r['type']:<{type_w}}  "
+                  f"{dflt:<{dflt_w}}  {cur:<{cur_w}}  {r['doc']}")
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; redirect stdout to
+        # devnull so interpreter shutdown doesn't re-raise on flush
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="shifu_tpu",
@@ -329,6 +362,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_switch)
     sub.add_parser("show", help="list model-set snapshots") \
         .set_defaults(fn=cmd_show)
+    p = sub.add_parser("knobs",
+                       help="list every SHIFU_TPU_* knob (type/default/"
+                            "current/doc)")
+    p.add_argument("--all", action="store_true",
+                   help="include bench/tools-scoped knobs even when unset")
+    p.add_argument("--markdown", action="store_true",
+                   help="emit the markdown table (same as python -m "
+                        "shifu_tpu.analysis --knobs-md)")
+    p.set_defaults(fn=cmd_knobs)
     sub.add_parser("version").set_defaults(fn=cmd_version)
     return ap
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 log = logging.getLogger(__name__)
 
@@ -80,3 +80,246 @@ def load_shifuconfig(shifu_home: Optional[str] = None) -> Dict[str, str]:
     for k, v in merged.items():
         os.environ.setdefault(k, v)
     return merged
+
+
+# ---------------------------------------------------------------------------
+# central knob registry
+# ---------------------------------------------------------------------------
+#
+# Every SHIFU_TPU_* environment knob the codebase reads is DECLARED here
+# with its type, documented default and one-line doc. The static
+# analyzer (`python -m shifu_tpu.analysis`) enforces the contract both
+# ways: an os.environ/getenv read of an undeclared SHIFU_TPU_* name is a
+# lint finding (`undeclared-knob`), and a declared knob no scanned file
+# references is a dead registry entry. `shifu knobs` prints this table
+# with current values; `python -m shifu_tpu.analysis --knobs-md`
+# renders it as markdown (KNOBS.md).
+#
+# `default=None` means "unset = auto/off" — the reading site owns the
+# contextual fallback (e.g. SHIFU_TPU_MESH_DEVICES unset = all devices).
+# `scope` says where the knob is read: "package" entries must be
+# referenced inside shifu_tpu/ itself; "bench"/"tools" entries live in
+# bench.py / tools/ and are exempt from the dead-entry check when only
+# the package is scanned.
+
+class Knob(NamedTuple):
+    name: str
+    type: str            # int | float | str | bool | flag
+    default: object      # documented default; None = unset (auto/off)
+    doc: str
+    scope: str = "package"
+
+
+KNOBS: "Dict[str, Knob]" = {}
+
+
+def _declare(name: str, type_: str, default, doc: str,
+             scope: str = "package") -> None:
+    KNOBS[name] = Knob(name, type_, default, doc, scope)
+
+
+# --- resilience / retries / faults ---
+_declare("SHIFU_TPU_RETRY_ATTEMPTS", "int", 4,
+         "max attempts per retried remote-I/O call")
+_declare("SHIFU_TPU_RETRY_BASE_S", "float", 0.05,
+         "first retry backoff delay (seconds)")
+_declare("SHIFU_TPU_RETRY_MAX_S", "float", 2.0,
+         "retry backoff cap (seconds)")
+_declare("SHIFU_TPU_FAULT", "str", None,
+         "deterministic fault spec <site>:<kind>:<nth>[;...]")
+_declare("SHIFU_TPU_RESUME", "flag", "0",
+         "1 = skip steps whose completion manifest matches inputs")
+_declare("SHIFU_TPU_MAX_RESTARTS", "int", 0,
+         "supervised in-process restarts around the train step")
+_declare("SHIFU_TPU_ABORT_DIR", "str", None,
+         "abort-marker directory override (normally set by step_guard)")
+_declare("SHIFU_TPU_LOCKCHECK", "flag", "0",
+         "1 = instrumented locks record acquisition order and fail "
+         "the run on a lock-order cycle (analysis.lockcheck)")
+# --- distributed runtime ---
+_declare("SHIFU_TPU_COORDINATOR", "str", None,
+         "coordinator address for jax.distributed.initialize")
+_declare("SHIFU_TPU_NUM_PROCESSES", "int", None,
+         "process count for multi-host init (None = auto)")
+_declare("SHIFU_TPU_PROCESS_ID", "int", None,
+         "this process's index for multi-host init (None = auto)")
+_declare("SHIFU_TPU_INIT_TIMEOUT_S", "float", None,
+         "bound on the jax.distributed coordinator handshake")
+_declare("SHIFU_TPU_BARRIER_TIMEOUT_S", "float", None,
+         "collective watchdog deadline; unset = block forever")
+_declare("SHIFU_TPU_MESH_DEVICES", "int", None,
+         "cap the device count in the default mesh (None = all)")
+_declare("SHIFU_TPU_MESH_MODEL", "int", 1,
+         "devices on the 'model' mesh axis (WDL/MTL table sharding)")
+# --- input pipeline ---
+_declare("SHIFU_TPU_PREFETCH_DEPTH", "int", 2,
+         "chunks buffered ahead of the consumer; 0 = sequential")
+_declare("SHIFU_TPU_PREFETCH_WORKERS", "int", 2,
+         "host-assembly threads for map_prefetch; 0 = sequential")
+_declare("SHIFU_TPU_NATIVE_READER", "bool", "1",
+         "use the native C fast reader when the .so is present")
+# --- streaming chunk triggers ---
+_declare("SHIFU_TPU_STATS_CHUNK_ROWS", "int", None,
+         "explicit stats streaming chunk rows; 0 forces resident")
+_declare("SHIFU_TPU_STATS_STREAM_BYTES", "int", 2 * 1024 ** 3,
+         "raw-bytes threshold that auto-triggers streaming stats")
+_declare("SHIFU_TPU_NORM_CHUNK_ROWS", "int", None,
+         "explicit norm streaming chunk rows; 0 forces resident")
+_declare("SHIFU_TPU_NORM_STREAM_BYTES", "int", 2 * 1024 ** 3,
+         "raw-bytes threshold that auto-triggers streaming norm")
+_declare("SHIFU_TPU_EVAL_CHUNK_ROWS", "int", None,
+         "explicit eval streaming chunk rows; 0 forces resident")
+_declare("SHIFU_TPU_EVAL_STREAM_BYTES", "int", 2 * 1024 ** 3,
+         "raw-bytes threshold that auto-triggers streaming eval")
+_declare("SHIFU_TPU_ANALYSIS_CHUNK_ROWS", "int", None,
+         "explicit analysis-step chunk rows; 0 forces resident")
+_declare("SHIFU_TPU_ANALYSIS_STREAM_BYTES", "int", 2 * 1024 ** 3,
+         "raw-bytes threshold that auto-triggers sampled analysis")
+_declare("SHIFU_TPU_ANALYSIS_MAX_ROWS", "int", 2_000_000,
+         "row cap for the sampled analysis frame (varselect)")
+# --- device compute ---
+_declare("SHIFU_TPU_HIST", "str", "auto",
+         "histogram kernel route: auto | pallas | xla")
+_declare("SHIFU_TPU_HIST_PRECISION", "str", None,
+         "'highest' switches the pallas histogram to f32-exact")
+_declare("SHIFU_TPU_HIST_SUBTRACT", "bool", "1",
+         "sibling-subtraction trick in GBT histogram builds")
+_declare("SHIFU_TPU_HIST_VMEM_MB", "int", 64,
+         "VMEM budget for pallas histogram tiling")
+_declare("SHIFU_TPU_GBT_ROUTE", "str", "gather",
+         "GBT split-feature routing: gather | onehot")
+_declare("SHIFU_TPU_GBT_SCAN_GROUP", "int", 0,
+         "trees per lax.scan group in GBT build; 0 = no grouping")
+_declare("SHIFU_TPU_NN_COMPUTE", "str", "float32",
+         "NN forward/backward compute dtype (float32 | bfloat16)")
+# --- export ---
+_declare("SHIFU_TPU_UME_EXPORTER", "str", None,
+         "pkg.module:Class hook for `export -t ume` bundles")
+# --- bench / tools (read outside the package) ---
+_declare("SHIFU_TPU_BENCH_ATTEMPTS", "int", 2,
+         "re-measure attempts per bench workload", scope="bench")
+_declare("SHIFU_TPU_BENCH_REFRESH", "flag", "0",
+         "1 = re-measure even when a baseline record exists",
+         scope="bench")
+_declare("SHIFU_TPU_BENCH_STREAMING", "bool", "1",
+         "0 = skip the streaming-trainer bench workload",
+         scope="bench")
+_declare("SHIFU_TPU_RF_ROWS", "int", 11_000_000,
+         "row count for the RF bench workload", scope="bench")
+_declare("SHIFU_TPU_RF_TREES", "int", 40,
+         "tree count for the RF bench workload", scope="bench")
+_declare("SHIFU_TPU_STREAM_ROWS", "int", 15_000_000,
+         "row count for the streaming-trainer bench", scope="bench")
+_declare("SHIFU_TPU_STREAM_FEATURES", "int", 300,
+         "feature count for the streaming-trainer bench",
+         scope="bench")
+_declare("SHIFU_TPU_STREAM_CHUNK_ROWS", "int", 262_144,
+         "chunk rows for the streaming-trainer bench", scope="bench")
+_declare("SHIFU_TPU_PIPE_ROWS", "int", 1_000_000,
+         "row count for the input-pipeline bench", scope="bench")
+_declare("SHIFU_TPU_PIPE_EPOCHS", "int", 30,
+         "epochs for the input-pipeline bench", scope="bench")
+_declare("SHIFU_TPU_GBT_TRACE", "flag", "0",
+         "1 = capture a jax.profiler trace in tools/profile_gbt.py",
+         scope="tools")
+
+
+def _require(name: str) -> Knob:
+    k = KNOBS.get(name)
+    if k is None:
+        raise KeyError(
+            f"{name} is not declared in the knob registry "
+            "(shifu_tpu/config/environment.py) — declare it there; the "
+            "static analyzer rejects undeclared SHIFU_TPU_* reads")
+    return k
+
+
+def knob_raw(name: str) -> Optional[str]:
+    """The raw environment string for a DECLARED knob, or None when
+    unset. The one sanctioned os.environ read for SHIFU_TPU_* names."""
+    _require(name)
+    return os.environ.get(name)
+
+
+def knob_is_set(name: str) -> bool:
+    v = knob_raw(name)
+    return v is not None and v.strip() != ""
+
+
+def knob_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    """Declared knob as int; a malformed value falls back to the
+    registry default (matching the historical _env_int semantics —
+    a typo'd knob must not crash a multi-day run)."""
+    k = _require(name)
+    raw = os.environ.get(name)
+    fallback = default if default is not None else k.default
+    if raw is None or raw.strip() == "":
+        return fallback
+    try:
+        return int(float(raw))
+    except ValueError:
+        log.warning("ignoring malformed %s=%r (want int); using %r",
+                    name, raw, fallback)
+        return fallback
+
+
+def knob_float(name: str,
+               default: Optional[float] = None) -> Optional[float]:
+    k = _require(name)
+    raw = os.environ.get(name)
+    fallback = default if default is not None else k.default
+    if raw is None or raw.strip() == "":
+        return fallback
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r (want float); using %r",
+                    name, raw, fallback)
+        return fallback
+
+
+def knob_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    k = _require(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default if default is not None else k.default
+    return raw
+
+
+def knob_bool(name: str, default: Optional[bool] = None) -> bool:
+    """bool/flag knobs: "0"/"false"/"no"/"off" (any case) are False,
+    anything else set is True; unset uses the registry default."""
+    k = _require(name)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        raw = str(k.default if default is None else default)
+    return raw.strip().lower() not in ("0", "false", "no", "off", "none")
+
+
+def knobs_rows() -> List[dict]:
+    """One row per declared knob: name, type, default, current value
+    (unset → ''), doc, scope — the `shifu knobs` table."""
+    rows = []
+    for k in sorted(KNOBS.values()):
+        cur = os.environ.get(k.name)
+        rows.append({"name": k.name, "type": k.type,
+                     "default": "" if k.default is None else str(k.default),
+                     "current": "" if cur is None else cur,
+                     "doc": k.doc, "scope": k.scope})
+    return rows
+
+
+def knobs_markdown() -> str:
+    """The knob reference table as markdown (KNOBS.md;
+    `python -m shifu_tpu.analysis --knobs-md`)."""
+    out = ["# SHIFU_TPU_* knob reference",
+           "",
+           "Auto-generated by `python -m shifu_tpu.analysis --knobs-md`"
+           " from the registry in `shifu_tpu/config/environment.py`.",
+           "",
+           "| Knob | Type | Default | Doc |",
+           "|---|---|---|---|"]
+    for k in sorted(KNOBS.values()):
+        default = "*(unset)*" if k.default is None else f"`{k.default}`"
+        out.append(f"| `{k.name}` | {k.type} | {default} | {k.doc} |")
+    return "\n".join(out) + "\n"

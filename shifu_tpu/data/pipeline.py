@@ -60,12 +60,13 @@ from __future__ import annotations
 
 import collections
 import logging
-import os
 import queue
 import threading
 import time
 from typing import Callable, Dict, Iterable, Iterator, Sequence, TypeVar
 
+from shifu_tpu.analysis.lockcheck import make_lock
+from shifu_tpu.config.environment import knob_int
 from shifu_tpu.resilience import fault_point
 
 log = logging.getLogger("shifu_tpu")
@@ -76,28 +77,21 @@ U = TypeVar("U")
 FETCH_SITE = "pipeline.fetch"
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 def prefetch_depth() -> int:
     """SHIFU_TPU_PREFETCH_DEPTH (chunks buffered ahead; 0 = off)."""
-    return max(_env_int("SHIFU_TPU_PREFETCH_DEPTH", 2), 0)
+    return max(knob_int("SHIFU_TPU_PREFETCH_DEPTH"), 0)
 
 
 def prefetch_workers() -> int:
     """SHIFU_TPU_PREFETCH_WORKERS (assembly threads; 0 = off)."""
-    return max(_env_int("SHIFU_TPU_PREFETCH_WORKERS", 2), 0)
+    return max(knob_int("SHIFU_TPU_PREFETCH_WORKERS"), 0)
 
 
 # ---------------------------------------------------------------------------
 # per-stage wall-time accumulator (drained into steps.jsonl)
 # ---------------------------------------------------------------------------
 
-_timers_lock = threading.Lock()
+_timers_lock = make_lock("pipeline.timers")
 _timers: collections.Counter = collections.Counter()
 
 
@@ -123,6 +117,22 @@ def drain_stage_timers() -> Dict[str, float]:
     with _timers_lock:
         out = {k: round(float(v), 6) for k, v in _timers.items()}
         _timers.clear()
+    return out
+
+
+def host_fetch(x):
+    """The ONE sanctioned device→host sync in hot paths: block on `x`,
+    return it as a numpy array, and accrue the wait into the
+    ``host_sync_s`` stage timer so an intentional sync shows up in
+    ``steps.jsonl`` instead of hiding as generic slowness. The lint
+    rule ``host-sync-in-hot-loop`` flags raw ``np.asarray``/``float``/
+    ``.item()`` on device values inside loops; routing a *deliberate*
+    per-chunk or per-epoch fetch through here keeps the loop clean and
+    the cost measured."""
+    import numpy as np
+    t0 = time.perf_counter()
+    out = np.asarray(x)
+    add_stage_time("host_sync_s", time.perf_counter() - t0)
     return out
 
 

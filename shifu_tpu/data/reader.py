@@ -18,6 +18,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 import pandas as pd
 
+from shifu_tpu.config.environment import knob_bool
 from shifu_tpu.config.model_config import ModelConfig, ModelSourceDataConf
 from shifu_tpu.data import fs as fs_mod
 from shifu_tpu.resilience import retrying
@@ -173,7 +174,7 @@ def read_raw_table(mc: ModelConfig,
     if numeric_columns and max_rows is None and \
             not any(fs_mod.has_scheme(p) for p in files) and \
             not any(is_parquet(p) for p in files) and \
-            os.environ.get("SHIFU_TPU_NATIVE_READER", "1") != "0":
+            knob_bool("SHIFU_TPU_NATIVE_READER"):
         from shifu_tpu.data.native_reader import read_files_native
         names = simple if simple is not None else list(header)
         df = read_files_native(

@@ -20,13 +20,14 @@ Activations mirror `core/dtrain/layer/activation/*`
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from shifu_tpu.config.environment import knob_str
 
 Params = List[Dict[str, jax.Array]]
 
@@ -134,7 +135,7 @@ class MLPSpec:
         reg = float(get("RegularizedConstant", 0.0) or 0.0)
         l1orl2 = str(get("L1orL2", "L2") or "L2").upper()
         cd = str(get("ComputeDtype",
-                     os.environ.get("SHIFU_TPU_NN_COMPUTE", "float32"))
+                     knob_str("SHIFU_TPU_NN_COMPUTE"))
                  or "float32").lower()
         if cd in ("bf16", "bfloat16"):
             cd = "bfloat16"

@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from shifu_tpu.config.environment import knob_int, knob_str
+
 __all__ = ["level_histograms_pallas"]
 
 
@@ -114,7 +116,7 @@ def derive_tiles(n_cols: int, n_slots: int, n_bins: int,
     buffering halves what a kernel may scope);
     SHIFU_TPU_HIST_VMEM_MB overrides for other parts."""
     import os
-    budget = int(os.environ.get("SHIFU_TPU_HIST_VMEM_MB", 64)) << 20
+    budget = knob_int("SHIFU_TPU_HIST_VMEM_MB") << 20
     col_tile = min(128, max(1, n_cols))
     row_tile = 64 if highest else 512
 
@@ -150,9 +152,8 @@ def level_histograms_pallas(binsT: jax.Array, slot: jax.Array,
     SHIFU_TPU_HIST_PRECISION=highest switches to the f32-exact
     multi-pass algorithm, which needs a small row tile to fit scoped
     VMEM (measured 0.35 s — still ~28× the scatter)."""
-    import os
-    highest = os.environ.get("SHIFU_TPU_HIST_PRECISION",
-                             "").lower() == "highest"
+    highest = (knob_str("SHIFU_TPU_HIST_PRECISION", "") or
+               "").lower() == "highest"
     d_row, d_col = derive_tiles(binsT.shape[0], n_slots, n_bins, highest)
     row_tile = row_tile or d_row
     col_tile = col_tile or d_col

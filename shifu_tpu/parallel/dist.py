@@ -26,7 +26,6 @@ this testable single-process.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from contextlib import contextmanager
@@ -35,6 +34,8 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from shifu_tpu.analysis.lockcheck import make_lock
+from shifu_tpu.config.environment import knob_float, knob_int, knob_str
 from shifu_tpu.resilience import fault_point
 
 log = logging.getLogger("shifu_tpu")
@@ -53,15 +54,24 @@ class DistAborted(RuntimeError):
 def barrier_timeout_s() -> Optional[float]:
     """SHIFU_TPU_BARRIER_TIMEOUT_S as seconds, or None (no deadline —
     the pre-watchdog behavior: block forever)."""
-    raw = os.environ.get("SHIFU_TPU_BARRIER_TIMEOUT_S", "").strip()
-    if not raw:
-        return None
-    try:
-        v = float(raw)
-    except ValueError:
-        log.warning("ignoring bad SHIFU_TPU_BARRIER_TIMEOUT_S=%r", raw)
-        return None
-    return v if v > 0 else None
+    v = knob_float("SHIFU_TPU_BARRIER_TIMEOUT_S")
+    return v if v is not None and v > 0 else None
+
+
+# collectives currently blocked inside _watched, so a watchdog timeout
+# can say WHICH barriers the process was stuck in (threaded pipelines
+# can have several in flight) — guarded by the instrumented-lock shim
+_inflight_lock = make_lock("dist.inflight")
+_inflight: dict = {}
+_inflight_seq = 0
+
+
+def inflight_collectives() -> dict:
+    """tag -> seconds-in-flight for every collective some thread is
+    blocked on right now."""
+    with _inflight_lock:
+        now = time.monotonic()
+        return {k: round(now - v, 3) for k, v in _inflight.items()}
 
 
 def _my_index() -> int:
@@ -102,28 +112,40 @@ def _watched(tag: str, fn: Callable):
 
     t = threading.Thread(target=_call, daemon=True,
                          name=f"shifu-collective-{tag}")
+    global _inflight_seq
+    with _inflight_lock:
+        _inflight_seq += 1
+        key = f"{tag}#{_inflight_seq}"
+        _inflight[key] = time.monotonic()
     t.start()
-    deadline = None if timeout is None else time.monotonic() + timeout
-    last_abort_check = 0.0
-    while not done.wait(0.1):
-        now = time.monotonic()
-        if now - last_abort_check >= 0.5:
-            last_abort_check = now
-            ab = resilience.check_abort()
-            if ab and ab.get("process") != _my_index():
-                raise _abort_error(tag, ab)
-        if deadline is not None and now > deadline:
-            resilience.dump_thread_stacks(
-                f"collective {tag!r} timed out after "
-                f"SHIFU_TPU_BARRIER_TIMEOUT_S={timeout}s")
-            raise DistTimeout(
-                f"collective {tag!r} did not complete within "
-                f"SHIFU_TPU_BARRIER_TIMEOUT_S={timeout}s — a peer host "
-                "likely died or fell behind; thread stacks dumped to "
-                "stderr and steps.jsonl")
-    if "error" in box:
-        raise box["error"]
-    return box.get("value")
+    try:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        last_abort_check = 0.0
+        while not done.wait(0.1):
+            now = time.monotonic()
+            if now - last_abort_check >= 0.5:
+                last_abort_check = now
+                ab = resilience.check_abort()
+                if ab and ab.get("process") != _my_index():
+                    raise _abort_error(tag, ab)
+            if deadline is not None and now > deadline:
+                stuck = inflight_collectives()
+                resilience.dump_thread_stacks(
+                    f"collective {tag!r} timed out after "
+                    f"SHIFU_TPU_BARRIER_TIMEOUT_S={timeout}s "
+                    f"(in flight: {stuck})")
+                raise DistTimeout(
+                    f"collective {tag!r} did not complete within "
+                    f"SHIFU_TPU_BARRIER_TIMEOUT_S={timeout}s — a peer "
+                    "host likely died or fell behind; in-flight "
+                    f"collectives: {stuck}; thread stacks dumped to "
+                    "stderr and steps.jsonl")
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
+    finally:
+        with _inflight_lock:
+            _inflight.pop(key, None)
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -140,17 +162,17 @@ def initialize(coordinator_address: Optional[str] = None,
     indefinite hang."""
     fault_point("dist.init")
     coordinator_address = coordinator_address or \
-        os.environ.get("SHIFU_TPU_COORDINATOR")
-    if num_processes is None and "SHIFU_TPU_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["SHIFU_TPU_NUM_PROCESSES"])
-    if process_id is None and "SHIFU_TPU_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["SHIFU_TPU_PROCESS_ID"])
+        knob_str("SHIFU_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = knob_int("SHIFU_TPU_NUM_PROCESSES")
+    if process_id is None:
+        process_id = knob_int("SHIFU_TPU_PROCESS_ID")
     if num_processes in (None, 1) and coordinator_address is None:
         return
     kwargs = {}
-    timeout_s = os.environ.get("SHIFU_TPU_INIT_TIMEOUT_S")
+    timeout_s = knob_float("SHIFU_TPU_INIT_TIMEOUT_S")
     if timeout_s:
-        kwargs["initialization_timeout"] = int(float(timeout_s))
+        kwargs["initialization_timeout"] = int(timeout_s)
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

@@ -27,6 +27,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from shifu_tpu.config.environment import knob_int
+
 
 _MESH_CACHE: dict = {}
 
@@ -39,15 +41,14 @@ def default_mesh() -> Mesh:
     parallel/dist.initialize). SHIFU_TPU_MESH_DEVICES=N caps the
     device count (tests use it to compare 8-device vs 1-device runs).
     """
-    import os
-    cap = os.environ.get("SHIFU_TPU_MESH_DEVICES")
+    cap = knob_int("SHIFU_TPU_MESH_DEVICES")
     devs = jax.devices()
     n = min(int(cap), len(devs)) if cap else len(devs)
     # SHIFU_TPU_MESH_MODEL=K carves K devices onto the 'model' axis for
     # vocab-heavy WDL/MTL configs (embedding tables sharded instead of
     # replicated); default 1 = pure data parallel, the reference's only
     # strategy
-    n_model = int(os.environ.get("SHIFU_TPU_MESH_MODEL", "1") or 1)
+    n_model = knob_int("SHIFU_TPU_MESH_MODEL") or 1
     if n_model < 1 or n % n_model != 0:
         raise ValueError(
             f"SHIFU_TPU_MESH_MODEL={n_model} must divide the device "

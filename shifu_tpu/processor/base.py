@@ -21,6 +21,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from shifu_tpu.config.environment import knob_bool
 from shifu_tpu.config.column_config import (ColumnConfig, load_column_configs,
                                             save_column_configs)
 from shifu_tpu.config.inspector import ModelStep, probe
@@ -122,7 +123,7 @@ def step_guard(ctx: ProcessorContext, step: str,
     pf = ctx.path_finder
     mpath = pf.manifest_path(step)
     fp = _inputs_fingerprint(ctx)
-    if os.environ.get("SHIFU_TPU_RESUME", "0") == "1" \
+    if knob_bool("SHIFU_TPU_RESUME") \
             and os.path.exists(mpath):
         try:
             with open(mpath) as f:

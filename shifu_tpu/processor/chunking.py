@@ -14,6 +14,17 @@ import os
 
 import numpy as np
 
+from shifu_tpu.config.environment import knob_int, knob_raw
+
+
+def _env_lookup(key):
+    """Env lookup that keeps SHIFU_TPU_* reads honest: registry
+    accessor for declared knobs, plain environ for the java-style
+    `shifu.*` property keys the reference also honors."""
+    if key.startswith("SHIFU_TPU_"):
+        return knob_raw(key)
+    return os.environ.get(key)
+
 
 def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
                    label: str, default_rows: int = 2_000_000) -> int:
@@ -23,7 +34,7 @@ def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
     Compressed parts count at a conservative ~6× text expansion."""
     v = None
     for k in env_keys:
-        cand = os.environ.get(k)
+        cand = _env_lookup(k)
         if cand is not None and str(cand).strip() != "":
             v = cand
             break
@@ -58,7 +69,7 @@ def chunk_rows_for(ctx, env_keys, byte_env: str, data_path: str,
             "auto-trigger disabled, falling back to resident read",
             label, e)
         return 0
-    raw_limit = os.environ.get(byte_env)
+    raw_limit = _env_lookup(byte_env)
     if raw_limit is None or str(raw_limit).strip() == "":
         limit = 2 * 1024 ** 3
     else:
@@ -163,7 +174,7 @@ def analysis_frame(ctx, log=None):
     if not chunk:
         ctx._analysis_frame = None
         return None
-    cap = int(os.environ.get("SHIFU_TPU_ANALYSIS_MAX_ROWS", 2_000_000))
+    cap = knob_int("SHIFU_TPU_ANALYSIS_MAX_ROWS")
     if log is not None:
         log.warning("dataset exceeds the resident threshold — analysis "
                     "step runs on a ~%d-row uniform sample "

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from shifu_tpu.config.environment import knob_raw
 from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import EvalConfig, ModelConfig
 from shifu_tpu.data.dataset import build_columnar
@@ -202,7 +203,7 @@ def eval_chunk_rows(ctx: ProcessorContext, ec: EvalConfig) -> int:
     v = ec._extras.get("chunkRows")
     if v is not None and str(v).strip() != "" \
             and not os.environ.get("shifu.eval.chunkRows") \
-            and not os.environ.get("SHIFU_TPU_EVAL_CHUNK_ROWS"):
+            and not knob_raw("SHIFU_TPU_EVAL_CHUNK_ROWS"):
         try:
             return max(int(float(v)), 0)   # explicit 0 = resident mode
         except (TypeError, ValueError):

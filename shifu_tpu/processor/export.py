@@ -13,6 +13,7 @@ import logging
 import os
 import time
 
+from shifu_tpu.config.environment import knob_str
 from shifu_tpu.processor.base import ProcessorContext, step_guard
 
 from shifu_tpu.resilience import atomic_write
@@ -238,7 +239,7 @@ def _export_ume(ctx: ProcessorContext, et: str) -> int:
     .translate(model_set_name, params)."""
     import importlib
 
-    target = os.environ.get("SHIFU_TPU_UME_EXPORTER")
+    target = knob_str("SHIFU_TPU_UME_EXPORTER")
     if not target or ":" not in target:
         log.error("UME exporter not configured (set SHIFU_TPU_UME_"
                   "EXPORTER=pkg.module:Class); the reference's "

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from shifu_tpu.config.environment import knob_raw
 from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import BinningMethod
 from shifu_tpu.data.dataset import build_columnar
@@ -56,7 +57,7 @@ def explicitly_requested() -> bool:
     size trigger falls back to resident for configs streaming cannot
     serve — segments, DateStats)."""
     return bool(os.environ.get("shifu.stats.chunkRows")
-                or os.environ.get("SHIFU_TPU_STATS_CHUNK_ROWS"))
+                or knob_raw("SHIFU_TPU_STATS_CHUNK_ROWS"))
 
 
 def stats_chunk_rows(ctx: ProcessorContext) -> int:

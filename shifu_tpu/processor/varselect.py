@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.data.pipeline import host_fetch
 from shifu_tpu.config.inspector import ModelStep
 from shifu_tpu.config.model_config import ModelConfig
 from shifu_tpu.models import nn as nn_mod
@@ -414,7 +415,9 @@ def _filter_by_voted_wrapper(ctx: ProcessorContext,
         return jax.vmap(one)(masks, keys)
 
     for gen in range(generations):
-        errs = np.asarray(fitness(jnp.asarray(pop)))
+        # the GA is host-driven: selection/crossover need this
+        # generation's fitness on host before the next can dispatch
+        errs = host_fetch(fitness(jnp.asarray(pop)))
         order = np.argsort(errs)
         n_keep = max(pop_size // 2, 2)
         survivors = pop[order[:n_keep]]

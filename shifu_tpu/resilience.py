@@ -84,6 +84,9 @@ import traceback
 from contextlib import contextmanager
 from typing import Callable, Iterable, List, NamedTuple, Optional
 
+from shifu_tpu.analysis.lockcheck import make_lock
+from shifu_tpu.config.environment import knob_float, knob_int, knob_str
+
 log = logging.getLogger("shifu_tpu")
 
 # ---------------------------------------------------------------------------
@@ -182,7 +185,7 @@ def _parse_fault_spec(raw: str) -> List[_FaultRule]:
 def fault_point(site: str) -> None:
     """Instrumentation seam: no-op unless SHIFU_TPU_FAULT names `site`."""
     global _rules_cache
-    raw = os.environ.get("SHIFU_TPU_FAULT", "")
+    raw = knob_str("SHIFU_TPU_FAULT", "") or ""
     if not raw:
         return
     if _rules_cache[0] != raw:
@@ -214,24 +217,10 @@ def fault_point(site: str) -> None:
 # retry
 # ---------------------------------------------------------------------------
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 # per-site retry accounting (surfaced in `shifu test` output and each
 # step's tmp/metrics/steps.jsonl line) — thread-safe: retried I/O can
 # run on pipeline prefetch workers
-_retry_lock = threading.Lock()
+_retry_lock = make_lock("resilience.retry_stats")
 _retry_stats: dict = {}
 
 
@@ -262,9 +251,9 @@ def retrying(site: str, fn: Callable, *args, **kwargs):
     """Call `fn(*args, **kwargs)` with bounded exponential-backoff
     retries on transient errors. The site's fault point fires before
     every attempt, so injected faults go through the real loop."""
-    attempts = max(_env_int("SHIFU_TPU_RETRY_ATTEMPTS", 4), 1)
-    base = _env_float("SHIFU_TPU_RETRY_BASE_S", 0.05)
-    cap = _env_float("SHIFU_TPU_RETRY_MAX_S", 2.0)
+    attempts = max(knob_int("SHIFU_TPU_RETRY_ATTEMPTS"), 1)
+    base = knob_float("SHIFU_TPU_RETRY_BASE_S")
+    cap = knob_float("SHIFU_TPU_RETRY_MAX_S")
     for attempt in range(1, attempts + 1):
         try:
             fault_point(site)
@@ -511,7 +500,7 @@ def set_abort_scope(tmp_dir: Optional[str]) -> None:
 
 
 def _abort_dir() -> Optional[str]:
-    return _abort_scope or os.environ.get("SHIFU_TPU_ABORT_DIR")
+    return _abort_scope or knob_str("SHIFU_TPU_ABORT_DIR")
 
 
 def _abort_path() -> Optional[str]:
@@ -602,7 +591,7 @@ def clear_abort() -> None:
 # step_metrics drains; dump_thread_stacks ALSO appends a standalone
 # line immediately, because a hung/killed process may never reach the
 # step record
-_events_lock = threading.Lock()
+_events_lock = make_lock("resilience.events")
 _events: List[dict] = []
 
 
@@ -736,9 +725,9 @@ def supervise(fn: Callable[[], "object"], step: str = "train",
     step's ``steps.jsonl`` line and appended durably when a scope is
     set. Permanent errors and exhausted budgets re-raise."""
     if max_restarts is None:
-        max_restarts = max(_env_int("SHIFU_TPU_MAX_RESTARTS", 0), 0)
-    base = _env_float("SHIFU_TPU_RETRY_BASE_S", 0.05)
-    cap = _env_float("SHIFU_TPU_RETRY_MAX_S", 2.0)
+        max_restarts = max(knob_int("SHIFU_TPU_MAX_RESTARTS"), 0)
+    base = knob_float("SHIFU_TPU_RETRY_BASE_S")
+    cap = knob_float("SHIFU_TPU_RETRY_MAX_S")
     restarts = 0
     while True:
         clear_preempt()

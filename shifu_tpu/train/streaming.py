@@ -544,6 +544,8 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 (seed ^ 0x5EED) + epoch).permutation(len(train_chunks))
             epoch_loss = np.zeros(n_bags, np.float64)
             epoch_w = np.zeros(n_bags, np.float64)
+            loss_parts: list = []   # per-chunk DEVICE values; host
+            sw_parts: list = []     # sync deferred to epoch end
             # host assembly of upcoming chunks runs on pipeline
             # workers; only the (async) device placement happens here,
             # one chunk ahead of the update consuming it
@@ -560,9 +562,10 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 t_dev = time.monotonic()
                 stacked, opt_state, loss, sw = update(stacked, opt_state,
                                                       *cur, sub)
-                sw = np.asarray(sw, np.float64)
-                epoch_loss += np.asarray(loss, np.float64) * sw
-                epoch_w += sw
+                # loss/sw stay on device: converting here would block
+                # the host per chunk and drain the dispatch pipeline
+                loss_parts.append(loss)
+                sw_parts.append(sw)
                 pipe.add_stage_time("device_step_s",
                                     time.monotonic() - t_dev)
             if prev_stacked is not None:
@@ -573,11 +576,23 @@ def train_streaming_core(train_conf: ModelTrainConf,
                         keep.reshape((-1,) + (1,) * (new.ndim - 1)),
                         old, new),
                     stacked, prev_stacked)
+            # ONE device->host sync for the whole epoch (timed as
+            # host_sync_s); accumulation stays float64-on-host,
+            # chunk-ordered, exactly as the per-chunk version did
+            losses_np = pipe.host_fetch(
+                jnp.stack(loss_parts)).astype(np.float64)
+            sws_np = pipe.host_fetch(
+                jnp.stack(sw_parts)).astype(np.float64)
+            for l_np, w_np in zip(losses_np, sws_np):
+                epoch_loss += l_np * w_np
+                epoch_w += w_np
             train_err = epoch_loss / np.maximum(epoch_w, 1e-12)
 
             if val_chunks:
                 se = np.zeros(n_bags, np.float64)
                 sw = 0.0
+                e_parts: list = []
+                w_parts: list = []
                 vchunks = pipe.map_prefetch(
                     lambda bnd: host_assemble(bnd, False), val_chunks)
                 nxt = place(next(vchunks), False)
@@ -587,10 +602,17 @@ def train_streaming_core(train_conf: ModelTrainConf,
                         nxt = place(next(vchunks), False)
                     t_dev = time.monotonic()
                     e, w_ = val_chunk_err(stacked, *cur)
-                    se += np.asarray(e, np.float64)
-                    sw += float(w_)
+                    e_parts.append(e)
+                    w_parts.append(w_)
                     pipe.add_stage_time("device_step_s",
                                         time.monotonic() - t_dev)
+                es_np = pipe.host_fetch(
+                    jnp.stack(e_parts)).astype(np.float64)
+                ws_np = pipe.host_fetch(
+                    jnp.stack(w_parts)).astype(np.float64)
+                for e_np, w_np in zip(es_np, ws_np):
+                    se += e_np
+                    sw += float(w_np)
                 val_err = se / max(sw, 1e-12)
             else:
                 val_err = train_err

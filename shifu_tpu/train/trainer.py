@@ -38,6 +38,7 @@ import optax
 
 from shifu_tpu import resilience
 from shifu_tpu.config.model_config import ModelTrainConf
+from shifu_tpu.data import pipeline as pipe
 from shifu_tpu.models import nn as nn_mod
 from shifu_tpu.parallel import mesh as mesh_mod
 from shifu_tpu.train.optimizers import optimizer_from_params
@@ -441,8 +442,11 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
                     early_stop_window, convergence_threshold, carry,
                     train_inputs, w_train_bags, val_inputs, w_val,
                     grad_mask, n_batches)
-                tr_chunks.append(np.asarray(tr))
-                va_chunks.append(np.asarray(va))
+                # keep the per-chunk error curves ON DEVICE — the
+                # host sync happens once after the loop, so chunk k+1
+                # dispatches while k's errors are still in flight
+                tr_chunks.append(tr)
+                va_chunks.append(va)
                 done += chunk
                 ckpt.save_state(checkpoint_dir, done, carry)
                 if resilience.preempt_requested() and done < n_epochs:
@@ -450,8 +454,10 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
                         f"train preempted after epoch {done}/{n_epochs};"
                         " checkpoint saved")
         if tr_chunks:
-            train_errs = np.concatenate(tr_chunks, axis=1)
-            val_errs = np.concatenate(va_chunks, axis=1)
+            train_errs = np.concatenate(
+                [pipe.host_fetch(t) for t in tr_chunks], axis=1)
+            val_errs = np.concatenate(
+                [pipe.host_fetch(v) for v in va_chunks], axis=1)
         else:  # resumed an already-finished run
             n_bags = w_train_bags.shape[0]
             train_errs = np.zeros((n_bags, 0), np.float32)

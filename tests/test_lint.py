@@ -1,0 +1,580 @@
+"""Static-analysis gate + rule unit tests (tier-1).
+
+Two layers:
+
+1. ``test_package_is_clean`` — the acceptance check from ISSUE 4: the
+   analyzer over the whole package (plus bench.py/tools, the
+   out-of-package knob readers) reports ZERO findings, with at most 5
+   justified inline suppressions. Any hot-path host sync, jit-in-loop,
+   undeclared knob, stale fault site or blocking-under-lock anyone
+   introduces from now on fails tier-1 here.
+2. Per-rule fixtures — positive (a known violation is flagged),
+   negative (the clean twin is not), suppressed (the violation with an
+   inline ``# lint: disable=`` is silenced but counted) — plus unit
+   tests for the runtime lock-order detector, including the deliberate
+   A->B / B->A inversion that MUST raise.
+"""
+
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from shifu_tpu.analysis import engine, lockcheck
+from shifu_tpu.analysis.lockcheck import CheckedLock, LockOrderError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def lint_source(tmp_path, source, name="fixture.py", rules=None):
+    """Run the engine on one fixture snippet; return the Report."""
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return engine.run([str(path)], rules=rules)
+
+
+def rule_names(report):
+    return [f.rule for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate
+# ---------------------------------------------------------------------------
+
+def test_package_is_clean():
+    report = engine.run([os.path.join(REPO, "shifu_tpu"),
+                         os.path.join(REPO, "bench.py"),
+                         os.path.join(REPO, "tools"),
+                         os.path.join(REPO, "tests", "synth.py")])
+    msgs = "\n".join(f.format() for f in report.findings)
+    assert not report.findings, f"lint findings:\n{msgs}"
+    assert report.files > 60, "walker found suspiciously few files"
+    assert len(report.suppressed) <= 5, (
+        "suppression budget exceeded — justify or fix: "
+        + "\n".join(f.format() for f in report.suppressed))
+
+
+def test_module_entrypoint_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n"
+                   "x = os.environ.get('SHIFU_TPU_NOT_A_KNOB')\n",
+                   encoding="utf-8")
+    r = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.analysis", str(bad)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "undeclared-knob" in r.stdout
+    r = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.analysis", "--knobs-md"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 0
+    assert "SHIFU_TPU_LOCKCHECK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-loop
+# ---------------------------------------------------------------------------
+
+HOT_SYNC_POSITIVE = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def run(xs):
+        total = 0.0
+        for x in xs:
+            y = jnp.sum(x)
+            total += float(y)
+        return total
+"""
+
+HOT_SYNC_NEGATIVE = """
+    import jax.numpy as jnp
+    import numpy as np
+    from shifu_tpu.data.pipeline import host_fetch
+
+    def run(xs):
+        parts = []
+        for x in xs:
+            parts.append(jnp.sum(x))       # stays on device
+            z = np.asarray(np.ones(3))     # numpy-only: no sync
+        return float(host_fetch(jnp.stack(parts)).sum())
+"""
+
+
+def test_host_sync_positive(tmp_path):
+    report = lint_source(tmp_path, HOT_SYNC_POSITIVE)
+    assert "host-sync-in-hot-loop" in rule_names(report)
+
+
+def test_host_sync_negative(tmp_path):
+    report = lint_source(tmp_path, HOT_SYNC_NEGATIVE)
+    assert "host-sync-in-hot-loop" not in rule_names(report)
+
+
+def test_host_sync_suppressed(tmp_path):
+    src = HOT_SYNC_POSITIVE.replace(
+        "total += float(y)",
+        "total += float(y)  # lint: disable=host-sync-in-hot-loop -- why")
+    report = lint_source(tmp_path, src)
+    assert "host-sync-in-hot-loop" not in rule_names(report)
+    assert any(f.rule == "host-sync-in-hot-loop"
+               for f in report.suppressed)
+
+
+def test_host_sync_item_and_asarray(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def run(xs):
+            out = []
+            while xs:
+                v = jnp.dot(xs.pop(), xs.pop())
+                out.append(np.asarray(v))
+                s = v.item()
+            return out, s
+    """
+    report = lint_source(tmp_path, src)
+    assert rule_names(report).count("host-sync-in-hot-loop") == 2
+
+
+def test_host_sync_sees_through_local_device_fn(tmp_path):
+    # the streaming.py shape: a closure whose return value is the
+    # product of a jax.jit-compiled callable
+    src = """
+        import jax
+        import numpy as np
+
+        _jits = {}
+
+        def run(chunks, step):
+            def update(s, c):
+                f = _jits.get("k")
+                if f is None:
+                    f = jax.jit(step)
+                    _jits["k"] = f
+                return f(s, c)
+
+            s, acc = None, 0.0
+            for c in chunks:
+                s, loss = update(s, c)
+                acc += float(loss)
+            return s, acc
+    """
+    report = lint_source(tmp_path, src)
+    assert "host-sync-in-hot-loop" in rule_names(report)
+
+
+# ---------------------------------------------------------------------------
+# jit-in-loop
+# ---------------------------------------------------------------------------
+
+def test_jit_in_loop_positive(tmp_path):
+    src = """
+        import jax
+
+        def run(xs, f):
+            out = []
+            for x in xs:
+                out.append(jax.jit(f)(x))
+            return out
+    """
+    report = lint_source(tmp_path, src)
+    assert "jit-in-loop" in rule_names(report)
+
+
+def test_jit_in_loop_negative_hoisted_and_vmap(tmp_path):
+    src = """
+        import jax
+
+        def run(xs, f):
+            jf = jax.jit(f)                  # hoisted: fine
+            out = []
+            for x in xs:
+                out.append(jf(x))
+                g = jax.vmap(f)(x)           # vmap is a cheap wrapper
+            return out, g
+    """
+    report = lint_source(tmp_path, src)
+    assert "jit-in-loop" not in rule_names(report)
+
+
+def test_jit_in_loop_suppressed(tmp_path):
+    src = """
+        import jax
+
+        def run(xs, f):
+            out = []
+            for x in xs:
+                out.append(jax.jit(f)(x))  # lint: disable=jit-in-loop
+            return out
+    """
+    report = lint_source(tmp_path, src)
+    assert "jit-in-loop" not in rule_names(report)
+    assert any(f.rule == "jit-in-loop" for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# donation-aliasing
+# ---------------------------------------------------------------------------
+
+def test_donation_aliasing_positive(tmp_path):
+    src = """
+        import jax
+
+        def run(step, state, batch):
+            f = jax.jit(step, donate_argnums=(0,))
+            out = f(state, batch)
+            return state.sum(), out   # reads the donated buffer
+    """
+    report = lint_source(tmp_path, src)
+    assert "donation-aliasing" in rule_names(report)
+
+
+def test_donation_aliasing_negative_rebound(tmp_path):
+    src = """
+        import jax
+
+        def run(step, state, batch):
+            f = jax.jit(step, donate_argnums=(0,))
+            state = f(state, batch)   # rebinding kills the old buffer
+            return state.sum()
+    """
+    report = lint_source(tmp_path, src)
+    assert "donation-aliasing" not in rule_names(report)
+
+
+def test_donation_aliasing_suppressed(tmp_path):
+    src = """
+        import jax
+
+        def run(step, state, batch):
+            f = jax.jit(step, donate_argnums=(0,))
+            out = f(state, batch)
+            return state.sum(), out  # lint: disable=donation-aliasing
+    """
+    report = lint_source(tmp_path, src)
+    assert "donation-aliasing" not in rule_names(report)
+    assert any(f.rule == "donation-aliasing" for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# undeclared-knob
+# ---------------------------------------------------------------------------
+
+def test_undeclared_knob_positive(tmp_path):
+    src = """
+        import os
+        x = os.environ.get("SHIFU_TPU_TOTALLY_NEW_KNOB", "1")
+        y = os.getenv("SHIFU_TPU_ANOTHER_ONE")
+        z = os.environ["SHIFU_TPU_THIRD"]
+    """
+    report = lint_source(tmp_path, src, rules=["undeclared-knob"])
+    undeclared = [f for f in report.findings
+                  if "not declared" in f.message]
+    assert len(undeclared) == 3
+
+
+def test_declared_knob_raw_read_flagged(tmp_path):
+    src = """
+        import os
+        x = os.environ.get("SHIFU_TPU_PREFETCH_DEPTH", "2")
+    """
+    report = lint_source(tmp_path, src, rules=["undeclared-knob"])
+    assert any("knob_int" in f.message for f in report.findings)
+
+
+def test_registry_accessor_read_clean(tmp_path):
+    src = """
+        from shifu_tpu.config.environment import knob_int
+        x = knob_int("SHIFU_TPU_PREFETCH_DEPTH")
+    """
+    report = lint_source(tmp_path, src, rules=["undeclared-knob"])
+    per_file = [f for f in report.findings if "dead registry" not in
+                f.message]
+    assert not per_file
+
+
+def test_knob_accessors_round_trip(monkeypatch):
+    from shifu_tpu.config import environment as env
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_DEPTH", "5")
+    assert env.knob_int("SHIFU_TPU_PREFETCH_DEPTH") == 5
+    monkeypatch.setenv("SHIFU_TPU_PREFETCH_DEPTH", "garbage")
+    assert env.knob_int("SHIFU_TPU_PREFETCH_DEPTH") == 2  # registry dflt
+    monkeypatch.delenv("SHIFU_TPU_PREFETCH_DEPTH")
+    assert env.knob_int("SHIFU_TPU_PREFETCH_DEPTH") == 2
+    monkeypatch.setenv("SHIFU_TPU_HIST_SUBTRACT", "0")
+    assert env.knob_bool("SHIFU_TPU_HIST_SUBTRACT") is False
+    monkeypatch.setenv("SHIFU_TPU_HIST_SUBTRACT", "yes")
+    assert env.knob_bool("SHIFU_TPU_HIST_SUBTRACT") is True
+    with pytest.raises(KeyError):
+        env.knob_int("SHIFU_TPU_NOT_DECLARED_ANYWHERE")
+    rows = env.knobs_rows()
+    names = {r["name"] for r in rows}
+    assert "SHIFU_TPU_LOCKCHECK" in names
+    assert len(names) >= 35
+    md = env.knobs_markdown()
+    for n in names:
+        assert n in md
+
+
+def test_every_package_getenv_is_declared():
+    """Acceptance: every literal SHIFU_TPU_* string in the package is a
+    declared knob (the analyzer enforces read sites; this sweeps ALL
+    literals so even exotic read paths can't smuggle one in)."""
+    import re
+    from shifu_tpu.config.environment import KNOBS
+    knob_shape = re.compile(r"^SHIFU_TPU_[A-Z0-9_]+$")
+    bad = []
+    pkg = os.path.join(REPO, "shifu_tpu")
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(root, fn)
+            tree = ast.parse(open(p, encoding="utf-8").read())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        knob_shape.match(node.value):
+                    if node.value in KNOBS:
+                        continue
+                    bad.append(f"{p}:{node.lineno}: {node.value}")
+    assert not bad, "undeclared SHIFU_TPU_* literals:\n" + "\n".join(bad)
+
+
+# ---------------------------------------------------------------------------
+# unregistered-fault-site
+# ---------------------------------------------------------------------------
+
+def test_fault_site_positive(tmp_path):
+    src = """
+        from shifu_tpu.resilience import fault_point
+
+        def go():
+            fault_point("pipeline.nonexistent_site")
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-fault-site"])
+    assert any("pipeline.nonexistent_site" in f.message
+               for f in report.findings)
+
+
+def test_fault_site_negative_registered_and_dynamic(tmp_path):
+    src = """
+        from shifu_tpu.resilience import fault_point
+
+        def go(step):
+            fault_point("pipeline.fetch")
+            fault_point(f"step.{step}")
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-fault-site"])
+    per_file = [f for f in report.findings if f.line > 0]
+    assert not per_file
+
+
+def test_fault_site_dynamic_outside_namespace_flagged(tmp_path):
+    src = """
+        from shifu_tpu.resilience import fault_point
+
+        def go(x):
+            fault_point(f"mystery.{x}")
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-fault-site"])
+    assert any("namespace" in f.message for f in report.findings)
+
+
+def test_fault_sites_all_referenced_in_package():
+    """Reverse direction of the rule at package scope: no stale
+    FAULT_SITES rows (the finalize hook reports them)."""
+    report = engine.run([os.path.join(REPO, "shifu_tpu")],
+                        rules=["unregistered-fault-site"])
+    stale = [f for f in report.findings if "never referenced" in
+             f.message]
+    assert not stale, "\n".join(f.format() for f in stale)
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_under_lock_positive(tmp_path):
+    src = """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def go(work_queue):
+            with _lock:
+                time.sleep(1.0)
+                item = work_queue.get()
+            return item
+    """
+    report = lint_source(tmp_path, src, rules=["blocking-under-lock"])
+    assert rule_names(report).count("blocking-under-lock") == 2
+
+
+def test_blocking_under_lock_negative(tmp_path):
+    src = """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def go(work_queue, d):
+            with _lock:
+                v = d.get("key")          # dict.get: not blocking
+                snapshot = list(d)
+            time.sleep(0.1)               # outside the lock: fine
+            item = work_queue.get()       # outside the lock: fine
+            return v, snapshot, item
+    """
+    report = lint_source(tmp_path, src, rules=["blocking-under-lock"])
+    assert "blocking-under-lock" not in rule_names(report)
+
+
+def test_blocking_under_lock_nested_function_exempt(tmp_path):
+    src = """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def go():
+            with _lock:
+                def later():
+                    time.sleep(5)      # runs after release
+                return later
+    """
+    report = lint_source(tmp_path, src, rules=["blocking-under-lock"])
+    assert "blocking-under-lock" not in rule_names(report)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order detector
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _fresh_lock_graph():
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+def test_lock_inversion_detected():
+    """Deliberate A->B / B->A inversion MUST raise LockOrderError."""
+    a, b = CheckedLock("A"), CheckedLock("B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+
+    with pytest.raises(LockOrderError, match="cycle"):
+        with b:
+            with a:
+                pass
+
+
+def test_consistent_order_passes():
+    a, b, c = CheckedLock("A"), CheckedLock("B"), CheckedLock("C")
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with a:
+                    with b:
+                        with c:
+                            pass
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_reacquire_same_lock_raises():
+    a = CheckedLock("A")
+    with a:
+        with pytest.raises(LockOrderError, match="re-acquired"):
+            a.acquire()
+
+
+def test_transitive_cycle_detected():
+    a, b, c = CheckedLock("A"), CheckedLock("B"), CheckedLock("C")
+    for first, second in ((a, b), (b, c)):
+        def run(x=first, y=second):
+            with x:
+                with y:
+                    pass
+        th = threading.Thread(target=run)
+        th.start()
+        th.join()
+    # A->B and B->C recorded; C->A closes the cycle transitively
+    with pytest.raises(LockOrderError, match="cycle"):
+        with c:
+            with a:
+                pass
+
+
+def test_make_lock_plain_by_default(monkeypatch):
+    monkeypatch.delenv("SHIFU_TPU_LOCKCHECK", raising=False)
+    lk = lockcheck.make_lock("plain")
+    assert not isinstance(lk, CheckedLock)
+    monkeypatch.setenv("SHIFU_TPU_LOCKCHECK", "1")
+    lk = lockcheck.make_lock("checked")
+    assert isinstance(lk, CheckedLock)
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_runtime_modules_use_the_shim(monkeypatch):
+    """resilience/pipeline/dist locks run instrumented under
+    SHIFU_TPU_LOCKCHECK=1: exercise the real lock sites in-process and
+    assert edges/state stay coherent (no LockOrderError)."""
+    monkeypatch.setenv("SHIFU_TPU_LOCKCHECK", "1")
+    import importlib
+    from shifu_tpu import resilience as res
+    from shifu_tpu.data import pipeline as pipe
+    from shifu_tpu.parallel import dist
+    for mod in (res, pipe, dist):
+        importlib.reload(mod)
+    try:
+        assert isinstance(pipe._timers_lock, CheckedLock)
+        assert isinstance(res._retry_lock, CheckedLock)
+        assert isinstance(res._events_lock, CheckedLock)
+        assert isinstance(dist._inflight_lock, CheckedLock)
+        pipe.add_stage_time("host_parse_s", 0.01)
+        pipe.drain_stage_timers()
+        res.note_event({"kind": "test"})
+        res.drain_events()
+        assert dist.inflight_collectives() == {}
+    finally:
+        monkeypatch.delenv("SHIFU_TPU_LOCKCHECK")
+        for mod in (res, pipe, dist):
+            importlib.reload(mod)

@@ -76,4 +76,7 @@ echo
 echo "chaos sweep ($KIND): $pass pass, $fail contract-fail, $hang hang"
 [ "$hang" -gt 0 ] && echo "  hung sites: ${HUNG[*]}"
 [ "$fail" -gt 0 ] && echo "  broken sites: ${BROKE[*]}"
+echo "site list is lint-enforced: tools/lint.sh (unregistered-fault-site)"
+echo "  keeps FAULT_SITES and the fault_point calls in sync both ways;"
+echo "  re-run with SHIFU_TPU_LOCKCHECK=1 to also certify lock ordering"
 [ $((fail + hang)) -eq 0 ]

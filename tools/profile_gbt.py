@@ -11,6 +11,8 @@ Usage: python tools/profile_gbt.py [rows] [trees]
 """
 import json
 import os
+
+from shifu_tpu.config.environment import knob_bool, knob_raw
 import sys
 import time
 
@@ -101,7 +103,7 @@ def main():
     # (c) routing: all levels' row advancement — both formulations
     # (env is read at trace time; tracing two distinct jits here keeps
     # the A/B inside one process)
-    caller_route = os.environ.get("SHIFU_TPU_GBT_ROUTE")
+    caller_route = knob_raw("SHIFU_TPU_GBT_ROUTE")
     for mode in ("gather", "onehot"):
         os.environ["SHIFU_TPU_GBT_ROUTE"] = mode
 
@@ -115,7 +117,7 @@ def main():
             return n.sum()
 
         timed(f"route_levels_{mode}_s", lambda: route_all(binsT),
-              lambda: float(route_all(binsT)))
+              lambda: float(route_all(binsT)))  # lint: disable=host-sync-in-hot-loop -- profiling: scalar fetch defeats the tunnel's async no-op
     if caller_route is None:
         os.environ.pop("SHIFU_TPU_GBT_ROUTE", None)
     else:
@@ -144,7 +146,7 @@ def main():
     timed("glue_s", lambda: glue(jnp.zeros(rows)),
           lambda: float(glue(jnp.zeros(rows))))
 
-    if os.environ.get("SHIFU_TPU_GBT_TRACE", "0") == "1":
+    if knob_bool("SHIFU_TPU_GBT_TRACE"):
         import jax.profiler
         tdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "gbt_trace")
