@@ -683,6 +683,12 @@ def task_streaming():
                                   n_rows, STREAM_FEATURES, seed=1,
                                   chunk_rows=STREAM_CHUNK_ROWS)
 
+    # compile-time counters + persistent cache (the parent already
+    # exports JAX_COMPILATION_CACHE_DIR for this subprocess; a second
+    # attempt should report cache hits and near-zero compile_s)
+    from shifu_tpu import profiling
+    profiling.enable_compile_cache()
+
     # warm-up on a 3-chunk prefix BEFORE the clock: compiles the
     # full-chunk train step (~1 GB of transfer instead of a whole
     # 18 GB epoch; the real run's differently-shaped validation
@@ -692,11 +698,18 @@ def task_streaming():
     run(1, n_rows=min(3 * STREAM_CHUNK_ROWS, STREAM_ROWS))
 
     from shifu_tpu.data import pipeline as pipe
-    pipe.drain_stage_timers()    # the measured run owns the interval
+    # the measured run owns the interval, but compile work happened in
+    # the warm-up — fold its counters into the record
+    warm = pipe.drain_stage_timers()
     t0 = time.time()
     res = run(STREAM_EPOCHS_LONG)
     d_wall = time.time() - t0
     stages = pipe.drain_stage_timers()
+    compile_s = warm.get("compile_s", 0.0) + stages.get("compile_s", 0.0)
+    cache_hits = int(warm.get("compile_cache_hits", 0)
+                     + stages.get("compile_cache_hits", 0))
+    cache_misses = int(warm.get("compile_cache_misses", 0)
+                       + stages.get("compile_cache_misses", 0))
     stall_frac = min(stages.get("input_stall_s", 0.0) / d_wall, 1.0)
     _log(f"[stream] {STREAM_EPOCHS_LONG} epochs in {d_wall:.0f}s "
          f"(input stall {100 * stall_frac:.1f}%)")
@@ -720,6 +733,9 @@ def task_streaming():
         "stream_train_rows_per_s": n_train * d_epochs / d_wall,
         "input_stall_frac": round(stall_frac, 4),
         "input_stage_s": {k: round(v, 2) for k, v in stages.items()},
+        "compile_s": round(compile_s, 2),
+        "compile_cache_hits": cache_hits,
+        "compile_cache_misses": cache_misses,
         "wall_s": d_wall, "epochs": d_epochs, "auc": a,
         "disk_gb": round(gb, 1),
         "stream_gbps": gb * d_epochs / d_wall,
@@ -1500,6 +1516,10 @@ def main():
                 st["stream_train_rows_per_s"], 1)
         if "input_stall_frac" in st:
             extra["streaming_input_stall_frac"] = st["input_stall_frac"]
+        if "compile_s" in st:
+            extra["streaming_compile_s"] = st["compile_s"]
+            extra["streaming_compile_cache_hits"] = st.get(
+                "compile_cache_hits", 0)
 
     def _fill_pipeline(pl):
         extra["pipeline_phase_walls_s"] = pl["phases"]

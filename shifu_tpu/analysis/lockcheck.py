@@ -1,10 +1,11 @@
 """Opt-in runtime lock-order race detector.
 
 `make_lock("module.purpose")` is how the threaded runtime modules
-(`resilience.py`, `data/pipeline.py`, `parallel/dist.py`) create
-their locks. With `SHIFU_TPU_LOCKCHECK` unset/0 it returns a plain
-`threading.Lock` — zero overhead. With `SHIFU_TPU_LOCKCHECK=1` it
-returns an instrumented lock that, on every acquire:
+(`resilience.py`, `data/pipeline.py`, `parallel/dist.py`,
+`train/checkpoint.py`) create their locks. With `SHIFU_TPU_LOCKCHECK`
+unset/0 it returns a plain `threading.Lock` — zero overhead. With
+`SHIFU_TPU_LOCKCHECK=1` it returns an instrumented lock that, on every
+acquire:
 
   * records an edge held-lock -> acquiring-lock in a global,
     name-keyed lock graph (per-thread held stack in a
@@ -21,11 +22,23 @@ ordering discipline for every pair of lock classes the run touched —
 the cross-thread interleaving itself doesn't need to happen. Two
 instances sharing a name are distinct for the re-acquire check (keyed
 by id) but merged in the graph.
-"""
+
+Instrumented runs also keep a per-(lock, acquisition-site) held-time
+histogram — count / total / max seconds between acquire and release,
+keyed by the `file.py:line` that took the lock. `held_time_stats()`
+returns a snapshot; `report()` bundles it with the edge graph, and an
+atexit hook dumps both to stderr so a LOCKCHECK=1 run ends with the
+evidence (e.g. the async-checkpoint writer lock `ckpt.writer` must
+show sub-millisecond holds — a long hold there means the serialize
+crept under the lock)."""
 
 from __future__ import annotations
 
+import atexit
+import os
+import sys
 import threading
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from shifu_tpu.config.environment import knob_bool
@@ -39,7 +52,10 @@ _graph_lock = threading.Lock()
 # edge a -> b: some thread held a while acquiring b; value = one
 # (thread-name, stack-of-held-names) witness for the error message
 _edges: Dict[str, Dict[str, str]] = {}
+# (lock name, acquisition site) -> [count, total_s, max_s]
+_held_stats: Dict[Tuple[str, str], List[float]] = {}
 _tls = threading.local()
+_atexit_registered = False
 
 
 def enabled() -> bool:
@@ -50,13 +66,72 @@ def reset() -> None:
     """Drop all recorded ordering state (test isolation)."""
     with _graph_lock:
         _edges.clear()
+        _held_stats.clear()
 
 
-def _held() -> List[Tuple[str, int]]:
+def _held() -> List[Tuple[str, int, float, str]]:
+    """This thread's stack of held locks:
+    (name, instance id, acquire monotonic time, acquisition site)."""
     h = getattr(_tls, "held", None)
     if h is None:
         h = _tls.held = []
     return h
+
+
+def _acquire_site() -> str:
+    """`file.py:line` of the frame that called acquire, skipping
+    frames inside this module."""
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:  # pragma: no cover — acquire always has a caller
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _record_held(name: str, site: str, dt: float) -> None:
+    with _graph_lock:
+        st = _held_stats.setdefault((name, site), [0, 0.0, 0.0])
+        st[0] += 1
+        st[1] += dt
+        st[2] = max(st[2], dt)
+
+
+def held_time_stats() -> Dict[str, Dict[str, Dict[str, float]]]:
+    """{lock name: {site: {count, total_s, max_s}}} snapshot of
+    held-time accounting across all instrumented locks."""
+    with _graph_lock:
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for (name, site), (cnt, total, mx) in sorted(_held_stats.items()):
+            out.setdefault(name, {})[site] = {
+                "count": int(cnt), "total_s": round(total, 6),
+                "max_s": round(mx, 6)}
+        return out
+
+
+def report() -> Dict[str, object]:
+    """The recorded lock-order graph plus held-time histograms."""
+    with _graph_lock:
+        edges = {a: sorted(bs) for a, bs in sorted(_edges.items())}
+    held = held_time_stats()   # takes _graph_lock itself
+    return {"edges": edges, "held": held}
+
+
+def _dump_at_exit() -> None:  # pragma: no cover — exercised via atexit
+    rep = report()
+    if not rep["edges"] and not rep["held"]:
+        return
+    lines = ["lockcheck: lock-order graph:"]
+    for a, bs in rep["edges"].items():  # type: ignore[union-attr]
+        lines.append(f"  {a} -> {', '.join(bs)}")
+    lines.append("lockcheck: held-time per acquisition site "
+                 "(count / total_s / max_s):")
+    for name, sites in rep["held"].items():  # type: ignore[union-attr]
+        for site, st in sites.items():
+            lines.append(f"  {name} @ {site}: {st['count']} / "
+                         f"{st['total_s']:.6f} / {st['max_s']:.6f}")
+    print("\n".join(lines), file=sys.stderr)
 
 
 def _find_path(src: str, dst: str) -> Optional[List[str]]:
@@ -81,15 +156,19 @@ class CheckedLock:
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
+        global _atexit_registered
+        if not _atexit_registered:
+            _atexit_registered = True
+            atexit.register(_dump_at_exit)
 
     def _before_acquire(self) -> None:
         held = _held()
-        if any(i == id(self) for _, i in held):
+        if any(i == id(self) for _, i, _t, _s in held):
             raise LockOrderError(
                 f"thread {threading.current_thread().name!r} "
                 f"re-acquired non-reentrant lock '{self.name}' it "
                 "already holds — guaranteed self-deadlock")
-        held_names = [n for n, _ in held if n != self.name]
+        held_names = [n for n, _i, _t, _s in held if n != self.name]
         if not held_names:
             return
         with _graph_lock:
@@ -114,14 +193,17 @@ class CheckedLock:
         self._before_acquire()
         got = self._lock.acquire(blocking, timeout)
         if got:
-            _held().append((self.name, id(self)))
+            _held().append((self.name, id(self), time.monotonic(),
+                            _acquire_site()))
         return got
 
     def release(self) -> None:
         held = _held()
         for i in range(len(held) - 1, -1, -1):
             if held[i][1] == id(self):
+                _name, _id, t0, site = held[i]
                 del held[i]
+                _record_held(self.name, site, time.monotonic() - t0)
                 break
         self._lock.release()
 

@@ -5,8 +5,9 @@ ctx)` and optionally `finalize(ctx)`. Add new modules to
 `RULE_MODULES` to register them.
 """
 
-from shifu_tpu.analysis.rules import faults, hotloop, knobs, locks
+from shifu_tpu.analysis.rules import (deviceput, faults, hotloop, knobs,
+                                      locks)
 
-RULE_MODULES = (hotloop, knobs, faults, locks)
+RULE_MODULES = (hotloop, knobs, faults, locks, deviceput)
 
 ALL_RULES = tuple(r for m in RULE_MODULES for r in m.RULES)

@@ -1,0 +1,45 @@
+"""unsharded-device-put: `jax.device_put` without a placement is a
+single-device transfer.
+
+A `device_put(x)` call with no sharding/device argument lands the
+whole array committed to the default device — on a pod that means a
+mesh-sized chunk materializes on device 0 and every later sharded use
+pays a reshard (or OOMs the one chip). Every placement in the hot
+paths (`parallel/mesh.shard_axis`, `dist.global_row_array`, the
+double-buffered H2D path in `train/streaming`) must say where the
+bytes go: pass a `Sharding`/`Device` as the second positional argument
+or the `device=` keyword.
+
+A bare `device_put` used as a function REFERENCE (e.g.
+`jax.tree.map(jax.device_put, params, shardings)`) is not a call with
+a missing argument and is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("unsharded-device-put",)
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted(node.func).rsplit(".", 1)[-1] != "device_put":
+            continue
+        if len(node.args) >= 2:
+            continue   # sharding/device passed positionally
+        if any(kw.arg == "device" for kw in node.keywords):
+            continue
+        findings.append(Finding(
+            "unsharded-device-put", path, node.lineno, node.col_offset,
+            "device_put without a sharding/device commits the array to "
+            "the default device — pass NamedSharding(mesh, spec) (or "
+            "device=) so mesh-sized arrays shard instead of landing on "
+            "one chip"))
+    return findings

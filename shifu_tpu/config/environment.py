@@ -136,6 +136,19 @@ _declare("SHIFU_TPU_ABORT_DIR", "str", None,
 _declare("SHIFU_TPU_LOCKCHECK", "flag", "0",
          "1 = instrumented locks record acquisition order and fail "
          "the run on a lock-order cycle (analysis.lockcheck)")
+# --- checkpoint / overlap / compile cache ---
+_declare("SHIFU_TPU_CKPT_ASYNC", "flag", "1",
+         "1 = background checkpoint writer (snapshot on-thread, "
+         "serialize+publish off-thread); 0 = fully synchronous saves")
+_declare("SHIFU_TPU_H2D_DOUBLE_BUFFER", "flag", "1",
+         "1 = place chunk N+1 on device while chunk N computes "
+         "(auto-disabled on the cpu backend unless set explicitly)")
+_declare("SHIFU_TPU_COMPILE_CACHE_DIR", "str", None,
+         "persistent XLA compilation cache dir; unset = auto under "
+         "the model workspace tmp/, 0/off/none = disabled")
+_declare("SHIFU_TPU_COMPILE_CACHE_MIN_S", "float", 0.0,
+         "minimum compile seconds before a kernel is cached "
+         "(jax_persistent_cache_min_compile_time_secs)")
 # --- distributed runtime ---
 _declare("SHIFU_TPU_COORDINATOR", "str", None,
          "coordinator address for jax.distributed.initialize")

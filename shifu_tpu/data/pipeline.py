@@ -49,11 +49,17 @@ thread-safe accumulator — ``host_parse_s`` (producer time in
 ``next()``), ``host_assemble_s`` (map_prefetch worker time), ``h2d_s``
 and ``device_step_s`` (reported by the streaming trainer), and
 ``input_stall_s`` (consumer time spent WAITING on the pipeline — the
-number that should collapse when overlap works). ``profiling.
-step_metrics`` drains the accumulator into the step's
-``tmp/metrics/steps.jsonl`` line under ``inputPipeline``. On the
-synchronous fallback paths the full fetch time counts as both parse
-and stall — by definition all of it sits on the critical path.
+number that should collapse when overlap works). The overlap layer
+adds ``ckpt_save_s`` (full checkpoint serialize+publish wall time) vs
+``ckpt_stall_s`` (what the step loop actually waited — staging only
+under ``SHIFU_TPU_CKPT_ASYNC=1``), ``host_sync_s`` (deliberate
+``host_fetch`` waits), and the compile-cache counters ``compile_s`` /
+``compile_cache_hits`` / ``compile_cache_misses`` fed by
+``profiling.enable_compile_cache``. ``profiling.step_metrics`` drains
+the accumulator into the step's ``tmp/metrics/steps.jsonl`` line under
+``inputPipeline``. On the synchronous fallback paths the full fetch
+time counts as both parse and stall — by definition all of it sits on
+the critical path.
 """
 
 from __future__ import annotations
@@ -66,7 +72,7 @@ import time
 from typing import Callable, Dict, Iterable, Iterator, Sequence, TypeVar
 
 from shifu_tpu.analysis.lockcheck import make_lock
-from shifu_tpu.config.environment import knob_int
+from shifu_tpu.config.environment import knob_bool, knob_int, knob_is_set
 from shifu_tpu.resilience import fault_point
 
 log = logging.getLogger("shifu_tpu")
@@ -85,6 +91,21 @@ def prefetch_depth() -> int:
 def prefetch_workers() -> int:
     """SHIFU_TPU_PREFETCH_WORKERS (assembly threads; 0 = off)."""
     return max(knob_int("SHIFU_TPU_PREFETCH_WORKERS"), 0)
+
+
+def h2d_double_buffer() -> bool:
+    """Whether the streaming trainer places chunk N+1 on device AFTER
+    dispatching chunk N's update (so the `jax.device_put` host cost
+    overlaps device compute) instead of before it. An explicitly set
+    `SHIFU_TPU_H2D_DOUBLE_BUFFER` wins on any backend (tests exercise
+    the overlap path on CPU); unset, the overlap is enabled only where
+    the runtime actually has an async transfer engine — on the cpu
+    backend `device_put` degenerates to a copy on the calling thread,
+    so the reorder would buy nothing."""
+    if knob_is_set("SHIFU_TPU_H2D_DOUBLE_BUFFER"):
+        return knob_bool("SHIFU_TPU_H2D_DOUBLE_BUFFER")
+    import jax
+    return jax.default_backend() != "cpu"
 
 
 # ---------------------------------------------------------------------------

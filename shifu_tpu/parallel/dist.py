@@ -263,6 +263,14 @@ def single_writer(tag: str):
     writer). A raising participant first publishes an abort marker so
     blocked peers poison out with the same error (`DistAborted`)
     rather than waiting for the timeout."""
+    # the background checkpoint publisher also writes as process 0: a
+    # single-writer scope must not overlap an in-flight publish into
+    # the same tree (rmtree/os.replace races), so join it first
+    try:
+        from shifu_tpu.train import checkpoint as _ckpt
+        _ckpt.flush_saves(reraise=False)
+    except Exception:  # pragma: no cover — optional import cycle
+        pass
     try:
         yield is_writer()
     except BaseException as e:

@@ -13,7 +13,14 @@ metrics plus `jax.profiler` traces.
   reports them);
 - `shifu --profile <cmd>` additionally captures a `jax.profiler` trace
   under `tmp/profile/<step>-<timestamp>/` — openable in TensorBoard /
-  Perfetto for op-level TPU timing.
+  Perfetto for op-level TPU timing;
+- `enable_compile_cache(root)` points jax's persistent compilation
+  cache under the model workspace (`SHIFU_TPU_COMPILE_CACHE_DIR`
+  overrides; `0`/`off` disables) and registers `jax.monitoring`
+  listeners so per-jit compile time and cache hit/miss counts land in
+  the stage timers (`compile_s`, `compile_cache_hits`,
+  `compile_cache_misses`) and thence in `steps.jsonl` — restart /
+  resume / supervise / grid-search paths stop re-paying XLA compiles.
 """
 
 from __future__ import annotations
@@ -26,6 +33,74 @@ import time
 from typing import Dict, Optional
 
 log = logging.getLogger("shifu_tpu")
+
+_DISABLED_VALUES = ("0", "off", "none", "disabled", "false", "no")
+_compile_listeners_on = False
+
+
+def _register_compile_listeners() -> None:
+    """Route jax's compile-time monitoring events into the pipeline
+    stage timers (idempotent; safe on jax builds without the events)."""
+    global _compile_listeners_on
+    if _compile_listeners_on:
+        return
+    import jax
+    from shifu_tpu.data import pipeline as pipe
+
+    def _on_event(event: str, **kw) -> None:  # noqa: ARG001 — jax API
+        if event.endswith("/cache_hits"):
+            pipe.add_stage_count("compile_cache_hits", 1)
+        elif event.endswith("/cache_misses"):
+            pipe.add_stage_count("compile_cache_misses", 1)
+
+    def _on_duration(event: str, secs: float, **kw) -> None:  # noqa: ARG001
+        if event.endswith("/backend_compile_duration"):
+            pipe.add_stage_time("compile_s", secs)
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _compile_listeners_on = True
+
+
+def enable_compile_cache(workspace_root: Optional[str] = None) -> \
+        Optional[str]:
+    """Turn on jax's persistent compilation cache and the compile-time
+    counters. Resolution order for the cache dir: an explicit
+    `SHIFU_TPU_COMPILE_CACHE_DIR` wins (`0`/`off`/`none` = disabled);
+    unset, an already-configured jax (e.g. `JAX_COMPILATION_CACHE_DIR`
+    in the environment) is left alone; otherwise the cache defaults to
+    `<workspace_root>/tmp/jax_cache`. Returns the active cache dir or
+    None when disabled. Never raises — a cache failure must not take
+    down training."""
+    try:
+        _register_compile_listeners()
+    except Exception as e:  # noqa: BLE001 — metrics must never fail a run
+        log.warning("compile-time listeners unavailable: %s", e)
+    try:
+        import jax
+        from shifu_tpu.config.environment import knob_float, knob_str
+        explicit = knob_str("SHIFU_TPU_COMPILE_CACHE_DIR")
+        if explicit is not None and \
+                explicit.strip().lower() in _DISABLED_VALUES:
+            return None
+        cache_dir = explicit
+        if cache_dir is None:
+            configured = jax.config.jax_compilation_cache_dir
+            if configured:
+                return configured   # respect an externally set cache
+            if workspace_root is None:
+                return None
+            cache_dir = os.path.join(os.path.abspath(workspace_root),
+                                     "tmp", "jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(knob_float("SHIFU_TPU_COMPILE_CACHE_MIN_S")))
+        log.info("persistent compilation cache at %s", cache_dir)
+        return cache_dir
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        log.warning("persistent compilation cache unavailable: %s", e)
+        return None
 
 
 def device_stats() -> Dict:

@@ -136,7 +136,8 @@ class _FaultRule(NamedTuple):
 FAULT_SITES = (
     "fs.exists", "fs.size", "fs.list", "fs.open",
     "reader.read", "reader.native",
-    "ckpt.save", "ckpt.saved", "ckpt.restore",
+    "ckpt.save", "ckpt.stage", "ckpt.publish", "ckpt.saved",
+    "ckpt.restore",
     "atomic.commit", "pipeline.fetch",
     "dist.init", "dist.barrier", "dist.allgather",
 )
@@ -707,6 +708,13 @@ def graceful_shutdown(note: str = "training"):
     finally:
         for s, h in prev.items():
             signal.signal(s, h)
+        # a preempt exits rc 75 right after this scope unwinds — any
+        # in-flight background checkpoint must be durable first
+        try:
+            from shifu_tpu.train import checkpoint as _ckpt
+            _ckpt.flush_saves(reraise=False)
+        except Exception:  # pragma: no cover — optional import cycle
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -10,14 +10,29 @@ counters, epoch cursor — is one pytree saved with orbax every
 the epoch scan exactly where it stopped; there is no separate master /
 worker recovery because the SPMD program has no master.
 
+Async saves (CheckFreq-style snapshot-then-background-write): with
+`SHIFU_TPU_CKPT_ASYNC=1` (the default) `save_checkpoint` only pays the
+device→host *snapshot* on the training thread — `np.asarray` over the
+state pytree, which also decouples the save from donated device
+buffers — and hands the serialize + atomic publish to a single
+background writer thread. A join barrier runs at the next save (at
+most one write in flight), at preemption (`graceful_shutdown` flushes
+before exiting rc 75), and at trainer exit; writer errors surface at
+the next barrier. The stage timers split the cost: `ckpt_stall_s` is
+what the step loop actually waited (staging only), `ckpt_save_s` the
+full serialize+publish time.
+
 Crash safety: saves stage to a `.tmp` sibling and `os.replace` into the
 `step_N` name, so a kill mid-save never corrupts the published
 checkpoint; `restore_latest` walks steps newest-first and falls back
 past any truncated/unreadable `step_N` (a kill can still land between
 orbax's internal file writes on filesystems without atomic dir rename).
 Fault-injection sites: `ckpt.save` (before staging — a kill here loses
-nothing), `ckpt.saved` (after publication — a kill here is the
-"crash right after checkpoint N" case), `ckpt.restore`.
+nothing), `ckpt.stage` (during the device→host snapshot),
+`ckpt.publish` (after serialize, before the rename commit — a kill
+here leaves only `step_{N-1}` restorable), `ckpt.saved` (after
+publication — a kill here is the "crash right after checkpoint N"
+case), `ckpt.restore`.
 """
 
 from __future__ import annotations
@@ -25,11 +40,16 @@ from __future__ import annotations
 import logging
 import os
 import shutil
+import threading
+import time
 from typing import Any, Callable, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
 
+from shifu_tpu.analysis.lockcheck import make_lock
+from shifu_tpu.config.environment import knob_bool
+from shifu_tpu.data import pipeline as pipe
 from shifu_tpu.resilience import fault_point, sweep_stale_tmp
 
 log = logging.getLogger("shifu_tpu")
@@ -41,12 +61,21 @@ except Exception:  # pragma: no cover - orbax is in the base image
     _HAVE_ORBAX = False
 
 
-def save_state(ckpt_dir: str, step: int, state: Any) -> None:
-    """Write training state for `step` (epoch count done), replacing any
-    older checkpoint (the reference keeps only the latest tmp model)."""
-    fault_point("ckpt.save")
+def _snapshot(state: Any) -> Any:
+    """Device→host staging: a host COPY of the state pytree. This is
+    the only part of a save the training thread must wait for — once
+    it returns, the caller may donate/overwrite the device buffers
+    (np.asarray would alias host-resident numpy leaves, letting an
+    in-place update race the background serialize)."""
+    fault_point("ckpt.stage")
+    return jax.tree.map(lambda x: np.array(x), state)
+
+
+def _publish(ckpt_dir: str, step: int, snap: Any) -> None:
+    """Serialize the host snapshot and atomically publish `step_N`,
+    pruning older steps (the reference keeps only the latest tmp
+    model). Runs on the background writer thread in async mode."""
     ckpt_dir = os.path.abspath(ckpt_dir)
-    os.makedirs(ckpt_dir, exist_ok=True)
     sweep_stale_tmp(ckpt_dir)
     path = os.path.join(ckpt_dir, f"step_{step}")
     if _HAVE_ORBAX:
@@ -54,13 +83,17 @@ def save_state(ckpt_dir: str, step: int, state: Any) -> None:
         tmp = path + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
-        ckptr.save(tmp, jax.tree.map(np.asarray, state))
+        ckptr.save(tmp, snap)
+        # the commit point: a kill before the rename leaves only the
+        # previously published step restorable
+        fault_point("ckpt.publish")
         if os.path.exists(path):
             shutil.rmtree(path)
         os.replace(tmp, path)
     else:
         from shifu_tpu.models.spec import save_model
-        save_model(path + ".npz", "ckpt", {"step": step}, state)
+        fault_point("ckpt.publish")
+        save_model(path + ".npz", "ckpt", {"step": step}, snap)
     for old in os.listdir(ckpt_dir):
         if old.startswith("step_") and old not in (f"step_{step}",
                                                    f"step_{step}.npz"):
@@ -70,11 +103,110 @@ def save_state(ckpt_dir: str, step: int, state: Any) -> None:
     fault_point("ckpt.saved")
 
 
+def save_state(ckpt_dir: str, step: int, state: Any) -> None:
+    """Write training state for `step` (epoch count done) fully
+    synchronously, replacing any older checkpoint. The async path in
+    `save_checkpoint` stages on-thread and publishes in the
+    background; this entry is the synchronous contract (and what the
+    writer thread ultimately executes, minus the staging)."""
+    t0 = time.monotonic()
+    fault_point("ckpt.save")
+    os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+    _publish(ckpt_dir, step, _snapshot(state))
+    dt = time.monotonic() - t0
+    pipe.add_stage_time("ckpt_save_s", dt)
+    pipe.add_stage_time("ckpt_stall_s", dt)  # sync: the step waits it all
+
+
+class AsyncCheckpointWriter:
+    """Single background writer: at most one serialize+publish in
+    flight; `save` joins the previous write (surfacing its error),
+    snapshots on the calling thread, then returns while the new write
+    runs. The lock guards only pointer swaps (thread/error fields), so
+    holds stay sub-millisecond — the join happens outside it."""
+
+    def __init__(self) -> None:
+        self._lock = make_lock("ckpt.writer")
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, ckpt_dir: str, step: int, state: Any) -> None:
+        t0 = time.monotonic()
+        fault_point("ckpt.save")
+        self.flush()  # join barrier: at most one write in flight
+        os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+        snap = _snapshot(state)
+
+        def _write() -> None:
+            try:
+                _publish(ckpt_dir, step, snap)
+                pipe.add_stage_time("ckpt_save_s", time.monotonic() - t0)
+            except BaseException as e:  # noqa: BLE001 — surfaced at flush
+                with self._lock:
+                    self._error = e
+
+        th = threading.Thread(target=_write, name=f"ckpt-writer-{step}",
+                              daemon=True)
+        with self._lock:
+            self._thread = th
+        th.start()
+        pipe.add_stage_time("ckpt_stall_s", time.monotonic() - t0)
+
+    def flush(self, reraise: bool = True) -> None:
+        """Join the in-flight write, if any; re-raise (or warn about)
+        its error. Idempotent — a flush with nothing in flight is a
+        cheap no-op."""
+        with self._lock:
+            th, self._thread = self._thread, None
+        if th is not None:
+            th.join()
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            if reraise:
+                raise err
+            log.warning("background checkpoint write failed (%s: %s); "
+                        "the previously published step remains "
+                        "restorable", type(err).__name__, err)
+
+
+_WRITER = AsyncCheckpointWriter()
+
+
+def writer() -> AsyncCheckpointWriter:
+    return _WRITER
+
+
+def async_enabled() -> bool:
+    return knob_bool("SHIFU_TPU_CKPT_ASYNC")
+
+
+def save_checkpoint(ckpt_dir: str, step: int, state: Any) -> None:
+    """The trainers' save entry: background write when
+    `SHIFU_TPU_CKPT_ASYNC=1` (default), synchronous otherwise. Callers
+    must `flush_saves()` before exiting / raising `Preempted` so the
+    last save is durable."""
+    if async_enabled():
+        _WRITER.save(ckpt_dir, step, state)
+    else:
+        save_state(ckpt_dir, step, state)
+
+
+def flush_saves(reraise: bool = True) -> None:
+    """Join barrier over the background writer (no-op when idle or in
+    sync mode). `reraise=False` logs writer errors instead — for
+    unwind paths that must not mask the original exception."""
+    _WRITER.flush(reraise=reraise)
+
+
 def save_interrupt(ckpt_dir: str, step: int, state: Any) -> None:
-    """Preemption-shutdown checkpoint: identical atomic `save_state`,
-    logged distinctly so a resumed run's logs show where the preempt
-    landed (off-interval steps are legal — `restore_latest` just takes
-    the newest usable one)."""
+    """Preemption-shutdown checkpoint: flush any in-flight background
+    write first (never lose the last interval save to a writer error),
+    then an atomic synchronous `save_state`, logged distinctly so a
+    resumed run's logs show where the preempt landed (off-interval
+    steps are legal — `restore_latest` just takes the newest usable
+    one)."""
+    flush_saves(reraise=False)
     log.warning("preempt: saving shutdown checkpoint at step %d to %s "
                 "(resume with SHIFU_TPU_RESUME=1)", step, ckpt_dir)
     save_state(ckpt_dir, step, state)

@@ -534,6 +534,12 @@ def train_streaming_core(train_conf: ModelTrainConf,
             # their default behavior.
             _sig.enter_context(
                 resilience.graceful_shutdown("streaming train"))
+            from shifu_tpu.train import checkpoint as ckpt_mod
+            # trainer-exit join barrier for the background checkpoint
+            # writer: surface writer errors on a clean exit, only log
+            # them while another exception is already unwinding
+            _sig.push(lambda *exc: ckpt_mod.flush_saves(
+                reraise=exc[0] is None))
         for epoch in range(start_epoch, train_conf.numTrainEpochs):
             sub = jax.random.fold_in(key, epoch)
             # per-epoch chunk-order reshuffle: chunked SGD sees a new
@@ -552,12 +558,13 @@ def train_streaming_core(train_conf: ModelTrainConf,
             chunks = pipe.map_prefetch(
                 lambda bnd: host_assemble(bnd, True),
                 [train_chunks[i] for i in order])
+            double_buf = pipe.h2d_double_buffer()
             nxt = place(next(chunks), True)
             prev_stacked = jax.tree.map(jnp.copy, stacked) \
                 if stopped.any() else None   # copy: donation-safe
             for ci in range(len(order)):
                 cur = nxt
-                if ci + 1 < len(order):
+                if not double_buf and ci + 1 < len(order):
                     nxt = place(next(chunks), True)  # prefetch
                 t_dev = time.monotonic()
                 stacked, opt_state, loss, sw = update(stacked, opt_state,
@@ -568,6 +575,11 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 sw_parts.append(sw)
                 pipe.add_stage_time("device_step_s",
                                     time.monotonic() - t_dev)
+                if double_buf and ci + 1 < len(order):
+                    # chunk N+1's H2D runs while chunk N's update (the
+                    # async dispatch above) executes on device, so
+                    # h2d_s now times only the non-overlapped remainder
+                    nxt = place(next(chunks), True)
             if prev_stacked is not None:
                 # stopped bags freeze: restore their params post-epoch
                 keep = jnp.asarray(stopped)
@@ -598,7 +610,7 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 nxt = place(next(vchunks), False)
                 for ci in range(len(val_chunks)):
                     cur = nxt
-                    if ci + 1 < len(val_chunks):
+                    if not double_buf and ci + 1 < len(val_chunks):
                         nxt = place(next(vchunks), False)
                     t_dev = time.monotonic()
                     e, w_ = val_chunk_err(stacked, *cur)
@@ -606,6 +618,8 @@ def train_streaming_core(train_conf: ModelTrainConf,
                     w_parts.append(w_)
                     pipe.add_stage_time("device_step_s",
                                         time.monotonic() - t_dev)
+                    if double_buf and ci + 1 < len(val_chunks):
+                        nxt = place(next(vchunks), False)
                 es_np = pipe.host_fetch(
                     jnp.stack(e_parts)).astype(np.float64)
                 ws_np = pipe.host_fetch(
@@ -648,8 +662,8 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 # (replicated) state, and concurrent rmtree/os.replace
                 # on a shared checkpoint dir would race
                 from shifu_tpu.train import checkpoint as ckpt_mod
-                ckpt_mod.save_state(checkpoint_dir, epoch + 1,
-                                    _ckpt_state())
+                ckpt_mod.save_checkpoint(checkpoint_dir, epoch + 1,
+                                         _ckpt_state())
                 saved = True
             if checkpointing and resilience.preempt_requested():
                 # preemption notice (SIGTERM/SIGINT or injected
@@ -658,9 +672,12 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 # distinct rc — SHIFU_TPU_RESUME=1 (or the supervisor)
                 # resumes at exactly this epoch
                 from shifu_tpu.train import checkpoint as ckpt_mod
-                if proc == 0 and not saved:
-                    ckpt_mod.save_interrupt(checkpoint_dir, epoch + 1,
-                                            _ckpt_state())
+                if proc == 0:
+                    if saved:
+                        ckpt_mod.flush_saves()
+                    else:
+                        ckpt_mod.save_interrupt(checkpoint_dir, epoch + 1,
+                                                _ckpt_state())
                 raise resilience.Preempted(
                     f"streaming train preempted after epoch "
                     f"{epoch + 1}/{train_conf.numTrainEpochs}; "

@@ -435,24 +435,33 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
         # checkpoint, raise Preempted (rc 75); SHIFU_TPU_RESUME=1 (or
         # resilience.supervise) resumes at `done`
         with resilience.graceful_shutdown("train"):
-            while done < n_epochs:
-                chunk = min(checkpoint_interval, n_epochs - done)
-                carry, tr, va = train_bags_carry(
-                    loss_fn, metric_fn, optimizer, chunk,
-                    early_stop_window, convergence_threshold, carry,
-                    train_inputs, w_train_bags, val_inputs, w_val,
-                    grad_mask, n_batches)
-                # keep the per-chunk error curves ON DEVICE — the
-                # host sync happens once after the loop, so chunk k+1
-                # dispatches while k's errors are still in flight
-                tr_chunks.append(tr)
-                va_chunks.append(va)
-                done += chunk
-                ckpt.save_state(checkpoint_dir, done, carry)
-                if resilience.preempt_requested() and done < n_epochs:
-                    raise resilience.Preempted(
-                        f"train preempted after epoch {done}/{n_epochs};"
-                        " checkpoint saved")
+            try:
+                while done < n_epochs:
+                    chunk = min(checkpoint_interval, n_epochs - done)
+                    carry, tr, va = train_bags_carry(
+                        loss_fn, metric_fn, optimizer, chunk,
+                        early_stop_window, convergence_threshold, carry,
+                        train_inputs, w_train_bags, val_inputs, w_val,
+                        grad_mask, n_batches)
+                    # keep the per-chunk error curves ON DEVICE — the
+                    # host sync happens once after the loop, so chunk
+                    # k+1 dispatches while k's errors are still in
+                    # flight
+                    tr_chunks.append(tr)
+                    va_chunks.append(va)
+                    done += chunk
+                    ckpt.save_checkpoint(checkpoint_dir, done, carry)
+                    if resilience.preempt_requested() and done < n_epochs:
+                        ckpt.flush_saves()
+                        raise resilience.Preempted(
+                            f"train preempted after epoch "
+                            f"{done}/{n_epochs}; checkpoint saved")
+                ckpt.flush_saves()  # trainer-exit join barrier
+            except BaseException:
+                # make the last interval save durable without masking
+                # the unwinding exception
+                ckpt.flush_saves(reraise=False)
+                raise
         if tr_chunks:
             train_errs = np.concatenate(
                 [pipe.host_fetch(t) for t in tr_chunks], axis=1)

@@ -14,12 +14,20 @@ is the in-tree subset kept fast enough for tier-1.
 """
 
 import os
+import signal
+import subprocess
+import sys
+import textwrap
 import time
 
+import numpy as np
 import pytest
 
 from shifu_tpu import resilience
 from shifu_tpu.cli import main as cli_main
+from shifu_tpu.train import checkpoint as ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
@@ -44,9 +52,11 @@ def _no_tmp_residue(root):
 
 
 # one site per instrumented class: filesystem probe, data open, record
-# read, atomic commit, processor step entry, distributed runtime init
+# read, atomic commit, processor step entry, distributed runtime init,
+# checkpoint staging/publish (the async-writer seams)
 CHAOS_SITES = ["fs.exists", "fs.open", "reader.read",
-               "atomic.commit", "step.init", "dist.init"]
+               "atomic.commit", "step.init", "dist.init",
+               "ckpt.stage", "ckpt.publish"]
 
 
 @pytest.mark.parametrize("site", CHAOS_SITES)
@@ -94,3 +104,73 @@ def test_chaos_sites_are_registered():
         if site == "step.init":   # dynamic step.<name> site
             continue
         assert site in resilience.FAULT_SITES, site
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-writer drills (the async-save crash seams)
+# ---------------------------------------------------------------------------
+
+def _state(scale):
+    return {"w": np.arange(16, dtype=np.float32) * scale,
+            "b": np.float64(scale)}
+
+
+def test_ckpt_publish_fault_surfaces_and_previous_step_survives(
+        tmp_path, monkeypatch):
+    """An injected error at the `ckpt.publish` commit point must name
+    the site and leave the previously published step restorable."""
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "0")
+    ckpt.save_state(ck, 1, _state(1.0))
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "ckpt.publish:oserror:1")
+    resilience.reset_faults()
+    with pytest.raises(OSError, match="injected oserror at ckpt.publish"):
+        ckpt.save_state(ck, 2, _state(2.0))
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    restored = ckpt.restore_latest(ck, _state(0.0))
+    assert restored is not None
+    step, st = restored
+    assert step == 1
+    np.testing.assert_array_equal(st["w"], _state(1.0)["w"])
+
+
+_KILL_DRILL = textwrap.dedent("""\
+    import sys
+    import numpy as np
+    from shifu_tpu.train import checkpoint as ckpt
+    ck = sys.argv[1]
+    ckpt.save_checkpoint(ck, 1, {"w": np.arange(16, dtype=np.float32),
+                                 "b": np.float64(1.0)})
+    ckpt.flush_saves()
+    ckpt.save_checkpoint(ck, 2, {"w": np.arange(16, dtype=np.float32) * 2,
+                                 "b": np.float64(2.0)})
+    ckpt.flush_saves()
+    print("UNREACHABLE")
+""")
+
+
+def test_kill_during_background_save_falls_back_to_previous_step(
+        tmp_path):
+    """SIGKILL on the background writer thread at `ckpt.publish`
+    (serialized, not yet renamed into place): step_2 must never become
+    visible and `restore_latest` must return the intact step_1."""
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SHIFU_TPU_CKPT_ASYNC="1",
+               SHIFU_TPU_FAULT="ckpt.publish:kill:2",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, "-c", _KILL_DRILL, ck],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=300)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stdout,
+                                             r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert ckpt.latest_step(ck) == 1
+    restored = ckpt.restore_latest(ck, _state(0.0))
+    assert restored is not None
+    step, st = restored
+    assert step == 1
+    np.testing.assert_array_equal(st["w"],
+                                  np.arange(16, dtype=np.float32))
+    np.testing.assert_array_equal(st["b"], np.float64(1.0))

@@ -244,3 +244,133 @@ def test_streaming_checkpoint_resume(tmp_path, rng, caplog):
     from shifu_tpu.train.streaming import cleanup_checkpoints
     cleanup_checkpoints(ck)
     assert not os.path.exists(ck)
+
+
+# ---------------------------------------------------------------------------
+# async writer (SHIFU_TPU_CKPT_ASYNC) — snapshot-then-background-write
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _fresh_writer():
+    """Every test starts with an idle writer and ends with any
+    leftover background write joined (never leaks into the next)."""
+    ckpt.flush_saves(reraise=False)
+    yield
+    ckpt.flush_saves(reraise=False)
+
+
+def _tree(scale):
+    return ({"w": (np.arange(12, dtype=np.float32) * scale).reshape(3, 4),
+             "m": np.full(5, scale, np.float64)},
+            {"count": np.asarray([int(scale)], np.int64)})
+
+
+def test_async_save_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "1")
+    d = str(tmp_path / "ck")
+    state = _tree(3.0)
+    ckpt.save_checkpoint(d, 7, state)
+    ckpt.flush_saves()
+    assert ckpt.latest_step(d) == 7
+    restored = ckpt.restore_state(d, 7, state)
+    np.testing.assert_array_equal(restored[0]["w"], state[0]["w"])
+    np.testing.assert_array_equal(restored[1]["count"], state[1]["count"])
+
+
+def test_async_vs_sync_saves_are_bit_identical(tmp_path, monkeypatch):
+    """ISSUE-5 acceptance: the async writer publishes byte-for-byte the
+    same checkpoint the synchronous path does."""
+    state = _tree(2.5)
+    da, ds = str(tmp_path / "async"), str(tmp_path / "sync")
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "1")
+    ckpt.save_checkpoint(da, 4, state)
+    ckpt.flush_saves()
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "0")
+    ckpt.save_checkpoint(ds, 4, state)
+    ra = ckpt.restore_state(da, 4, state)
+    rs = ckpt.restore_state(ds, 4, state)
+    flat_a = [ra[0]["w"], ra[0]["m"], ra[1]["count"]]
+    flat_s = [rs[0]["w"], rs[0]["m"], rs[1]["count"]]
+    for a, s in zip(flat_a, flat_s):
+        assert a.dtype == s.dtype
+        np.testing.assert_array_equal(a, s)
+
+
+def test_async_snapshot_decouples_from_mutation(tmp_path, monkeypatch):
+    """The on-thread snapshot must capture the state AT save time: the
+    trainer overwrites (donates) its buffers immediately after
+    save_checkpoint returns, and the background write must not see
+    that."""
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "1")
+    d = str(tmp_path / "ck")
+    state = {"w": np.arange(8, dtype=np.float32)}
+    ckpt.save_checkpoint(d, 1, state)
+    state["w"] *= -1.0   # mutate right after the (async) save returns
+    ckpt.flush_saves()
+    restored = ckpt.restore_state(d, 1, {"w": np.zeros(8, np.float32)})
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(8, dtype=np.float32))
+
+
+def test_background_write_error_surfaces_at_flush(tmp_path, monkeypatch):
+    """A writer-thread failure must not vanish: the next join barrier
+    re-raises it (and reraise=False only logs it)."""
+    from shifu_tpu import resilience
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "1")
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "ckpt.publish:oserror:1")
+    resilience.reset_faults()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, 1, _tree(1.0))
+    with pytest.raises(OSError, match="injected oserror at ckpt.publish"):
+        ckpt.flush_saves()
+    # the error was consumed: a second flush is a clean no-op
+    ckpt.flush_saves()
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    ckpt.save_checkpoint(d, 2, _tree(2.0))
+    ckpt.flush_saves()
+    assert ckpt.latest_step(d) == 2
+
+
+def test_save_interrupt_flushes_inflight_write_first(tmp_path,
+                                                     monkeypatch,
+                                                     caplog):
+    """Preempt path: an errored in-flight background save must be
+    logged (not raised — the shutdown save matters more) and the
+    synchronous interrupt save must still land."""
+    import logging
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "1")
+    orig = ckpt._publish
+    calls = {"n": 0}
+
+    def flaky(ckpt_dir, step, snap):
+        calls["n"] += 1
+        if calls["n"] == 1:   # the in-flight background write fails
+            raise OSError("simulated background write failure")
+        return orig(ckpt_dir, step, snap)
+
+    monkeypatch.setattr(ckpt, "_publish", flaky)
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, 4, _tree(4.0))
+    with caplog.at_level(logging.WARNING, logger="shifu_tpu"):
+        ckpt.save_interrupt(d, 5, _tree(5.0))
+    assert any("background checkpoint write failed" in r.getMessage()
+               for r in caplog.records)
+    assert ckpt.latest_step(d) == 5
+
+
+def test_ckpt_stall_much_smaller_than_save_async(tmp_path, monkeypatch):
+    """ISSUE-5 acceptance (unit form): with async on, the step-loop
+    stall (`ckpt_stall_s`) is a small fraction of the full
+    serialize+publish time (`ckpt_save_s`)."""
+    from shifu_tpu.data import pipeline as pipe
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", "1")
+    pipe.drain_stage_timers()
+    d = str(tmp_path / "ck")
+    big = {"w": np.zeros((512, 1024), np.float32)}   # 2 MiB serialize
+    for step in range(1, 4):
+        ckpt.save_checkpoint(d, step, big)
+    ckpt.flush_saves()
+    stages = pipe.drain_stage_timers()
+    assert stages.get("ckpt_save_s", 0) > 0
+    assert stages["ckpt_stall_s"] < stages["ckpt_save_s"], stages

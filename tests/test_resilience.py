@@ -340,7 +340,8 @@ def test_train_sigkill_then_resume_matches_uninterrupted(tmp_path):
 
     killed = subprocess.run(
         [sys.executable, "-c", _TRAIN_SCRIPT, ckdir],
-        env={**env, "SHIFU_TPU_FAULT": "ckpt.saved:kill:2"},
+        env={**env, "SHIFU_TPU_FAULT": "ckpt.saved:kill:2",
+             "SHIFU_TPU_CKPT_ASYNC": "1"},   # kill lands on the writer
         cwd="/root/repo", timeout=600, capture_output=True, text=True)
     assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
     assert ckpt.latest_step(ckdir) == 8, \
@@ -457,12 +458,17 @@ def test_supervise_off_by_default():
     assert len(n) == 1
 
 
+@pytest.mark.parametrize("ckpt_async", ["0", "1"])
 def test_preempt_supervised_resume_matches_uninterrupted(tmp_path,
-                                                         monkeypatch):
+                                                         monkeypatch,
+                                                         ckpt_async):
     """The acceptance run: inject a preemption notice right after the
     first checkpoint lands; training raises Preempted, the supervisor
-    re-invokes, the trainer restores at epoch 4 and finishes — with the
-    SAME final validation metric as an uninterrupted run."""
+    re-invokes, the trainer restores at the checkpointed epoch and
+    finishes — with the SAME final validation metric as an
+    uninterrupted run. Parametrized over the background checkpoint
+    writer (ISSUE-5: preempt-then-resume under async must match sync
+    and the uninterrupted run)."""
     from shifu_tpu.config.model_config import ModelTrainConf
     from shifu_tpu.train.trainer import train_nn
 
@@ -477,6 +483,7 @@ def test_preempt_supervised_resume_matches_uninterrupted(tmp_path,
                    "Propagation": "ADAM"}})
     ckdir = str(tmp_path / "ck")
 
+    monkeypatch.setenv("SHIFU_TPU_CKPT_ASYNC", ckpt_async)
     monkeypatch.setenv("SHIFU_TPU_FAULT", "ckpt.saved:preempt:1")
     monkeypatch.setenv("SHIFU_TPU_MAX_RESTARTS", "2")
     resilience.reset_faults()

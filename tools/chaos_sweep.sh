@@ -26,6 +26,10 @@ trap 'rm -rf "$WORK"' EXIT
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
 export JAX_PLATFORMS=cpu
 export SHIFU_TPU_RETRY_BASE_S=0.01
+# the ckpt.* sites only fire when training actually checkpoints, and
+# the async-writer seams (ckpt.stage/ckpt.publish) only exist with the
+# background writer on
+export SHIFU_TPU_CKPT_ASYNC=1
 
 SITES=$(python -c \
   "from shifu_tpu.resilience import FAULT_SITES; print('\n'.join(FAULT_SITES))")
@@ -35,7 +39,10 @@ build_model_set() {  # $1 = dest dir
 import sys
 import numpy as np
 from tests.synth import make_model_set
-print(make_model_set(sys.argv[1], np.random.default_rng(7), n_rows=300))
+# CheckpointInterval=2 makes train pass ckpt.save/stage/publish/saved
+# every other epoch, so those sites are exercised, not skipped
+print(make_model_set(sys.argv[1], np.random.default_rng(7), n_rows=300,
+                     train_params={"CheckpointInterval": 2}))
 PYEOF
 }
 

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Schema-drift gate: README-documented steps.jsonl stage fields must
+exist among the keys the code actually emits.
+
+README describes the per-stage timing keys carried in each
+``tmp/metrics/steps.jsonl`` record's ``inputPipeline`` block
+(`host_parse_s`, `ckpt_stall_s`, `compile_s`, ...). The only writers
+of that block are ``pipeline.add_stage_time`` / ``add_stage_count``,
+so the emitted vocabulary is statically enumerable: this script
+AST-walks ``shifu_tpu/`` collecting every string-literal stage name
+passed to those calls (plus the string defaults of ``stage=``
+parameters, which name the key when callers rely on the default),
+extracts the backticked stage tokens README claims, and exits 1 when
+documented ⊄ emitted — a renamed or deleted stage key must not leave
+the README describing fields that no longer appear in the logs.
+
+Token heuristic: backticked lowercase identifiers ending in ``_s``,
+``_hits`` or ``_misses`` are treated as stage fields; ``*per_s`` /
+``*_frac`` tokens are bench.py record keys, not steps.jsonl stages,
+and are skipped.
+
+Optionally pass a real steps.jsonl to ALSO verify against a live log
+(every documented field must appear in at least one record's
+``inputPipeline`` block across the file):
+
+    python tools/check_steps_schema.py [path/to/steps.jsonl]
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "shifu_tpu")
+README = os.path.join(REPO, "README.md")
+
+_TOKEN = re.compile(r"`([a-z][a-z0-9_]*(?:_s|_hits|_misses))`")
+_WRITERS = ("add_stage_time", "add_stage_count")
+
+
+def documented_fields() -> set:
+    with open(README, encoding="utf-8") as f:
+        text = f.read()
+    return {tok for tok in _TOKEN.findall(text)
+            if "per_s" not in tok and not tok.endswith("_frac")}
+
+
+def emitted_fields() -> set:
+    out = set()
+    for dirpath, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    fname = fn.attr if isinstance(fn, ast.Attribute) \
+                        else getattr(fn, "id", None)
+                    if fname in _WRITERS and node.args and \
+                            isinstance(node.args[0], ast.Constant) and \
+                            isinstance(node.args[0].value, str):
+                        out.add(node.args[0].value)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    # `stage="host_assemble_s"` style defaults name the
+                    # emitted key when callers rely on the default
+                    a = node.args
+                    params = a.posonlyargs + a.args + a.kwonlyargs
+                    defaults = ([None] * (len(a.posonlyargs + a.args)
+                                          - len(a.defaults))
+                                + list(a.defaults) + list(a.kw_defaults))
+                    for p, d in zip(params, defaults):
+                        if p.arg == "stage" and \
+                                isinstance(d, ast.Constant) and \
+                                isinstance(d.value, str):
+                            out.add(d.value)
+    return out
+
+
+def log_fields(path: str) -> set:
+    out = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            out |= set(rec.get("inputPipeline", {}))
+    return out
+
+
+def main(argv) -> int:
+    doc, emit = documented_fields(), emitted_fields()
+    missing = sorted(doc - emit)
+    if missing:
+        print("steps.jsonl schema drift: README documents stage fields "
+              "the code never emits:", file=sys.stderr)
+        for tok in missing:
+            print(f"  {tok}", file=sys.stderr)
+        print(f"emitted vocabulary: {sorted(emit)}", file=sys.stderr)
+        return 1
+    print(f"steps.jsonl schema: {len(doc)} documented stage fields, "
+          f"all within the {len(emit)}-key emitted vocabulary")
+    if argv:
+        seen = log_fields(argv[0])
+        absent = sorted(doc - seen)
+        if absent:
+            print(f"live log {argv[0]} never carried documented "
+                  f"field(s): {absent}", file=sys.stderr)
+            return 1
+        print(f"live log {argv[0]}: all documented fields observed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

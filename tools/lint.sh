@@ -1,16 +1,18 @@
 #!/usr/bin/env bash
-# Repo lint gate — exits non-zero on ANY finding. Three passes:
+# Repo lint gate — exits non-zero on ANY finding. Four passes:
 #
 #   1. `python -m shifu_tpu.analysis` over the package AND the
-#      out-of-package knob readers (bench.py, tools/) — the six
+#      out-of-package knob readers (bench.py, tools/) — the seven
 #      repo-native rules: host-sync-in-hot-loop, jit-in-loop,
 #      donation-aliasing, undeclared-knob, unregistered-fault-site,
-#      blocking-under-lock.
+#      blocking-under-lock, unsharded-device-put.
 #   2. `python -m compileall` — syntax across every tree we ship.
 #   3. hygiene: no tracked .pyc/__pycache__ artifacts, and the
 #      fault-site registry must agree with the chaos matrix driver
 #      (tools/chaos_sweep.sh enumerates resilience.FAULT_SITES, so a
 #      site that import fails would silently shrink the sweep).
+#   4. steps.jsonl schema: every stage field README documents must be
+#      in the emitted vocabulary (tools/check_steps_schema.py).
 #
 # tests/test_lint.py runs pass 1 in tier-1; this script is the full
 # pre-push/CI gate. Suppress an intentional finding inline with
@@ -55,6 +57,9 @@ assert sites, "FAULT_SITES is empty — the chaos matrix would be a no-op"
 print(f"{len(sites)} fault sites registered; "
       "tools/chaos_sweep.sh sweeps all of them")
 PYEOF
+
+echo "== steps.jsonl schema (README vs emitted keys) =="
+python tools/check_steps_schema.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
   echo "lint: FAILED" >&2
