@@ -106,6 +106,17 @@ WDL_HIDDEN = (256, 128)
 WDL_EPOCHS_SHORT = 2
 WDL_EPOCHS_LONG = 22
 
+# MTL (multi-task shared trunk + per-task heads, models/mtl.py — the
+# reference's MTLWorker/MultiTaskModel path). Exists mainly so the
+# roofline coverage spans every model family; shape modest enough to
+# fit any tunnel window.
+MTL_ROWS = 500_000
+MTL_FEATURES = 64
+MTL_TASKS = 4
+MTL_HIDDEN = (128, 64)
+MTL_EPOCHS_SHORT = 2
+MTL_EPOCHS_LONG = 22
+
 # v5e HBM bandwidth (GB/s) for the roofline estimate in extra
 TPU_HBM_GBPS = 819.0
 
@@ -365,11 +376,15 @@ def task_nn():
 
     # fwd ≈ 2·N·(F·H + H) FLOPs; training ≈ 3× fwd (bwd 2×)
     flops = 3 * 2 * n_train * (N_FEATURES * HIDDEN + HIDDEN) * d_epochs
+    from shifu_tpu import profiling
     print(json.dumps({
         "row_epochs_per_sec": row_epochs_per_sec,
         "wall_s": wall, "wall_short_s": walls[BENCH_EPOCHS_SHORT],
         "wall_long_s": walls[BENCH_EPOCHS], "auc": a,
         "mxu_util_est": flops / wall / TPU_PEAK_FLOPS_BF16,
+        "roofline": profiling.roofline(
+            "NN", *profiling.mlp_row_costs(N_FEATURES, [HIDDEN]),
+            row_epochs_per_sec),
     }))
 
 
@@ -426,6 +441,7 @@ def task_nn_wide(compute="float32"):
     # bf16 halves the activation/input bytes the epoch streams
     if compute == "bfloat16":
         hbm_bytes //= 2
+    from shifu_tpu import profiling
     print(json.dumps({
         "row_epochs_per_sec": row_epochs_per_sec,
         "wall_s": d_wall, "wall_short_s": walls[WIDE_EPOCHS_SHORT],
@@ -435,6 +451,11 @@ def task_nn_wide(compute="float32"):
         "mxu_util": achieved / TPU_PEAK_FLOPS_BF16,
         "hbm_gbps_est": hbm_bytes / d_wall / 1e9,
         "hbm_util_est": hbm_bytes / d_wall / 1e9 / TPU_HBM_GBPS,
+        "roofline": profiling.roofline(
+            "NN", *profiling.mlp_row_costs(
+                WIDE_FEATURES, WIDE_HIDDEN,
+                dtype_bytes=2 if compute == "bfloat16" else 4),
+            row_epochs_per_sec, compute_dtype=compute),
     }))
 
 
@@ -508,10 +529,86 @@ def task_wdl():
         raise ValueError(f"WDL failed to learn (AUC {a})")
     # embedding traffic LOWER bound per epoch: fwd gather + bwd scatter
     emb_bytes = 2 * n_train * WDL_CAT * WDL_EMBED * 4 * d_epochs
+    from shifu_tpu import profiling
     print(json.dumps({
         "row_epochs_per_sec": n_train * d_epochs / d_wall,
         "wall_s": d_wall, "auc": a,
         "embed_gather_gbps_est": emb_bytes / d_wall / 1e9,
+        "roofline": profiling.roofline(
+            "WDL", *profiling.wdl_row_costs(WDL_DENSE, WDL_CAT,
+                                            WDL_EMBED, WDL_HIDDEN),
+            n_train * d_epochs / d_wall),
+    }))
+
+
+def task_mtl():
+    """Multi-task training throughput: the real train_bags path through
+    the shared-trunk + per-task-heads model (models/mtl.py). Delta
+    timing and on-device data generation like the other model-layer
+    tasks; per-task labels get distinct planted margins so every head
+    must actually learn (AUC gate on the first task)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models import mtl
+    from shifu_tpu.ops.metrics import auc
+    from shifu_tpu.train.optimizers import optimizer_from_params
+    from shifu_tpu.train.trainer import split_validation, train_bags
+
+    kb, kx, kn = jax.random.split(jax.random.PRNGKey(0), 3)
+    betas = jax.random.normal(kb, (MTL_FEATURES, MTL_TASKS), jnp.float32)
+    x = jax.random.normal(kx, (MTL_ROWS, MTL_FEATURES), jnp.float32)
+    margins = x @ betas / jnp.sqrt(float(MTL_FEATURES)) * 2.0
+    y = (margins + jax.random.normal(kn, (MTL_ROWS, MTL_TASKS)) > 0) \
+        .astype(jnp.float32)
+    w = jnp.ones(MTL_ROWS, jnp.float32)
+
+    spec = mtl.MTLSpec(input_dim=MTL_FEATURES, n_tasks=MTL_TASKS,
+                       hidden_dims=MTL_HIDDEN,
+                       activations=("relu",) * len(MTL_HIDDEN))
+    tr_mask, val_mask = split_validation(MTL_ROWS, 0.05, 7)
+    n_train = int(tr_mask.sum())
+    optimizer = optimizer_from_params({"Propagation": "ADAM",
+                                       "LearningRate": 0.02})
+
+    def loss(params, inputs, w_, key_):
+        x_, y_ = inputs
+        return mtl.loss_fn(spec, params, x_, y_, w_)
+
+    def metric(params, inputs, w_):
+        x_, y_ = inputs
+        return mtl.mse(spec, params, x_, y_, w_)
+
+    key = jax.random.PRNGKey(1)
+    bag_keys = jax.random.split(key, 1)
+
+    def measure(epochs):
+        stacked = jax.vmap(lambda k: mtl.init_params(spec, k))(bag_keys)
+        grad_mask = jax.tree.map(lambda l: jnp.ones_like(l[0]), stacked)
+        args = (loss, metric, optimizer, epochs, 0, 0.0, stacked,
+                (x[tr_mask], y[tr_mask]), w[tr_mask][None, :],
+                (x[val_mask], y[val_mask]), w[val_mask], bag_keys,
+                grad_mask)
+        train_bags(*args)   # compile this scan length
+        t0 = time.time()
+        return t0, train_bags(*args)
+
+    out, walls, d_wall = _delta_timed(measure, MTL_EPOCHS_SHORT,
+                                      MTL_EPOCHS_LONG)
+    res_params = jax.tree.map(lambda p: p[0], out[0])
+    d_epochs = MTL_EPOCHS_LONG - MTL_EPOCHS_SHORT
+    scores = mtl.forward(spec, res_params, jnp.asarray(x[:200_000]))
+    a = float(auc(scores[:, 0], jnp.asarray(y[:200_000, 0])))
+    if a <= 0.7:
+        raise ValueError(f"MTL failed to learn (task-0 AUC {a})")
+    from shifu_tpu import profiling
+    print(json.dumps({
+        "row_epochs_per_sec": n_train * d_epochs / d_wall,
+        "wall_s": d_wall, "auc": a, "tasks": MTL_TASKS,
+        "roofline": profiling.roofline(
+            "MTL", *profiling.mtl_row_costs(MTL_FEATURES, MTL_HIDDEN,
+                                            MTL_TASKS),
+            n_train * d_epochs / d_wall),
     }))
 
 
@@ -729,6 +826,10 @@ def task_streaming():
         raise ValueError(f"streaming model failed to learn (AUC {a})")
     gb = STREAM_GB
     print(json.dumps({
+        "roofline": profiling.roofline(
+            "NN", *profiling.mlp_row_costs(STREAM_FEATURES,
+                                           STREAM_HIDDEN),
+            n_train * d_epochs / d_wall),
         "row_epochs_per_sec": n_train * d_epochs / d_wall,
         "stream_train_rows_per_s": n_train * d_epochs / d_wall,
         "input_stall_frac": round(stall_frac, 4),
@@ -817,6 +918,7 @@ def task_varsel():
         raise ValueError(f"sensitivity ranking failed to recover the "
                          f"planted importances (spearman {rho})")
 
+    from shifu_tpu import profiling
     print(json.dumps({
         "lr_row_epochs_per_sec": n_train * d_epochs / lr_wall,
         "lr_auc": a,
@@ -824,6 +926,9 @@ def task_varsel():
         "sens_col_rows_per_sec": VARSEL_ROWS * VARSEL_COLS / sens_wall,
         "rank_spearman": rho,
         "rows": VARSEL_ROWS, "cols": VARSEL_COLS,
+        "roofline": profiling.roofline(
+            "NN", *profiling.mlp_row_costs(VARSEL_COLS, ()),
+            n_train * d_epochs / lr_wall),
     }))
 
 
@@ -872,10 +977,15 @@ def task_gbt(rows=None, trees=None):
         jax.tree.map(jnp.asarray, built), binsT[:, :probe_rows],
         cfg.max_depth, cfg.n_bins)).sum(axis=0)
     a = float(auc(jnp.asarray(scores), y[:probe_rows]))
+    from shifu_tpu import profiling
     print(json.dumps({
         "row_trees_per_sec": rows * trees / wall,
         "wall_s": wall, "auc": a,
         "rows": rows, "trees": trees, "depth": GBT_DEPTH,
+        "roofline": profiling.roofline(
+            "GBT", *profiling.tree_row_costs(GBT_COLS, n_bins,
+                                             GBT_DEPTH),
+            rows * trees / wall),
     }))
 
 
@@ -924,10 +1034,14 @@ def task_rf():
         jax.tree.map(jnp.asarray, built), binsT[:, :probe],
         cfg.max_depth, cfg.n_bins)).mean(axis=0)   # RF = tree average
     a = float(auc(jnp.asarray(scores), y[:probe]))
+    from shifu_tpu import profiling
     print(json.dumps({
         "row_trees_per_sec": RF_ROWS * RF_TREES / wall,
         "wall_s": wall, "auc": a, "rows": RF_ROWS, "trees": RF_TREES,
         "depth": RF_DEPTH,
+        "roofline": profiling.roofline(
+            "RF", *profiling.tree_row_costs(GBT_COLS, n_bins, RF_DEPTH),
+            RF_ROWS * RF_TREES / wall),
     }))
 
 
@@ -1217,6 +1331,9 @@ def _workload(task):
         "wdl": {"rows": WDL_ROWS, "dense": WDL_DENSE, "cat": WDL_CAT,
                 "vocab": WDL_VOCAB, "embed": WDL_EMBED,
                 "epochs": [WDL_EPOCHS_SHORT, WDL_EPOCHS_LONG]},
+        "mtl": {"rows": MTL_ROWS, "features": MTL_FEATURES,
+                "tasks": MTL_TASKS, "hidden": list(MTL_HIDDEN),
+                "epochs": [MTL_EPOCHS_SHORT, MTL_EPOCHS_LONG]},
         "hist_xla": {"rows": HIST_ROWS, "cols": HIST_COLS,
                      "bins": HIST_BINS, "slots": HIST_SLOTS},
         "hist_pallas": {"rows": HIST_ROWS, "cols": HIST_COLS,
@@ -1292,21 +1409,41 @@ def _run_cpu_denom(res, diags):
 def _resolve_backend(diags):
     """Probe the default backend in a subprocess; retry a flaky TPU
     init; fall back to CPU. A user-pinned JAX_PLATFORMS is honored:
-    retried like any backend but never silently replaced by cpu."""
+    retried like any backend but never silently replaced by cpu.
+
+    SHIFU_TPU_BENCH_PROBE_TIMEOUT_S / SHIFU_TPU_BENCH_PROBE_ATTEMPTS
+    bound the probe: the axon tunnel has failed its init probe since
+    r01 (BENCH_r05 diagnostics), and on a bad tunnel day the right
+    budget is an env knob, not a bench edit. Every path taken here is
+    logged to stderr so the headline's provenance is reconstructible
+    from the run log alone."""
     pinned = os.environ.get("JAX_PLATFORMS")
-    for i in range(3):
-        out, err = _run_task("probe", timeout=300)
+    probe_timeout = max(1, knob_int("SHIFU_TPU_BENCH_PROBE_TIMEOUT_S"))
+    attempts = max(1, knob_int("SHIFU_TPU_BENCH_PROBE_ATTEMPTS"))
+    for i in range(attempts):
+        out, err = _run_task("probe", timeout=probe_timeout)
         if out:
+            _log(f"probe: backend {out['backend']} up "
+                 f"(attempt {i + 1}/{attempts})")
             return out["backend"], {}
-        diags.append(f"probe attempt {i + 1} failed: {err.splitlines()[-1] if err else '?'}")
+        diags.append(f"probe attempt {i + 1}/{attempts} failed "
+                     f"(timeout {probe_timeout}s): "
+                     f"{err.splitlines()[-1] if err else '?'}")
+        _log(f"probe: attempt {i + 1}/{attempts} failed; "
+             f"{'retrying' if i + 1 < attempts else 'giving up'}")
         time.sleep(5 * (i + 1))
     if pinned and pinned != "cpu":
+        _log(f"probe: JAX_PLATFORMS={pinned} pinned by the user — "
+             "NOT falling back to cpu")
         diags.append(f"JAX_PLATFORMS={pinned} was pinned by the user; "
                      "not falling back to cpu")
         return None, {}
+    _log(f"probe: default backend unreachable after {attempts} "
+         f"attempt(s) x {probe_timeout}s — falling back to "
+         "JAX_PLATFORMS=cpu")
     diags.append("falling back to JAX_PLATFORMS=cpu")
     out, err = _run_task("probe", env_extra={"JAX_PLATFORMS": "cpu"},
-                         timeout=300)
+                         timeout=probe_timeout)
     if out:
         return "cpu", {"JAX_PLATFORMS": "cpu"}
     diags.append(f"cpu probe failed too: {err.splitlines()[-1] if err else '?'}")
@@ -1344,6 +1481,8 @@ def main():
         return task_nn_wide("bfloat16")
     if args.task == "wdl":
         return task_wdl()
+    if args.task == "mtl":
+        return task_mtl()
     if args.task == "varsel":
         return task_varsel()
     if args.task in ("hist_pallas", "hist_xla"):
@@ -1404,6 +1543,8 @@ def main():
                  timeout=2700)
             step("wdl", f"WDL bench ({WDL_ROWS}x{WDL_DENSE}d+{WDL_CAT}c, "
                  f"vocab {WDL_VOCAB})", timeout=2700)
+            step("mtl", f"MTL bench ({MTL_ROWS}x{MTL_FEATURES}, "
+                 f"{MTL_TASKS} tasks)", timeout=2400)
             # Pallas interpret mode on CPU is not a perf path; only
             # measure the kernel where it actually runs.
             step("hist_pallas", "GBDT histogram bench (pallas MXU)")
@@ -1474,6 +1615,11 @@ def main():
         extra["wdl_auc"] = round(wd["auc"], 4)
         extra["wdl_embed_gather_gbps_est"] = round(
             wd["embed_gather_gbps_est"], 1)
+
+    def _fill_mtl(mt):
+        extra["mtl_Mrow_epochs_per_s"] = round(
+            mt["row_epochs_per_sec"] / 1e6, 3)
+        extra["mtl_auc"] = round(mt["auc"], 4)
 
     def _fill_hists(hp):
         hx = res.get("hist_xla")
@@ -1570,6 +1716,7 @@ def main():
     fill("nn", _fill_nn)
     fill("nn_wide", _fill_nn_wide)
     fill("wdl", _fill_wdl)
+    fill("mtl", _fill_mtl)
     fill("hist_xla", lambda hx: extra.__setitem__(
         "gbdt_hist_xla_gcells_per_s", round(hx["cells_per_sec"] / 1e9, 3)))
     fill("hist_pallas", _fill_hists)
@@ -1577,6 +1724,15 @@ def main():
     fill("varsel", _fill_varsel)
     fill("gbt", _fill_gbt)
     fill("streaming", _fill_streaming)
+
+    # per-family roofline blocks (profiling.roofline): every task that
+    # measured one carries it into the headline JSON so the r06+
+    # trajectory says WHY a shape is slow (compute- vs memory-bound),
+    # not just that it is
+    rooflines = {t: out["roofline"] for t, out in res.items()
+                 if isinstance(out, dict) and "roofline" in out}
+    if rooflines:
+        extra["roofline"] = rooflines
     nn, nw = res.get("nn"), res.get("nn_wide")
 
     # headline selection: the wide shape (600x512x256) is the

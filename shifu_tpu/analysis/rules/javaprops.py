@@ -1,0 +1,92 @@
+"""java-property-key: dotted `shifu.*` java-style property keys (the
+shifuconfig / -D compatibility surface, reference
+`util/Environment.java`) must be declared in
+`config.environment.JAVA_PROPS` — an ad-hoc literal key anywhere else
+in the package is how the legacy property surface sprawls invisibly.
+
+Flags, per file (everything under `config/` is exempt — that is where
+the registry and the shifuconfig parser live):
+  * a string literal matching `shifu.<seg>.<seg>[...]` that is not a
+    JAVA_PROPS entry — declare it (key + one-line doc) or rename it
+    off the reserved `shifu.` prefix.
+
+Flags, cross-file (finalize): a JAVA_PROPS entry no scanned file ever
+references — a dead declaration (mirrors the undeclared-knob rule's
+dead-entry sweep).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Set
+
+from shifu_tpu.analysis.engine import Finding
+
+RULES = ("java-property-key",)
+
+# dotted lowercase-first key with >= 2 segments after "shifu." —
+# "shifu.config" (a filename) doesn't match, "shifu.norm.chunkRows" does
+_KEY_RE = re.compile(r"^shifu(\.[A-Za-z0-9_]+){2,}$")
+
+
+def _registry():
+    from shifu_tpu.config import environment
+    return environment.JAVA_PROPS
+
+
+def _in_config(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return "/config/" in p or p.startswith("config/")
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    props = _registry()
+    seen: Set[str] = ctx.setdefault("javaprop-refs", set())
+    in_registry = path.replace(os.sep, "/").endswith("config/environment.py")
+    if in_registry:
+        ctx["javaprop-registry-scanned"] = True
+
+    # docstring constants don't count (prose mentioning a key is fine)
+    doc_ids = {id(n.value) for n in ast.walk(tree)
+               if isinstance(n, ast.Expr)
+               and isinstance(n.value, ast.Constant)
+               and isinstance(n.value.value, str)}
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _KEY_RE.match(node.value)
+                and id(node) not in doc_ids):
+            continue
+        if not in_registry:
+            # the registry's own dict literal must not count as a live
+            # reference — the dead-entry sweep would never fire
+            seen.add(node.value)
+        if _in_config(path):
+            continue
+        if node.value not in props:
+            findings.append(Finding(
+                "java-property-key", path, node.lineno, node.col_offset,
+                f"ad-hoc java-style property key {node.value!r} — "
+                "declare it in config.environment.JAVA_PROPS (key + "
+                "doc) so the shifuconfig compatibility surface stays "
+                "enumerable, or rename it off the shifu. prefix"))
+    return findings
+
+
+def finalize(ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.get("javaprop-registry-scanned"):
+        return findings
+    seen: Set[str] = ctx.get("javaprop-refs", set())
+    for key in sorted(_registry()):
+        if key not in seen:
+            findings.append(Finding(
+                "java-property-key", "config/environment.py", 0, 0,
+                f"dead JAVA_PROPS entry: {key!r} is declared but never "
+                "referenced by any scanned file — delete the entry or "
+                "wire up the read"))
+    return findings

@@ -205,12 +205,37 @@ _declare("SHIFU_TPU_GBT_SCAN_GROUP", "int", 0,
          "trees per lax.scan group in GBT build; 0 = no grouping")
 _declare("SHIFU_TPU_NN_COMPUTE", "str", "float32",
          "NN forward/backward compute dtype (float32 | bfloat16)")
+_declare("SHIFU_TPU_COMPUTE_DTYPE", "str", None,
+         "default compute dtype for NN/WDL/MTL forward+backward "
+         "(float32 | bfloat16); params/optimizer state stay f32 and "
+         "matmuls accumulate in f32. Per-model train params and "
+         "SHIFU_TPU_NN_COMPUTE override it")
+_declare("SHIFU_TPU_HIST_FUSED", "bool", "0",
+         "1 = GBT level builds bin numeric values inside the histogram "
+         "kernel (no materialized bin-index matrix); needs FusedBins "
+         "inputs from gbdt.make_fused_inputs")
+_declare("SHIFU_TPU_SCORE_FUSED", "str", "auto",
+         "fused normalize+first-matmul scoring kernel route: "
+         "auto | pallas | xla")
+# --- remote fs ---
+_declare("SHIFU_TPU_FS_CACHE_TYPE", "str", "readahead",
+         "fsspec cache_type hint for remote streaming opens "
+         "(readahead | bytes | block | none)")
+_declare("SHIFU_TPU_FS_BLOCK_SIZE", "int", 4 * 1024 * 1024,
+         "fsspec block_size hint (bytes) for remote streaming opens; "
+         "0 = leave the filesystem default")
 # --- export ---
 _declare("SHIFU_TPU_UME_EXPORTER", "str", None,
          "pkg.module:Class hook for `export -t ume` bundles")
 # --- bench / tools (read outside the package) ---
 _declare("SHIFU_TPU_BENCH_ATTEMPTS", "int", 2,
          "re-measure attempts per bench workload", scope="bench")
+_declare("SHIFU_TPU_BENCH_PROBE_TIMEOUT_S", "int", 300,
+         "per-attempt timeout for the bench backend probe subprocess",
+         scope="bench")
+_declare("SHIFU_TPU_BENCH_PROBE_ATTEMPTS", "int", 3,
+         "backend probe attempts before falling back to cpu",
+         scope="bench")
 _declare("SHIFU_TPU_BENCH_REFRESH", "flag", "0",
          "1 = re-measure even when a baseline record exists",
          scope="bench")
@@ -235,6 +260,27 @@ _declare("SHIFU_TPU_PIPE_EPOCHS", "int", 30,
 _declare("SHIFU_TPU_GBT_TRACE", "flag", "0",
          "1 = capture a jax.profiler trace in tools/profile_gbt.py",
          scope="tools")
+
+
+# ---------------------------------------------------------------------------
+# Java-style property keys (shifuconfig compatibility surface)
+# ---------------------------------------------------------------------------
+# The reference reads dotted `shifu.*` properties from shifuconfig /
+# -D system properties (util/Environment.java); a few of those keys are
+# honored here verbatim for drop-in compatibility. Every such key MUST
+# be declared in this map — the `java-property-key` lint rule rejects
+# ad-hoc `shifu.*` string literals outside config/ so the legacy
+# surface cannot silently sprawl (same philosophy as KNOBS above).
+JAVA_PROPS: Dict[str, str] = {
+    "shifu.analysis.chunkRows":
+        "chunk size override for the exact streaming analysis passes",
+    "shifu.eval.chunkRows": "chunk size override for streaming eval",
+    "shifu.norm.chunkRows": "chunk size override for streaming norm",
+    "shifu.precision.type": "output float precision for norm records",
+    "shifu.stats.chunkRows": "chunk size override for streaming stats",
+    "shifu.varsel.reuse.model":
+        "true = reuse the trained probe model across varselect steps",
+}
 
 
 def _require(name: str) -> Knob:

@@ -47,12 +47,32 @@ def _fs_and_path(path: str):
             "pyarrow for hdfs://)") from e
 
 
+def readahead_hints() -> dict:
+    """fsspec caching hints for remote sequential scans: the streaming
+    reader consumes whole files front to back in chunk-sized bites, so
+    a readahead cache with a multi-MiB block turns many latency-bound
+    small range requests into a few large ones. SHIFU_TPU_FS_CACHE_TYPE
+    ("none" = leave the backend default) and SHIFU_TPU_FS_BLOCK_SIZE
+    (0 = backend default) tune or disable the hints."""
+    from shifu_tpu.config.environment import knob_int, knob_str
+    hints = {}
+    ct = (knob_str("SHIFU_TPU_FS_CACHE_TYPE") or "").lower()
+    if ct and ct != "none":
+        hints["cache_type"] = ct
+    bs = knob_int("SHIFU_TPU_FS_BLOCK_SIZE")
+    if bs > 0:
+        hints["block_size"] = bs
+    return hints
+
+
 def open_text(path: str, mode: str = "rt"):
     """Open a (possibly remote, possibly compressed) file for reading."""
     import fsspec
 
+    hints = readahead_hints()
+
     def _open():
-        return fsspec.open(path, mode, compression="infer").open()
+        return fsspec.open(path, mode, compression="infer", **hints).open()
 
     return retrying("fs.open", _open)
 

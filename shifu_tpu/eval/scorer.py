@@ -29,22 +29,40 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
                  dense: np.ndarray,
                  index: Optional[np.ndarray] = None,
                  raw_dense: Optional[np.ndarray] = None,
-                 raw_codes: Optional[np.ndarray] = None) -> np.ndarray:
+                 raw_codes: Optional[np.ndarray] = None,
+                 norm: Optional[Dict[str, Any]] = None) -> np.ndarray:
     """Score one model → (N,) scores. NN-family models consume the
     NORMALIZED blocks (dense/index); tree models consume the CLEANED
     raw features (raw_dense numeric with NaN missing, raw_codes with
     −1/vocab_len missing) — mirroring the reference's split where trees
-    train on cleaned data (TrainModelProcessor:1547-1550)."""
+    train on cleaned data (TrainModelProcessor:1547-1550).
+
+    `norm` ({"mean", "std", "cutoff"}) asserts that `dense` is exactly
+    zscore(raw_dense) — then the NN path fuses the normalize with the
+    first-layer matmul (ops/pallas_score) instead of reading the
+    materialized dense matrix (SHIFU_TPU_SCORE_FUSED routes it)."""
     if kind in ("nn", "lr"):
         from shifu_tpu.parallel import mesh as mesh_mod
         sd = dict(meta["spec"])
         sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
         sd["activations"] = tuple(sd.get("activations", ()))
         spec = nn_mod.MLPSpec(**sd)
+        n = dense.shape[0]
+        if norm is not None and raw_dense is not None \
+                and raw_dense.shape[1] == spec.input_dim:
+            from shifu_tpu.ops import pallas_score
+            if pallas_score.score_fused_mode() == "pallas":
+                out = pallas_score.score_nn(
+                    spec, jax.tree.map(jnp.asarray, params),
+                    jnp.asarray(raw_dense, jnp.float32),
+                    jnp.asarray(norm["mean"], jnp.float32),
+                    jnp.asarray(norm["std"], jnp.float32),
+                    float(norm["cutoff"]),
+                    interpret=jax.default_backend() != "tpu")
+                return np.asarray(out)[:n]
         # scoring shards rows over the data mesh (the Pig EvalScore
         # mappers' split, EvalScoreUDF); padded rows are sliced off
         mesh = mesh_mod.default_mesh()
-        n = dense.shape[0]
         d_dense = mesh_mod.shard_axis(mesh, np.asarray(dense, np.float32), 0)
         out = nn_mod.forward(spec, jax.tree.map(jnp.asarray, params),
                              d_dense)
@@ -187,13 +205,15 @@ class Scorer:
     def score(self, dense: np.ndarray,
               index: Optional[np.ndarray] = None,
               raw_dense: Optional[np.ndarray] = None,
-              raw_codes: Optional[np.ndarray] = None) -> Dict[str, np.ndarray]:
+              raw_codes: Optional[np.ndarray] = None,
+              norm: Optional[Dict[str, Any]] = None) -> Dict[str, np.ndarray]:
         """→ {"mean","max","min","median","model0".."modelN"} like the
         reference EvalScore output columns."""
         per_model = []
         for kind, meta, params in self.models:
             s = score_matrix(kind, meta, params, dense, index,
-                             raw_dense=raw_dense, raw_codes=raw_codes)
+                             raw_dense=raw_dense, raw_codes=raw_codes,
+                             norm=norm)
             if kind in ("gbt",):
                 s = convert_tree_score(s, self.gbt_convert)
             per_model.append(s)

@@ -34,7 +34,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -113,11 +113,91 @@ def _hist_mode() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
+class FusedBins(NamedTuple):
+    """Raw feature values + per-column cut boundaries, carried in place
+    of the pre-binned int32 matrix when SHIFU_TPU_HIST_FUSED=1: the
+    histogram kernel re-derives bin indices in-register from these
+    (ops/pallas_hist.level_histograms_fused), so the resident GBT level
+    build never materializes the (C, R) bin-index intermediate in HBM.
+
+    valuesT: (C, R) f32, transposed like binsT; NaN = missing.
+    Categorical columns carry their host-mapped bin id as a float —
+    identity cuts at 0.5, 1.5, … make the in-kernel compare count
+    reproduce the id exactly (see make_fused_inputs).
+    cuts: (C, K) f32, ascending per row, +inf padded.
+    """
+    valuesT: Any
+    cuts: Any
+
+    @property
+    def shape(self):
+        return self.valuesT.shape
+
+
+def hist_fused_enabled() -> bool:
+    """SHIFU_TPU_HIST_FUSED=1 routes the resident GBT build through
+    FusedBins instead of the pre-binned int32 matrix."""
+    return knob_bool("SHIFU_TPU_HIST_FUSED")
+
+
+def make_fused_inputs(tables: Dict[str, np.ndarray],
+                      dense: Optional[np.ndarray],
+                      codes: Optional[np.ndarray],
+                      n_bins: int) -> FusedBins:
+    """Host-side packing for the fused histogram path — the FusedBins
+    analog of bin_dataset (same column order: numeric then categorical,
+    same missing semantics).
+
+    Numeric columns pass through raw (NaN = missing) with their stats
+    cut boundaries; the kernel's `Σ(v >= cut)` count equals
+    ops/stats.bin_index_numeric exactly (+inf pad cuts never fire for
+    finite values). Categorical columns are host-mapped through
+    cat_map — same as bin_dataset — and the resulting bin id rides as
+    a float with identity boundaries 0.5, 1.5, …; missing (id
+    n_bins-1) becomes NaN so the kernel's NaN→missing rule lands it
+    in the same slot."""
+    num_cuts = np.asarray(tables["num_cuts"], np.float32)   # (K0, Cn)
+    vals_parts: List[np.ndarray] = []
+    cut_parts: List[np.ndarray] = []
+    if dense is not None and dense.shape[1]:
+        vals_parts.append(np.asarray(dense, np.float32).T)  # (Cn, R)
+        cut_parts.append(np.ascontiguousarray(num_cuts.T))  # (Cn, K0)
+    if codes is not None and codes.shape[1]:
+        cat_map = tables["cat_map"]
+        cc = codes.shape[1]
+        safe = np.clip(codes, 0, cat_map.shape[1] - 1)
+        mapped = cat_map[np.arange(cc)[None, :], safe]
+        mapped = np.where(codes < 0, n_bins - 1, mapped)    # (R, Cc)
+        v = mapped.T.astype(np.float32)                     # (Cc, R)
+        v[v == (n_bins - 1)] = np.nan
+        vals_parts.append(v)
+        ident = 0.5 + np.arange(n_bins - 2, dtype=np.float32)
+        cut_parts.append(np.broadcast_to(ident, (cc, n_bins - 2)))
+    if not vals_parts:
+        raise ValueError("no features to bin")
+    k = max(p.shape[1] for p in cut_parts)
+    cut_parts = [np.pad(p, ((0, 0), (0, k - p.shape[1])),
+                        constant_values=np.inf) for p in cut_parts]
+    return FusedBins(np.ascontiguousarray(np.concatenate(vals_parts)),
+                     np.ascontiguousarray(np.concatenate(cut_parts)))
+
+
 def _local_level_histograms(binsT, slot, grad, hess, n_level_nodes, n_bins):
     """Single-shard histogram kernel (slot already computed, incl. the
     trailing dump slot for inactive rows). binsT is TRANSPOSED (C, R) —
     rows on the lane axis, so narrow feature matrices don't pay the
-    TPU's 128-lane minor-dim padding."""
+    TPU's 128-lane minor-dim padding. A FusedBins binsT routes to the
+    fused bin-and-accumulate kernel (or bins on the fly for the XLA
+    scatter fallback)."""
+    if isinstance(binsT, FusedBins):
+        if _hist_mode() == "pallas":
+            from shifu_tpu.ops.pallas_hist import level_histograms_fused
+            return level_histograms_fused(
+                binsT.valuesT, binsT.cuts, slot, grad, hess,
+                n_level_nodes, n_bins,
+                interpret=jax.default_backend() != "tpu")
+        from shifu_tpu.ops.pallas_hist import bins_from_values
+        binsT = bins_from_values(binsT.valuesT, binsT.cuts, n_bins)
     c, r = binsT.shape
     if _hist_mode() == "pallas":
         from shifu_tpu.ops.pallas_hist import level_histograms_pallas
@@ -159,8 +239,13 @@ def _level_histograms(binsT, node_of_row, grad, hess, level_offset,
     if mesh is not None and mesh.shape.get("data", 1) > 1:
         from jax.sharding import PartitionSpec as P
 
+        # FusedBins: rows of valuesT shard like binsT; the small (C, K)
+        # cut table is replicated on every device
+        bspec = (FusedBins(P(None, "data"), P(None, None))
+                 if isinstance(binsT, FusedBins) else P(None, "data"))
+
         @_shard_map(mesh=mesh,
-                    in_specs=(P(None, "data"), P("data"), P("data"),
+                    in_specs=(bspec, P("data"), P("data"),
                               P("data")),
                     out_specs=(P(), P()), check_vma=False)
         def sharded(b, s, g, h):
@@ -387,7 +472,18 @@ def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
     node_bin = tree["bin"][node_of_row]
     node_dl = tree["default_left"][node_of_row]
     feat_idx = jnp.maximum(node_feat, 0)
-    if _route_mode() == "onehot":
+    if isinstance(binsT, FusedBins):
+        # bin the routed feature's raw value on the fly: one (R,)
+        # gather of values + an (R, K) boundary compare — no (C, R)
+        # bin matrix exists on the fused path
+        vals = jnp.take_along_axis(binsT.valuesT, feat_idx[None, :],
+                                   axis=0)[0]              # (R,)
+        cuts = binsT.cuts[feat_idx]                        # (R, K)
+        row_bin = jnp.sum(vals[:, None] >= cuts,
+                          axis=1).astype(jnp.int32)
+        row_bin = jnp.minimum(row_bin, cfg.n_bins - 2)
+        row_bin = jnp.where(jnp.isnan(vals), cfg.n_bins - 1, row_bin)
+    elif _route_mode() == "onehot":
         # (C, R) one-hot × bins, reduced over C: bin ids ≤ 2^24 are
         # exact in f32, and XLA fuses the product into the reduction
         sel = jax.nn.one_hot(feat_idx, binsT.shape[0],
@@ -518,6 +614,12 @@ def _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level):
 
 def _walk_trees(trees, binsT, max_depth: int, n_bins: int):
     """Per-tree landing node of every row. binsT: (C, R)."""
+    if isinstance(binsT, FusedBins):
+        # prediction re-walks every feature per level — bin once here
+        # rather than re-deriving per gather (the fused path optimizes
+        # the level BUILD; a resume/val predict is a one-off)
+        from shifu_tpu.ops.pallas_hist import bins_from_values
+        binsT = bins_from_values(binsT.valuesT, binsT.cuts, n_bins)
 
     def one_tree(tree):
         r = binsT.shape[1]
@@ -630,6 +732,18 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
     # device-resident data skip the host round-trip entirely).
     if isinstance(bins, jax.Array):
         jb, jy, jw = bins, jnp.asarray(y), jnp.asarray(weights)
+    elif isinstance(bins, FusedBins):
+        # fused path (SHIFU_TPU_HIST_FUSED): raw values shard like the
+        # bin matrix would (NaN pad rows land in the missing bin with
+        # zero weight); the small cut table replicates
+        jb = FusedBins(
+            mesh_mod.shard_axis(
+                mesh,
+                np.ascontiguousarray(np.asarray(bins.valuesT, np.float32)),
+                1, pad_value=np.nan),
+            jnp.asarray(np.asarray(bins.cuts, np.float32)))
+        jy, jw = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32),
+                                     np.asarray(weights, np.float32))
     else:
         jb = mesh_mod.shard_axis(
             mesh, np.ascontiguousarray(np.asarray(bins, np.int32).T), 1,

@@ -28,6 +28,9 @@ class MTLSpec:
     hidden_dims: tuple = (64, 32)
     activations: tuple = ("relu", "relu")
     l2: float = 0.0
+    # "bfloat16" runs the trunk GEMMs + the heads matmul in bf16 with
+    # f32 accumulation; heads params, losses and metrics stay f32.
+    compute_dtype: str = "float32"
 
     @classmethod
     def from_train_params(cls, params: Dict[str, Any], input_dim: int,
@@ -38,7 +41,9 @@ class MTLSpec:
             honor_num_layers=False)
         return cls(input_dim=input_dim, n_tasks=n_tasks,
                    hidden_dims=nodes, activations=acts,
-                   l2=float(get("RegularizedConstant", 0.0) or 0.0))
+                   l2=float(get("RegularizedConstant", 0.0) or 0.0),
+                   compute_dtype=nn_mod.resolve_compute_dtype(
+                       get("ComputeDtype"), model_knob=None))
 
     @property
     def trunk_spec(self) -> nn_mod.MLPSpec:
@@ -49,7 +54,8 @@ class MTLSpec:
             activations=self.activations[:-1] if self.hidden_dims else (),
             output_dim=trunk_out,
             output_activation=self.activations[-1] if self.hidden_dims
-            else "linear")
+            else "linear",
+            compute_dtype=self.compute_dtype)
 
 
 def init_params(spec: MTLSpec, key: jax.Array) -> Dict[str, Any]:
@@ -65,7 +71,12 @@ def init_params(spec: MTLSpec, key: jax.Array) -> Dict[str, Any]:
 def forward(spec: MTLSpec, params, x: jax.Array) -> jax.Array:
     """(N, D) → (N, T) per-task probabilities."""
     h = nn_mod.forward(spec.trunk_spec, params["trunk"], x)
-    logits = h @ params["heads_w"].T + params["heads_b"][None, :]
+    if spec.compute_dtype == "bfloat16":
+        logits = nn_mod.mm_f32(h.astype(jnp.bfloat16),
+                               params["heads_w"].T.astype(jnp.bfloat16))
+    else:
+        logits = nn_mod.mm_f32(h, params["heads_w"].T)
+    logits = logits + params["heads_b"][None, :]
     return jax.nn.sigmoid(logits)
 
 

@@ -27,9 +27,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from shifu_tpu.config.environment import knob_str
+from shifu_tpu.config.environment import knob_is_set, knob_str
 
 Params = List[Dict[str, jax.Array]]
+
+
+def resolve_compute_dtype(explicit: Optional[str] = None,
+                          model_knob: Optional[str] =
+                          "SHIFU_TPU_NN_COMPUTE") -> str:
+    """One precedence chain for the mixed-precision dtype, shared by
+    NN/WDL/MTL: explicit train#params ComputeDtype > the model-family
+    env knob (set) > package-wide SHIFU_TPU_COMPUTE_DTYPE > float32.
+    Returns the normalized name ("float32" | "bfloat16")."""
+    cd = explicit
+    if cd is None and model_knob and knob_is_set(model_knob):
+        cd = knob_str(model_knob)
+    if cd is None:
+        cd = knob_str("SHIFU_TPU_COMPUTE_DTYPE")
+    cd = str(cd or "float32").lower()
+    return "bfloat16" if cd in ("bf16", "bfloat16") else "float32"
+
+
+def mm_f32(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Matmul that always accumulates in f32: bf16×bf16 operands hit
+    the MXU's low-precision path but the product leaves the unit as
+    f32 (preferred_element_type), so reductions never round in bf16."""
+    return jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -134,13 +159,7 @@ class MLPSpec:
         nodes, acts = parse_arch_params(params)
         reg = float(get("RegularizedConstant", 0.0) or 0.0)
         l1orl2 = str(get("L1orL2", "L2") or "L2").upper()
-        cd = str(get("ComputeDtype",
-                     knob_str("SHIFU_TPU_NN_COMPUTE"))
-                 or "float32").lower()
-        if cd in ("bf16", "bfloat16"):
-            cd = "bfloat16"
-        else:
-            cd = "float32"
+        cd = resolve_compute_dtype(get("ComputeDtype"))
         return cls(
             input_dim=input_dim, hidden_dims=nodes,
             activations=acts, output_dim=output_dim,
@@ -249,24 +268,25 @@ def forward(spec: MLPSpec, params: Params, x: jax.Array,
     """Batched forward pass → (N,) score in (0,1) for binary output.
     Dropout (train-time only) mirrors NNMaster's per-iteration node
     sampling (`NNMaster.doCompute:323` dropout nodes)."""
-    # bfloat16 compute: activations and GEMM operands in bf16 (the MXU
-    # accumulates f32 internally either way), master params/grads stay
-    # f32 — autodiff through the casts yields f32 grads, so the
-    # optimizer and checkpoints are unchanged. Halves the HBM bytes
-    # the wide training shape streams per epoch.
+    # bfloat16 compute: GEMM operands and stored activations in bf16,
+    # accumulation pinned to f32 (mm_f32's preferred_element_type), so
+    # bias-add, activation and every reduction happen in f32; master
+    # params/grads stay f32 — autodiff through the casts yields f32
+    # grads, so the optimizer and checkpoints are unchanged. Halves the
+    # HBM bytes the wide training shape streams per epoch.
     bf16 = spec.compute_dtype == "bfloat16"
     cast = (lambda a: a.astype(jnp.bfloat16)) if bf16 else (lambda a: a)
     h = cast(x)
     for i, layer in enumerate(params[:-1]):
-        h = h @ cast(layer["w"]) + cast(layer["b"])
+        h = mm_f32(h, cast(layer["w"])) + layer["b"]
         h = activation(spec.activations[i])(h)
         if dropout_key is not None and spec.dropout_rate > 0.0:
             dropout_key, sub = jax.random.split(dropout_key)
             keep = jax.random.bernoulli(sub, 1.0 - spec.dropout_rate, h.shape)
             h = jnp.where(keep, h / (1.0 - spec.dropout_rate),
                           jnp.zeros((), h.dtype))
-    out = (h @ cast(params[-1]["w"]) + cast(params[-1]["b"])) \
-        .astype(jnp.float32)
+        h = cast(h)
+    out = mm_f32(h, cast(params[-1]["w"])) + params[-1]["b"]
     if spec.output_activation == "softmax":
         # multi-class NATIVE head: one unit per flattened tag
         # (train#multiClassifyMethod NATIVE — the reference builds an

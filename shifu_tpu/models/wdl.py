@@ -44,6 +44,11 @@ class WDLSpec:
     l2: float = 0.0
     wide_enable: bool = True
     deep_enable: bool = True
+    # "bfloat16" runs the deep-trunk GEMMs in bf16 with f32
+    # accumulation (see nn.forward); embeddings, the wide logit and
+    # the loss stay f32. train#params ComputeDtype or the package-wide
+    # SHIFU_TPU_COMPUTE_DTYPE knob.
+    compute_dtype: str = "float32"
 
     @classmethod
     def from_train_params(cls, params: Dict[str, Any], dense_dim: int,
@@ -59,11 +64,21 @@ class WDLSpec:
             l2=float(get("RegularizedConstant", 0.0) or 0.0),
             wide_enable=bool(get("WideEnable", True)),
             deep_enable=bool(get("DeepEnable", True)),
+            compute_dtype=nn_mod.resolve_compute_dtype(
+                get("ComputeDtype"), model_knob=None),
         )
 
     @property
     def deep_input_dim(self) -> int:
         return self.dense_dim + self.n_cat * self.embed_size
+
+    @property
+    def deep_spec(self) -> "nn_mod.MLPSpec":
+        return nn_mod.MLPSpec(
+            input_dim=self.deep_input_dim, hidden_dims=self.hidden_dims,
+            activations=self.activations, output_dim=1,
+            output_activation="linear",
+            compute_dtype=self.compute_dtype)
 
 
 def init_params(spec: WDLSpec, key: jax.Array) -> Dict[str, Any]:
@@ -75,11 +90,7 @@ def init_params(spec: WDLSpec, key: jax.Array) -> Dict[str, Any]:
         params["wide_cat"] = jnp.zeros((spec.n_cat, spec.vocab_size))
     params["wide_dense"] = jnp.zeros((spec.dense_dim,))
     params["wide_bias"] = jnp.zeros(())
-    mlp_spec = nn_mod.MLPSpec(
-        input_dim=spec.deep_input_dim, hidden_dims=spec.hidden_dims,
-        activations=spec.activations, output_dim=1,
-        output_activation="linear")
-    params["deep"] = nn_mod.init_params(mlp_spec, k_deep)
+    params["deep"] = nn_mod.init_params(spec.deep_spec, k_deep)
     return params
 
 
@@ -100,11 +111,7 @@ def forward(spec: WDLSpec, params: Dict[str, Any], dense: jax.Array,
         logit = logit + dense @ params["wide_dense"]
     logit = logit + params["wide_bias"]
     if spec.deep_enable and deep_in:
-        mlp_spec = nn_mod.MLPSpec(
-            input_dim=spec.deep_input_dim, hidden_dims=spec.hidden_dims,
-            activations=spec.activations, output_dim=1,
-            output_activation="linear")
-        deep_logit = nn_mod.forward(mlp_spec, params["deep"],
+        deep_logit = nn_mod.forward(spec.deep_spec, params["deep"],
                                     jnp.concatenate(deep_in, axis=1))
         logit = logit + deep_logit
     return jax.nn.sigmoid(logit)

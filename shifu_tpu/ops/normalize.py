@@ -240,6 +240,13 @@ class NormResult:
     index: np.ndarray = field(default_factory=lambda: np.zeros((0, 0), np.int32))
     index_names: List[str] = field(default_factory=list)
     index_vocab_sizes: List[int] = field(default_factory=list)
+    # (mean, std) per dense column when `dense` is EXACTLY
+    # zscore(raw numeric) — i.e. a plain ZSCORE/ZSCALE run with no
+    # categorical block. Lets the scorer fuse normalize + first matmul
+    # over the raw values (ops/pallas_score) instead of re-reading the
+    # materialized dense matrix. None whenever any other family or a
+    # categorical/index block contributed.
+    zscore_params: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
 
 def _num_family_value(norm_type: NormType, values, tbl: NumericNormTable,
@@ -396,6 +403,9 @@ def normalize_dataset(norm_type: NormType, cutoff: float,
 
     dense = (np.concatenate(dense_parts, axis=1) if dense_parts
              else np.zeros((r, 0), np.float32))
+    zs = ((num_tbl.mean, num_tbl.std)
+          if (norm_type in (NormType.ZSCORE, NormType.ZSCALE)
+              and has_num and not has_cat) else None)
     return NormResult(dense=dense.astype(np.float32), dense_names=dense_names,
                       index=index_mat, index_names=index_names,
-                      index_vocab_sizes=index_vocabs)
+                      index_vocab_sizes=index_vocabs, zscore_params=zs)

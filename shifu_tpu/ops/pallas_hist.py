@@ -46,19 +46,15 @@ from jax.experimental import pallas as pl
 
 from shifu_tpu.config.environment import knob_int, knob_str
 
-__all__ = ["level_histograms_pallas"]
+__all__ = ["level_histograms_pallas", "level_histograms_fused",
+           "bins_from_values"]
 
 
-def _hist_kernel(binsT_ref, pk_ref, out_g_ref, out_h_ref, *,
-                 n_slots: int, n_bins: int, precision, interpret: bool):
-    # grid = (col_tiles, row_tiles): the ROW (reduction) dimension is
-    # innermost, so each output block's revisits are consecutive grid
-    # steps — required for the += accumulation pattern on TPU (the
-    # output VMEM buffer is flushed between non-consecutive revisits)
-    i = pl.program_id(1)
-
-    binsT = binsT_ref[:, :]                     # (TC, TR) int32
-    pk = pk_ref[:, :]                           # (8, TR) f32
+def _hist_body(binsT, pk, out_g_ref, out_h_ref, i, *,
+               n_slots: int, n_bins: int, precision, interpret: bool):
+    """Shared contraction body: a (TC, TR) int32 bins tile + the (8, TR)
+    packed [slot, grad, hess] block → accumulate the (S, B·TC) G/H
+    output blocks. `i` is the row-tile (reduction) grid index."""
     slot = pk[0:1, :].astype(jnp.int32)         # (1, TR)
     grad = pk[1:2, :]
     hess = pk[2:3, :]
@@ -99,6 +95,56 @@ def _hist_kernel(binsT_ref, pk_ref, out_g_ref, out_h_ref, *,
     def _accum():
         out_g_ref[:, :] += part_g
         out_h_ref[:, :] += part_h
+
+
+def _hist_kernel(binsT_ref, pk_ref, out_g_ref, out_h_ref, *,
+                 n_slots: int, n_bins: int, precision, interpret: bool):
+    # grid = (col_tiles, row_tiles): the ROW (reduction) dimension is
+    # innermost, so each output block's revisits are consecutive grid
+    # steps — required for the += accumulation pattern on TPU (the
+    # output VMEM buffer is flushed between non-consecutive revisits)
+    i = pl.program_id(1)
+    _hist_body(binsT_ref[:, :], pk_ref[:, :], out_g_ref, out_h_ref, i,
+               n_slots=n_slots, n_bins=n_bins, precision=precision,
+               interpret=interpret)
+
+
+def _fused_hist_kernel(valT_ref, cuts_ref, pk_ref, out_g_ref, out_h_ref,
+                       *, n_slots: int, n_bins: int, n_cuts: int,
+                       precision, interpret: bool):
+    """Fused bin-lookup + histogram: the (TC, TR) tile arrives as RAW
+    feature values (NaN = missing) plus each column's ascending cut
+    boundaries, and the bin index is derived in-register — GBT level
+    building never materializes the (C, R) bin-index matrix in HBM.
+
+    Bin semantics match gbdt.bin_dataset / ops.stats.bin_index_numeric:
+    bin = #(v >= cut) clamped to n_bins-2 (cuts are +inf padded, so
+    pad entries never count for finite v), NaN → the shared missing
+    bin n_bins-1. The per-cut compare loop is statically unrolled
+    (n_cuts ≤ n_bins-1 iterations of one VPU compare+add each)."""
+    i = pl.program_id(1)
+    valT = valT_ref[:, :]                       # (TC, TR) f32
+    cuts = cuts_ref[:, :]                       # (TC, K) f32
+    bins = jnp.zeros(valT.shape, jnp.int32)
+    for k in range(n_cuts):
+        bins += (valT >= cuts[:, k:k + 1]).astype(jnp.int32)
+    bins = jnp.minimum(bins, n_bins - 2)
+    bins = jnp.where(jnp.isnan(valT), n_bins - 1, bins)
+    _hist_body(bins, pk_ref[:, :], out_g_ref, out_h_ref, i,
+               n_slots=n_slots, n_bins=n_bins, precision=precision,
+               interpret=interpret)
+
+
+def bins_from_values(valuesT: jax.Array, cutsT: jax.Array,
+                     n_bins: int) -> jax.Array:
+    """Lax reference for the fused kernel's in-register binning: (C, R)
+    raw values + (C, K) ascending per-column cuts → (C, R) int32 bins,
+    NaN → n_bins-1. Also the binning stage of the XLA fallback."""
+    def one(v, c):
+        # side="right" counts boundaries <= v — identical to #(v >= c)
+        return jnp.searchsorted(c, v, side="right").astype(jnp.int32)
+    b = jnp.minimum(jax.vmap(one)(valuesT, cutsT), n_bins - 2)
+    return jnp.where(jnp.isnan(valuesT), n_bins - 1, b)
 
 
 def derive_tiles(n_cols: int, n_slots: int, n_bins: int,
@@ -219,6 +265,93 @@ def _level_histograms_pallas(binsT, slot, grad, hess,
     def reassemble(a):
         # out lanes are (S, [tile j][bin b][col c]) col-major-in-bin →
         # (S, C, B); cheap XLA reshape/transpose on the small output
+        a = a.reshape(n_slots, n_ct, n_bins, col_tile)
+        a = a.transpose(0, 1, 3, 2).reshape(n_slots, cp, n_bins)
+        return a[:, :c, :]
+
+    return reassemble(g), reassemble(h)
+
+
+def level_histograms_fused(valuesT: jax.Array, cutsT: jax.Array,
+                           slot: jax.Array, grad: jax.Array,
+                           hess: jax.Array, n_slots: int, n_bins: int,
+                           row_tile: int = 0, col_tile: int = 0,
+                           interpret: bool = False):
+    """Fused variant of `level_histograms_pallas`: takes (C, R) RAW
+    transposed feature values (NaN = missing) and each column's (C, K)
+    ascending cut boundaries (+inf padded; categorical columns use
+    identity boundaries over host-mapped codes — gbdt.make_fused_inputs
+    packs both), and performs the bin lookup inside the kernel so the
+    (C, R) int32 bin matrix never exists in HBM. Same tiling, output
+    layout, and precision contract as the int-bins kernel."""
+    highest = (knob_str("SHIFU_TPU_HIST_PRECISION", "") or
+               "").lower() == "highest"
+    d_row, d_col = derive_tiles(valuesT.shape[0], n_slots, n_bins, highest)
+    row_tile = row_tile or d_row
+    col_tile = col_tile or d_col
+    if highest:
+        row_tile = min(row_tile, 64)
+    return _level_histograms_fused(valuesT, cutsT, slot, grad, hess,
+                                   n_slots, n_bins, row_tile, col_tile,
+                                   interpret, highest)
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "n_bins",
+                                             "row_tile", "col_tile",
+                                             "interpret", "highest"))
+def _level_histograms_fused(valuesT, cutsT, slot, grad, hess,
+                            n_slots: int, n_bins: int,
+                            row_tile: int, col_tile: int,
+                            interpret: bool, highest: bool):
+    precision = jax.lax.Precision.HIGHEST if highest \
+        else jax.lax.Precision.DEFAULT
+    c, r = valuesT.shape
+    n_cuts = cutsT.shape[1]
+    row_tile = min(row_tile, max(8, r))
+    col_tile = min(col_tile, max(1, c))
+    pad_r = (-r) % row_tile
+    pad_c = (-c) % col_tile
+    slot = jnp.where((slot >= 0) & (slot < n_slots), slot, n_slots)
+    packed = jnp.zeros((8, r + pad_r), jnp.float32)
+    packed = packed.at[0, :r].set(slot.astype(jnp.float32))
+    packed = packed.at[1, :r].set(grad.astype(jnp.float32))
+    packed = packed.at[2, :r].set(hess.astype(jnp.float32))
+    if pad_r:
+        packed = packed.at[0, r:].set(float(n_slots))  # dump slot
+        valuesT = jnp.pad(valuesT, ((0, 0), (0, pad_r)))
+    if pad_c:
+        # pad columns bin to 0 and are sliced off after reassembly;
+        # pad cut rows are +inf so they never count for any value
+        valuesT = jnp.pad(valuesT, ((0, pad_c), (0, 0)))
+        cutsT = jnp.pad(cutsT, ((0, pad_c), (0, 0)),
+                        constant_values=jnp.inf)
+    cp, rp = valuesT.shape
+    n_ct = cp // col_tile
+    grid = (n_ct, rp // row_tile)
+
+    kern = functools.partial(_fused_hist_kernel, n_slots=n_slots,
+                             n_bins=n_bins, n_cuts=n_cuts,
+                             precision=precision, interpret=interpret)
+    lanes = col_tile * n_bins
+    out_shape = jax.ShapeDtypeStruct((n_slots, n_ct * lanes), jnp.float32)
+
+    g, h = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((col_tile, row_tile), lambda j, i: (j, i)),
+            pl.BlockSpec((col_tile, n_cuts), lambda j, i: (j, 0)),
+            pl.BlockSpec((8, row_tile), lambda j, i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n_slots, lanes), lambda j, i: (0, j)),
+            pl.BlockSpec((n_slots, lanes), lambda j, i: (0, j)),
+        ],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(valuesT.astype(jnp.float32), cutsT.astype(jnp.float32), packed)
+
+    def reassemble(a):
         a = a.reshape(n_slots, n_ct, n_bins, col_tile)
         a = a.transpose(0, 1, 3, 2).reshape(n_slots, cp, n_bins)
         return a[:, :c, :]

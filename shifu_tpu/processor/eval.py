@@ -137,9 +137,17 @@ def _score_dataset(mc: ModelConfig, scorer: Scorer, dset, cols):
         scores = {f"class{c}": probs[:, c] for c in range(probs.shape[1])}
         scores["final"] = pred.astype(np.float32)
         return scores
+    # plain-zscore runs advertise (mean, std) so the NN path may fuse
+    # normalize + first matmul over the raw block (ops/pallas_score)
+    norm = None
+    if result.zscore_params is not None:
+        norm = {"mean": result.zscore_params[0],
+                "std": result.zscore_params[1],
+                "cutoff": mc.normalize.stdDevCutOff}
     return scorer.score(result.dense,
                         result.index if result.index.size else None,
-                        raw_dense=dset.numeric, raw_codes=raw_codes)
+                        raw_dense=dset.numeric, raw_codes=raw_codes,
+                        norm=norm)
 
 
 def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,

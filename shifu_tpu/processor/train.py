@@ -189,10 +189,13 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
         return spec
 
     results: List[Tuple[Dict[str, Any], TrainResult]] = []
+    t_train = time.time()
+    total_epochs = 0
     for ci, params in enumerate(combos):
         tc = mc.train
         spec = make_spec(params)
         conf = _conf_with_params(tc, params)
+        total_epochs += int(conf.numTrainEpochs or 0) * (kfold or 1)
         if kfold:
             res = _train_kfold(conf, spec, x, y, w, kfold, seed)
         else:
@@ -216,9 +219,32 @@ def _train_dense(ctx: ProcessorContext, seed: int) -> List[TrainResult]:
     if is_gs:
         log.info("grid search best params: %s", best_params)
 
+    _record_train_roofline(best.spec, x.shape[0], mc.train.validSetRate,
+                           total_epochs, time.time() - t_train)
     _save_dense_models(ctx, best, alg)
     _write_val_errors(ctx, best)
     return [best]
+
+
+def _record_train_roofline(spec: nn_mod.MLPSpec, n_rows: int,
+                           valid_rate: float, total_epochs: int,
+                           wall: float) -> None:
+    """Queue a `roofline` block for this command's steps.jsonl record:
+    analytic per-row costs from the trained spec combined with the
+    measured row-epochs/s (profiling.roofline). Wall covers the whole
+    train loop (compile included), so the utilization figures are a
+    floor — the bench's delta-timed numbers are the sharp ones."""
+    from shifu_tpu import profiling
+    try:
+        n_train = max(int(n_rows * (1 - (valid_rate or 0.0))), 1)
+        bpe = 2 if spec.compute_dtype == "bfloat16" else 4
+        f, b = profiling.mlp_row_costs(spec.input_dim, spec.hidden_dims,
+                                       spec.output_dim, dtype_bytes=bpe)
+        profiling.set_step_extra("roofline", profiling.roofline(
+            "NN", f, b, n_train * total_epochs / max(wall, 1e-9),
+            compute_dtype=spec.compute_dtype))
+    except Exception as e:  # noqa: BLE001 — metrics must never fail a run
+        log.debug("roofline record skipped: %s", e)
 
 
 def _conf_with_params(tc, params):
@@ -315,6 +341,9 @@ def _dense_spec_meta(ctx: ProcessorContext, spec: nn_mod.MLPSpec,
             "dropout_rate": 0.0,  # inference never drops
             "l2": spec.l2, "l1": spec.l1,
             "loss": spec.loss, "weight_init": spec.weight_init,
+            # training-dtype provenance: scoring rebuilds the spec from
+            # this dict, so a bf16-trained model scores in bf16 too
+            "compute_dtype": spec.compute_dtype,
         },
         "inputNames": meta["denseNames"],
         "normType": mc.normalize.normType.value,

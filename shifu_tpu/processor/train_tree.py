@@ -165,8 +165,15 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
         if alg is Algorithm.GBT:
             init_trees = _continuous_trees(ctx, mc, bag)
             w_tr = w[tr_mask] if bag_w is None else w[tr_mask] * bag_w[bag]
+            train_bins = bins[tr_mask]
+            if gbdt.hist_fused_enabled():
+                # SHIFU_TPU_HIST_FUSED: ship raw values + cuts instead
+                # of the pre-binned matrix; the histogram kernel bins
+                # in-register (ops/pallas_hist.level_histograms_fused)
+                train_bins = gbdt.make_fused_inputs(
+                    tables, dense[tr_mask], codes[tr_mask], n_bins)
             trees, val_errs = gbdt.build_gbt(
-                cfg, bins[tr_mask], y[tr_mask], w_tr,
+                cfg, train_bins, y[tr_mask], w_tr,
                 n_trees, init_trees=init_trees,
                 val_data=(bins[val_mask], y[val_mask]) if val_mask.any() else None,
                 early_stop_window=int(mc.train.get_param(

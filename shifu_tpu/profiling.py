@@ -37,6 +37,17 @@ log = logging.getLogger("shifu_tpu")
 _DISABLED_VALUES = ("0", "off", "none", "disabled", "false", "no")
 _compile_listeners_on = False
 
+# enrichments queued by deeper layers (e.g. the train processor's
+# roofline block) for the step record step_metrics is currently
+# building — the same drain-at-exit pattern as the stage timers
+_step_extras: Dict = {}
+
+
+def set_step_extra(key: str, value) -> None:
+    """Attach one key to the step_metrics record being recorded (the
+    processor layer knows the roofline; cli.py owns the record)."""
+    _step_extras[key] = value
+
 
 def _register_compile_listeners() -> None:
     """Route jax's compile-time monitoring events into the pipeline
@@ -149,6 +160,7 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
     rec: Dict = {"step": step, "startedAt": round(time.time(), 3)}
     if extra:
         rec.update(extra)
+    _step_extras.clear()   # the interval belongs to THIS step
     try:
         # the interval belongs to THIS step: drop whatever an earlier
         # caller in the same process left behind
@@ -165,6 +177,9 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
     finally:
         rec["wallSeconds"] = round(time.time() - t0, 3)
         rec.update(device_stats())
+        if _step_extras:
+            rec.update(_step_extras)
+            _step_extras.clear()
         try:
             from shifu_tpu.data.pipeline import drain_stage_timers
             stages = drain_stage_timers()
@@ -195,6 +210,115 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
                 f.write(json.dumps(rec) + "\n")
         except OSError as e:
             log.warning("metrics: could not write steps.jsonl: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# roofline accounting (ROADMAP item 2: close the MXU gap)
+# ---------------------------------------------------------------------------
+#
+# Analytic per-row FLOPs and bytes-moved are derived from the model
+# spec alone, so the same numbers describe every backend; utilization
+# estimates divide measured throughput by the single-chip peaks below
+# (TPU v5e: 394 bf16 TFLOP/s; f32 runs through the MXU at about half
+# that; 819 GB/s HBM). bench.py emits one `roofline` block per task
+# and tools/check_steps_schema.py pins README docs to ROOFLINE_FIELDS.
+
+TPU_PEAK_FLOPS = {"bfloat16": 394e12, "float32": 197e12}
+TPU_PEAK_HBM_BPS = 819e9
+
+ROOFLINE_FIELDS = ("family", "compute_dtype", "flops_per_row",
+                   "bytes_per_row", "rows_per_s", "flops_per_s",
+                   "bytes_per_s", "arith_intensity", "ridge_intensity",
+                   "mxu_util", "hbm_util", "bound")
+
+
+def mlp_row_costs(input_dim: int, hidden_dims, n_out: int = 1,
+                  train: bool = True, dtype_bytes: int = 4):
+    """Analytic (flops, bytes) per data row for an MLP (NN family).
+
+    FLOPs: 2·d_in·d_out per matmul, and a train step costs ~3× forward
+    (forward, activation-grad, and weight-grad matmuls). Bytes: every
+    activation is written once and read once (2× each layer width) in
+    the compute dtype, doubled again for the backward pass; per-row
+    weight traffic amortizes across the batch and is excluded.
+    """
+    dims = [int(input_dim)] + [int(d) for d in hidden_dims] + [int(n_out)]
+    mm = sum(2 * a * b for a, b in zip(dims, dims[1:]))
+    flops = (3 if train else 1) * mm
+    bytes_ = 2 * dtype_bytes * sum(dims) * (2 if train else 1)
+    return float(flops), float(bytes_)
+
+
+def wdl_row_costs(dense_dim: int, n_cat: int, embed_size: int,
+                  hidden_dims, train: bool = True, dtype_bytes: int = 4):
+    """WDL = deep MLP over [dense ‖ embeddings] + wide linear logit.
+    Embedding rows are gathered per example (read fwd, read+write in
+    the backward scatter)."""
+    deep_in = int(dense_dim) + int(n_cat) * int(embed_size)
+    flops, bytes_ = mlp_row_costs(deep_in, hidden_dims, 1, train,
+                                  dtype_bytes)
+    flops += 2 * (int(dense_dim) + int(n_cat))
+    bytes_ += dtype_bytes * int(n_cat) * int(embed_size) * \
+        (3 if train else 1)
+    return float(flops), float(bytes_)
+
+
+def mtl_row_costs(input_dim: int, hidden_dims, n_tasks: int,
+                  train: bool = True, dtype_bytes: int = 4):
+    """MTL = shared trunk MLP + one linear head per task; exactly an
+    MLP whose output width is the task count."""
+    return mlp_row_costs(input_dim, hidden_dims, int(n_tasks), train,
+                         dtype_bytes)
+
+
+def tree_row_costs(n_cols: int, n_bins: int, max_depth: int,
+                   n_trees: int = 1, subtract: bool = True):
+    """GBT/RF level building: each level contracts a node one-hot
+    (slots×R) against a gradient-weighted bin one-hot (R×C·n_bins) on
+    the MXU, twice (grad + hess); sibling subtraction halves the slots
+    actually built below the root. Bytes: the int32 bin row (or f32
+    value row on the fused path) plus grad/hess are re-read per level.
+    """
+    flops = 0.0
+    for d in range(int(max_depth)):
+        slots = 2 ** d
+        if subtract and d > 0:
+            slots /= 2
+        flops += 2 * 2 * slots * int(n_cols) * int(n_bins)
+    bytes_ = int(max_depth) * (4 * int(n_cols) + 8)
+    return float(flops * n_trees), float(bytes_ * n_trees)
+
+
+def roofline(family: str, flops_per_row: float, bytes_per_row: float,
+             rows_per_s: float, compute_dtype: str = "float32",
+             peak_flops: Optional[float] = None,
+             peak_bytes_per_s: float = TPU_PEAK_HBM_BPS) -> Dict:
+    """Combine analytic per-row costs with a measured rows/s into the
+    `roofline` block (steps.jsonl + bench JSON): achieved flops_per_s /
+    bytes_per_s, arithmetic intensity vs the ridge point, and MXU/HBM
+    utilization estimates that say whether the shape is compute- or
+    bandwidth-bound."""
+    dtype = str(compute_dtype)
+    if peak_flops is None:
+        peak_flops = TPU_PEAK_FLOPS.get(dtype, TPU_PEAK_FLOPS["float32"])
+    rows = max(float(rows_per_s), 0.0)
+    fps = float(flops_per_row) * rows
+    bps = float(bytes_per_row) * rows
+    ai = float(flops_per_row) / bytes_per_row if bytes_per_row else 0.0
+    ridge = peak_flops / peak_bytes_per_s if peak_bytes_per_s else 0.0
+    return {"family": family,
+            "compute_dtype": dtype,
+            "flops_per_row": float(flops_per_row),
+            "bytes_per_row": float(bytes_per_row),
+            "rows_per_s": round(rows, 3),
+            "flops_per_s": round(fps, 3),
+            "bytes_per_s": round(bps, 3),
+            "arith_intensity": round(ai, 4),
+            "ridge_intensity": round(ridge, 4),
+            "mxu_util": round(fps / peak_flops, 4) if peak_flops else 0.0,
+            "hbm_util": round(bps / peak_bytes_per_s, 4)
+            if peak_bytes_per_s else 0.0,
+            "bound": "compute" if ai >= ridge else "memory"}
 
 
 @contextlib.contextmanager

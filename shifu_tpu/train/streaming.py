@@ -292,11 +292,18 @@ def train_streaming_core(train_conf: ModelTrainConf,
         grad_mask = jax.tree.map(jnp.ones_like, one_bag)
     grad_mask = mesh_mod.place_replicated(mesh, grad_mask)
 
+    compute_dtype = str(getattr(spec, "compute_dtype", "float32"))
+
     def _upcast(t):
         """Half-precision chunks (FLOAT16 streaming layouts) transfer
         at half the host→device bytes and widen ON DEVICE — the
         values are identical (the layout was rounded through f16 at
-        norm time), only the transfer shrinks."""
+        norm time), only the transfer shrinks. Under bfloat16 compute
+        a bf16 chunk stays narrow: the model forward consumes bf16
+        GEMM operands directly (f32 accumulation inside nn.mm_f32) and
+        widening here would double the activation HBM footprint."""
+        if compute_dtype == "bfloat16" and t.dtype == jnp.bfloat16:
+            return t
         return t.astype(jnp.float32) \
             if t.dtype in (jnp.float16, jnp.bfloat16) else t
 

@@ -318,3 +318,108 @@ def test_stream_layout_prefix_reuse(tmp_path, monkeypatch):
     d3, _, _ = bench._ensure_stream_layout(1_500, 5, chunk=1_000)
     assert os.path.getmtime(str(tmp_path / "s1" / "dense.npy")) != mtime
     assert d3.shape == (1_500, 5)
+
+
+def test_task_mtl(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "MTL_ROWS", 6_000)
+    monkeypatch.setattr(bench, "MTL_FEATURES", 12)
+    monkeypatch.setattr(bench, "MTL_TASKS", 3)
+    monkeypatch.setattr(bench, "MTL_HIDDEN", (16, 8))
+    monkeypatch.setattr(bench, "MTL_EPOCHS_SHORT", 2)
+    monkeypatch.setattr(bench, "MTL_EPOCHS_LONG", 30)
+    bench.task_mtl()  # gates task-0 AUC > 0.7 internally
+    rec = _last_json(capsys)
+    assert rec["row_epochs_per_sec"] > 0
+    assert rec["roofline"]["family"] == "MTL"
+
+
+def test_task_records_carry_roofline(monkeypatch, capsys):
+    """Every model-family task record carries a roofline block with
+    EXACTLY the profiling.ROOFLINE_FIELDS schema (the same invariant
+    tools/check_steps_schema.py enforces on live logs)."""
+    from shifu_tpu import profiling
+    _patch_small(monkeypatch)
+    bench.task_nn()
+    roof = _last_json(capsys)["roofline"]
+    assert set(roof) == set(profiling.ROOFLINE_FIELDS)
+    assert roof["family"] == "NN"
+    assert roof["compute_dtype"] == "float32"
+    assert roof["bound"] in ("compute", "memory")
+    # measured rows/s must reconcile with the derived rates
+    assert roof["flops_per_s"] == pytest.approx(
+        roof["flops_per_row"] * roof["rows_per_s"], rel=1e-6)
+    bench.task_gbt()
+    roof = _last_json(capsys)["roofline"]
+    assert roof["family"] == "GBT"
+    assert roof["flops_per_row"] > 0 and roof["bytes_per_row"] > 0
+
+
+def test_resolve_backend_probe_knobs(monkeypatch):
+    """SHIFU_TPU_BENCH_PROBE_ATTEMPTS/_TIMEOUT_S bound the probe, and
+    an exhausted probe falls back to cpu with the path in diags."""
+    monkeypatch.setenv("SHIFU_TPU_BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setenv("SHIFU_TPU_BENCH_PROBE_TIMEOUT_S", "7")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+
+    def fake_run_task(task, env_extra=None, timeout=1200):
+        calls.append((task, env_extra, timeout))
+        if env_extra and env_extra.get("JAX_PLATFORMS") == "cpu":
+            return {"backend": "cpu", "n_devices": 1}, None
+        return None, "probe wedged"
+
+    monkeypatch.setattr(bench, "_run_task", fake_run_task)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    diags = []
+    backend, env_extra = bench._resolve_backend(diags)
+    assert backend == "cpu" and env_extra == {"JAX_PLATFORMS": "cpu"}
+    # 2 default-backend attempts at the knob timeout, then the cpu probe
+    assert [c[2] for c in calls] == [7, 7, 7]
+    assert any("attempt 2/2" in d for d in diags)
+    assert any("falling back" in d for d in diags)
+
+
+def test_row_cost_models_closed_form():
+    """Analytic per-row costs for known specs, by hand: the roofline's
+    inputs must be auditable numbers, not plausible-looking ones."""
+    from shifu_tpu import profiling
+    # MLP 10 -> 20 -> 5 -> 1: matmul FLOPs 2*(200+100+5) = 610, x3 for
+    # a train step; activation bytes 2*4B*(10+20+5+1), x2 backward
+    flops, bytes_ = profiling.mlp_row_costs(10, (20, 5), 1)
+    assert flops == 3 * 610
+    assert bytes_ == 2 * 4 * 36 * 2
+    # inference, bf16: single forward pass, half the bytes
+    flops_i, bytes_i = profiling.mlp_row_costs(10, (20, 5), 1,
+                                               train=False, dtype_bytes=2)
+    assert flops_i == 610
+    assert bytes_i == 2 * 2 * 36
+    # tree level building with sibling subtraction: depth 3, 8 cols,
+    # 16 bins -> 2*2*(1 + 1 + 2)*8*16 FLOPs, 3 levels re-reading the
+    # int32 bin row + grad/hess
+    tf, tb = profiling.tree_row_costs(8, 16, 3)
+    assert tf == 2 * 2 * (1 + 1 + 2) * 8 * 16
+    assert tb == 3 * (4 * 8 + 8)
+
+
+def test_roofline_math_known_values():
+    """roofline() arithmetic on hand-checkable numbers (fields round to
+    4 decimals, so explicit peaks keep the expectations exact)."""
+    from shifu_tpu import profiling
+    roof = profiling.roofline("NN", 1830.0, 576.0, 1e6,
+                              peak_flops=1e12, peak_bytes_per_s=1e10)
+    assert roof["flops_per_s"] == pytest.approx(1.83e9)
+    assert roof["bytes_per_s"] == pytest.approx(5.76e8)
+    assert roof["arith_intensity"] == round(1830 / 576, 4)
+    assert roof["ridge_intensity"] == 100.0
+    assert roof["mxu_util"] == round(1.83e9 / 1e12, 4)
+    assert roof["hbm_util"] == round(5.76e8 / 1e10, 4)
+    # AI (~3.2) far below the ridge (100) -> memory bound
+    assert roof["bound"] == "memory"
+    # the dtype picks the peak: bf16 doubles the default MXU ceiling,
+    # halving the utilization estimate for the same achieved rate
+    f32 = profiling.roofline("NN", 1830.0, 576.0, 1e9)
+    bf16 = profiling.roofline("NN", 1830.0, 576.0, 1e9,
+                              compute_dtype="bfloat16")
+    assert f32["mxu_util"] == pytest.approx(2 * bf16["mxu_util"],
+                                            abs=2e-4)
+    assert bf16["compute_dtype"] == "bfloat16"

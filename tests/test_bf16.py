@@ -1,0 +1,95 @@
+"""bf16 compute parity: the mixed-precision contract documented in
+README's Raw speed section.
+
+`ComputeDtype=bfloat16` (train#params, or SHIFU_TPU_COMPUTE_DTYPE
+package-wide) runs the GEMMs and stored activations in bf16 with f32
+accumulation (`mm_f32`'s preferred_element_type); master weights,
+gradients and the optimizer state stay f32. That truncation is
+statistically inert for model quality: a bf16 run's eval AUC must land
+within 0.01 of the f32 run through the REAL training path (processor
+train -> eval over the synthetic model set), and the saved spec must
+record the dtype so scoring reproduces it.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.processor import (eval as eval_proc, init as init_proc,
+                                 norm as norm_proc, stats as stats_proc,
+                                 train as train_proc)
+from shifu_tpu.processor.base import ProcessorContext
+
+BF16_AUC_TOL = 0.01  # documented tolerance (README Raw speed section)
+
+
+def _pipeline_auc(tmp_path, compute_dtype):
+    from tests.synth import make_model_set
+    # fresh generator per run: BOTH dtypes must see the identical
+    # dataset, or the comparison measures data noise, not precision
+    rng = np.random.default_rng(2024)
+    root = make_model_set(
+        tmp_path, rng, n_rows=1200,
+        train_params={"NumHiddenLayers": 1, "NumHiddenNodes": [12],
+                      "ActivationFunc": ["relu"], "Propagation": "ADAM",
+                      "LearningRate": 0.1,
+                      "ComputeDtype": compute_dtype})
+    for proc in (init_proc, stats_proc, norm_proc, train_proc):
+        ctx = ProcessorContext.load(root)
+        assert proc.run(ctx) == 0
+    ctx = ProcessorContext.load(root)
+    assert eval_proc.run(ctx) == 0
+    perf = json.load(open(ctx.path_finder.eval_performance_path("Eval1")))
+    from shifu_tpu.models.spec import load_model
+    _, meta, _ = load_model(ctx.path_finder.model_path(0, "nn"))
+    return perf["areaUnderRoc"], meta
+
+
+def test_bf16_auc_within_tolerance_of_f32(tmp_path):
+    """End-to-end train+eval with ComputeDtype=bfloat16 scores within
+    BF16_AUC_TOL of the identical float32 run."""
+    auc32, _ = _pipeline_auc(os.path.join(str(tmp_path), "f32"),
+                             "float32")
+    auc16, meta16 = _pipeline_auc(os.path.join(str(tmp_path), "bf16"),
+                                  "bfloat16")
+    assert auc32 > 0.85                       # data is separable
+    assert abs(auc16 - auc32) < BF16_AUC_TOL, \
+        f"bf16 AUC {auc16:.4f} vs f32 {auc32:.4f}"
+    # the trained spec must persist the dtype it was trained with
+    assert meta16["spec"]["compute_dtype"] == "bfloat16"
+
+
+def test_forward_bf16_close_to_f32(rng):
+    """Single forward pass: bf16 compute stays within bf16 rounding of
+    the f32 result (f32 accumulation keeps the error per-element, not
+    per-reduction)."""
+    c = 30
+    base = nn_mod.MLPSpec(input_dim=c, hidden_dims=(64, 32),
+                          activations=("relu", "relu"))
+    spec16 = nn_mod.MLPSpec(input_dim=c, hidden_dims=(64, 32),
+                            activations=("relu", "relu"),
+                            compute_dtype="bfloat16")
+    import jax
+    params = nn_mod.init_params(base, jax.random.PRNGKey(3))
+    x = jnp.asarray(rng.normal(0, 1, (256, c)).astype(np.float32))
+    out32 = np.asarray(nn_mod.forward(base, params, x))
+    out16 = np.asarray(nn_mod.forward(spec16, params, x))
+    # sigmoid outputs in (0,1): absolute tolerance ~ bf16 epsilon
+    np.testing.assert_allclose(out16, out32, atol=2e-2)
+    assert np.mean(np.abs(out16 - out32)) < 5e-3
+
+
+def test_resolve_compute_dtype_precedence(monkeypatch):
+    """explicit param > family knob > package knob > float32; junk
+    values fall back rather than poisoning the spec."""
+    monkeypatch.delenv("SHIFU_TPU_COMPUTE_DTYPE", raising=False)
+    monkeypatch.delenv("SHIFU_TPU_NN_COMPUTE", raising=False)
+    assert nn_mod.resolve_compute_dtype(None) == "float32"
+    assert nn_mod.resolve_compute_dtype("bfloat16") == "bfloat16"
+    monkeypatch.setenv("SHIFU_TPU_COMPUTE_DTYPE", "bfloat16")
+    assert nn_mod.resolve_compute_dtype(None) == "bfloat16"
+    assert nn_mod.resolve_compute_dtype("float32") == "float32"

@@ -682,3 +682,73 @@ def test_lockcheck_atexit_dump_lists_graph_and_held(tmp_path):
     assert "outer -> inner" in r.stderr
     assert "held-time per acquisition site" in r.stderr
     assert "outer @" in r.stderr and "inner @" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# java-property-key
+# ---------------------------------------------------------------------------
+
+def test_javaprop_positive(tmp_path):
+    src = """
+        def chunk_rows(props):
+            return int(props.get("shifu.foo.chunkRows", 0))
+    """
+    report = lint_source(tmp_path, src, rules=["java-property-key"])
+    assert rule_names(report) == ["java-property-key"]
+    assert "shifu.foo.chunkRows" in report.findings[0].message
+
+
+def test_javaprop_negative(tmp_path):
+    src = """
+        def chunk_rows(props):
+            # a declared key is fine anywhere; one-segment dotted
+            # strings (module paths, filenames) never match
+            a = props.get("shifu.norm.chunkRows")
+            b = "shifu.config"
+            c = "not.a.shifu.key"
+            return a, b, c
+    """
+    report = lint_source(tmp_path, src, rules=["java-property-key"])
+    assert "java-property-key" not in rule_names(report)
+
+
+def test_javaprop_docstring_mention_clean(tmp_path):
+    src = '''
+        def helper():
+            """Prose mentioning shifu.bogus.key is documentation,
+            not a reference."""
+            return "shifu.bogus.key"
+    '''
+    report = lint_source(tmp_path, src, rules=["java-property-key"])
+    # the docstring is skipped; the return-value literal IS flagged
+    assert len(report.findings) == 1
+    assert report.findings[0].line > 4
+
+
+def test_javaprop_config_dir_exempt(tmp_path):
+    cfg = tmp_path / "config"
+    cfg.mkdir()
+    path = cfg / "props.py"
+    path.write_text('KEY = "shifu.anything.goes"\n', encoding="utf-8")
+    report = engine.run([str(path)], rules=["java-property-key"])
+    assert not report.findings
+
+
+def test_javaprop_suppressed(tmp_path):
+    src = """
+        def chunk_rows(props):
+            return props.get("shifu.foo.chunkRows")  # lint: disable=java-property-key -- fixture
+    """
+    report = lint_source(tmp_path, src, rules=["java-property-key"])
+    assert not report.findings
+    assert any(f.rule == "java-property-key" for f in report.suppressed)
+
+
+def test_javaprop_registry_entries_all_referenced():
+    """The dead-entry sweep over the real package: every JAVA_PROPS key
+    has a live read site (subset of test_package_is_clean, kept
+    separate so a dead entry names this invariant directly)."""
+    report = engine.run([os.path.join(REPO, "shifu_tpu")],
+                        rules=["java-property-key"])
+    dead = [f for f in report.findings if "dead JAVA_PROPS" in f.message]
+    assert not dead, "\n".join(f.format() for f in dead)

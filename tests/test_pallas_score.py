@@ -1,0 +1,137 @@
+"""Fused normalize + first-layer scoring kernel parity (ops/pallas_score).
+
+The scoring path's z-scored matrix is written once and read once;
+`fused_first_layer` folds the z-score into the first-layer contraction.
+These tests pin the contract on CPU (interpret mode): the Pallas route
+must match the XLA route (which is itself `normalize.zscore` + matmul,
+the lax reference), including the tiny-std column rule, NaN -> mean ->
+exact 0, and the mean ± cutoff·std clamp; `score_nn` must match
+`nn.forward` over pre-normalized inputs; and `scorer.score_matrix` must
+return the same scores whether or not the fused route is engaged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.ops import pallas_score
+from shifu_tpu.ops.normalize import STD_EPS, zscore
+
+CUTOFF = 4.0
+
+
+def _norm_case(rng, n=300, c=20, h=16):
+    """Raw values with missing cells, a tiny-std column (index 3), and
+    outliers beyond the cutoff clamp."""
+    values = rng.normal(2.0, 3.0, (n, c)).astype(np.float32)
+    values[rng.random((n, c)) < 0.1] = np.nan
+    values[:5, 0] = 1e6                        # beyond the clamp
+    mean = rng.normal(0, 1, c).astype(np.float32)
+    std = rng.uniform(0.5, 2.0, c).astype(np.float32)
+    std[3] = STD_EPS / 10                      # tiny-std -> exact 0
+    w = rng.normal(0, 0.3, (c, h)).astype(np.float32)
+    b = rng.normal(0, 0.1, h).astype(np.float32)
+    return (jnp.asarray(values), jnp.asarray(mean), jnp.asarray(std),
+            jnp.asarray(w), jnp.asarray(b))
+
+
+def test_fused_first_layer_matches_xla(rng):
+    values, mean, std, w, b = _norm_case(rng)
+    ref = pallas_score.fused_first_layer(values, mean, std, CUTOFF, w, b,
+                                         mode="xla")
+    got = pallas_score.fused_first_layer(values, mean, std, CUTOFF, w, b,
+                                         mode="pallas", row_tile=64,
+                                         col_tile=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_fused_tiny_std_column_contributes_zero(rng):
+    """A column with std < STD_EPS must land on EXACTLY 0 in-register
+    (lo = hi = mean collapses the clamp), so wild values there change
+    nothing: the output equals the bias when every column is tiny."""
+    n, c, h = 64, 6, 8
+    values = jnp.asarray(rng.normal(0, 100, (n, c)).astype(np.float32))
+    mean = jnp.zeros(c, jnp.float32)
+    std = jnp.full(c, STD_EPS / 2, jnp.float32)
+    w = jnp.asarray(rng.normal(0, 1, (c, h)).astype(np.float32))
+    b = jnp.asarray(rng.normal(0, 1, h).astype(np.float32))
+    out = pallas_score.fused_first_layer(values, mean, std, CUTOFF, w, b,
+                                         mode="pallas", interpret=True)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.broadcast_to(np.asarray(b), (n, h)))
+
+
+def test_fused_nan_rows_equal_mean_rows(rng):
+    """NaN (missing) fills to the column mean, i.e. z = 0 — an all-NaN
+    row scores identically to a row carrying the means verbatim."""
+    c, h = 10, 4
+    mean = jnp.asarray(rng.normal(0, 1, c).astype(np.float32))
+    std = jnp.asarray(rng.uniform(0.5, 2.0, c).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 1, (c, h)).astype(np.float32))
+    b = jnp.zeros(h, jnp.float32)
+    values = jnp.stack([jnp.full(c, jnp.nan), mean])
+    out = np.asarray(pallas_score.fused_first_layer(
+        values, mean, std, CUTOFF, w, b, mode="pallas", interpret=True))
+    np.testing.assert_array_equal(out[0], out[1])
+
+
+def test_score_nn_matches_forward_on_normalized(rng):
+    """Full fused MLP forward over RAW values == nn.forward over the
+    materialized z-scored matrix."""
+    c = 12
+    spec = nn_mod.MLPSpec(input_dim=c, hidden_dims=(16, 8),
+                          activations=("relu", "tanh"))
+    params = nn_mod.init_params(spec, jax.random.PRNGKey(7))
+    values = rng.normal(1.0, 2.0, (200, c)).astype(np.float32)
+    values[rng.random((200, c)) < 0.15] = np.nan
+    mean = jnp.asarray(rng.normal(0, 1, c).astype(np.float32))
+    std = jnp.asarray(rng.uniform(0.5, 2.0, c).astype(np.float32))
+    z = zscore(jnp.asarray(values), mean, std, CUTOFF)
+    ref = nn_mod.forward(spec, params, z)
+    got = pallas_score.score_nn(spec, params, jnp.asarray(values), mean,
+                                std, CUTOFF, mode="pallas",
+                                interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_score_fused_mode_knob(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_SCORE_FUSED", "pallas")
+    assert pallas_score.score_fused_mode() == "pallas"
+    monkeypatch.setenv("SHIFU_TPU_SCORE_FUSED", "xla")
+    assert pallas_score.score_fused_mode() == "xla"
+    monkeypatch.delenv("SHIFU_TPU_SCORE_FUSED", raising=False)
+    # auto resolves by backend: CPU tier-1 -> xla fallback
+    if jax.default_backend() != "tpu":
+        assert pallas_score.score_fused_mode() == "xla"
+
+
+def test_score_matrix_fused_route_matches_plain(rng, monkeypatch):
+    """scorer.score_matrix with a `norm` block + SHIFU_TPU_SCORE_FUSED=
+    pallas (interpret on CPU) returns the same scores as the plain
+    path reading the materialized normalized matrix."""
+    from shifu_tpu.eval import scorer
+
+    c = 9
+    spec = nn_mod.MLPSpec(input_dim=c, hidden_dims=(8,),
+                          activations=("relu",))
+    params = nn_mod.init_params(spec, jax.random.PRNGKey(11))
+    params = jax.tree.map(np.asarray, params)
+    meta = {"spec": {"input_dim": c, "hidden_dims": [8],
+                     "activations": ["relu"]}}
+    raw = rng.normal(0.5, 1.5, (150, c)).astype(np.float32)
+    raw[rng.random((150, c)) < 0.1] = np.nan
+    mean = rng.normal(0, 1, c).astype(np.float32)
+    std = rng.uniform(0.5, 2.0, c).astype(np.float32)
+    dense = np.asarray(zscore(jnp.asarray(raw), jnp.asarray(mean),
+                              jnp.asarray(std), CUTOFF))
+
+    monkeypatch.delenv("SHIFU_TPU_SCORE_FUSED", raising=False)
+    plain = scorer.score_matrix("nn", meta, params, dense)
+    monkeypatch.setenv("SHIFU_TPU_SCORE_FUSED", "pallas")
+    norm = {"mean": mean, "std": std, "cutoff": CUTOFF}
+    fused = scorer.score_matrix("nn", meta, params, dense,
+                                raw_dense=raw, norm=norm)
+    np.testing.assert_allclose(fused, plain, rtol=1e-5, atol=1e-5)

@@ -81,3 +81,45 @@ def test_probe_checks_remote_existence(tmp_path, rng):
     r = probe(mc, ModelStep.INIT)
     assert not r.status
     assert any("does not exist" in c for c in r.causes)
+
+
+def test_readahead_hints_defaults(monkeypatch):
+    """Remote streaming opens default to a 4 MiB readahead cache; the
+    knobs tune or disable each hint independently."""
+    monkeypatch.delenv("SHIFU_TPU_FS_CACHE_TYPE", raising=False)
+    monkeypatch.delenv("SHIFU_TPU_FS_BLOCK_SIZE", raising=False)
+    assert fs_mod.readahead_hints() == {"cache_type": "readahead",
+                                        "block_size": 4 * 1024 * 1024}
+    monkeypatch.setenv("SHIFU_TPU_FS_CACHE_TYPE", "bytes")
+    monkeypatch.setenv("SHIFU_TPU_FS_BLOCK_SIZE", "1048576")
+    assert fs_mod.readahead_hints() == {"cache_type": "bytes",
+                                        "block_size": 1048576}
+    # "none" / 0 drop the hints entirely -> fsspec backend defaults
+    monkeypatch.setenv("SHIFU_TPU_FS_CACHE_TYPE", "none")
+    monkeypatch.setenv("SHIFU_TPU_FS_BLOCK_SIZE", "0")
+    assert fs_mod.readahead_hints() == {}
+
+
+def test_open_text_carries_hints_to_fsspec(tmp_path, monkeypatch):
+    """open_text forwards the hints as fsspec.open kwargs (memory://
+    ignores them gracefully, so the default-on hints cannot break
+    backends without range-request caching)."""
+    import fsspec
+
+    seen = {}
+    real_open = fsspec.open
+
+    def spy(path, mode, **kw):
+        seen.update(kw)
+        return real_open(path, mode, **kw)
+
+    monkeypatch.delenv("SHIFU_TPU_FS_CACHE_TYPE", raising=False)
+    monkeypatch.delenv("SHIFU_TPU_FS_BLOCK_SIZE", raising=False)
+    monkeypatch.setattr(fsspec, "open", spy)
+    mfs = fsspec.filesystem("memory")
+    with mfs.open("/hints/part-0", "wb") as f:
+        f.write(b"a|b\n1|2\n")
+    with fs_mod.open_text("memory://hints/part-0") as f:
+        assert f.readline().strip() == "a|b"
+    assert seen["cache_type"] == "readahead"
+    assert seen["block_size"] == 4 * 1024 * 1024

@@ -19,9 +19,18 @@ Token heuristic: backticked lowercase identifiers ending in ``_s``,
 ``*_frac`` tokens are bench.py record keys, not steps.jsonl stages,
 and are skipped.
 
+The ``roofline`` block (train-step records + every bench.py task
+record) is pinned the same way: its schema is the single
+``profiling.ROOFLINE_FIELDS`` tuple (AST-read, no imports), every
+field must be documented in README's Raw speed section, and any
+backticked README token that LOOKS like a roofline field (matches a
+member) is cross-checked so a renamed field fails here before it
+ships stale docs.
+
 Optionally pass a real steps.jsonl to ALSO verify against a live log
 (every documented field must appear in at least one record's
-``inputPipeline`` block across the file):
+``inputPipeline`` block across the file, and any record carrying a
+``roofline`` block must carry exactly the ROOFLINE_FIELDS keys):
 
     python tools/check_steps_schema.py [path/to/steps.jsonl]
 """
@@ -85,6 +94,37 @@ def emitted_fields() -> set:
     return out
 
 
+def roofline_fields() -> tuple:
+    """profiling.ROOFLINE_FIELDS, read from the AST so this gate keeps
+    working without importing jax-adjacent modules."""
+    path = os.path.join(PKG, "profiling.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "ROOFLINE_FIELDS"
+                for t in node.targets):
+            return tuple(ast.literal_eval(node.value))
+    raise SystemExit("profiling.py no longer defines ROOFLINE_FIELDS")
+
+
+def check_roofline_docs() -> int:
+    """Every ROOFLINE_FIELDS member must be backtick-documented in
+    README (the Raw speed section) — a field added to the block without
+    docs, or renamed out from under them, fails here."""
+    fields = roofline_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("roofline schema drift: ROOFLINE_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    print(f"roofline block: all {len(fields)} ROOFLINE_FIELDS "
+          "documented in README")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -100,6 +140,30 @@ def log_fields(path: str) -> set:
     return out
 
 
+def check_roofline_log(path: str) -> list:
+    """Records carrying a ``roofline`` block must carry EXACTLY the
+    ROOFLINE_FIELDS keys; returns the deviations (line no + diff)."""
+    want = set(roofline_fields())
+    bad = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            roof = rec.get("roofline")
+            if not isinstance(roof, dict):
+                continue
+            got = set(roof)
+            if got != want:
+                bad.append(f"line {lineno}: missing="
+                           f"{sorted(want - got)} extra={sorted(got - want)}")
+    return bad
+
+
 def main(argv) -> int:
     doc, emit = documented_fields(), emitted_fields()
     missing = sorted(doc - emit)
@@ -112,6 +176,8 @@ def main(argv) -> int:
         return 1
     print(f"steps.jsonl schema: {len(doc)} documented stage fields, "
           f"all within the {len(emit)}-key emitted vocabulary")
+    if check_roofline_docs():
+        return 1
     if argv:
         seen = log_fields(argv[0])
         absent = sorted(doc - seen)
@@ -120,6 +186,11 @@ def main(argv) -> int:
                   f"field(s): {absent}", file=sys.stderr)
             return 1
         print(f"live log {argv[0]}: all documented fields observed")
+        bad = check_roofline_log(argv[0])
+        if bad:
+            print(f"live log {argv[0]}: roofline block(s) deviate from "
+                  f"ROOFLINE_FIELDS: {bad}", file=sys.stderr)
+            return 1
     return 0
 
 
