@@ -117,6 +117,13 @@ MTL_HIDDEN = (128, 64)
 MTL_EPOCHS_SHORT = 2
 MTL_EPOCHS_LONG = 22
 
+# serving-plane bench (serve/ subsystem): modest MLP so the latency
+# numbers measure the service machinery, not a giant matmul; request
+# sizes mixed across the bucket ladder's low rungs
+SERVE_FEATURES = 30
+SERVE_HIDDEN = (64, 32)
+SERVE_MIX = (1, 4, 16, 64)
+
 # v5e HBM bandwidth (GB/s) for the roofline estimate in extra
 TPU_HBM_GBPS = 819.0
 
@@ -1213,6 +1220,120 @@ def task_pipeline():
     }))
 
 
+def task_serving():
+    """Open-loop serving bench: Poisson arrivals with mixed request
+    sizes against a warm `ScorerService`, reporting sustained QPS,
+    p50/p95/p99 latency, batch occupancy, and the steady-state
+    compile-cache miss count (the zero-recompile acceptance gate).
+    Open loop: arrivals follow the schedule regardless of completions,
+    so queueing delay is measured rather than hidden — a full
+    admission queue counts as a rejection, not as extra latency."""
+    import queue as queue_mod
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from shifu_tpu import profiling
+    from shifu_tpu.config.environment import knob_float
+    from shifu_tpu.data import pipeline
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.models.spec import save_model
+    from shifu_tpu.serve.service import ScorerService
+
+    qps = knob_float("SHIFU_TPU_SERVE_BENCH_QPS")
+    duration = knob_float("SHIFU_TPU_SERVE_BENCH_SECONDS")
+    max_delay_ms = knob_float("SHIFU_TPU_SERVE_MAX_DELAY_MS")
+
+    root = tempfile.mkdtemp(prefix="shifu_serve_bench_")
+    spec = nn_mod.MLPSpec(input_dim=SERVE_FEATURES,
+                          hidden_dims=SERVE_HIDDEN,
+                          activations=("relu",) * len(SERVE_HIDDEN))
+    params = nn_mod.init_params(spec, jax.random.PRNGKey(0))
+    save_model(os.path.join(root, "models", "model0.npz"), "nn",
+               {"spec": {"input_dim": SERVE_FEATURES,
+                         "hidden_dims": list(SERVE_HIDDEN),
+                         "activations": ["relu"] * len(SERVE_HIDDEN)}},
+               jax.tree.map(np.asarray, params))
+
+    service = ScorerService(models_dir=os.path.join(root, "models"),
+                            workspace_root=root)
+    rng = np.random.default_rng(0)
+    pool = rng.normal(0, 1, (max(SERVE_MIX), SERVE_FEATURES)) \
+        .astype(np.float32)
+    service.start(proto={"dense": pool[:1]})
+    warm_s = service.stats()["warm_s"]
+    _log(f"[serving] warm: {len(service.ladder)} buckets in "
+         f"{warm_s:.2f}s")
+    pipeline.drain_stage_timers()  # warmup compiles are not steady state
+
+    n_req = max(int(qps * duration), 1)
+    gaps = rng.exponential(1.0 / qps, n_req)
+    sizes = rng.choice(SERVE_MIX, n_req)
+    reqs, rejected = [], 0
+    t_start = time.monotonic()
+    t_next = t_start
+    for i in range(n_req):
+        t_next += gaps[i]
+        lag = t_next - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        try:
+            reqs.append(service.submit_async(dense=pool[:sizes[i]]))
+        except queue_mod.Full:
+            rejected += 1
+    lat, dev = [], []
+    for r in reqs:
+        r.wait(60.0)
+        lat.append(r.timing["total_s"])
+        dev.append(r.timing["device_s"])
+    elapsed = time.monotonic() - t_start
+    service.close()
+
+    steady = pipeline.drain_stage_timers()
+    misses = int(steady.get("compile_cache_misses", 0))
+    lat = np.asarray(lat)
+    p50, p95, p99 = (np.percentile(lat, [50, 95, 99]) * 1e3
+                     if lat.size else (0.0, 0.0, 0.0))
+    # "one device-step budget" = p95 of the batch device times.  The
+    # p99 gate allows TWO of them: an open-loop arrival can land while
+    # a batch is mid-flight, so the tail waits out the in-flight step,
+    # then its own admission deadline, then its own step
+    budget_ms = float(np.percentile(dev, 95)) * 1e3 if dev else 0.0
+    bstats = service.stats()["batcher"]
+    rows_per_s = bstats["rows"] / elapsed
+    stats = {
+        "qps_offered": qps,
+        "qps_sustained": round(len(reqs) / elapsed, 2),
+        "requests": len(reqs),
+        "rejected": rejected,
+        "rows_per_s": round(rows_per_s, 2),
+        "p50_ms": round(float(p50), 3),
+        "p95_ms": round(float(p95), 3),
+        "p99_ms": round(float(p99), 3),
+        "batch_occupancy": round(bstats["occupancy_mean"], 4),
+        "rows_per_batch": round(bstats["rows_per_batch"], 2),
+        "serve_warm_s": round(warm_s, 3),
+        "device_step_budget_ms": round(budget_ms, 3),
+        "compile_cache_misses_steady": misses,
+    }
+    if misses:
+        _log(f"[serving] WARNING: {misses} steady-state compile-cache "
+             "misses — the shape-bucket discipline leaked a shape")
+    if stats["p99_ms"] > max_delay_ms + 2.0 * budget_ms + 1.0:
+        _log(f"[serving] WARNING: p99 {stats['p99_ms']:.2f}ms exceeds "
+             f"deadline {max_delay_ms}ms + 2x device budget "
+             f"{budget_ms:.2f}ms — offered load may be past saturation")
+    record = {k: stats[k] for k in profiling.SERVING_FIELDS}
+    record["roofline"] = profiling.roofline(
+        "SERVE-NN",
+        *profiling.mlp_row_costs(SERVE_FEATURES, SERVE_HIDDEN,
+                                 train=False),
+        rows_per_s)
+    print(json.dumps(record))
+
+
 def task_cpu_denom():
     """Measured same-host CPU denominator: nn / nn_wide / gbt bench
     shapes on the JAX CPU backend (this host), giving vs_baseline a
@@ -1495,6 +1616,8 @@ def main():
         return task_streaming()
     if args.task == "pipeline":
         return task_pipeline()
+    if args.task == "serving":
+        return task_serving()
     if args.task == "rf":
         return task_rf()
     if args.task == "cpu_denom":
@@ -1555,6 +1678,8 @@ def main():
                  f"{VARSEL_COLS})", timeout=2400)
             step("nn", f"NN flagship bench ({N_ROWS}x{N_FEATURES}, "
                  f"{BENCH_EPOCHS} epochs)", timeout=2400)
+            step("serving", "serving-plane bench (open-loop Poisson, "
+                 f"mix {SERVE_MIX})", timeout=1800)
             step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
                  f"{GBT_COLS}, {GBT_TREES} trees)", timeout=3000)
             if knob_bool("SHIFU_TPU_BENCH_STREAMING"):
@@ -1620,6 +1745,13 @@ def main():
         extra["mtl_Mrow_epochs_per_s"] = round(
             mt["row_epochs_per_sec"] / 1e6, 3)
         extra["mtl_auc"] = round(mt["auc"], 4)
+
+    def _fill_serving(sv):
+        extra["serve_qps"] = round(sv["qps_sustained"], 1)
+        extra["serve_p50_ms"] = round(sv["p50_ms"], 2)
+        extra["serve_p99_ms"] = round(sv["p99_ms"], 2)
+        extra["serve_occupancy"] = round(sv["batch_occupancy"], 3)
+        extra["serve_steady_misses"] = sv["compile_cache_misses_steady"]
 
     def _fill_hists(hp):
         hx = res.get("hist_xla")
@@ -1723,6 +1855,7 @@ def main():
     fill("gbt_small", _fill_gbt_small)
     fill("varsel", _fill_varsel)
     fill("gbt", _fill_gbt)
+    fill("serving", _fill_serving)
     fill("streaming", _fill_streaming)
 
     # per-family roofline blocks (profiling.roofline): every task that

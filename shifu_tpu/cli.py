@@ -135,6 +135,47 @@ def cmd_eval(args) -> int:
     return p.run(_ctx(args), eval_name=args.run)
 
 
+def cmd_serve(args) -> int:
+    """`shifu serve` — persistent low-latency scorer over the trained
+    model set: AOT-warms every shape bucket, micro-batches submits
+    behind a bounded-latency admission queue, and (unless --no-http)
+    answers POST /score on a stdlib HTTP/JSON listener. SIGTERM/SIGINT
+    drain and stop the service (the graceful_shutdown contract the
+    trainers use); --duration-s bounds the run for scripted use."""
+    import json as _json
+    import time as _time
+
+    from shifu_tpu import resilience
+    from shifu_tpu.serve.service import ScorerService
+
+    ctx = _ctx(args)
+    service = ScorerService(models_dir=ctx.path_finder.models_path(),
+                            workspace_root=args.dir)
+    service.start()
+    log.info("scorer service warm: %s", service.stats())
+    front = None
+    if not args.no_http:
+        from shifu_tpu.serve.http import HttpFrontEnd
+        front = HttpFrontEnd(service, port=args.port).start()
+        log.info("serving HTTP on %s:%d", *front.address)
+    deadline = _time.monotonic() + args.duration_s if args.duration_s \
+        else None
+    try:
+        with resilience.graceful_shutdown("serving"):
+            while not resilience.preempt_requested():
+                if deadline is not None and _time.monotonic() >= deadline:
+                    break
+                _time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if front is not None:
+            front.close()
+        service.close()
+    print(_json.dumps(service.stats()))
+    return 0
+
+
 def cmd_export(args) -> int:
     from shifu_tpu.processor import export as p
     return p.run(_ctx(args), export_type=args.type)
@@ -324,6 +365,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", "--n", type=int, default=100,
                    help="audit record count (eval -audit -n N)")
     p.set_defaults(fn=cmd_eval)
+    p = sub.add_parser("serve", help="low-latency scorer service")
+    p.add_argument("--port", type=int, default=None,
+                   help="HTTP port (default SHIFU_TPU_SERVE_PORT; "
+                        "0 = ephemeral)")
+    p.add_argument("--no-http", action="store_true",
+                   help="in-process service only, no listener")
+    p.add_argument("--duration-s", type=float, default=0.0,
+                   help="exit after this many seconds (0 = run until "
+                        "SIGTERM/SIGINT)")
+    p.set_defaults(fn=cmd_serve)
     p = sub.add_parser("export", help="export model/stats")
     p.add_argument("-t", "--type", default="columnstats",
                    choices=["columnstats", "correlation", "woemapping",
@@ -410,7 +461,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # barrier just to copy files.
     if args.command in ("init", "stats", "norm", "normalize", "varsel",
                         "varselect", "train", "posttrain", "eval",
-                        "export", "encode", "combo"):
+                        "export", "encode", "combo", "serve"):
         from shifu_tpu.parallel import dist
         dist.initialize()
     t0 = time.time()

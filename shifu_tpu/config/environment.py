@@ -217,6 +217,29 @@ _declare("SHIFU_TPU_HIST_FUSED", "bool", "0",
 _declare("SHIFU_TPU_SCORE_FUSED", "str", "auto",
          "fused normalize+first-matmul scoring kernel route: "
          "auto | pallas | xla")
+# --- serving plane ---
+_declare("SHIFU_TPU_SERVE_BUCKETS", "str", "1,8,64,512",
+         "padded-row shape-bucket ladder for the serving plane and "
+         "chunked eval scoring (comma-separated ascending row counts; "
+         "ragged batches pad up to the nearest bucket, sizes beyond "
+         "the top bucket pad to its next doubling)")
+_declare("SHIFU_TPU_SERVE_MAX_DELAY_MS", "float", 2.0,
+         "micro-batcher admission deadline: a queued request waits at "
+         "most this long for co-riders before its batch is scored")
+_declare("SHIFU_TPU_SERVE_QUEUE_DEPTH", "int", 1024,
+         "bounded admission-queue depth for the scorer service; a "
+         "full queue rejects submits instead of buffering unbounded")
+_declare("SHIFU_TPU_SERVE_PORT", "int", 8488,
+         "HTTP/JSON listener port for `shifu serve` (0 = ephemeral)")
+_declare("SHIFU_TPU_EVAL_PAD_BUCKETS", "bool", "1",
+         "1 = chunked eval scoring pads ragged chunks up to the "
+         "SHIFU_TPU_SERVE_BUCKETS ladder so the final short chunk "
+         "reuses an already-compiled executable instead of compiling "
+         "its own")
+_declare("SHIFU_TPU_CKPT_SLOTS", "int", 1,
+         "staged async checkpoint writes allowed in flight; >1 lets "
+         "very short save intervals overlap serializes instead of "
+         "joining the previous write at each save")
 # --- remote fs ---
 _declare("SHIFU_TPU_FS_CACHE_TYPE", "str", "readahead",
          "fsspec cache_type hint for remote streaming opens "
@@ -260,6 +283,12 @@ _declare("SHIFU_TPU_PIPE_EPOCHS", "int", 30,
 _declare("SHIFU_TPU_GBT_TRACE", "flag", "0",
          "1 = capture a jax.profiler trace in tools/profile_gbt.py",
          scope="tools")
+_declare("SHIFU_TPU_SERVE_BENCH_QPS", "float", 200.0,
+         "offered Poisson arrival rate for the serving bench",
+         scope="bench")
+_declare("SHIFU_TPU_SERVE_BENCH_SECONDS", "float", 8.0,
+         "open-loop load duration for the serving bench",
+         scope="bench")
 
 
 # ---------------------------------------------------------------------------

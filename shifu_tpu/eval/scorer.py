@@ -63,7 +63,11 @@ def score_matrix(kind: str, meta: Dict[str, Any], params: Any,
         # scoring shards rows over the data mesh (the Pig EvalScore
         # mappers' split, EvalScoreUDF); padded rows are sliced off
         mesh = mesh_mod.default_mesh()
-        d_dense = mesh_mod.shard_axis(mesh, np.asarray(dense, np.float32), 0)
+        # the serving plane pre-places the padded batch (its h2d timing
+        # stage); shard_axis keeps device arrays device-side
+        host = dense if isinstance(dense, jax.Array) \
+            else np.asarray(dense, np.float32)
+        d_dense = mesh_mod.shard_axis(mesh, host, 0)
         out = nn_mod.forward(spec, jax.tree.map(jnp.asarray, params),
                              d_dense)
         return np.asarray(out)[:n]

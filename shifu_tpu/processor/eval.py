@@ -130,10 +130,23 @@ def _score_dataset(mc: ModelConfig, scorer: Scorer, dset, cols):
                              dset.cat_codes).astype(np.int32)
     else:
         raw_codes = dset.cat_codes
+    # ragged chunk rows (most visibly the short FINAL chunk of a
+    # streaming eval) pad up the serving plane's shape-bucket ladder so
+    # each distinct row count reuses an already-compiled executable
+    # instead of compiling its own; repeat-last-row padding keeps every
+    # score bit-identical after the slice (serve/aot.py)
+    from shifu_tpu.serve import aot as serve_aot
+    n = result.dense.shape[0]
+    blocks = {"dense": result.dense,
+              "index": result.index if result.index.size else None,
+              "raw_dense": dset.numeric, "raw_codes": raw_codes}
+    pad = serve_aot.eval_pad_enabled() and n > 0
     if mc.is_multi_classification:
-        probs, pred = scorer.score_multiclass(
-            result.dense, result.index if result.index.size else None,
-            raw_dense=dset.numeric, raw_codes=raw_codes)
+        if pad:
+            probs, pred = serve_aot.padded_call(
+                scorer.score_multiclass, n, blocks)
+        else:
+            probs, pred = scorer.score_multiclass(**blocks)
         scores = {f"class{c}": probs[:, c] for c in range(probs.shape[1])}
         scores["final"] = pred.astype(np.float32)
         return scores
@@ -144,10 +157,9 @@ def _score_dataset(mc: ModelConfig, scorer: Scorer, dset, cols):
         norm = {"mean": result.zscore_params[0],
                 "std": result.zscore_params[1],
                 "cutoff": mc.normalize.stdDevCutOff}
-    return scorer.score(result.dense,
-                        result.index if result.index.size else None,
-                        raw_dense=dset.numeric, raw_codes=raw_codes,
-                        norm=norm)
+    if pad:
+        return serve_aot.padded_call(scorer.score, n, blocks, norm=norm)
+    return scorer.score(norm=norm, **blocks)
 
 
 def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,

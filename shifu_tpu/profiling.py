@@ -231,6 +231,16 @@ ROOFLINE_FIELDS = ("family", "compute_dtype", "flops_per_row",
                    "bytes_per_s", "arith_intensity", "ridge_intensity",
                    "mxu_util", "hbm_util", "bound")
 
+# the serving bench's record schema (bench.py task_serving builds its
+# JSON line from exactly these keys, plus the shared `roofline` block);
+# tools/check_steps_schema.py pins README docs to this tuple the same
+# way it pins ROOFLINE_FIELDS.
+SERVING_FIELDS = ("qps_offered", "qps_sustained", "requests",
+                  "rejected", "rows_per_s", "p50_ms", "p95_ms",
+                  "p99_ms", "batch_occupancy", "rows_per_batch",
+                  "serve_warm_s", "device_step_budget_ms",
+                  "compile_cache_misses_steady")
+
 
 def mlp_row_costs(input_dim: int, hidden_dims, n_out: int = 1,
                   train: bool = True, dtype_bytes: int = 4):

@@ -138,7 +138,7 @@ FAULT_SITES = (
     "reader.read", "reader.native",
     "ckpt.save", "ckpt.stage", "ckpt.publish", "ckpt.saved",
     "ckpt.restore",
-    "atomic.commit", "pipeline.fetch",
+    "atomic.commit", "pipeline.fetch", "serve.request",
     "dist.init", "dist.barrier", "dist.allgather",
 )
 

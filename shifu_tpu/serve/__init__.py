@@ -1,0 +1,8 @@
+"""Low-latency serving plane: shape-bucket AOT compilation, dynamic
+micro-batching, and a persistent in-process/HTTP scorer service.
+
+Layout mirrors the rest of the package — pure-python plumbing here,
+device work delegated to `eval/scorer.score_matrix` so the serving
+path and batch eval share one numeric code path (bit parity by
+construction).
+"""

@@ -1,0 +1,122 @@
+"""Thin stdlib HTTP/JSON listener over `ScorerService`.
+
+Deliberately dependency-free (http.server + json), mirroring the
+reference's dependency-free `Independent*Model` stance: the serving
+plane must run where the training stack isn't installed-adjacent.
+`ThreadingHTTPServer` gives one handler thread per connection; all
+handlers funnel into the service's admission queue, so concurrency is
+bounded by the batcher, not the listener.
+
+    POST /score   {"dense": [[...]], "index"?, "raw_dense"?,
+                   "raw_codes"?}            → scores + per-stage ms
+    GET  /healthz                           → liveness
+    GET  /stats                             → service counters
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.serve.service import ScorerService
+
+_MAX_BODY = 64 << 20  # 64 MiB: generous for top-bucket float rows
+
+
+def _np_blocks(payload: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    out = {}
+    for key, dtype in (("dense", np.float32), ("index", np.int32),
+                       ("raw_dense", np.float32), ("raw_codes", np.int32)):
+        if payload.get(key) is not None:
+            out[key] = np.asarray(payload[key], dtype)
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ScorerService  # set on the server class by serve_http
+
+    def log_message(self, fmt, *args):  # stdout belongs to metrics
+        pass
+
+    def _reply(self, code: int, body: Dict[str, Any]) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {"ok": True})
+        elif self.path == "/stats":
+            self._reply(200, self.server.service.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/score":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if not 0 < length <= _MAX_BODY:
+                raise ValueError(f"bad Content-Length {length}")
+            payload = json.loads(self.rfile.read(length))
+            blocks = _np_blocks(payload)
+            scores, timing = self.server.service.submit_timed(**blocks)
+        except queue.Full:
+            self._reply(429, {"error": "admission queue full"})
+            return
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except OSError as e:  # injected serve.request faults land here
+            self._reply(503, {"error": str(e)})
+            return
+        self._reply(200, {
+            "scores": {k: np.asarray(v).tolist() for k, v in scores.items()},
+            "timing_ms": {k: v * 1e3 for k, v in timing.items()},
+        })
+
+
+class HttpFrontEnd:
+    """Owns the listener thread; `address` is the bound (host, port) —
+    pass port 0 for an ephemeral port (tests)."""
+
+    def __init__(self, service: ScorerService, host: str = "0.0.0.0",
+                 port: Optional[int] = None):
+        from shifu_tpu.config import environment as env
+        if port is None:
+            port = env.knob_int("SHIFU_TPU_SERVE_PORT")
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.service = service
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def start(self) -> "HttpFrontEnd":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, name="serve-http",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join()
+            self._thread = None
+        self._server.server_close()

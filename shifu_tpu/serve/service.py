@@ -1,0 +1,245 @@
+"""Persistent scorer service: AOT-warmed, micro-batched, in-process.
+
+`ScorerService` owns one `eval.scorer.Scorer` ensemble and a
+`MicroBatcher`.  Every micro-batch is padded up the shape-bucket
+ladder and scored through `Scorer.score` → `score_matrix` — the exact
+code path batch eval uses, including the fused normalize+score Pallas
+kernel and bf16 spec metadata — so a served request scored at the
+same bucket batch eval lands on is bit-identical to batch eval by
+construction; across DIFFERENT buckets XLA's shape-dependent
+scheduling bounds the difference at ~1 ulp (see serve/aot.py).  Two
+standing caveats: batch-GLOBAL tree-score conversions like MAXMIN are
+batch-defined and therefore applied per micro-batch (the default RAW
+conversion has no such dependence), and which requests share a
+micro-batch depends on arrival timing.
+
+Per-request latency decomposes into queue / pad / h2d / device / d2h:
+queue is measured by the batcher, pad is host-side batch assembly,
+h2d is an explicit `jax.device_put` of the padded dense block (taken
+only when the whole ensemble is NN-family so the placed array is the
+one the matmul reads), device is the `Scorer.score` call, and d2h is
+per-request result extraction.  For mixed ensembles the transfer
+happens inside `score_matrix` and is accounted under device.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.config import environment as env
+from shifu_tpu.data import pipeline
+from shifu_tpu.eval.scorer import Scorer
+from shifu_tpu.serve import aot
+from shifu_tpu.serve.batcher import MicroBatcher, Request
+
+_BLOCK_KEYS = ("dense", "index", "raw_dense", "raw_codes")
+
+
+class ScorerService:
+    """In-process serving front end; `submit` is thread-safe."""
+
+    def __init__(self, models_dir: Optional[str] = None,
+                 model_paths: Optional[List[str]] = None,
+                 score_selector: str = "mean",
+                 gbt_convert: str = "RAW",
+                 norm: Optional[Dict[str, Any]] = None,
+                 ladder: Optional[Tuple[int, ...]] = None,
+                 max_delay: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 workspace_root: Optional[str] = None,
+                 aot_compile: bool = True):
+        if workspace_root is not None:
+            from shifu_tpu import profiling
+            profiling.enable_compile_cache(workspace_root)
+        if models_dir is not None:
+            self.scorer = Scorer.from_dir(models_dir, model_paths,
+                                          score_selector=score_selector,
+                                          gbt_convert=gbt_convert)
+        else:
+            self.scorer = Scorer(model_paths or [],
+                                 score_selector=score_selector,
+                                 gbt_convert=gbt_convert)
+        self.norm = norm
+        self.ladder = tuple(ladder) if ladder else aot.bucket_ladder()
+        self._aot_enabled = aot_compile
+        self._aot_executables: Dict[Tuple[int, int], Any] = {}
+        self._batcher = MicroBatcher(self._score_batch,
+                                     max_rows=self.ladder[-1],
+                                     max_delay=max_delay,
+                                     depth=queue_depth)
+        self._schema: Optional[frozenset] = None
+        self._started = False
+        self._warm_s = 0.0
+        self._warmed_buckets = 0
+        # consumer-thread-appended; stats() reads racily (monitoring)
+        self._latencies: collections.deque = collections.deque(maxlen=8192)
+        self._schema_lock = threading.Lock()
+
+    # pre-place the padded dense block on device only when every model
+    # reads it as-is: an all-NN ensemble with no fused-normalize route
+    @property
+    def _preplace(self) -> bool:
+        return self.norm is None and all(
+            kind in ("nn", "lr") for kind, _, _ in self.scorer.models)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, proto: Optional[Dict[str, np.ndarray]] = None
+              ) -> "ScorerService":
+        """Warm every shape bucket, then open the admission queue.
+        `proto` is one representative request (row blocks); without
+        one, an all-NN ensemble warms from a zeros row and anything
+        else warms lazily on first traffic."""
+        if self._started:
+            return self
+        if proto is None:
+            proto = self._default_proto()
+        if proto:
+            t0 = time.monotonic()
+            proto = {k: np.asarray(v) for k, v in proto.items()
+                     if v is not None}
+            self._schema = frozenset(proto)
+            if self._aot_enabled and "dense" in proto:
+                self._aot_executables = aot.aot_compile(
+                    self.scorer, int(proto["dense"].shape[1]), self.ladder)
+                aot.aot_selfcheck(self._aot_executables, self.scorer, proto)
+            self._warmed_buckets = aot.warm_scores(
+                self.scorer, proto, self.ladder, norm=self.norm)
+            self._warm_s = time.monotonic() - t0
+            pipeline.add_stage_time("serve_warm_s", self._warm_s)
+        self._batcher.start()
+        self._started = True
+        return self
+
+    def close(self) -> None:
+        self._batcher.close()
+        self._started = False
+
+    def __enter__(self) -> "ScorerService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _default_proto(self) -> Optional[Dict[str, np.ndarray]]:
+        for kind, meta, _ in self.scorer.models:
+            if kind in ("nn", "lr"):
+                dim = int(meta["spec"]["input_dim"])
+                return {"dense": np.zeros((1, dim), np.float32)}
+        return None
+
+    # -- request path --------------------------------------------------
+    def submit_async(self, dense: Optional[np.ndarray] = None,
+                     index: Optional[np.ndarray] = None,
+                     raw_dense: Optional[np.ndarray] = None,
+                     raw_codes: Optional[np.ndarray] = None) -> Request:
+        blocks = {"dense": dense, "index": index,
+                  "raw_dense": raw_dense, "raw_codes": raw_codes}
+        blocks = {k: np.asarray(v) for k, v in blocks.items()
+                  if v is not None}
+        if not blocks:
+            raise ValueError("request carries no feature blocks")
+        schema = frozenset(blocks)
+        with self._schema_lock:
+            if self._schema is None:
+                self._schema = schema
+            elif schema != self._schema:
+                raise ValueError(
+                    f"request blocks {sorted(schema)} do not match the "
+                    f"service schema {sorted(self._schema)}")
+        n = next(iter(blocks.values())).shape[0]
+        if any(v.shape[0] != n for v in blocks.values()):
+            raise ValueError("feature blocks disagree on row count")
+        return self._batcher.submit(blocks, n)
+
+    def submit(self, dense: Optional[np.ndarray] = None,
+               index: Optional[np.ndarray] = None,
+               raw_dense: Optional[np.ndarray] = None,
+               raw_codes: Optional[np.ndarray] = None,
+               timeout: Optional[float] = 30.0) -> Dict[str, np.ndarray]:
+        """Score one request (blocking) → the `Scorer.score` dict
+        ({"model0"..,"mean","max","min","median","final"}) sliced to
+        this request's rows."""
+        return self.submit_async(dense, index, raw_dense,
+                                 raw_codes).wait(timeout)
+
+    def submit_timed(self, timeout: Optional[float] = 30.0, **blocks
+                     ) -> Tuple[Dict[str, np.ndarray], Dict[str, float]]:
+        req = self.submit_async(**blocks)
+        return req.wait(timeout), dict(req.timing)
+
+    # -- device consumer (batcher thread) ------------------------------
+    def _score_batch(self, batch: List[Request]) -> None:
+        t0 = time.monotonic()
+        n = sum(r.n for r in batch)
+        keys = sorted(batch[0].blocks)
+        concat = {k: (batch[0].blocks[k] if len(batch) == 1
+                      else np.concatenate([r.blocks[k] for r in batch]))
+                  for k in keys}
+        bucket = aot.bucket_for(n, self.ladder)
+        padded = aot.pad_blocks(concat, bucket)
+        t_pad = time.monotonic()
+
+        t_h2d = t_pad
+        if self._preplace and "dense" in padded:
+            import jax
+            # single-device placement: score_matrix's shard_axis moves
+            # it onto the data mesh without a host round-trip
+            padded["dense"] = jax.device_put(
+                np.asarray(padded["dense"], np.float32), jax.devices()[0])
+            jax.block_until_ready(padded["dense"])
+            t_h2d = time.monotonic()
+
+        # tree ensembles may serve raw blocks only; score_matrix's tree
+        # path reads raw_dense, so any row-aligned block satisfies the
+        # positional dense argument
+        out = self.scorer.score(
+            dense=padded.get("dense", padded.get("raw_dense")),
+            index=padded.get("index"),
+            raw_dense=padded.get("raw_dense"),
+            raw_codes=padded.get("raw_codes"),
+            norm=self.norm)
+        t_dev = time.monotonic()
+
+        off, t_prev = 0, t_dev
+        for r in batch:
+            r.timing.update(
+                pad_s=t_pad - t0, h2d_s=t_h2d - t_pad,
+                device_s=t_dev - t_h2d)
+            sliced = {k: np.ascontiguousarray(v[off:off + r.n])
+                      for k, v in out.items()}
+            off += r.n
+            t_done = time.monotonic()
+            r.timing["d2h_s"] = t_done - t_prev
+            t_prev = t_done
+            r.timing["total_s"] = t_done - r.t_submit
+            self._latencies.append(r.timing["total_s"])
+            r.resolve(sliced)
+        t_d2h = time.monotonic()
+
+        pipeline.add_stage_time("serve_pad_s", t_pad - t0)
+        pipeline.add_stage_time("serve_h2d_s", t_h2d - t_pad)
+        pipeline.add_stage_time("serve_device_s", t_dev - t_h2d)
+        pipeline.add_stage_time("serve_d2h_s", t_d2h - t_dev)
+
+    # -- monitoring ----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        lat = np.asarray(self._latencies, np.float64)
+        pct = {}
+        if lat.size:
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+            pct = {"p50_ms": p50 * 1e3, "p95_ms": p95 * 1e3,
+                   "p99_ms": p99 * 1e3}
+        return {
+            "models": [kind for kind, _, _ in self.scorer.models],
+            "ladder": list(self.ladder),
+            "warm_s": self._warm_s,
+            "warmed_buckets": self._warmed_buckets,
+            "aot_executables": len(self._aot_executables),
+            "latency": pct,
+            "batcher": self._batcher.stats(),
+        }

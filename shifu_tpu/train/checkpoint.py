@@ -15,10 +15,12 @@ Async saves (CheckFreq-style snapshot-then-background-write): with
 device→host *snapshot* on the training thread — `np.asarray` over the
 state pytree, which also decouples the save from donated device
 buffers — and hands the serialize + atomic publish to a single
-background writer thread. A join barrier runs at the next save (at
-most one write in flight), at preemption (`graceful_shutdown` flushes
-before exiting rc 75), and at trainer exit; writer errors surface at
-the next barrier. The stage timers split the cost: `ckpt_stall_s` is
+background writer thread. Up to `SHIFU_TPU_CKPT_SLOTS` staged
+snapshots (default 1) may be in flight before a save blocks on a
+slot; the one FIFO worker publishes them in step order. Full join
+barriers run at preemption (`graceful_shutdown` flushes before
+exiting rc 75) and at trainer exit; writer errors surface at the next
+save or flush barrier. The stage timers split the cost: `ckpt_stall_s` is
 what the step loop actually waited (staging only), `ckpt_save_s` the
 full serialize+publish time.
 
@@ -37,6 +39,7 @@ case), `ckpt.restore`.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import shutil
@@ -48,7 +51,7 @@ import jax
 import numpy as np
 
 from shifu_tpu.analysis.lockcheck import make_lock
-from shifu_tpu.config.environment import knob_bool
+from shifu_tpu.config.environment import knob_bool, knob_int
 from shifu_tpu.data import pipeline as pipe
 from shifu_tpu.resilience import fault_point, sweep_stale_tmp
 
@@ -119,49 +122,92 @@ def save_state(ckpt_dir: str, step: int, state: Any) -> None:
 
 
 class AsyncCheckpointWriter:
-    """Single background writer: at most one serialize+publish in
-    flight; `save` joins the previous write (surfacing its error),
-    snapshots on the calling thread, then returns while the new write
-    runs. The lock guards only pointer swaps (thread/error fields), so
-    holds stay sub-millisecond — the join happens outside it."""
+    """Multi-slot background writer: up to `SHIFU_TPU_CKPT_SLOTS`
+    staged snapshots may be in flight (queued or publishing) at once,
+    all drained by ONE persistent worker thread in FIFO order — the
+    single ordered consumer is what keeps `_publish`'s prune-older
+    sweep safe (concurrent publishes would delete each other's steps).
+
+    `save` surfaces any pending writer error, snapshots on the calling
+    thread, then blocks only while all slots are occupied; with the
+    default ``SHIFU_TPU_CKPT_SLOTS=1`` that reproduces the PR-5
+    at-most-one-write join barrier exactly. `flush` waits for every
+    in-flight write (FIFO ⇒ the newest step is published last), so the
+    sync contract and the kill-drill guarantee — a crash mid-publish
+    leaves the previous step restorable — are unchanged.
+
+    The CheckedLock guards only error-pointer swaps (sub-ms holds);
+    slot accounting lives on a Condition the worker signals."""
 
     def __init__(self) -> None:
         self._lock = make_lock("ckpt.writer")
+        self._cond = threading.Condition()
+        self._staged: "collections.deque" = collections.deque()
+        self._inflight = 0  # queued + currently publishing
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
-    def save(self, ckpt_dir: str, step: int, state: Any) -> None:
-        t0 = time.monotonic()
-        fault_point("ckpt.save")
-        self.flush()  # join barrier: at most one write in flight
-        os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
-        snap = _snapshot(state)
+    @staticmethod
+    def slots() -> int:
+        return max(1, knob_int("SHIFU_TPU_CKPT_SLOTS"))
 
-        def _write() -> None:
+    def _take_error(self) -> Optional[BaseException]:
+        with self._lock:
+            err, self._error = self._error, None
+        return err
+
+    def _ensure_worker(self) -> None:
+        # callers hold self._cond
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._staged:
+                    self._cond.wait()
+                ckpt_dir, step, snap, t0 = self._staged.popleft()
             try:
                 _publish(ckpt_dir, step, snap)
                 pipe.add_stage_time("ckpt_save_s", time.monotonic() - t0)
             except BaseException as e:  # noqa: BLE001 — surfaced at flush
                 with self._lock:
-                    self._error = e
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
 
-        th = threading.Thread(target=_write, name=f"ckpt-writer-{step}",
-                              daemon=True)
-        with self._lock:
-            self._thread = th
-        th.start()
+    def save(self, ckpt_dir: str, step: int, state: Any) -> None:
+        t0 = time.monotonic()
+        fault_point("ckpt.save")
+        # a previous write's failure surfaces before more work stages
+        err = self._take_error()
+        if err is not None:
+            raise err
+        os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+        snap = _snapshot(state)
+        slots = self.slots()
+        with self._cond:
+            while self._inflight >= slots:
+                self._cond.wait()
+            self._inflight += 1
+            self._staged.append((ckpt_dir, step, snap, t0))
+            self._ensure_worker()
+            self._cond.notify_all()
         pipe.add_stage_time("ckpt_stall_s", time.monotonic() - t0)
 
     def flush(self, reraise: bool = True) -> None:
-        """Join the in-flight write, if any; re-raise (or warn about)
-        its error. Idempotent — a flush with nothing in flight is a
-        cheap no-op."""
-        with self._lock:
-            th, self._thread = self._thread, None
-        if th is not None:
-            th.join()
-        with self._lock:
-            err, self._error = self._error, None
+        """Barrier over every in-flight write; re-raise (or warn about)
+        the first writer error. Idempotent — a flush with nothing in
+        flight is a cheap no-op."""
+        with self._cond:
+            while self._inflight:
+                self._cond.wait()
+        err = self._take_error()
         if err is not None:
             if reraise:
                 raise err

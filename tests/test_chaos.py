@@ -174,3 +174,41 @@ def test_kill_during_background_save_falls_back_to_previous_step(
     np.testing.assert_array_equal(st["w"],
                                   np.arange(16, dtype=np.float32))
     np.testing.assert_array_equal(st["b"], np.float64(1.0))
+
+
+# ---------------------------------------------------------------------------
+# serving-plane drills (the `serve.request` admission seam)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind,exc", [("oserror", OSError),
+                                      ("timeout", TimeoutError)])
+def test_serving_fault_never_hangs_and_is_recoverable(
+        tmp_path, monkeypatch, kind, exc):
+    """An injected fault at `serve.request` fails exactly one submit,
+    promptly and naming the site; the service keeps serving and shuts
+    down cleanly afterwards (no consumer-thread hang)."""
+    from tests.test_serve import _tiny_nn_dir
+    from shifu_tpu.serve.service import ScorerService
+
+    assert "serve.request" in resilience.FAULT_SITES
+    models = _tiny_nn_dir(str(tmp_path / "models"))
+    svc = ScorerService(models_dir=models, max_delay=0.005,
+                        aot_compile=False).start()
+    try:
+        monkeypatch.setenv("SHIFU_TPU_FAULT", f"serve.request:{kind}:1")
+        resilience.reset_faults()
+        x = np.zeros((2, 12), np.float32)
+
+        t0 = time.monotonic()
+        with pytest.raises(exc, match=f"injected {kind} at serve.request"):
+            svc.submit(dense=x)
+        assert time.monotonic() - t0 < 60, "faulted submit hung"
+
+        out = svc.submit(dense=x, timeout=60.0)   # service still healthy
+        assert np.asarray(out["mean"]).shape == (2,)
+    finally:
+        monkeypatch.delenv("SHIFU_TPU_FAULT", raising=False)
+        resilience.reset_faults()
+        t0 = time.monotonic()
+        svc.close()
+        assert time.monotonic() - t0 < 60, "service close hung"

@@ -1,0 +1,575 @@
+"""Low-latency serving plane (shifu_tpu/serve/).
+
+The serving contract has three legs, each tested here:
+
+- PARITY: served scores bit-match batch eval (`Scorer.score` on the
+  whole block) for NN and GBT, and agree with the portable / PMML
+  external evaluators within their usual tolerances — padding up the
+  shape-bucket ladder and micro-batch merging must be invisible.
+- BATCHING: the micro-batcher flushes on bucket fill OR the opener's
+  deadline, preserves FIFO order through overflow carry, rejects on a
+  full admission queue, and surfaces injected `serve.request` faults
+  to exactly one caller.
+- WARM START: after `start()` warms every bucket, steady-state ragged
+  traffic takes zero compile-cache misses (the "never recompiles"
+  acceptance gate), and chunked batch eval routed through the same
+  pad helper scores identically with padding on or off.
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from shifu_tpu import resilience
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.data import pipeline
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.serve import aot
+from shifu_tpu.serve.batcher import MicroBatcher
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    resilience.reset_faults()
+    yield
+    resilience.reset_faults()
+
+
+def _pipeline(model_set, *extra):
+    for cmd in (["init"], ["stats"], ["norm"], ["train"], *extra):
+        assert cli_main(["--dir", model_set] + list(cmd)) == 0
+    return model_set
+
+
+def _norm_blocks(root):
+    from shifu_tpu.processor import norm as norm_proc
+    ctx = ProcessorContext.load(root)
+    data, meta = norm_proc.load_normalized(
+        ctx.path_finder.normalized_data_path())
+    return ctx, data, meta
+
+
+def _tiny_nn_dir(root, input_dim=12, seed=0):
+    """A throwaway single-NN model dir (no training) for batcher /
+    service plumbing tests — the parity tests use real pipelines."""
+    import jax
+
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.models.spec import save_model
+    os.makedirs(root, exist_ok=True)
+    spec = nn_mod.MLPSpec(input_dim=input_dim, hidden_dims=(8,),
+                          activations=("relu",))
+    params = nn_mod.init_params(spec, jax.random.PRNGKey(seed))
+    save_model(os.path.join(root, "model0.npz"), "nn",
+               {"spec": {"input_dim": input_dim, "hidden_dims": [8],
+                         "activations": ["relu"]}},
+               jax.tree.map(np.asarray, params))
+    return root
+
+
+def _ragged_pieces(n, sizes=(3, 1, 7, 5, 2)):
+    """Split [0, n) into uneven request-sized pieces."""
+    out, off, i = [], 0, 0
+    while off < n:
+        step = min(sizes[i % len(sizes)], n - off)
+        out.append((off, off + step))
+        off += step
+        i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shape buckets + padding
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_and_padding(monkeypatch):
+    assert aot.bucket_for(1, (1, 8, 64)) == 1
+    assert aot.bucket_for(2, (1, 8, 64)) == 8
+    assert aot.bucket_for(64, (1, 8, 64)) == 64
+    assert aot.bucket_for(65, (1, 8, 64)) == 128    # top rung doubles
+    assert aot.bucket_for(300, (1, 8, 64)) == 512
+    with pytest.raises(ValueError):
+        aot.bucket_for(0)
+
+    block = np.arange(6, dtype=np.float32).reshape(3, 2)
+    padded = aot.pad_rows(block, 8)
+    assert padded.shape == (8, 2)
+    np.testing.assert_array_equal(padded[:3], block)
+    np.testing.assert_array_equal(padded[3:],
+                                  np.repeat(block[-1:], 5, axis=0))
+    with pytest.raises(ValueError):
+        aot.pad_rows(block, 2)
+
+    monkeypatch.setenv("SHIFU_TPU_SERVE_BUCKETS", "16,4,256")
+    assert aot.bucket_ladder() == (4, 16, 256)      # sorted + deduped
+    monkeypatch.setenv("SHIFU_TPU_SERVE_BUCKETS", "banana")
+    assert aot.bucket_ladder() == aot.DEFAULT_LADDER
+
+
+def test_padded_call_slices_back(tmp_path):
+    """Within a bucket, padding is bit-invisible; vs an unpadded call
+    at a different shape, results agree to XLA scheduling noise."""
+    from shifu_tpu.eval.scorer import Scorer
+    models = _tiny_nn_dir(str(tmp_path / "models"))
+    scorer = Scorer.from_dir(models)
+    x = np.random.default_rng(3).normal(0, 1, (11, 12)).astype(np.float32)
+
+    padded = aot.padded_call(scorer.score, 11, {"dense": x},
+                             ladder=(1, 8, 64))
+    manual = {k: np.asarray(v)[:11]
+              for k, v in scorer.score(aot.pad_rows(x, 64)).items()}
+    for key in manual:                         # same bucket → bitwise
+        np.testing.assert_array_equal(np.asarray(padded[key]),
+                                      manual[key])
+
+    direct = scorer.score(x)                   # different shape → ~1 ulp
+    for key in manual:
+        np.testing.assert_allclose(np.asarray(padded[key]),
+                                   np.asarray(direct[key]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher units
+# ---------------------------------------------------------------------------
+
+def _echo_batcher(max_rows, max_delay, depth=64):
+    def score_batch(batch):
+        for r in batch:
+            r.resolve(r.blocks["x"] * 2.0)
+    mb = MicroBatcher(score_batch, max_rows=max_rows,
+                      max_delay=max_delay, depth=depth)
+    mb.start()
+    return mb
+
+
+def test_batcher_deadline_flush():
+    """A lone request is not held past the opener's deadline even when
+    the bucket never fills."""
+    mb = _echo_batcher(max_rows=512, max_delay=0.08)
+    try:
+        t0 = time.monotonic()
+        req = mb.submit({"x": np.ones((2, 3), np.float32)}, 2)
+        out = req.wait(10.0)
+        waited = time.monotonic() - t0
+        np.testing.assert_array_equal(out, np.full((2, 3), 2.0))
+        assert waited < 5.0, "deadline flush did not fire"
+        assert req.timing["queue_s"] >= 0.05, \
+            "lone request should ride out the full admission window"
+        assert mb.stats()["batches"] == 1
+    finally:
+        mb.close()
+
+
+def test_batcher_bucket_fill_flushes_early():
+    """Once queued rows reach the top bucket the batch launches without
+    waiting for a (deliberately huge) deadline."""
+    mb = _echo_batcher(max_rows=8, max_delay=30.0)
+    try:
+        t0 = time.monotonic()
+        r1 = mb.submit({"x": np.ones((4, 2), np.float32)}, 4)
+        r2 = mb.submit({"x": np.ones((4, 2), np.float32)}, 4)
+        r1.wait(10.0)
+        r2.wait(10.0)
+        assert time.monotonic() - t0 < 10.0, \
+            "full bucket waited for the deadline"
+        s = mb.stats()
+        assert s["batches"] == 1 and s["requests"] == 2 and s["rows"] == 8
+    finally:
+        mb.close()
+
+
+def test_batcher_ordering_and_carry():
+    """Each request gets exactly its own rows back; a co-rider that
+    would overflow the bucket opens the NEXT batch (FIFO preserved)."""
+    got = []
+
+    def score_batch(batch):
+        got.append([r.n for r in batch])
+        off = 0
+        for r in batch:
+            r.resolve(r.blocks["x"] + 100.0)
+            off += r.n
+
+    mb = MicroBatcher(score_batch, max_rows=8, max_delay=0.2, depth=64)
+    mb.start()
+    try:
+        reqs = [mb.submit({"x": np.full((4, 2), float(i), np.float32)}, 4)
+                for i in range(3)]
+        outs = [r.wait(10.0) for r in reqs]
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(
+                out, np.full((4, 2), 100.0 + i, np.float32))
+        flat = [n for b in got for n in b]
+        assert flat == [4, 4, 4], f"requests reordered/split: {got}"
+        assert len(got) >= 2, "third request must overflow to batch 2"
+    finally:
+        mb.close()
+
+
+def test_batcher_backpressure_and_close():
+    """Bounded admission queue: overflow is a prompt `queue.Full`, and
+    close() rejects stragglers instead of stranding them."""
+    gate = threading.Event()
+
+    def score_batch(batch):
+        gate.wait(30.0)
+        for r in batch:
+            r.resolve(r.blocks["x"])
+
+    mb = MicroBatcher(score_batch, max_rows=4, max_delay=0.01, depth=1)
+    mb.start()
+    try:
+        first = mb.submit({"x": np.ones((4, 1), np.float32)}, 4)
+        deadline = time.monotonic() + 10.0
+        while mb.stats()["batches"] < 1:     # consumer holds `first`
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        second = mb.submit({"x": np.ones((4, 1), np.float32)}, 4)
+        with pytest.raises(queue.Full):
+            mb.submit({"x": np.ones((4, 1), np.float32)}, 4)
+        gate.set()
+        first.wait(10.0)
+        second.wait(10.0)
+    finally:
+        gate.set()
+        mb.close()
+    with pytest.raises(RuntimeError):
+        mb.submit({"x": np.ones((1, 1), np.float32)}, 1)
+
+
+def test_batcher_row_bounds():
+    mb = _echo_batcher(max_rows=8, max_delay=0.01)
+    try:
+        with pytest.raises(ValueError):
+            mb.submit({"x": np.ones((9, 1), np.float32)}, 9)
+        with pytest.raises(ValueError):
+            mb.submit({"x": np.ones((1, 1), np.float32)}, 0)
+    finally:
+        mb.close()
+
+
+# ---------------------------------------------------------------------------
+# Service parity: served == batch eval == portable / PMML
+# ---------------------------------------------------------------------------
+
+def test_served_bitmatch_nn_and_portable(model_set):
+    """A served request bit-matches batch eval scored at the same
+    bucket (the padded path eval itself uses); ragged concurrent
+    submits reassemble to the same scores up to XLA scheduling noise;
+    and the numpy-only portable scorer agrees."""
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.portable import PortableScorer
+    from shifu_tpu.serve.service import ScorerService
+
+    _pipeline(model_set)
+    ctx, data, meta = _norm_blocks(model_set)
+    dense = np.asarray(data["dense"], np.float32)[:96]
+    models = ctx.path_finder.models_path()
+    scorer = Scorer.from_dir(models)
+    batch = {k: np.asarray(v)
+             for k, v in aot.padded_call(scorer.score, dense.shape[0],
+                                         {"dense": dense}).items()}
+
+    svc = ScorerService(models_dir=models, max_delay=0.005)
+    with svc:
+        # leg 1 — whole block as one request: same bucket as the
+        # padded batch-eval call above, so every column is bitwise
+        whole = svc.submit(dense=dense, timeout=60.0)
+        for key in batch:
+            np.testing.assert_array_equal(
+                np.asarray(whole[key]), batch[key],
+                err_msg=f"served {key} deviates from batch eval")
+
+        # leg 2 — ragged concurrent submits: micro-batches land on
+        # arrival-dependent buckets, bounded by scheduling noise
+        pieces = _ragged_pieces(dense.shape[0])
+        reqs = [None] * len(pieces)
+
+        def submit(i, lo, hi):
+            reqs[i] = svc.submit_async(dense=dense[lo:hi])
+
+        threads = [threading.Thread(target=submit, args=(i, lo, hi))
+                   for i, (lo, hi) in enumerate(pieces)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        outs = [r.wait(60.0) for r in reqs]
+
+    for key in batch:
+        served = np.concatenate([np.asarray(o[key]) for o in outs])
+        np.testing.assert_allclose(
+            served, batch[key], rtol=1e-6, atol=1e-7,
+            err_msg=f"ragged served {key} deviates from batch eval")
+
+    portable = PortableScorer(models).score(dense=dense)["mean"]
+    np.testing.assert_allclose(np.asarray(whole["mean"]), portable,
+                               rtol=1e-5, atol=1e-6)
+
+    stats = svc.stats()
+    assert stats["warmed_buckets"] == len(stats["ladder"])
+    assert stats["aot_executables"] == len(stats["ladder"])  # 1 NN model
+
+
+def test_served_bitmatch_gbt(tmp_path, rng):
+    """Tree ensembles serve raw blocks; padding by repeating the last
+    row cannot move any per-row tree walk, so RAW scores bit-match."""
+    from tests.synth import make_model_set
+
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.processor import norm as norm_proc
+    from shifu_tpu.processor.norm import load_dataset_for_columns
+    from shifu_tpu.serve.service import ScorerService
+
+    root = make_model_set(tmp_path, rng, n_rows=1200, algorithm="GBT",
+                          train_params={"TreeNum": 4, "MaxDepth": 3,
+                                        "LearningRate": 0.1,
+                                        "Loss": "squared"})
+    _pipeline(root)
+    ctx = ProcessorContext.load(root)
+    cols = norm_proc.selected_candidates(ctx.column_configs)
+    dset = load_dataset_for_columns(ctx.model_config, ctx.column_configs,
+                                    cols)
+    if dset.cat_codes.shape[1]:
+        vlen = np.asarray([len(v) for v in dset.vocabs], np.int32)
+        raw_codes = np.where(dset.cat_codes < 0, vlen[None, :],
+                             dset.cat_codes).astype(np.int32)
+    else:
+        raw_codes = dset.cat_codes
+    numeric = np.asarray(dset.numeric, np.float32)[:80]
+    raw_codes = np.asarray(raw_codes)[:80]
+
+    models = ctx.path_finder.models_path()
+    scorer = Scorer.from_dir(models)
+    blocks = {"raw_dense": numeric, "raw_codes": raw_codes}
+    batch = aot.padded_call(
+        lambda raw_dense=None, raw_codes=None: scorer.score(
+            raw_dense, raw_dense=raw_dense, raw_codes=raw_codes),
+        numeric.shape[0], blocks)["mean"]
+
+    svc = ScorerService(models_dir=models, max_delay=0.005)
+    with svc.start(proto={"raw_dense": numeric[:1],
+                          "raw_codes": raw_codes[:1]}):
+        whole = svc.submit(raw_dense=numeric, raw_codes=raw_codes,
+                           timeout=60.0)
+        np.testing.assert_array_equal(np.asarray(whole["mean"]),
+                                      np.asarray(batch))
+        outs = [svc.submit(raw_dense=numeric[lo:hi],
+                           raw_codes=raw_codes[lo:hi], timeout=60.0)
+                for lo, hi in _ragged_pieces(numeric.shape[0])]
+    served = np.concatenate([np.asarray(o["mean"]) for o in outs])
+    np.testing.assert_allclose(served, np.asarray(batch),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_served_matches_pmml_external_eval(model_set):
+    """Scores served over the wire-facing path agree with the exported
+    PMML document evaluated from RAW records — the cross-stack
+    conformance gate, at the jpmml tolerances."""
+    from shifu_tpu import pmml as pmml_mod
+    from shifu_tpu.data.dataset import build_columnar
+    from shifu_tpu.eval.model_runner import ModelRunner
+    from shifu_tpu.processor import norm as norm_proc
+    from shifu_tpu.serve.service import ScorerService
+    from tests.test_portable_pmml import _raw_eval_frame
+
+    _pipeline(model_set)
+    assert cli_main(["--dir", model_set, "export", "-t", "pmml"]) == 0
+    ctx = ProcessorContext.load(model_set)
+    df = _raw_eval_frame(model_set).head(48)
+    pmml_scores = pmml_mod.evaluate_pmml(
+        open(ctx.path_finder.pmml_path(0)).read(), df.copy())
+
+    # the exact preprocessing ModelRunner.score_frame applies
+    runner = ModelRunner.from_model_set(model_set)
+    frame = df.copy()
+    for c in runner.cols:
+        if c.columnName not in frame.columns:
+            frame = frame.assign(**{c.columnName: ""})
+    dset = build_columnar(
+        runner.mc, norm_proc._restrict(runner.ccs, runner.cols),
+        frame.astype(str),
+        vocabs={c.columnNum: (c.columnBinning.binCategory or [])
+                for c in runner.cols if c.is_categorical})
+    result = norm_proc.normalize_columns(runner.mc, runner.cols, dset)
+    dense = np.asarray(result.dense, np.float32)
+
+    svc = ScorerService(models_dir=ctx.path_finder.models_path(),
+                        max_delay=0.005)
+    with svc:
+        outs = [svc.submit(dense=dense[lo:hi], timeout=60.0)
+                for lo, hi in _ragged_pieces(dense.shape[0])]
+    served = np.concatenate([np.asarray(o["mean"]) for o in outs])
+
+    want = runner.score_frame(df.copy())["mean"]   # unpadded shape
+    np.testing.assert_allclose(served, np.asarray(want),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(served, pmml_scores, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection + schema guard
+# ---------------------------------------------------------------------------
+
+def test_serve_request_fault_hits_one_caller(tmp_path, monkeypatch):
+    """`serve.request:oserror:1` fails exactly the first submit; the
+    service stays healthy for the next one."""
+    from shifu_tpu.serve.service import ScorerService
+
+    models = _tiny_nn_dir(str(tmp_path / "models"))
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "serve.request:oserror:1")
+    resilience.reset_faults()
+    x = np.zeros((2, 12), np.float32)
+    with ScorerService(models_dir=models, max_delay=0.005,
+                       aot_compile=False) as svc:
+        with pytest.raises(OSError):
+            svc.submit(dense=x)
+        out = svc.submit(dense=x, timeout=30.0)
+        assert np.asarray(out["mean"]).shape == (2,)
+
+
+def test_service_schema_guard(tmp_path):
+    from shifu_tpu.serve.service import ScorerService
+
+    models = _tiny_nn_dir(str(tmp_path / "models"))
+    with ScorerService(models_dir=models, max_delay=0.005,
+                       aot_compile=False) as svc:
+        svc.submit(dense=np.zeros((1, 12), np.float32), timeout=30.0)
+        with pytest.raises(ValueError):        # schema mismatch
+            svc.submit(dense=np.zeros((1, 12), np.float32),
+                       raw_dense=np.zeros((1, 12), np.float32))
+        with pytest.raises(ValueError):        # row-count disagreement
+            svc.submit_async(dense=np.zeros((2, 12), np.float32),
+                             index=np.zeros((3, 1), np.int32))
+        with pytest.raises(ValueError):        # no blocks at all
+            svc.submit_async()
+
+
+# ---------------------------------------------------------------------------
+# AOT warm start: steady state never recompiles
+# ---------------------------------------------------------------------------
+
+def test_warm_start_zero_steady_state_cache_misses(tmp_path):
+    """After a second service start against the same workspace warms
+    every bucket, ragged traffic triggers ZERO compile-cache misses —
+    the core latency guarantee of the AOT layer."""
+    from shifu_tpu.serve.service import ScorerService
+
+    models = _tiny_nn_dir(str(tmp_path / "models"))
+    ws = str(tmp_path / "ws")
+
+    with ScorerService(models_dir=models, workspace_root=ws,
+                       max_delay=0.005) as svc:
+        svc.submit(dense=np.zeros((3, 12), np.float32), timeout=30.0)
+
+    # second start of the same service shape: warm-up repopulates the
+    # in-process caches (reading the persistent cache where eligible)
+    svc = ScorerService(models_dir=models, workspace_root=ws,
+                        max_delay=0.005)
+    with svc:
+        pipeline.drain_stage_timers()          # discard warm-up compiles
+        rng = np.random.default_rng(0)
+        for n in (1, 3, 7, 8, 13, 64, 100, 512):
+            out = svc.submit(
+                dense=rng.normal(0, 1, (n, 12)).astype(np.float32),
+                timeout=60.0)
+            assert np.asarray(out["mean"]).shape == (n,)
+        steady = pipeline.drain_stage_timers()
+
+    assert steady.get("compile_cache_misses", 0) == 0, \
+        f"steady-state traffic recompiled: {steady}"
+    assert steady.get("serve_batches", 0) >= 1
+    assert steady.get("serve_device_s", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Batch eval rides the same pad helper
+# ---------------------------------------------------------------------------
+
+def test_eval_pad_buckets_score_parity(model_set, monkeypatch):
+    """Chunked `shifu eval` with SHIFU_TPU_EVAL_PAD_BUCKETS on vs off
+    scores every row identically (up to the ~1-ulp XLA scheduling
+    noise a shape change can introduce, far below the %.6f the score
+    file carries) — bucket padding is a compile-count optimization,
+    not a numerics change."""
+    _pipeline(model_set)
+    ctx = ProcessorContext.load(model_set)
+    score_path = ctx.path_finder.eval_score_path("Eval1")
+    monkeypatch.setenv("SHIFU_TPU_EVAL_CHUNK_ROWS", "96")  # ragged tail
+
+    monkeypatch.setenv("SHIFU_TPU_EVAL_PAD_BUCKETS", "0")
+    assert cli_main(["--dir", model_set, "eval"]) == 0
+    unpadded = pd.read_csv(score_path)
+
+    monkeypatch.setenv("SHIFU_TPU_EVAL_PAD_BUCKETS", "1")
+    assert cli_main(["--dir", model_set, "eval"]) == 0
+    padded = pd.read_csv(score_path)
+
+    assert list(padded.columns) == list(unpadded.columns)
+    assert len(padded) == len(unpadded)
+    for col in padded.columns:
+        if padded[col].dtype.kind in "fc":
+            np.testing.assert_allclose(padded[col], unpadded[col],
+                                       rtol=0, atol=1.1e-6)
+        else:
+            assert (padded[col] == unpadded[col]).all(), col
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+def test_http_front_end_roundtrip(tmp_path):
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.serve.http import HttpFrontEnd
+    from shifu_tpu.serve.service import ScorerService
+
+    models = _tiny_nn_dir(str(tmp_path / "models"))
+    x = np.random.default_rng(5).normal(0, 1, (5, 12)).astype(np.float32)
+    want = np.asarray(Scorer.from_dir(models).score(x)["mean"])
+
+    with ScorerService(models_dir=models, max_delay=0.005,
+                       aot_compile=False) as svc:
+        front = HttpFrontEnd(svc, host="127.0.0.1", port=0).start()
+        try:
+            host, port = front.address
+            base = f"http://{host}:{port}"
+
+            body = json.dumps({"dense": x.tolist()}).encode()
+            req = urllib.request.Request(
+                base + "/score", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+            np.testing.assert_allclose(
+                np.asarray(payload["scores"]["mean"], np.float64),
+                want, rtol=1e-6, atol=1e-7)  # json float round-trip
+            assert {"queue_s", "pad_s", "device_s",
+                    "total_s"} <= set(payload["timing_ms"])
+            assert payload["timing_ms"]["total_s"] > 0
+
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+
+            with urllib.request.urlopen(base + "/stats",
+                                        timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert stats["batcher"]["requests"] >= 1
+
+            bad = urllib.request.Request(
+                base + "/score", data=b'{"dense": "nope"}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            front.close()

@@ -27,6 +27,10 @@ backticked README token that LOOKS like a roofline field (matches a
 member) is cross-checked so a renamed field fails here before it
 ships stale docs.
 
+The serving bench record is pinned likewise: its schema is
+``profiling.SERVING_FIELDS`` (AST-read), every field must be
+README-documented, and bench.py must build the record from the tuple.
+
 Optionally pass a real steps.jsonl to ALSO verify against a live log
 (every documented field must appear in at least one record's
 ``inputPipeline`` block across the file, and any record carrying a
@@ -94,18 +98,27 @@ def emitted_fields() -> set:
     return out
 
 
-def roofline_fields() -> tuple:
-    """profiling.ROOFLINE_FIELDS, read from the AST so this gate keeps
-    working without importing jax-adjacent modules."""
+def _profiling_tuple(name: str) -> tuple:
+    """A module-level tuple constant from profiling.py, read from the
+    AST so this gate keeps working without importing jax-adjacent
+    modules."""
     path = os.path.join(PKG, "profiling.py")
     with open(path, encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=path)
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "ROOFLINE_FIELDS"
+                isinstance(t, ast.Name) and t.id == name
                 for t in node.targets):
             return tuple(ast.literal_eval(node.value))
-    raise SystemExit("profiling.py no longer defines ROOFLINE_FIELDS")
+    raise SystemExit(f"profiling.py no longer defines {name}")
+
+
+def roofline_fields() -> tuple:
+    return _profiling_tuple("ROOFLINE_FIELDS")
+
+
+def serving_fields() -> tuple:
+    return _profiling_tuple("SERVING_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -122,6 +135,33 @@ def check_roofline_docs() -> int:
         return 1
     print(f"roofline block: all {len(fields)} ROOFLINE_FIELDS "
           "documented in README")
+    return 0
+
+
+def check_serving_docs() -> int:
+    """Every SERVING_FIELDS member (bench.py task_serving's record
+    schema) must be backtick-documented in README's Serving section,
+    and task_serving must build its record from the tuple — the AST
+    check asserts bench.py subscripts `profiling.SERVING_FIELDS` (or
+    iterates it) so the record cannot silently drift from the pinned
+    schema."""
+    fields = serving_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("serving schema drift: SERVING_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "SERVING_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the serving record from "
+              "profiling.SERVING_FIELDS", file=sys.stderr)
+        return 1
+    print(f"serving bench: all {len(fields)} SERVING_FIELDS documented "
+          "in README and pinned in bench.py")
     return 0
 
 
@@ -177,6 +217,8 @@ def main(argv) -> int:
     print(f"steps.jsonl schema: {len(doc)} documented stage fields, "
           f"all within the {len(emit)}-key emitted vocabulary")
     if check_roofline_docs():
+        return 1
+    if check_serving_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
