@@ -269,8 +269,15 @@ def _latest_persisted(task, backend_filter=None):
 def task_probe():
     import jax
     jax.numpy.zeros((8, 8)).block_until_ready()
-    print(json.dumps({"backend": jax.default_backend(),
-                      "n_devices": jax.local_device_count()}))
+    rec = {"backend": jax.default_backend(),
+           "n_devices": jax.local_device_count()}
+    try:
+        from shifu_tpu.parallel import mesh as mesh_mod
+        rec["mesh"] = mesh_mod.mesh_topology(mesh_mod.default_mesh())
+        rec["meshRules"] = mesh_mod.default_rules().to_dict()
+    except Exception as e:  # noqa: BLE001 — topology is informational
+        rec["meshError"] = str(e)
+    print(json.dumps(rec))
 
 
 def _delta_timed(measure, short_epochs: int, long_epochs: int):

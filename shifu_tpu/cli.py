@@ -253,6 +253,42 @@ def cmd_show(args) -> int:
     return p.show(_ctx(args))
 
 
+def cmd_ckpt(args) -> int:
+    """Checkpoint inventory + topology: one JSON record per bag with
+    the latest restorable step and the sharding sidecar's provenance
+    (the mesh that wrote it, its logical→physical rules, how many
+    leaves were device-sharded) — answers "what topology wrote this,
+    and can the current fleet restore it?" without touching devices
+    (elastic restores re-resolve the sidecar onto whatever mesh the
+    restarted fleet actually has)."""
+    import json
+    from shifu_tpu.processor.base import ProcessorContext
+    from shifu_tpu.train import checkpoint as ckpt_mod
+    ctx = ProcessorContext.load(args.dir, need_columns=False)
+    n_bags = max(ctx.model_config.train.baggingNum, 1)
+    records = []
+    for bag in range(n_bags):
+        d = ctx.path_finder.checkpoint_path(bag)
+        step = ckpt_mod.latest_step(d)
+        if step is None:
+            continue
+        rec = {"bag": bag, "dir": d, "latestStep": step}
+        meta = ckpt_mod.load_sharding_meta(d, step)
+        if meta is None:
+            rec["sharding"] = None   # pre-sidecar or all-host state:
+            # restores replicated on any mesh
+        else:
+            rec["sharding"] = {
+                "mesh": meta.get("mesh"),
+                "rules": meta.get("rules"),
+                "shardedLeaves": sum(1 for v in meta.get("leaves",
+                                                         {}).values() if v),
+                "deviceLeaves": len(meta.get("leaves", {}))}
+        records.append(rec)
+    print(json.dumps({"checkpoints": records}, indent=1))
+    return 0
+
+
 def cmd_version(args) -> int:
     import shifu_tpu
     print(f"shifu-tpu {shifu_tpu.__version__}")
@@ -422,6 +458,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit the markdown table (same as python -m "
                         "shifu_tpu.analysis --knobs-md)")
     p.set_defaults(fn=cmd_knobs)
+    sub.add_parser("ckpt",
+                   help="checkpoint inventory: latest step + the mesh "
+                        "topology that wrote it (sharding sidecar)") \
+        .set_defaults(fn=cmd_ckpt)
     sub.add_parser("version").set_defaults(fn=cmd_version)
     return ap
 
@@ -484,6 +524,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         log.warning("preempted: %s — exiting rc=%d; rerun with "
                     "SHIFU_TPU_RESUME=1 to resume", e,
                     resilience.PREEMPT_RC)
+        # multi-host: peers exit first, the coordinator (process 0)
+        # last — its death tears down the jax coordination service and
+        # SIGABRTs any peer still inside a collective
+        resilience.preempt_exit_sync()
         return resilience.PREEMPT_RC
     except (FileNotFoundError, ValueError, NotImplementedError) as e:
         log.error("%s", e)

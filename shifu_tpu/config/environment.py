@@ -149,6 +149,11 @@ _declare("SHIFU_TPU_COMPILE_CACHE_DIR", "str", None,
 _declare("SHIFU_TPU_COMPILE_CACHE_MIN_S", "float", 0.0,
          "minimum compile seconds before a kernel is cached "
          "(jax_persistent_cache_min_compile_time_secs)")
+_declare("SHIFU_TPU_COMPILE_CACHE_SHARED", "str", None,
+         "shared (possibly scheme://) compile-cache dir mirrored into "
+         "the local cache at startup and published back with atomic "
+         "single-writer-safe commits; a scheme:// "
+         "SHIFU_TPU_COMPILE_CACHE_DIR routes here automatically")
 # --- distributed runtime ---
 _declare("SHIFU_TPU_COORDINATOR", "str", None,
          "coordinator address for jax.distributed.initialize")
@@ -164,6 +169,14 @@ _declare("SHIFU_TPU_MESH_DEVICES", "int", None,
          "cap the device count in the default mesh (None = all)")
 _declare("SHIFU_TPU_MESH_MODEL", "int", 1,
          "devices on the 'model' mesh axis (WDL/MTL table sharding)")
+_declare("SHIFU_TPU_MESH_RULES", "str", None,
+         "logical→physical axis overrides 'logical=axis[,...]' "
+         "(empty axis = replicate); unset = rows=data, hidden/cat/"
+         "task=model")
+_declare("SHIFU_TPU_PREEMPT_GRACE_S", "float", 15.0,
+         "after observing a peer's preempt marker inside a watched "
+         "collective, seconds to wait for the collective before "
+         "raising Preempted (rc 75) directly")
 # --- input pipeline ---
 _declare("SHIFU_TPU_PREFETCH_DEPTH", "int", 2,
          "chunks buffered ahead of the consumer; 0 = sequential")

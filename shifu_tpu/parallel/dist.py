@@ -18,9 +18,16 @@ dumps every Python thread's stack to stderr + ``steps.jsonl`` and
 raises `DistTimeout`; on a peer's abort marker it raises `DistAborted`
 carrying the peer's original error. `single_writer` publishes that
 marker when its body raises, so one host's exception becomes a clean
-same-error abort on every host instead of a pod-wide deadlock. Fault
-sites ``dist.init``, ``dist.barrier``, ``dist.allgather`` make all of
-this testable single-process.
+same-error abort on every host instead of a pod-wide deadlock. The
+watchdog also polls the PREEMPT marker (`resilience.publish_preempt`):
+a SIGTERM'd peer's broadcast sets this host's preempt flag so both
+take the epoch-boundary checkpoint-and-exit(75) path together, and if
+the collective stays blocked past SHIFU_TPU_PREEMPT_GRACE_S the peer
+is gone and `Preempted` raises directly — cluster-wide preemption
+consensus. `initialize` itself runs under the same watchdog with its
+own deadline (SHIFU_TPU_INIT_TIMEOUT_S + margin). Fault sites
+``dist.init``, ``dist.barrier``, ``dist.allgather``,
+``dist.preempt_marker`` make all of this testable single-process.
 """
 
 from __future__ import annotations
@@ -88,17 +95,43 @@ def _abort_error(tag: str, ab: dict) -> "DistAborted":
         f"the same error instead of hanging at {tag!r}")
 
 
-def _watched(tag: str, fn: Callable):
-    """Run a blocking collective on a daemon thread while this thread
-    polls (a) completion, (b) the shared abort marker, (c) the
-    SHIFU_TPU_BARRIER_TIMEOUT_S deadline. Exceptions from the
-    collective re-raise here; an expired deadline dumps all thread
-    stacks and raises `DistTimeout`; a peer's abort marker raises
-    `DistAborted`. With no timeout set the deadline check is off but
-    abort polling still runs — a poisoned barrier never needs the
-    timeout to fail cleanly."""
+def _observe_preempt(tag: str) -> bool:
+    """Join a peer's broadcast preemption: when a preempt marker from
+    ANOTHER process exists, set this process's preempt flag so its
+    epoch loop takes the same checkpoint-and-exit(75) path at the next
+    boundary. Returns True when a peer marker is present."""
     from shifu_tpu import resilience
-    timeout = barrier_timeout_s()
+    pm = resilience.check_preempt_marker()
+    if not pm or pm.get("process") == _my_index():
+        return False
+    if not resilience.preempt_requested():
+        log.warning(
+            "peer process %s published a preemption notice (%s) while "
+            "this host waited at %r — joining the cluster-wide "
+            "checkpoint-and-exit(rc=%d) at the next epoch boundary",
+            pm.get("process"), pm.get("note", ""), tag,
+            resilience.PREEMPT_RC)
+        resilience.request_preempt()
+    return True
+
+
+def _watched(tag: str, fn: Callable, timeout_s: Optional[float] = None):
+    """Run a blocking collective on a daemon thread while this thread
+    polls (a) completion, (b) the shared abort AND preempt markers,
+    (c) the deadline — `timeout_s` when given (dist.init's own knob),
+    else SHIFU_TPU_BARRIER_TIMEOUT_S. Exceptions from the collective
+    re-raise here; an expired deadline dumps all thread stacks and
+    raises `DistTimeout`; a peer's abort marker raises `DistAborted`.
+    A peer's PREEMPT marker first just sets the local preempt flag
+    (the collective normally completes — the preempting host finishes
+    its epoch before exiting); if the collective is still blocked
+    SHIFU_TPU_PREEMPT_GRACE_S later, the peer is gone and this raises
+    `Preempted` directly so the host still exits rc 75, not a timeout.
+    With no timeout set the deadline check is off but marker polling
+    still runs — a poisoned barrier never needs the timeout to fail
+    cleanly."""
+    from shifu_tpu import resilience
+    timeout = barrier_timeout_s() if timeout_s is None else timeout_s
     box: dict = {}
     done = threading.Event()
 
@@ -120,7 +153,9 @@ def _watched(tag: str, fn: Callable):
     t.start()
     try:
         deadline = None if timeout is None else time.monotonic() + timeout
+        grace = knob_float("SHIFU_TPU_PREEMPT_GRACE_S")
         last_abort_check = 0.0
+        preempt_seen_at = None
         while not done.wait(0.1):
             now = time.monotonic()
             if now - last_abort_check >= 0.5:
@@ -128,6 +163,17 @@ def _watched(tag: str, fn: Callable):
                 ab = resilience.check_abort()
                 if ab and ab.get("process") != _my_index():
                     raise _abort_error(tag, ab)
+                if _observe_preempt(tag):
+                    if preempt_seen_at is None:
+                        preempt_seen_at = now
+                    elif grace is not None and \
+                            now - preempt_seen_at > grace:
+                        raise resilience.Preempted(
+                            f"peer preemption consensus: collective "
+                            f"{tag!r} still blocked "
+                            f"{now - preempt_seen_at:.1f}s after a "
+                            "peer's preempt marker — the peer has "
+                            "exited; stopping with the same rc")
             if deadline is not None and now > deadline:
                 stuck = inflight_collectives()
                 resilience.dump_thread_stacks(
@@ -142,6 +188,10 @@ def _watched(tag: str, fn: Callable):
                     "stderr and steps.jsonl")
         if "error" in box:
             raise box["error"]
+        # a collective can complete before the first 0.5s poll tick —
+        # one final check so even fast collectives observe a peer's
+        # preemption and set the local flag for the next boundary
+        _observe_preempt(tag)
         return box.get("value")
     finally:
         with _inflight_lock:
@@ -173,10 +223,19 @@ def initialize(coordinator_address: Optional[str] = None,
     timeout_s = knob_float("SHIFU_TPU_INIT_TIMEOUT_S")
     if timeout_s:
         kwargs["initialization_timeout"] = int(timeout_s)
+    # the handshake runs under the collective watchdog with its OWN
+    # deadline (the init knob + margin, so jax's native timeout error
+    # wins when it works) — jax builds whose initialization_timeout
+    # does not cover every internal wait can otherwise still hang a
+    # pod bring-up forever
+    watchdog_s = (timeout_s + 30.0) if timeout_s else None
     try:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id, **kwargs)
+        _watched("dist.init", lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id, **kwargs), timeout_s=watchdog_s)
+    except (DistTimeout, DistAborted):
+        raise    # already self-describing, with stacks dumped
     except Exception as e:
         raise RuntimeError(
             f"distributed initialize failed (coordinator="
@@ -245,12 +304,13 @@ def writer_barrier(tag: str) -> None:
         from jax.experimental import multihost_utils
         _watched(tag, lambda: multihost_utils.sync_global_devices(tag))
         # the barrier itself released: a peer may still have published
-        # an abort between our poll ticks — one last check so every
-        # host leaves with the same verdict
+        # an abort or preemption between our poll ticks — one last
+        # check so every host leaves with the same verdict
         from shifu_tpu import resilience
         ab = resilience.check_abort()
         if ab and ab.get("process") != _my_index():
             raise _abort_error(tag, ab)
+        _observe_preempt(tag)
 
 
 @contextmanager

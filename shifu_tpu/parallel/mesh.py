@@ -21,16 +21,94 @@ Axes:
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import logging
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from shifu_tpu.config.environment import knob_int
+from shifu_tpu.config.environment import knob_int, knob_str
+
+log = logging.getLogger("shifu_tpu")
 
 
 _MESH_CACHE: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# logical→physical axis rules
+# ---------------------------------------------------------------------------
+
+# the LOGICAL tensor-dimension names the layouts below speak, mapped to
+# the physical mesh axis each shards over (None = replicate). Layouts
+# written against these names re-resolve on whatever mesh the process
+# actually has, which is what makes a checkpoint's sharding sidecar
+# topology-portable: "rows over 'data', hidden units over 'model'" is
+# meaningful on 1, 4, 8 or 16 devices, while "split 2 ways over chips
+# 6-7" is not.
+_DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "rows": "data",      # feature-matrix rows (the worker-split axis)
+    "hidden": "model",   # MLP hidden units (Megatron split)
+    "cat": "model",      # WDL per-column embedding/wide tables
+    "task": "model",     # MTL per-task head rows
+}
+
+
+class MeshRules:
+    """Logical→physical mesh-axis mapping. `rules("rows", "hidden")`
+    resolves logical tensor-dimension names to physical axis names
+    (unknown names resolve to None = replicated); `rules.spec(...)`
+    wraps the resolution in a PartitionSpec. Overrides come from
+    SHIFU_TPU_MESH_RULES ("hidden=,cat=data" — an empty right side
+    replicates that logical axis)."""
+
+    def __init__(self, overrides: Optional[Dict[str, Optional[str]]] = None):
+        self._rules = dict(_DEFAULT_RULES)
+        if overrides:
+            self._rules.update(overrides)
+
+    def __call__(self, *logical: Optional[str]) -> Tuple[Optional[str], ...]:
+        # a physical mesh axis may shard at most one positional dim; the
+        # first logical name to claim it wins, later claims replicate
+        out: list = []
+        used: set = set()
+        for n in logical:
+            ax = self._rules.get(n) if n else None
+            if ax is not None and ax in used:
+                ax = None
+            if ax is not None:
+                used.add(ax)
+            out.append(ax)
+        return tuple(out)
+
+    def spec(self, *logical: Optional[str]) -> P:
+        return P(*self(*logical))
+
+    def to_dict(self) -> Dict[str, Optional[str]]:
+        return dict(self._rules)
+
+
+def _parse_rules_env(raw: str) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad SHIFU_TPU_MESH_RULES entry {part!r}: want "
+                "logical=physical (empty physical = replicate)")
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip() or None
+    return out
+
+
+def default_rules() -> MeshRules:
+    """The process-wide rules: package defaults plus any
+    SHIFU_TPU_MESH_RULES overrides."""
+    raw = knob_str("SHIFU_TPU_MESH_RULES")
+    return MeshRules(_parse_rules_env(raw) if raw else None)
 
 
 def default_mesh() -> Mesh:
@@ -97,16 +175,84 @@ def place_replicated(mesh: Mesh, tree):
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a ("data", "model") mesh. Defaults to all devices on the
-    data axis — pure data parallel, the reference's only strategy."""
+    """Build a 2-D ("data", "model") DCN×ICI mesh. Defaults to all
+    devices on the data axis — pure data parallel, the reference's only
+    strategy.
+
+    Multi-host, devices are ordered host-major (process_index, id) so
+    every model-axis group of `n_model` devices lives within ONE host:
+    the model axis's per-step collectives (the Megatron all-reduce
+    pair, WDL table gathers) ride ICI, and only the data axis's
+    gradient mean crosses the slower DCN — the layout MULTICHIP_r05's
+    data=4×model=2 run validated. `n_model` must then divide each
+    host's local device count (a model group spanning two hosts would
+    put the hottest collective on the coldest link)."""
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
         n_data = len(devices) // n_model
     assert n_data * n_model <= len(devices), \
         f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, " \
         f"have {len(devices)}"
-    arr = np.asarray(devices[:n_data * n_model]).reshape(n_data, n_model)
+    devices = devices[:n_data * n_model]
+    n_hosts = len({getattr(d, "process_index", 0) for d in devices})
+    if n_hosts > 1:
+        devices = sorted(
+            devices, key=lambda d: (d.process_index, d.id))
+        local = len(devices) // n_hosts
+        per_host: Dict[int, int] = {}
+        for d in devices:
+            per_host[d.process_index] = per_host.get(d.process_index, 0) + 1
+        if any(c != local for c in per_host.values()) or \
+                n_model > local or local % n_model:
+            raise ValueError(
+                f"mesh {n_data}x{n_model} over {n_hosts} hosts: the "
+                f"model axis ({n_model}) must divide each host's local "
+                f"device count ({sorted(per_host.values())}) so model "
+                "collectives stay on ICI; shrink SHIFU_TPU_MESH_MODEL "
+                "or rebalance hosts")
+    arr = np.asarray(devices).reshape(n_data, n_model)
     return Mesh(arr, ("data", "model"))
+
+
+def mesh_topology(mesh: Mesh) -> dict:
+    """JSON-ready description of a mesh — the checkpoint sidecar's
+    provenance record and the bench/CLI topology report."""
+    return {"axes": list(mesh.axis_names),
+            "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+            "devices": int(mesh.devices.size),
+            "hosts": len({getattr(d, "process_index", 0)
+                          for d in mesh.devices.flat})}
+
+
+def resolve_spec(mesh: Mesh, entries, shape, label: str = "") -> P:
+    """Re-resolve a RECORDED PartitionSpec (a list of axis names /
+    name-tuples / None, as the checkpoint sidecar stores it) against
+    the CURRENT mesh: an axis name survives only when this mesh has an
+    axis of that name AND the leaf dimension divides its size; anything
+    else replicates, loudly when it used to shard — save on
+    data=4×model=2, restore on a 1-, 4- or 16-device mesh."""
+    out = []
+    for i, entry in enumerate(entries):
+        if entry is None:
+            out.append(None)
+            continue
+        names = [entry] if isinstance(entry, str) else list(entry)
+        kept = [n for n in names if n in mesh.shape]
+        size = int(np.prod([mesh.shape[n] for n in kept])) if kept else 1
+        if kept and i < len(shape) and shape[i] % size == 0:
+            out.append(kept[0] if len(kept) == 1 else tuple(kept))
+        else:
+            if names and size > 1:
+                log.warning(
+                    "reshard: %s dim %d (length %s) cannot shard over "
+                    "mesh axes %s on this %s-device mesh — replicating "
+                    "that dimension", label or "a leaf", i,
+                    shape[i] if i < len(shape) else "?", names,
+                    mesh.devices.size)
+            out.append(None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
 
 
 def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
@@ -128,19 +274,23 @@ def shard_rows(mesh: Mesh, *arrays):
     return out if len(out) > 1 else out[0]
 
 
-def mlp_param_shardings(mesh: Mesh, n_layers: int):
+def mlp_param_shardings(mesh: Mesh, n_layers: int,
+                        rules: Optional[MeshRules] = None):
     """Tensor-parallel layout for an MLP parameter pytree
-    [{'w','b'}...]: first hidden layer column-sharded over 'model',
-    last layer row-sharded, middle layers replicated (keeps exactly one
-    all-reduce pair per forward, the standard Megatron split)."""
+    [{'w','b'}...]: first hidden layer column-sharded over the axis the
+    rules map 'hidden' to, last layer row-sharded, middle layers
+    replicated (keeps exactly one all-reduce pair per forward, the
+    standard Megatron split). Written in LOGICAL axes so the layout
+    re-resolves on whatever mesh the process has."""
+    rules = rules or default_rules()
     layouts = []
     for i in range(n_layers):
         if n_layers == 1:
             w, b = P(), P()
         elif i == 0:
-            w, b = P(None, "model"), P("model")
+            w, b = rules.spec("features", "hidden"), rules.spec("hidden")
         elif i == n_layers - 1:
-            w, b = P("model", None), P()
+            w, b = rules.spec("hidden", "out"), P()
         else:
             w, b = P(), P()
         layouts.append({"w": NamedSharding(mesh, w),
@@ -162,18 +312,20 @@ def place(params, shardings):
 
 def _model_spec(mesh: Mesh, axis_len: int, spec: P,
                 label: str = "") -> NamedSharding:
-    """Shard over 'model' only when the axis divides evenly (jax
-    requires it); otherwise replicate that leaf — LOUDLY, since the
-    user set the model axis precisely to avoid replicating it."""
-    n_model = mesh.shape.get("model", 1)
-    if n_model > 1 and axis_len % n_model == 0:
+    """Shard the leading axis only when it divides the target mesh axis
+    evenly (jax requires it); otherwise replicate that leaf — LOUDLY,
+    since the user set the model axis precisely to avoid replicating
+    it. The target axis comes from the spec itself (normally 'model',
+    but SHIFU_TPU_MESH_RULES may have re-pointed the logical axis)."""
+    ax = next((a for a in spec if isinstance(a, str)), None)
+    n = mesh.shape.get(ax, 1) if ax else 1
+    if n > 1 and axis_len % n == 0:
         return NamedSharding(mesh, spec)
-    if n_model > 1:
-        import logging
-        logging.getLogger("shifu_tpu").warning(
-            "model axis: %s axis length %d is not divisible by "
-            "SHIFU_TPU_MESH_MODEL=%d — that leaf replicates per chip",
-            label or "a parameter", axis_len, n_model)
+    if n > 1:
+        log.warning(
+            "model axis: %s axis length %d is not divisible by the "
+            "%d-device %r mesh axis — that leaf replicates per chip",
+            label or "a parameter", axis_len, n, ax)
     return NamedSharding(mesh, P())
 
 
@@ -187,12 +339,14 @@ def wdl_train_shardings(mesh: Mesh, params, megatron_deep: bool = False
     hidden units buy nothing from tensor parallelism and Megatron
     splits would add two collectives per step); `megatron_deep=True`
     (the dryrun's compile certification) splits it anyway."""
+    rules = default_rules()
     out = {}
     if "embed" in params:
         nc = int(np.shape(params["embed"])[0])
-        out["embed"] = _model_spec(mesh, nc, P("model", None, None),
+        out["embed"] = _model_spec(mesh, nc,
+                                   rules.spec("cat", "vocab", "embed"),
                                    "WDL embed (n_cat)")
-        out["wide_cat"] = _model_spec(mesh, nc, P("model", None),
+        out["wide_cat"] = _model_spec(mesh, nc, rules.spec("cat", "vocab"),
                                       "WDL wide_cat (n_cat)")
     out["wide_dense"] = NamedSharding(mesh, P())
     out["wide_bias"] = NamedSharding(mesh, P())
@@ -207,13 +361,15 @@ def mtl_train_shardings(mesh: Mesh, params) -> dict:
     """Product-path MTL layout: per-task head rows shard over 'model'
     (tasks are independent — the expert-parallel analog); the shared
     trunk is replicated (every task reads it)."""
+    rules = default_rules()
     n_tasks = int(np.shape(params["heads_w"])[0])
     return {"trunk": [{"w": NamedSharding(mesh, P()),
                        "b": NamedSharding(mesh, P())}
                       for _ in params["trunk"]],
-            "heads_w": _model_spec(mesh, n_tasks, P("model", None),
+            "heads_w": _model_spec(mesh, n_tasks,
+                                   rules.spec("task", "hidden"),
                                    "MTL heads (n_tasks)"),
-            "heads_b": _model_spec(mesh, n_tasks, P("model"),
+            "heads_b": _model_spec(mesh, n_tasks, rules.spec("task"),
                                    "MTL heads (n_tasks)")}
 
 

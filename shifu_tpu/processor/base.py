@@ -147,10 +147,12 @@ def step_guard(ctx: ProcessorContext, step: str,
     if dist.is_writer():
         if os.path.exists(mpath):
             os.remove(mpath)
-        # a fresh step invalidates any abort marker from an earlier
-        # failed run, and sweeps temp residue from aborted atomic
-        # writes — local dirs and their remote (scheme://) twins alike
+        # a fresh step invalidates any abort or preempt marker from an
+        # earlier failed/preempted run, and sweeps temp residue from
+        # aborted atomic writes — local dirs and their remote
+        # (scheme://) twins alike
         resilience.clear_abort()
+        resilience.clear_preempt_marker()
         for d in {os.path.dirname(p) for p in outputs if p}:
             resilience.sweep_stale(d)
         fault_point(f"step.{step}")

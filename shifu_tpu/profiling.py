@@ -20,7 +20,14 @@ metrics plus `jax.profiler` traces.
   listeners so per-jit compile time and cache hit/miss counts land in
   the stage timers (`compile_s`, `compile_cache_hits`,
   `compile_cache_misses`) and thence in `steps.jsonl` — restart /
-  resume / supervise / grid-search paths stop re-paying XLA compiles.
+  resume / supervise / grid-search paths stop re-paying XLA compiles;
+- `SHIFU_TPU_COMPILE_CACHE_SHARED` names a cluster-shared cache dir (a
+  mounted path or a `scheme://` URL; a `scheme://`
+  SHIFU_TPU_COMPILE_CACHE_DIR auto-routes here too): entries pull into
+  the local staging dir at enable time and new local entries push back
+  at process exit, each committed via `resilience.atomic_write` — an
+  elastic restart on a DIFFERENT host (or a grown mesh's fresh hosts)
+  reuses the fleet's compiles instead of re-paying XLA.
 """
 
 from __future__ import annotations
@@ -30,12 +37,13 @@ import json
 import logging
 import os
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 log = logging.getLogger("shifu_tpu")
 
 _DISABLED_VALUES = ("0", "off", "none", "disabled", "false", "no")
 _compile_listeners_on = False
+_cache_push_registered: Optional[tuple] = None
 
 # enrichments queued by deeper layers (e.g. the train processor's
 # roofline block) for the step record step_metrics is currently
@@ -73,6 +81,94 @@ def _register_compile_listeners() -> None:
     _compile_listeners_on = True
 
 
+def _cache_listing(path: str) -> Dict[str, int]:
+    """name → size for regular files directly under a local or
+    scheme:// directory (compile-cache entries are a flat namespace of
+    hash-named files). Missing dir = empty; dot-prefixed names (remote
+    atomic-write temps) are skipped."""
+    from shifu_tpu.data import fs as fs_mod
+    out: Dict[str, int] = {}
+    if fs_mod.has_scheme(path):
+        fsys, p = fs_mod._fs_and_path(path)
+        if not fsys.exists(p):
+            return out
+        for info in fsys.ls(p, detail=True):
+            name = str(info["name"]).rstrip("/").rsplit("/", 1)[-1]
+            if info.get("type") == "file" and not name.startswith("."):
+                out[name] = int(info.get("size") or 0)
+    elif os.path.isdir(path):
+        for name in os.listdir(path):
+            fp = os.path.join(path, name)
+            if os.path.isfile(fp) and not name.startswith("."):
+                out[name] = os.path.getsize(fp)
+    return out
+
+
+def _cache_read(dirpath: str, name: str) -> bytes:
+    from shifu_tpu.data import fs as fs_mod
+    if fs_mod.has_scheme(dirpath):
+        fsys, p = fs_mod._fs_and_path(dirpath)
+        with fsys.open(f"{p.rstrip('/')}/{name}", "rb") as f:
+            return f.read()
+    with open(os.path.join(dirpath, name), "rb") as f:
+        return f.read()
+
+
+def sync_compile_cache(local_dir: str, shared_dir: str,
+                       pull: bool = True, push: bool = True
+                       ) -> Tuple[int, int]:
+    """Diff-copy compile-cache entries between this host's local
+    staging dir and the cluster-shared one (`pull`: shared→local
+    entries the local dir lacks; `push`: local→shared the reverse).
+    Every copy commits through `resilience.atomic_write`, so hosts
+    racing to push the same key are benign — last complete rename wins
+    and readers never observe a torn entry. Returns (pulled, pushed);
+    never raises — the shared cache is an optimization."""
+    from shifu_tpu.resilience import atomic_write
+    pulled = pushed = 0
+    try:
+        local = _cache_listing(local_dir)
+        shared = _cache_listing(shared_dir)
+        if pull:
+            for name in shared.keys() - local.keys():
+                data = _cache_read(shared_dir, name)
+                with atomic_write(os.path.join(local_dir, name), "wb") as f:
+                    f.write(data)
+                pulled += 1
+        if push:
+            from shifu_tpu.data import fs as fs_mod
+            join = (lambda n: f"{shared_dir.rstrip('/')}/{n}") \
+                if fs_mod.has_scheme(shared_dir) \
+                else (lambda n: os.path.join(shared_dir, n))
+            if not fs_mod.has_scheme(shared_dir):
+                os.makedirs(shared_dir, exist_ok=True)
+            for name in local.keys() - shared.keys():
+                data = _cache_read(local_dir, name)
+                with atomic_write(join(name), "wb") as f:
+                    f.write(data)
+                pushed += 1
+        if pulled or pushed:
+            log.info("shared compile cache %s: pulled %d, pushed %d "
+                     "entr%s", shared_dir, pulled, pushed,
+                     "y" if pulled + pushed == 1 else "ies")
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        log.warning("shared compile-cache sync with %s failed: %s",
+                    shared_dir, e)
+    return pulled, pushed
+
+
+def _register_cache_push(local_dir: str, shared_dir: str) -> None:
+    """Push entries compiled this run to the shared dir at process
+    exit (idempotent; one registration per process)."""
+    global _cache_push_registered
+    if _cache_push_registered:
+        return
+    import atexit
+    atexit.register(sync_compile_cache, local_dir, shared_dir,
+                    pull=False, push=True)
+    _cache_push_registered = (local_dir, shared_dir)
+
+
 def enable_compile_cache(workspace_root: Optional[str] = None) -> \
         Optional[str]:
     """Turn on jax's persistent compilation cache and the compile-time
@@ -90,24 +186,43 @@ def enable_compile_cache(workspace_root: Optional[str] = None) -> \
     try:
         import jax
         from shifu_tpu.config.environment import knob_float, knob_str
+        from shifu_tpu.data import fs as fs_mod
         explicit = knob_str("SHIFU_TPU_COMPILE_CACHE_DIR")
         if explicit is not None and \
                 explicit.strip().lower() in _DISABLED_VALUES:
             return None
+        shared = knob_str("SHIFU_TPU_COMPILE_CACHE_SHARED")
         cache_dir = explicit
+        if cache_dir is not None and fs_mod.has_scheme(cache_dir):
+            # a scheme:// cache dir auto-routes to the shared-cache
+            # path: jax compiles against a local staging dir and
+            # entries sync to the URL
+            shared = shared or cache_dir
+            cache_dir = None
         if cache_dir is None:
             configured = jax.config.jax_compilation_cache_dir
-            if configured:
+            if configured and shared is None:
                 return configured   # respect an externally set cache
-            if workspace_root is None:
+            if configured:
+                cache_dir = configured
+            elif workspace_root is not None:
+                cache_dir = os.path.join(os.path.abspath(workspace_root),
+                                         "tmp", "jax_cache")
+            elif shared is not None:
+                import tempfile
+                cache_dir = os.path.join(tempfile.gettempdir(),
+                                         "shifu_tpu_jax_cache")
+            else:
                 return None
-            cache_dir = os.path.join(os.path.abspath(workspace_root),
-                                     "tmp", "jax_cache")
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(knob_float("SHIFU_TPU_COMPILE_CACHE_MIN_S")))
         log.info("persistent compilation cache at %s", cache_dir)
+        if shared is not None and \
+                shared.strip().lower() not in _DISABLED_VALUES:
+            sync_compile_cache(cache_dir, shared, pull=True, push=False)
+            _register_cache_push(cache_dir, shared)
         return cache_dir
     except Exception as e:  # noqa: BLE001 — cache is an optimization
         log.warning("persistent compilation cache unavailable: %s", e)

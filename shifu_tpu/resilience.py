@@ -58,7 +58,13 @@ Distributed-failure additions (see also `parallel/dist.py`):
    epoch loops check at step boundaries; the trainer saves a final
    checkpoint and raises `Preempted`, which the CLI converts to
    ``PREEMPT_RC`` (75, EX_TEMPFAIL) — rerunning with
-   ``SHIFU_TPU_RESUME=1`` picks up at the saved step.
+   ``SHIFU_TPU_RESUME=1`` picks up at the saved step. Multi-host, the
+   signalled process also publishes a ``preempt.marker``
+   (`publish_preempt`, same atomic machinery as the abort marker);
+   peers observe it from any watched collective and take the same
+   epoch-boundary checkpoint-and-exit(75) path — cluster-wide
+   preemption consensus instead of one clean exit plus N barrier
+   timeouts.
 
 6. **Supervised restarts** (`supervise`): re-invoke a training step on
    preemption or a transient failure up to ``SHIFU_TPU_MAX_RESTARTS``
@@ -137,9 +143,10 @@ FAULT_SITES = (
     "fs.exists", "fs.size", "fs.list", "fs.open",
     "reader.read", "reader.native",
     "ckpt.save", "ckpt.stage", "ckpt.publish", "ckpt.saved",
-    "ckpt.restore",
+    "ckpt.restore", "ckpt.reshard",
     "atomic.commit", "pipeline.fetch", "serve.request",
     "dist.init", "dist.barrier", "dist.allgather",
+    "dist.preempt_marker",
 )
 
 
@@ -489,11 +496,13 @@ def sweep_stale(directory: str) -> int:
 # shared storage without threading a root argument everywhere
 _abort_scope: Optional[str] = None
 _ABORT_NAME = "abort.marker"
+_PREEMPT_NAME = "preempt.marker"
 
 
 def set_abort_scope(tmp_dir: Optional[str]) -> None:
-    """Point the abort marker (and durable event records) at the model
-    set's ``tmp/`` directory — shared storage every host can read."""
+    """Point the abort/preempt markers (and durable event records) at
+    the model set's ``tmp/`` directory — shared storage every host can
+    read."""
     global _abort_scope
     _abort_scope = tmp_dir
     if tmp_dir is None:
@@ -504,13 +513,64 @@ def _abort_dir() -> Optional[str]:
     return _abort_scope or knob_str("SHIFU_TPU_ABORT_DIR")
 
 
-def _abort_path() -> Optional[str]:
+def _marker_path(name: str) -> Optional[str]:
     d = _abort_dir()
     if not d:
         return None
     if _SCHEME_RE.match(d):
-        return d.rstrip("/") + "/" + _ABORT_NAME
-    return os.path.join(d, _ABORT_NAME)
+        return d.rstrip("/") + "/" + name
+    return os.path.join(d, name)
+
+
+def _abort_path() -> Optional[str]:
+    return _marker_path(_ABORT_NAME)
+
+
+def _publish_marker(path: str, rec: dict) -> None:
+    d = _abort_dir()
+    if d and not _SCHEME_RE.match(d):
+        os.makedirs(d, exist_ok=True)
+    with atomic_write(path, "w") as f:
+        f.write(json.dumps(rec))
+
+
+def _read_marker(path: Optional[str], what: str) -> Optional[dict]:
+    if not path:
+        return None
+    try:
+        if _SCHEME_RE.match(path):
+            import fsspec
+            fs, key = fsspec.core.url_to_fs(path)
+            if not fs.exists(key):
+                return None
+            with fs.open(key, "r") as f:
+                raw = f.read()
+        else:
+            if not os.path.exists(path):
+                return None
+            with open(path) as f:
+                raw = f.read()
+        return json.loads(raw)
+    except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — corrupt marker still counts
+        return {"site": "unknown", "process": -1,
+                "error": f"unreadable {what} marker: {e}"}
+
+
+def _clear_marker(path: Optional[str], what: str) -> None:
+    if not path:
+        return
+    try:
+        if _SCHEME_RE.match(path):
+            import fsspec
+            fs, key = fsspec.core.url_to_fs(path)
+            if fs.exists(key):
+                fs.rm(key)
+        elif os.path.exists(path):
+            os.remove(path)
+    except Exception as e:  # noqa: BLE001 — best-effort
+        log.warning("could not clear %s marker %s: %s", what, path, e)
 
 
 def publish_abort(site: str, exc: BaseException,
@@ -531,11 +591,7 @@ def publish_abort(site: str, exc: BaseException,
            "error": f"{type(exc).__name__}: {exc}",
            "time": round(time.time(), 3)}
     try:
-        d = _abort_dir()
-        if d and not _SCHEME_RE.match(d):
-            os.makedirs(d, exist_ok=True)
-        with atomic_write(path, "w") as f:
-            f.write(json.dumps(rec))
+        _publish_marker(path, rec)
         log.error("abort marker published at %s (site=%s): %s",
                   path, site, rec["error"])
     except Exception as e:  # noqa: BLE001 — never mask the original
@@ -546,45 +602,106 @@ def check_abort() -> Optional[dict]:
     """Read the abort marker if one exists. Returns its record dict or
     None; unreadable/corrupt markers count as aborts too (a peer died
     mid-publish is still a peer that died)."""
-    path = _abort_path()
-    if not path:
-        return None
-    try:
-        if _SCHEME_RE.match(path):
-            import fsspec
-            fs, key = fsspec.core.url_to_fs(path)
-            if not fs.exists(key):
-                return None
-            with fs.open(key, "r") as f:
-                raw = f.read()
-        else:
-            if not os.path.exists(path):
-                return None
-            with open(path) as f:
-                raw = f.read()
-        return json.loads(raw)
-    except FileNotFoundError:
-        return None
-    except Exception as e:  # noqa: BLE001 — corrupt marker = abort
-        return {"site": "unknown", "process": -1,
-                "error": f"unreadable abort marker: {e}"}
+    return _read_marker(_abort_path(), "abort")
 
 
 def clear_abort() -> None:
     """Remove a stale abort marker (step startup / restart attempt)."""
-    path = _abort_path()
+    _clear_marker(_abort_path(), "abort")
+
+
+def publish_preempt(note: str = "", process: Optional[int] = None) -> None:
+    """Broadcast preemption consensus: atomically publish a
+    ``preempt.marker`` (same machinery as the poison abort marker) so
+    every peer observes the preemption from any watched collective and
+    takes the SAME epoch-boundary checkpoint-and-exit(75) path — one
+    SIGTERM'd host otherwise leaves its peers to die of barrier
+    timeouts. Best-effort: called from a signal handler, it must never
+    raise."""
+    path = _marker_path(_PREEMPT_NAME)
     if not path:
         return
+    if process is None:
+        try:
+            import jax
+            process = jax.process_index()
+        except Exception:  # noqa: BLE001
+            process = -1
+    rec = {"note": note or "preempt", "process": process,
+           "time": round(time.time(), 3)}
     try:
-        if _SCHEME_RE.match(path):
-            import fsspec
-            fs, key = fsspec.core.url_to_fs(path)
-            if fs.exists(key):
-                fs.rm(key)
-        elif os.path.exists(path):
-            os.remove(path)
-    except Exception as e:  # noqa: BLE001 — best-effort
-        log.warning("could not clear abort marker %s: %s", path, e)
+        fault_point("dist.preempt_marker")
+        _publish_marker(path, rec)
+        log.warning("preempt marker published at %s (process %s): peers "
+                    "will checkpoint and exit rc=%d at their next epoch "
+                    "boundary", path, process, PREEMPT_RC)
+    except Exception as e:  # noqa: BLE001 — best-effort broadcast
+        log.warning("could not publish preempt marker %s: %s — peers "
+                    "fall back to the barrier timeout", path, e)
+
+
+def check_preempt_marker() -> Optional[dict]:
+    """Read the cluster preempt marker if one exists (corrupt markers
+    count: a peer that died mid-publish while preempting is still a
+    preempting peer)."""
+    return _read_marker(_marker_path(_PREEMPT_NAME), "preempt")
+
+
+def clear_preempt_marker() -> None:
+    """Remove a stale preempt marker and any exit-ack markers (step
+    startup / restart attempt)."""
+    _clear_marker(_marker_path(_PREEMPT_NAME), "preempt")
+    d = _abort_dir()
+    if d and not _SCHEME_RE.match(d) and os.path.isdir(d):
+        for name in os.listdir(d):
+            if name.startswith(_PREEMPT_ACK_PREFIX):
+                _clear_marker(os.path.join(d, name), "preempt-ack")
+
+
+_PREEMPT_ACK_PREFIX = "preempt.ack."
+
+
+def preempt_exit_sync(timeout_s: Optional[float] = None) -> None:
+    """Ordered cluster exit on preemption. The jax coordination
+    service lives in process 0 — if it exits while a peer is still
+    inside a collective, that peer's coordination agent ABORTS the
+    process (SIGABRT) before it can reach its own clean rc-75 path.
+    So: every non-coordinator process publishes a ``preempt.ack.<p>``
+    marker just before exiting, and process 0 lingers until all acks
+    are present or `timeout_s` (default 2× the preempt grace) passes.
+    Best-effort and single-process no-op: never raises, never blocks
+    past the timeout."""
+    try:
+        from shifu_tpu.parallel import dist
+        if not dist._multi_process():
+            return
+        import jax
+        proc, nproc = jax.process_index(), jax.process_count()
+        if proc != 0:
+            path = _marker_path(f"{_PREEMPT_ACK_PREFIX}{proc}")
+            if path:
+                _publish_marker(path, {"process": proc,
+                                       "time": round(time.time(), 3)})
+            return
+        if timeout_s is None:
+            from shifu_tpu.config.environment import knob_float
+            timeout_s = 2.0 * knob_float("SHIFU_TPU_PREEMPT_GRACE_S")
+        want = {f"{_PREEMPT_ACK_PREFIX}{p}" for p in range(1, nproc)}
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while want and time.monotonic() < deadline:
+            want = {n for n in want
+                    if _read_marker(_marker_path(n), "preempt-ack") is None}
+            if want:
+                time.sleep(0.1)
+        if want:
+            log.warning("preempt exit: %d peer(s) never acked within "
+                        "%.1fs — exiting anyway (they may abort on the "
+                        "coordinator going away)", len(want), timeout_s)
+        else:
+            log.info("preempt exit: all %d peer(s) acked — coordinator "
+                     "exiting last", nproc - 1)
+    except Exception as e:  # noqa: BLE001 — exit ordering is best-effort
+        log.warning("preempt exit sync failed: %s", e)
 
 
 # resilience events (watchdog stack dumps, supervised restarts) —
@@ -696,6 +813,15 @@ def graceful_shutdown(note: str = "training"):
                     "with SHIFU_TPU_RESUME=1 to resume)",
                     signum, note, PREEMPT_RC)
         request_preempt()
+        # cluster-wide consensus: broadcast the preemption so peer
+        # hosts join the same checkpoint-and-exit(75) path instead of
+        # timing out at the next collective this host never reaches
+        try:
+            from shifu_tpu.parallel import dist as _dist
+            if _dist._multi_process():
+                publish_preempt(note)
+        except Exception as e:  # noqa: BLE001 — handler must not raise
+            log.warning("could not broadcast preemption: %s", e)
 
     try:
         for s in (signal.SIGTERM, signal.SIGINT):
@@ -740,6 +866,7 @@ def supervise(fn: Callable[[], "object"], step: str = "train",
     while True:
         clear_preempt()
         clear_abort()
+        clear_preempt_marker()
         try:
             return fn()
         except BaseException as e:  # noqa: BLE001 — classified below

@@ -34,12 +34,25 @@ nothing), `ckpt.stage` (during the device→host snapshot),
 `ckpt.publish` (after serialize, before the rename commit — a kill
 here leaves only `step_{N-1}` restorable), `ckpt.saved` (after
 publication — a kill here is the "crash right after checkpoint N"
-case), `ckpt.restore`.
+case), `ckpt.restore`, `ckpt.reshard` (the re-placement half of a
+topology-portable restore).
+
+Topology portability (elastic mesh): every `step_N` publishes a
+`step_N.sharding.json` sidecar — per-leaf PartitionSpecs in logical
+axis names (captured from the live device arrays BEFORE the host
+snapshot) plus the writing mesh's shape. `restore_resharded` loads
+host-side and re-places each leaf onto the CURRENT mesh
+(`mesh.resolve_spec` drops axes that no longer exist or divide), so a
+checkpoint written on data=4×model=2 resumes on 1, 4 or 16 devices —
+real preemption comes back on different hardware, and the reference
+survives that because Guagua masters reassign splits to whatever
+containers return; this is the SPMD equivalent.
 """
 
 from __future__ import annotations
 
 import collections
+import json
 import logging
 import os
 import shutil
@@ -53,7 +66,7 @@ import numpy as np
 from shifu_tpu.analysis.lockcheck import make_lock
 from shifu_tpu.config.environment import knob_bool, knob_int
 from shifu_tpu.data import pipeline as pipe
-from shifu_tpu.resilience import fault_point, sweep_stale_tmp
+from shifu_tpu.resilience import atomic_write, fault_point, sweep_stale_tmp
 
 log = logging.getLogger("shifu_tpu")
 
@@ -74,14 +87,84 @@ def _snapshot(state: Any) -> Any:
     return jax.tree.map(lambda x: np.array(x), state)
 
 
-def _publish(ckpt_dir: str, step: int, snap: Any) -> None:
-    """Serialize the host snapshot and atomically publish `step_N`,
-    pruning older steps (the reference keeps only the latest tmp
-    model). Runs on the background writer thread in async mode."""
+def _sidecar_name(step: int) -> str:
+    return f"step_{step}.sharding.json"
+
+
+def _spec_to_json(spec) -> list:
+    """PartitionSpec → JSON list: each entry None, an axis name, or a
+    list of axis names. LOGICAL axis names survive serialization; the
+    device count does not — which is exactly what makes the record
+    portable across topologies."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+def sharding_meta(state: Any) -> Optional[dict]:
+    """Capture the sharding-metadata sidecar from the LIVE state pytree
+    — must run before `_snapshot`, which collapses every leaf to host
+    numpy and loses the placements. Records per-leaf PartitionSpecs in
+    mesh-axis NAMES (the logical layer `MeshRules` resolves), plus the
+    writing mesh's topology for provenance. Host-resident leaves
+    (streaming's error curves, early-stop counters) get no entry and
+    restore host-side. Best-effort: returns None rather than failing a
+    save."""
+    try:
+        from jax.sharding import NamedSharding
+        leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+        entries = {}
+        mesh = None
+        for path, leaf in leaves:
+            if not isinstance(leaf, jax.Array):
+                continue
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                mesh = mesh or sh.mesh
+                entries[jax.tree_util.keystr(path)] = _spec_to_json(sh.spec)
+            else:
+                # single-device / positional sharding: replicated is
+                # the faithful portable reading
+                entries[jax.tree_util.keystr(path)] = []
+        if not entries:
+            return None   # all-host state: nothing to reshard
+        meta = {"version": 1, "leaves": entries}
+        if mesh is not None:
+            from shifu_tpu.parallel import mesh as mesh_mod
+            meta["mesh"] = mesh_mod.mesh_topology(mesh)
+            meta["rules"] = mesh_mod.default_rules().to_dict()
+        return meta
+    except Exception as e:  # noqa: BLE001 — sidecar is an enhancement
+        log.warning("could not capture sharding metadata: %s — the "
+                    "checkpoint restores with replicated placement", e)
+        return None
+
+
+def _publish(ckpt_dir: str, step: int, snap: Any,
+             meta: Optional[dict] = None) -> None:
+    """Serialize the host snapshot and atomically publish `step_N`
+    plus its sharding sidecar, pruning older steps (the reference
+    keeps only the latest tmp model). Runs on the background writer
+    thread in async mode. The sidecar commits AFTER the step itself —
+    a kill between the two leaves a restorable step that falls back to
+    replicated placement, never the reverse."""
     ckpt_dir = os.path.abspath(ckpt_dir)
     sweep_stale_tmp(ckpt_dir)
     path = os.path.join(ckpt_dir, f"step_{step}")
-    if _HAVE_ORBAX:
+    # orbax only single-process: its save() runs cross-process sync
+    # barriers, and the save is gated to host 0 (every host holds the
+    # identical snapshot; concurrent renames on shared storage would
+    # race) — one participant in a process_count()-wide barrier is a
+    # deadlock. The snapshot is host numpy either way, so the npz
+    # writer loses nothing.
+    from shifu_tpu.parallel import dist
+    if _HAVE_ORBAX and not dist._multi_process():
         ckptr = ocp.PyTreeCheckpointer()
         tmp = path + ".tmp"
         if os.path.exists(tmp):
@@ -97,9 +180,13 @@ def _publish(ckpt_dir: str, step: int, snap: Any) -> None:
         from shifu_tpu.models.spec import save_model
         fault_point("ckpt.publish")
         save_model(path + ".npz", "ckpt", {"step": step}, snap)
+    if meta is not None:
+        with atomic_write(os.path.join(ckpt_dir, _sidecar_name(step)),
+                          "w") as f:
+            json.dump({"step": step, **meta}, f)
+    keep = (f"step_{step}", f"step_{step}.npz", _sidecar_name(step))
     for old in os.listdir(ckpt_dir):
-        if old.startswith("step_") and old not in (f"step_{step}",
-                                                   f"step_{step}.npz"):
+        if old.startswith("step_") and old not in keep:
             full = os.path.join(ckpt_dir, old)
             shutil.rmtree(full, ignore_errors=True) if os.path.isdir(full) \
                 else os.remove(full)
@@ -115,7 +202,8 @@ def save_state(ckpt_dir: str, step: int, state: Any) -> None:
     t0 = time.monotonic()
     fault_point("ckpt.save")
     os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
-    _publish(ckpt_dir, step, _snapshot(state))
+    meta = sharding_meta(state)
+    _publish(ckpt_dir, step, _snapshot(state), meta)
     dt = time.monotonic() - t0
     pipe.add_stage_time("ckpt_save_s", dt)
     pipe.add_stage_time("ckpt_stall_s", dt)  # sync: the step waits it all
@@ -168,9 +256,9 @@ class AsyncCheckpointWriter:
             with self._cond:
                 while not self._staged:
                     self._cond.wait()
-                ckpt_dir, step, snap, t0 = self._staged.popleft()
+                ckpt_dir, step, snap, meta, t0 = self._staged.popleft()
             try:
-                _publish(ckpt_dir, step, snap)
+                _publish(ckpt_dir, step, snap, meta)
                 pipe.add_stage_time("ckpt_save_s", time.monotonic() - t0)
             except BaseException as e:  # noqa: BLE001 — surfaced at flush
                 with self._lock:
@@ -189,13 +277,16 @@ class AsyncCheckpointWriter:
         if err is not None:
             raise err
         os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+        # sharding capture must see the LIVE device arrays — the
+        # snapshot right after collapses them to host numpy
+        meta = sharding_meta(state)
         snap = _snapshot(state)
         slots = self.slots()
         with self._cond:
             while self._inflight >= slots:
                 self._cond.wait()
             self._inflight += 1
-            self._staged.append((ckpt_dir, step, snap, t0))
+            self._staged.append((ckpt_dir, step, snap, meta, t0))
             self._ensure_worker()
             self._cond.notify_all()
         pipe.add_stage_time("ckpt_stall_s", time.monotonic() - t0)
@@ -263,7 +354,8 @@ def _step_names(ckpt_dir: str) -> List[Tuple[int, str]]:
     dot-prefixed temp files excluded."""
     out = []
     for name in os.listdir(ckpt_dir):
-        if not name.startswith("step_") or name.endswith(".tmp"):
+        if not name.startswith("step_") or name.endswith(".tmp") \
+                or name.endswith(".sharding.json"):
             continue
         try:
             out.append((int(name.split("_")[1].split(".")[0]), name))
@@ -324,3 +416,84 @@ def restore_latest(ckpt_dir: str, like: Union[Any, Callable[[int], Any]],
                     "unreadable); starting from scratch", ckpt_dir,
                     len(candidates))
     return None
+
+
+# ---------------------------------------------------------------------------
+# topology-portable restore (reshard-on-restore)
+# ---------------------------------------------------------------------------
+
+def load_sharding_meta(ckpt_dir: str, step: int) -> Optional[dict]:
+    """Read `step_N`'s sharding sidecar; None when absent or unreadable
+    (pre-sidecar checkpoints, or a kill between step and sidecar
+    commit) — the restore then falls back to replicated placement."""
+    path = os.path.join(os.path.abspath(ckpt_dir), _sidecar_name(step))
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — corrupt sidecar ≠ lost ckpt
+        log.warning("sharding sidecar %s unreadable (%s) — restoring "
+                    "with replicated placement", path, e)
+        return None
+
+
+def place_resharded(state: Any, meta: Optional[dict], mesh=None,
+                    like: Any = None) -> Any:
+    """Re-place a host-side restored pytree onto the CURRENT mesh:
+    each leaf the sidecar recorded gets its logical PartitionSpec
+    re-resolved against this process's mesh (`mesh.resolve_spec` —
+    axes that no longer exist or no longer divide replicate, loudly);
+    leaves with no entry were host-resident at save time and stay
+    host-side. With no sidecar at all, device placement falls back to
+    replicating every leaf that is a device array in `like` (the
+    pre-reshard behavior, now shared by the same code path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from shifu_tpu.parallel import mesh as mesh_mod
+    fault_point("ckpt.reshard")
+    mesh = mesh if mesh is not None else mesh_mod.default_mesh()
+    entries = (meta or {}).get("leaves")
+    like_leaves = {}
+    if entries is None and like is not None:
+        like_leaves = {
+            jax.tree_util.keystr(p): isinstance(leaf, jax.Array)
+            for p, leaf in jax.tree_util.tree_flatten_with_path(like)[0]}
+    src = (meta or {}).get("mesh")
+    if src and src.get("shape") != mesh_mod.mesh_topology(mesh)["shape"]:
+        log.info("reshard: checkpoint written on a %s mesh restores "
+                 "onto this %s mesh",
+                 "x".join(map(str, src["shape"])),
+                 "x".join(map(str, mesh_mod.mesh_topology(mesh)["shape"])))
+
+    def _place(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if entries is not None:
+            rec = entries.get(key)
+            if rec is None:
+                return leaf       # host-resident at save time
+            spec = mesh_mod.resolve_spec(mesh, rec, np.shape(leaf), key)
+        elif like_leaves.get(key):
+            spec = P()            # no sidecar: replicate device leaves
+        else:
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(_place, state)
+
+
+def restore_resharded(ckpt_dir: str, like: Union[Any, Callable[[int], Any]],
+                      mesh=None, max_step: Optional[int] = None
+                      ) -> Optional[Tuple[int, Any]]:
+    """Topology-portable restore: load the newest usable checkpoint
+    HOST-SIDE (`restore_latest` — params are bitwise-identical numpy
+    regardless of where they were written), then re-place every leaf
+    onto the *current* mesh via its sharding sidecar. Save on 8
+    devices, restore on 4, 16, or 1; same-topology restores take
+    exactly the same path. Returns `(step, placed_state)` or None."""
+    res = restore_latest(ckpt_dir, like, max_step=max_step)
+    if res is None:
+        return None
+    step, state = res
+    meta = load_sharding_meta(ckpt_dir, step)
+    want = like(step) if callable(like) else like
+    return step, place_resharded(state, meta, mesh=mesh, like=want)

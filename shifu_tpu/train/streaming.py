@@ -492,7 +492,10 @@ def train_streaming_core(train_conf: ModelTrainConf,
             # picks the newest USABLE step (skipping truncated ones),
             # then every process must agree on the resume epoch or
             # they issue different collective counts and deadlock:
-            # broadcast the resolved step, then the restored pytree.
+            # broadcast the resolved step, then the restored pytree,
+            # then re-place through the same reshard path as
+            # single-process (the mesh may be a different shape than
+            # the one that wrote the checkpoint — elastic restarts).
             from jax.experimental import multihost_utils
             restored = ckpt_mod.restore_latest(
                 checkpoint_dir, _like,
@@ -504,18 +507,18 @@ def train_streaming_core(train_conf: ModelTrainConf,
                 st = restored[1] if proc == 0 \
                     else jax.tree.map(np.asarray, _like(step))
                 st = multihost_utils.broadcast_one_to_all(st)
+                st = ckpt_mod.place_resharded(
+                    st, ckpt_mod.load_sharding_meta(checkpoint_dir, step),
+                    mesh=mesh, like=_like(step))
         else:
-            restored = ckpt_mod.restore_latest(
-                checkpoint_dir, _like,
+            restored = ckpt_mod.restore_resharded(
+                checkpoint_dir, _like, mesh=mesh,
                 max_step=train_conf.numTrainEpochs)
             step, st = restored if restored is not None else (-1, None)
         if st is not None:
-            stacked = mesh_mod.place_replicated(
-                mesh, jax.tree.map(jnp.asarray, st["stacked"]))
-            opt_state = mesh_mod.place_replicated(
-                mesh, jax.tree.map(jnp.asarray, st["opt_state"]))
-            best = mesh_mod.place_replicated(
-                mesh, jax.tree.map(jnp.asarray, st["best"]))
+            stacked = st["stacked"]
+            opt_state = st["opt_state"]
+            best = st["best"]
             best_val = np.asarray(st["best_val"], np.float32)
             best_epoch = np.asarray(st["best_epoch"], np.int64)
             bad = np.asarray(st["bad"], np.int32)

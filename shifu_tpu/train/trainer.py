@@ -423,11 +423,14 @@ def train_bags(loss_fn, metric_fn, optimizer, n_epochs: int,
     tr_chunks, va_chunks = [], []
     if checkpoint_dir and checkpoint_interval > 0:
         from shifu_tpu.train import checkpoint as ckpt
-        restored = ckpt.restore_latest(checkpoint_dir, carry,
-                                       max_step=n_epochs)
+        # topology-portable restore: the sharding sidecar re-places
+        # each leaf onto THIS run's mesh, so a checkpoint written on 8
+        # devices resumes here on 1, 4 or 16 (same-topology restores
+        # take the identical path)
+        restored = ckpt.restore_resharded(checkpoint_dir, carry,
+                                          mesh=mesh, max_step=n_epochs)
         if restored is not None:
             last, carry = restored
-            carry = jax.tree.map(jnp.asarray, carry)
             done = last
             log.info("checkpoint: resumed at epoch %d from %s", last,
                      checkpoint_dir)

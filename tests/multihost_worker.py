@@ -23,6 +23,18 @@ collectives = the test-rig stand-in for DCN), then one of two modes:
   barrier — the case only the watchdog can catch: the survivor's
   collective blocks indefinitely until the SHIFU_TPU_BARRIER_TIMEOUT_S
   deadline dumps thread stacks and raises DistTimeout (rc 17).
+- ``--mode preempt-drill``: the cluster-wide preemption-consensus
+  drill. Both processes run a checkpointed barrier loop under
+  `graceful_shutdown`; the test SIGTERMs process 0, whose handler
+  publishes the ``preempt.marker``. Process 0 exits the loop at the
+  next boundary (checkpoint + rc 75); process 1 OBSERVES the marker
+  from inside its watched barrier and takes the same path — BOTH
+  processes must exit rc 75, neither via barrier timeout.
+- ``--mode preempt-resume``: the elastic restart after the drill —
+  run with --nproc 1 --local-devices 1 (a SMALLER mesh than the
+  drill's 2×2), it clears the stale marker the way step_guard does and
+  `restore_resharded`s the drill's checkpoint onto the 1-device mesh,
+  verifying the values bitwise.
 
 Usage: python multihost_worker.py --port P --nproc N --pid I --out F
 """
@@ -38,7 +50,8 @@ ap.add_argument("--pid", type=int, required=True)
 ap.add_argument("--out", required=True)
 ap.add_argument("--local-devices", type=int, default=2)
 ap.add_argument("--mode",
-                choices=("train", "barrier-kill", "barrier-stall"),
+                choices=("train", "barrier-kill", "barrier-stall",
+                         "preempt-drill", "preempt-resume"),
                 default="train")
 args = ap.parse_args()
 
@@ -50,8 +63,8 @@ os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
 if args.nproc > 1:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=f"localhost:{args.port}",
         num_processes=args.nproc, process_id=args.pid)
@@ -85,6 +98,65 @@ if args.mode in ("barrier-kill", "barrier-stall"):
     print("barrier with a dead peer unexpectedly succeeded",
           file=sys.stderr, flush=True)
     os._exit(19)
+
+if args.mode in ("preempt-drill", "preempt-resume"):
+    import time
+
+    import numpy as np
+
+    from shifu_tpu import resilience
+    from shifu_tpu.parallel import dist, mesh as mesh_mod
+    from shifu_tpu.train import checkpoint as ckpt_mod
+
+    workdir = os.path.dirname(os.path.abspath(args.out))
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    resilience.set_abort_scope(os.path.join(workdir, "tmp"))
+    # deterministic device-sharded state over THIS process's local
+    # devices (fully addressable, so the snapshot/restore path is the
+    # single-host one regardless of nproc)
+    local_mesh = mesh_mod.make_mesh(devices=jax.local_devices())
+    w_host = np.arange(16, dtype=np.float32).reshape(4, 4)
+    state = {"w": jax.device_put(
+        w_host, jax.sharding.NamedSharding(
+            local_mesh, jax.sharding.PartitionSpec("data")))}
+
+    if args.mode == "preempt-resume":
+        # a fresh run invalidates the drill's marker (step_guard analog)
+        resilience.clear_preempt_marker()
+        restored = ckpt_mod.restore_resharded(
+            ckpt_dir, {"w": w_host}, mesh=local_mesh)
+        assert restored is not None, f"nothing restorable in {ckpt_dir}"
+        step, st = restored
+        got = np.asarray(st["w"])
+        assert np.array_equal(got, w_host), (got, w_host)
+        print(f"RESUMED step={step} on a {local_mesh.devices.size}-device "
+              "mesh", file=sys.stderr, flush=True)
+        os._exit(0)
+
+    with resilience.graceful_shutdown("preempt-drill"):
+        try:
+            for i in range(600):
+                if resilience.preempt_requested():
+                    if dist.is_writer():
+                        ckpt_mod.save_checkpoint(ckpt_dir, i + 1, state)
+                        ckpt_mod.flush_saves()
+                    raise resilience.Preempted(
+                        f"drill preempted at boundary {i}")
+                dist.writer_barrier(f"drill-{i}")
+                if i == 0 and args.pid == 0:
+                    with open(os.path.join(workdir, "drill.ready"),
+                              "w") as f:
+                        f.write("1")
+                time.sleep(0.25)
+        except resilience.Preempted as e:
+            # peers exit first, coordinator last (its death tears down
+            # the coordination service and SIGABRTs blocked peers)
+            resilience.preempt_exit_sync()
+            print(f"PREEMPT_EXIT {e}", file=sys.stderr, flush=True)
+            os._exit(resilience.PREEMPT_RC)
+    print("drill loop exhausted without preemption", file=sys.stderr,
+          flush=True)
+    os._exit(20)
 
 import numpy as np  # noqa: E402
 

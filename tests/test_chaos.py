@@ -53,10 +53,12 @@ def _no_tmp_residue(root):
 
 # one site per instrumented class: filesystem probe, data open, record
 # read, atomic commit, processor step entry, distributed runtime init,
-# checkpoint staging/publish (the async-writer seams)
+# checkpoint staging/publish (the async-writer seams), elastic-mesh
+# restore placement and the preempt-marker broadcast
 CHAOS_SITES = ["fs.exists", "fs.open", "reader.read",
                "atomic.commit", "step.init", "dist.init",
-               "ckpt.stage", "ckpt.publish"]
+               "ckpt.stage", "ckpt.publish",
+               "ckpt.reshard", "dist.preempt_marker"]
 
 
 @pytest.mark.parametrize("site", CHAOS_SITES)

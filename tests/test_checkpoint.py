@@ -343,11 +343,11 @@ def test_save_interrupt_flushes_inflight_write_first(tmp_path,
     orig = ckpt._publish
     calls = {"n": 0}
 
-    def flaky(ckpt_dir, step, snap):
+    def flaky(ckpt_dir, step, snap, meta=None):
         calls["n"] += 1
         if calls["n"] == 1:   # the in-flight background write fails
             raise OSError("simulated background write failure")
-        return orig(ckpt_dir, step, snap)
+        return orig(ckpt_dir, step, snap, meta)
 
     monkeypatch.setattr(ckpt, "_publish", flaky)
     d = str(tmp_path / "ck")

@@ -180,6 +180,86 @@ def test_two_process_survivor_times_out_on_stuck_peer(tmp_path):
             p.kill()
 
 
+def test_two_process_preemption_consensus_then_smaller_mesh_resume(tmp_path):
+    """ISSUE-8 acceptance drill. Two processes loop over watched
+    barriers; SIGTERM lands on process 0 ONLY. Its graceful_shutdown
+    handler publishes the preempt marker; process 0 checkpoints and
+    exits rc 75 at the next boundary, and process 1 must OBSERVE the
+    marker from inside a watched collective and exit rc 75 as well —
+    cluster-wide consensus, not one clean exit plus a peer dying of
+    barrier timeout (rc 17/18). Then a 1-process 1-device run restores
+    the 2-device checkpoint bitwise on the smaller mesh (the elastic
+    restart)."""
+    import signal
+    import time
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHIFU_TPU_BARRIER_TIMEOUT_S"] = "30"
+    env["SHIFU_TPU_PREEMPT_GRACE_S"] = "2"
+    out = str(tmp_path / "drill.npz")
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--port", str(port),
+             "--nproc", "2", "--pid", str(i), "--out", out,
+             "--local-devices", "2", "--mode", "preempt-drill"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(2)
+    ]
+    ready = str(tmp_path / "drill.ready")
+    try:
+        while not os.path.exists(ready):
+            if time.monotonic() - t0 > 120:
+                for q in procs:
+                    q.kill()
+                pytest.fail("drill never reached the first barrier")
+            if any(p.poll() is not None for p in procs):
+                outs = [p.communicate() for p in procs]
+                pytest.fail(f"worker died before the drill: {outs}")
+            time.sleep(0.1)
+        procs[0].send_signal(signal.SIGTERM)
+        outs = []
+        for p in procs:
+            try:
+                so, se = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("a process hung after the preemption — "
+                            "consensus failed")
+            outs.append((p.returncode, so, se))
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (rc, _, se) in enumerate(outs):
+        assert rc == 75, f"proc {i} rc={rc} (want 75):\n{se[-3000:]}"
+        assert "PREEMPT_EXIT" in se, f"proc {i}:\n{se[-3000:]}"
+    # the SIGTERM'd writer checkpointed before exiting, sidecar included
+    ckpt_dir = str(tmp_path / "ckpt")
+    steps = [n for n in os.listdir(ckpt_dir) if n.startswith("step_")]
+    assert steps, os.listdir(str(tmp_path))
+    assert any(n.endswith(".sharding.json") for n in steps), steps
+
+    # elastic restart: 1 process × 1 device restores the 2-device state
+    p = subprocess.Popen(
+        [sys.executable, WORKER, "--port", str(_free_port()),
+         "--nproc", "1", "--pid", "0", "--out", out,
+         "--local-devices", "1", "--mode", "preempt-resume"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        so, se = p.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("smaller-mesh resume hung")
+    assert p.returncode == 0, f"resume rc={p.returncode}:\n{se[-3000:]}"
+    assert "RESUMED" in se, se[-3000:]
+
+
 def test_writer_guard_never_initializes_backend(monkeypatch):
     """is_writer/writer_barrier are called from pure FILE operations
     (shifu init writing ColumnConfig.json); they must not lazily

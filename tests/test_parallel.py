@@ -306,3 +306,91 @@ def test_model_axis_parity(tmp_path, monkeypatch, algorithm, kind,
     for a, b in zip(flat_d, flat_m):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh: logical axis rules, 2-D DCN×ICI builder, spec re-resolution
+# ---------------------------------------------------------------------------
+
+def test_mesh_rules_defaults_and_env_overrides(monkeypatch):
+    from shifu_tpu.parallel import mesh as mesh_mod
+    rules = mesh_mod.default_rules()
+    assert rules("rows", "hidden") == ("data", "model")
+    assert rules("unknown") == (None,)
+    # override: replicate 'hidden' (empty RHS), re-point 'cat' to data
+    monkeypatch.setenv("SHIFU_TPU_MESH_RULES", "hidden=,cat=data")
+    rules = mesh_mod.default_rules()
+    assert rules("hidden") == (None,)
+    assert rules("cat") == ("data",)
+    assert rules("task") == ("model",)   # untouched default
+    monkeypatch.setenv("SHIFU_TPU_MESH_RULES", "garbage")
+    with pytest.raises(ValueError, match="SHIFU_TPU_MESH_RULES"):
+        mesh_mod.default_rules()
+
+
+def test_mesh_rules_never_duplicate_a_physical_axis():
+    """jax rejects P('model','model'); when two logical dims map to the
+    same physical axis the FIRST claim wins and later ones replicate
+    (MTL heads: task and hidden both default to 'model')."""
+    from jax.sharding import PartitionSpec as P
+
+    from shifu_tpu.parallel import mesh as mesh_mod
+    rules = mesh_mod.default_rules()
+    assert rules.spec("task", "hidden") == P("model", None)
+    assert rules.spec("hidden", "task") == P("model", None)
+
+
+def test_make_mesh_multihost_host_major_and_ici_validation():
+    """Multi-host device ordering is host-major so each model group
+    stays within one host (ICI); an n_model that cannot divide a
+    host's local device count must fail loudly, naming the knob."""
+    from types import SimpleNamespace
+
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    def fake(host, i):
+        return SimpleNamespace(process_index=host, id=host * 10 + i)
+
+    # 2 hosts × 4 local: n_model=2 keeps each model pair on one host
+    devs = [fake(h, i) for h in (1, 0) for i in range(4)]   # shuffled
+    try:
+        mesh_mod.make_mesh(4, 2, devices=devs)
+    except TypeError:
+        # Mesh() itself rejects the fakes on some jax versions — the
+        # ordering/validation code above it is what this test covers
+        pass
+    # n_model=8 spans hosts → ValueError naming the knob
+    with pytest.raises(ValueError, match="SHIFU_TPU_MESH_MODEL"):
+        mesh_mod.make_mesh(1, 8, devices=devs)
+    # uneven per-host counts are rejected too
+    devs_uneven = [fake(0, i) for i in range(6)] + [fake(1, i)
+                                                    for i in range(2)]
+    with pytest.raises(ValueError, match="local device count"):
+        mesh_mod.make_mesh(2, 4, devices=devs_uneven)
+
+
+def test_resolve_spec_against_foreign_meshes():
+    import jax
+
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.make_mesh(4, 2)
+    # recorded on a matching mesh: names survive
+    spec = mesh_mod.resolve_spec(mesh, ["data", "model"], (8, 6))
+    assert tuple(spec) == ("data", "model")
+    # dim not divisible by the axis → that dim replicates
+    spec = mesh_mod.resolve_spec(mesh, [None, "model"], (8, 5))
+    assert tuple(spec) == ()
+    # axis name this mesh does not have → replicates
+    spec = mesh_mod.resolve_spec(mesh, ["expert"], (8,))
+    assert tuple(spec) == ()
+    # 1-device mesh: everything replicates trivially but specs survive
+    one = mesh_mod.make_mesh(1, 1, devices=jax.devices()[:1])
+    spec = mesh_mod.resolve_spec(one, ["data", "model"], (8, 6))
+    assert tuple(spec) == ("data", "model")
+
+
+def test_mesh_topology_record():
+    from shifu_tpu.parallel import mesh as mesh_mod
+    top = mesh_mod.mesh_topology(mesh_mod.make_mesh(4, 2))
+    assert top == {"axes": ["data", "model"], "shape": [4, 2],
+                   "devices": 8, "hosts": 1}

@@ -1,0 +1,265 @@
+"""Elastic-mesh tests (ISSUE 8): topology-portable checkpoint sidecars,
+reshard-on-restore across device counts, and the cluster preemption
+marker machinery. The conftest rig provides 8 virtual CPU devices, so
+"save on 8, restore on 4/1" runs anywhere."""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from shifu_tpu import resilience
+from shifu_tpu.parallel import dist, mesh as mesh_mod
+from shifu_tpu.train import checkpoint as ckpt
+
+
+def _sharded_state(mesh):
+    """A state pytree covering every sidecar class: a 2-D leaf sharded
+    on both axes, a 1-D model-sharded leaf, a replicated device leaf,
+    and a host-resident numpy leaf."""
+    rules = mesh_mod.default_rules()
+    return {
+        "w0": jax.device_put(
+            np.arange(48, dtype=np.float32).reshape(8, 6),
+            NamedSharding(mesh, rules.spec("rows", "hidden"))),
+        "b0": jax.device_put(np.arange(6, dtype=np.float32),
+                             NamedSharding(mesh, rules.spec("hidden"))),
+        "rep": jax.device_put(np.float32(3.5), NamedSharding(mesh, P())),
+        "host": np.arange(5, dtype=np.int64),
+    }
+
+
+def _like():
+    return {"w0": np.zeros((8, 6), np.float32),
+            "b0": np.zeros(6, np.float32),
+            "rep": np.float32(0.0),
+            "host": np.zeros(5, np.int64)}
+
+
+def test_sidecar_written_and_parsed(tmp_path):
+    mesh = mesh_mod.make_mesh(4, 2)
+    d = str(tmp_path / "ck")
+    ckpt.save_state(d, 3, _sharded_state(mesh))
+    side = os.path.join(d, "step_3.sharding.json")
+    assert os.path.exists(side)
+    with open(side) as f:
+        meta = json.load(f)
+    assert meta["step"] == 3 and meta["version"] == 1
+    assert meta["mesh"]["shape"] == [4, 2]
+    assert meta["mesh"]["axes"] == ["data", "model"]
+    assert meta["rules"]["hidden"] == "model"
+    leaves = meta["leaves"]
+    # device leaves recorded with their logical placement; the host
+    # leaf has NO entry (that absence is what keeps it host-side on
+    # restore)
+    assert leaves["['w0']"] == ["data", "model"]
+    assert leaves["['b0']"] == ["model"]
+    assert leaves["['rep']"] == []
+    assert "['host']" not in leaves
+    # load_sharding_meta round-trips the same record
+    assert ckpt.load_sharding_meta(d, 3)["leaves"] == leaves
+
+
+@pytest.mark.parametrize("target", ["1dev", "2x1", "4x2", "2x4"])
+def test_reshard_roundtrip_bitwise(tmp_path, target):
+    """Save on data=4 x model=2; restore onto 1-, 2-, 8-device and a
+    transposed 2x4 mesh: values bitwise identical everywhere, host
+    leaves stay numpy, and placement follows the re-resolved spec."""
+    save_mesh = mesh_mod.make_mesh(4, 2)
+    state = _sharded_state(save_mesh)
+    d = str(tmp_path / "ck")
+    ckpt.save_state(d, 7, state)
+
+    mesh = {"1dev": lambda: mesh_mod.make_mesh(1, 1,
+                                               devices=jax.devices()[:1]),
+            "2x1": lambda: mesh_mod.make_mesh(2, 1,
+                                              devices=jax.devices()[:2]),
+            "4x2": lambda: mesh_mod.make_mesh(4, 2),
+            "2x4": lambda: mesh_mod.make_mesh(2, 4)}[target]()
+    restored = ckpt.restore_resharded(d, _like(), mesh=mesh)
+    assert restored is not None
+    step, st = restored
+    assert step == 7
+    for key in ("w0", "b0", "rep"):
+        np.testing.assert_array_equal(np.asarray(st[key]),
+                                      np.asarray(state[key]))
+        assert isinstance(st[key], jax.Array), key
+    assert isinstance(st["host"], np.ndarray)
+    np.testing.assert_array_equal(st["host"], state["host"])
+    # placement re-resolved: on the 4x2 mesh w0 keeps both axes; on the
+    # 2x4 mesh the hidden dim (6) does not divide model=4 and must have
+    # replicated, loudly — never crashed
+    got = st["w0"].sharding.spec
+    if target == "4x2":
+        assert tuple(got) == ("data", "model"), got
+    elif target == "2x4":
+        assert len(got) < 2 or got[1] is None, got
+
+
+def test_missing_sidecar_falls_back_to_replicated(tmp_path):
+    mesh = mesh_mod.make_mesh(4, 2)
+    state = _sharded_state(mesh)
+    d = str(tmp_path / "ck")
+    ckpt.save_state(d, 2, state)
+    os.remove(os.path.join(d, "step_2.sharding.json"))
+    small = mesh_mod.make_mesh(2, 1, devices=jax.devices()[:2])
+    # like mirrors the trainer's carry: device leaves device-typed,
+    # host leaves numpy — with no sidecar, typing comes from like
+    import jax.numpy as jnp
+    like = _like()
+    like = {k: (v if k == "host" else jnp.asarray(v))
+            for k, v in like.items()}
+    step, st = ckpt.restore_resharded(d, like, mesh=small)
+    assert step == 2
+    # like-typed fallback: device leaves land replicated on the current
+    # mesh, host leaves stay host — values still bitwise
+    for key in ("w0", "b0", "rep"):
+        assert isinstance(st[key], jax.Array), key
+        np.testing.assert_array_equal(np.asarray(st[key]),
+                                      np.asarray(state[key]))
+    assert isinstance(st["host"], np.ndarray)
+
+
+def test_resumed_training_matches_uninterrupted_across_mesh_sizes(
+        tmp_path, rng, monkeypatch):
+    """The reshard parity gate: train 10 epochs on the 8-device mesh,
+    checkpoint, then RESUME on a 1-device mesh to 30 epochs — the loss
+    trajectory and final params must match the uninterrupted 30-epoch
+    run (up to f32 reduction-order noise across device counts)."""
+    from shifu_tpu.config.model_config import ModelTrainConf
+    from shifu_tpu.train.trainer import train_nn
+
+    x = rng.normal(0, 1, (600, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    w = np.ones(600, np.float32)
+
+    def conf(epochs):
+        return ModelTrainConf.from_dict({
+            "numTrainEpochs": epochs, "baggingNum": 2,
+            "validSetRate": 0.2,
+            "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [6],
+                       "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                       "Propagation": "ADAM"}})
+
+    straight = train_nn(conf(30), x, y, w, seed=7)
+    d = str(tmp_path / "ck")
+    train_nn(conf(10), x, y, w, seed=7, checkpoint_dir=d,
+             checkpoint_interval=10)
+    assert ckpt.latest_step(d) == 10
+    assert ckpt.load_sharding_meta(d, 10) is not None
+    monkeypatch.setenv("SHIFU_TPU_MESH_DEVICES", "1")   # shrink 8 → 1
+    resumed = train_nn(conf(30), x, y, w, seed=7, checkpoint_dir=d,
+                       checkpoint_interval=10)
+    # the resumed run reports only its own 20 epochs — they must match
+    # epochs 11-30 of the uninterrupted trajectory
+    assert resumed.val_errors.shape[1] == 20
+    np.testing.assert_allclose(straight.val_errors[:, 10:],
+                               resumed.val_errors, rtol=2e-3, atol=2e-4)
+    for a, b in zip(straight.params_per_bag[0],
+                    resumed.params_per_bag[0]):
+        np.testing.assert_allclose(a["w"], b["w"], rtol=5e-3, atol=5e-4)
+
+
+def test_reshard_fault_injection_names_site(tmp_path, monkeypatch):
+    mesh = mesh_mod.make_mesh(4, 2)
+    d = str(tmp_path / "ck")
+    ckpt.save_state(d, 1, _sharded_state(mesh))
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "ckpt.reshard:oserror:1")
+    resilience.reset_faults()
+    with pytest.raises(OSError, match="injected oserror at ckpt.reshard"):
+        ckpt.restore_resharded(d, _like(), mesh=mesh)
+    # recoverable: clear the fault, same restore succeeds
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    assert ckpt.restore_resharded(d, _like(), mesh=mesh) is not None
+
+
+# ---------------------------------------------------------------------------
+# preemption consensus machinery (single-process units; the 2-process
+# drill lives in test_multihost.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def abort_scope(tmp_path):
+    resilience.set_abort_scope(str(tmp_path / "tmp"))
+    resilience.clear_preempt()
+    yield str(tmp_path / "tmp")
+    resilience.clear_preempt_marker()
+    resilience.clear_preempt()
+    resilience.set_abort_scope(None)
+
+
+def test_preempt_marker_roundtrip(abort_scope):
+    assert resilience.check_preempt_marker() is None
+    resilience.publish_preempt("unit", process=3)
+    rec = resilience.check_preempt_marker()
+    assert rec["process"] == 3 and rec["note"] == "unit"
+    resilience.clear_preempt_marker()
+    assert resilience.check_preempt_marker() is None
+
+
+def test_corrupt_preempt_marker_still_counts(abort_scope):
+    os.makedirs(abort_scope, exist_ok=True)
+    with open(os.path.join(abort_scope, "preempt.marker"), "w") as f:
+        f.write("{not json")
+    rec = resilience.check_preempt_marker()
+    assert rec is not None and "unreadable" in rec["error"]
+
+
+def test_watched_collective_observes_peer_preempt(abort_scope):
+    """A watched collective that COMPLETES while a peer's preempt
+    marker is up must still return its value — and leave the local
+    preempt flag set so the caller exits at its own boundary."""
+    resilience.publish_preempt("peer", process=1)
+    assert dist._watched("unit.ok", lambda: 41 + 1) == 42
+    assert resilience.preempt_requested()
+
+
+def test_watched_collective_grace_raises_preempted(abort_scope,
+                                                   monkeypatch):
+    """A watched collective still BLOCKED past the grace window after a
+    peer preempted must raise Preempted (clean rc-75 path), not wait
+    for the much longer barrier timeout."""
+    monkeypatch.setenv("SHIFU_TPU_PREEMPT_GRACE_S", "0.4")
+    monkeypatch.setenv("SHIFU_TPU_BARRIER_TIMEOUT_S", "60")
+    resilience.publish_preempt("peer", process=1)
+    release = threading.Event()
+    try:
+        with pytest.raises(resilience.Preempted):
+            dist._watched("unit.block", release.wait)
+    finally:
+        release.set()
+
+
+def test_preempt_exit_sync_single_process_noop(abort_scope):
+    resilience.preempt_exit_sync(timeout_s=0.1)   # must not block/raise
+
+
+def test_clear_preempt_marker_sweeps_acks(abort_scope):
+    os.makedirs(abort_scope, exist_ok=True)
+    for name in ("preempt.marker", "preempt.ack.1", "preempt.ack.2"):
+        with open(os.path.join(abort_scope, name), "w") as f:
+            f.write("{}")
+    resilience.clear_preempt_marker()
+    left = [n for n in os.listdir(abort_scope)
+            if n.startswith("preempt")]
+    assert left == [], left
+
+
+def test_preempt_marker_fault_absorbed(abort_scope, monkeypatch):
+    """An injected fault at dist.preempt_marker must be ABSORBED:
+    publish_preempt runs from a signal handler, where raising would
+    kill the very checkpoint-and-exit path the marker protects. Peers
+    then simply fall back to the barrier timeout."""
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "dist.preempt_marker:oserror:1")
+    resilience.reset_faults()
+    resilience.publish_preempt("unit", process=0)   # must not raise
+    assert resilience.check_preempt_marker() is None
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    resilience.publish_preempt("unit", process=0)
+    assert resilience.check_preempt_marker() is not None
