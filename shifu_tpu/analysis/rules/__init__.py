@@ -5,9 +5,10 @@ ctx)` and optionally `finalize(ctx)`. Add new modules to
 `RULE_MODULES` to register them.
 """
 
-from shifu_tpu.analysis.rules import (deviceput, faults, hotloop,
-                                      javaprops, knobs, locks)
+from shifu_tpu.analysis.rules import (dagsteps, deviceput, faults,
+                                      hotloop, javaprops, knobs, locks)
 
-RULE_MODULES = (hotloop, knobs, faults, locks, deviceput, javaprops)
+RULE_MODULES = (hotloop, knobs, faults, locks, deviceput, javaprops,
+                dagsteps)
 
 ALL_RULES = tuple(r for m in RULE_MODULES for r in m.RULES)

@@ -1,0 +1,118 @@
+"""unregistered-dag-step: `step_guard(...)` step names and the
+pipeline DAG registry (`shifu_tpu.pipeline.nodes.STEP_REGISTRY`) must
+agree, both ways.
+
+Per file: a step name passed to `step_guard(ctx, "<name>")` that the
+registry does not know means the DAG scheduler can never schedule,
+resume-skip, or poison that step — it silently runs outside the
+pipeline's dependency graph. Family steps (`eval.<set>`,
+`export.<kind>`) are declared once in the registry and instantiated
+with f-strings at the call site; their f-string prefix must be a
+registered family key.
+
+Cross-file (finalize): a registry entry with `manifest=True` that no
+scanned file guards with `step_guard` is a stale row — the scheduler
+would build done-checks and resume logic for a step that never writes
+a manifest. (`init` is exempt by design: it has no manifest because
+later steps rewrite ColumnConfig.json, so it is declared with
+`manifest=False` and a ColumnConfig-exists done-check.)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from shifu_tpu.analysis.engine import Finding, const_str, dotted
+
+RULES = ("unregistered-dag-step",)
+
+_GUARD_FUNCS = {"step_guard"}
+
+
+def _registry():
+    from shifu_tpu.pipeline.nodes import STEP_REGISTRY
+    return STEP_REGISTRY
+
+
+def _step_arg(call: ast.Call):
+    """The step-name argument node of a step_guard call, else None."""
+    d = dotted(call.func)
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf not in _GUARD_FUNCS or len(call.args) < 2:
+        return None
+    return call.args[1]
+
+
+def _fstring_prefix(node: ast.AST) -> str:
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and \
+                isinstance(first.value, str):
+            return first.value
+    return ""
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    reg = _registry()
+    seen: Set[str] = ctx.setdefault("dag-step-refs", set())
+    if path.replace(os.sep, "/").endswith("shifu_tpu/pipeline/nodes.py"):
+        # stale-entry sweep only fires when the scan covered the
+        # registry's home module (i.e. a package-wide scan)
+        ctx["dag-registry-scanned"] = True
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        arg = _step_arg(node)
+        if arg is None:
+            continue
+        ok, lit = const_str(arg)
+        if ok:
+            base = lit.split(".", 1)[0]
+            spec = reg.get(lit) or reg.get(base)
+            if spec is None or ("." in lit and not spec.family):
+                findings.append(Finding(
+                    "unregistered-dag-step", path, node.lineno,
+                    node.col_offset,
+                    f"step_guard step '{lit}' is not in "
+                    "pipeline.nodes.STEP_REGISTRY — register it there "
+                    "so the DAG scheduler can schedule, resume-skip "
+                    "and poison it"))
+            else:
+                seen.add(lit if spec is reg.get(lit) else base)
+        elif isinstance(arg, ast.JoinedStr):
+            prefix = _fstring_prefix(arg)
+            base = prefix.split(".", 1)[0]
+            spec = reg.get(base)
+            if not prefix.endswith(".") or spec is None or \
+                    not spec.family:
+                findings.append(Finding(
+                    "unregistered-dag-step", path, node.lineno,
+                    node.col_offset,
+                    "dynamic step_guard name must use a registered "
+                    "family prefix ('eval.', 'export.', ...) from "
+                    "pipeline.nodes.STEP_REGISTRY; "
+                    f"got prefix '{prefix}'"))
+            else:
+                seen.add(base)
+    return findings
+
+
+def finalize(ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.get("dag-registry-scanned"):
+        return findings
+    reg = _registry()
+    seen: Set[str] = ctx.get("dag-step-refs", set())
+    for name in sorted(reg):
+        if reg[name].manifest and name not in seen:
+            findings.append(Finding(
+                "unregistered-dag-step",
+                "shifu_tpu/pipeline/nodes.py", 0, 0,
+                f"STEP_REGISTRY entry '{name}' declares manifest=True "
+                "but no scanned file guards it with step_guard — "
+                "remove the stale entry or restore the guard"))
+    return findings

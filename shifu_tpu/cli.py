@@ -182,19 +182,57 @@ def cmd_export(args) -> int:
 
 
 def cmd_test(args) -> int:
-    """Dry-run the dataSet filterExpressions on N records
-    (ShifuTestProcessor / DataPurifier), and report I/O health: any
-    resilience retries the sampled read needed (site, attempts, last
-    error)."""
+    """Dry-run the model set through the pipeline DAG scheduler
+    (ShifuTestProcessor / DataPurifier): the train-data filter check,
+    one node per eval set, and a full-pipeline DAG validation run as
+    independent host-only sibling nodes, then I/O health (resilience
+    retries) is reported. The per-node outcome lands as the `dag`
+    block of this command's steps.jsonl record."""
     from shifu_tpu.data.purifier import DataPurifier
     from shifu_tpu.data.reader import read_raw_table
+    from shifu_tpu.pipeline.nodes import STEP_REGISTRY, pipeline_nodes
+    from shifu_tpu.pipeline.scheduler import Node, run_dag
     from shifu_tpu.resilience import retry_stats
     ctx = _ctx(args)
     mc = ctx.model_config
-    df = read_raw_table(mc, max_rows=args.n)
-    keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
-    log.info("filter %r keeps %d / %d sampled records",
-             mc.dataSet.filterExpressions, int(keep.sum()), len(df))
+    root = ctx.path_finder.root
+
+    def check_filter():
+        df = read_raw_table(mc, max_rows=args.n)
+        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+        log.info("filter %r keeps %d / %d sampled records",
+                 mc.dataSet.filterExpressions, int(keep.sum()), len(df))
+
+    def check_eval(ec):
+        def fn():
+            df = read_raw_table(mc, ds=ec.dataSet, max_rows=args.n)
+            keep = DataPurifier(ec.dataSet.filterExpressions).apply(df)
+            log.info("eval %s: filter %r keeps %d / %d sampled records",
+                     ec.name, ec.dataSet.filterExpressions,
+                     int(keep.sum()), len(df))
+        return fn
+
+    def check_plan():
+        # the full pipeline for this model set, validated (unique
+        # names, known deps, acyclic) without running anything
+        plan = pipeline_nodes(root, eval_sets=[e.name for e in mc.evals])
+        log.info("pipeline DAG: %d nodes over %d registered steps "
+                 "validate clean", len(plan), len(STEP_REGISTRY))
+
+    def check_config():
+        log.info("config: model set %s, algorithm %s, %d eval set(s)",
+                 mc.model_set_name, mc.train.algorithm.value,
+                 len(mc.evals))
+
+    nodes = [Node("test.config", check_config, (), device=False)]
+    nodes.append(Node("test.filter", check_filter, ("test.config",),
+                      device=False))
+    for ec in mc.evals:
+        nodes.append(Node(f"test.eval.{ec.name}", check_eval(ec),
+                          ("test.config",), device=False))
+    nodes.append(Node("test.plan", check_plan, ("test.config",),
+                      device=False))
+    run_dag(nodes, root=root, label="test")
     retries = retry_stats()
     if retries:
         for site, d in sorted(retries.items()):

@@ -129,6 +129,9 @@ _declare("SHIFU_TPU_FAULT", "str", None,
          "deterministic fault spec <site>:<kind>:<nth>[;...]")
 _declare("SHIFU_TPU_RESUME", "flag", "0",
          "1 = skip steps whose completion manifest matches inputs")
+_declare("SHIFU_TPU_DAG_WORKERS", "int", 2,
+         "pipeline DAG scheduler: concurrent device-using nodes "
+         "(host-only nodes are admitted immediately)")
 _declare("SHIFU_TPU_MAX_RESTARTS", "int", 0,
          "supervised in-process restarts around the train step")
 _declare("SHIFU_TPU_ABORT_DIR", "str", None,

@@ -140,6 +140,26 @@ def default_mesh() -> Mesh:
     return m
 
 
+def reprobe_devices() -> int:
+    """Re-probe the local device set after an in-process restart
+    (supervised `resilience.supervise` retry): drop every cached mesh
+    and ask the runtime again, so a restart after losing chips comes
+    back on whatever is still healthy instead of building meshes over
+    devices that no longer answer. Returns the device count the next
+    `default_mesh()` will see."""
+    _MESH_CACHE.clear()
+    try:
+        # jax re-discovers backends lazily after this; on runtimes
+        # without the API the stale backend keeps serving, which is
+        # still correct when the device set did not actually change
+        jax.clear_backends()
+    except Exception as e:  # noqa: BLE001 — best-effort
+        log.debug("reprobe_devices: clear_backends unavailable (%s)", e)
+    n = len(jax.devices())
+    log.info("reprobe_devices: %d local device(s) visible", n)
+    return n
+
+
 def shard_axis(mesh: Mesh, a: np.ndarray, axis: int = 0,
                pad_value=0):
     """Place one host array onto the mesh sharded along `axis`, padding

@@ -1,0 +1,25 @@
+"""Pipeline-as-DAG: a dependency graph over the processor steps and a
+small bounded-worker scheduler that runs every ready node concurrently.
+
+The reference Shifu drives `init → stats → norm → varselect → train →
+eval → export` strictly sequentially, one Processor per CLI command.
+The per-step manifests from `processor.base.step_guard` already encode
+completion + input fingerprints, so the dependency structure exists on
+disk — this package promotes it into an explicit DAG:
+
+- `nodes`     — the step registry (deps, device tag, manifest name per
+                step) and builders that turn a model set into Node
+                lists (single pipeline, multi-model fan-out, combo
+                sub-models, grid-search variants).
+- `scheduler` — `run_dag`: bounded worker pool, per-node RESUME skip,
+                failure poisons only descendants, abort-marker
+                discipline shared with `parallel/dist.py`, and a
+                per-node `dag` block for steps.jsonl.
+
+The scheduler changes *when* steps run, never *what* they compute:
+outputs are bitwise identical to a sequential walk of the same nodes.
+"""
+
+from shifu_tpu.pipeline.nodes import STEP_REGISTRY, StepSpec  # noqa: F401
+from shifu_tpu.pipeline.scheduler import (DagError, Node,  # noqa: F401
+                                          run_dag)

@@ -1,0 +1,305 @@
+"""Bounded-worker DAG scheduler over pipeline nodes.
+
+Execution model: the calling thread is the dispatcher; every node that
+becomes ready (all dependencies done) is handed to a fresh worker
+thread, subject to admission control — nodes tagged ``device=True``
+share ``SHIFU_TPU_DAG_WORKERS`` slots so fan-out trainers cannot
+oversubscribe the chips, while host-only nodes (export, posttrain,
+config checks) are admitted immediately and never queue behind a
+trainer. Node bodies are typically CLI subprocesses (see
+`pipeline.nodes`): one process per step keeps the per-process global
+state — abort scope, stage timers, retry counters — isolated exactly
+as it is in a sequential run, which is what makes the "bitwise
+identical outputs" guarantee cheap to keep.
+
+Failure discipline mirrors `parallel/dist.py`: the FIRST failing node
+publishes an abort marker (`resilience.publish_abort("dag.<node>")`)
+so multi-host peers blocked at a barrier die with this error instead
+of a timeout; the failure poisons only the node's descendants, every
+independent branch still runs to completion, and `DagError` is raised
+at the end naming the first failure with the full per-node report.
+
+Resume discipline mirrors `step_guard`: a node's ``done_check``
+(usually `processor.base.manifest_complete`) is evaluated at dispatch
+time — after its dependencies finished, so the inputs fingerprint it
+hashes is the one a sequential resume would see — and a complete
+manifest parks the node in the ``cached`` state without running it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from shifu_tpu import profiling, resilience
+from shifu_tpu.config.environment import knob_int
+from shifu_tpu.resilience import fault_point
+
+log = logging.getLogger("shifu_tpu")
+
+# terminal node states, as they appear in the steps.jsonl `dag` block
+# (see profiling.DAG_FIELDS for the per-node record schema)
+DONE, CACHED, FAILED, POISONED = "done", "cached", "failed", "poisoned"
+
+
+class DagError(RuntimeError):
+    """First node failure, raised after every independent branch has
+    been given its chance to run. Carries the per-node report so
+    callers (and the chaos drill) can assert exactly which descendants
+    were poisoned."""
+
+    def __init__(self, message: str, report: Dict):
+        super().__init__(message)
+        self.report = report
+
+
+@dataclass
+class Node:
+    """One schedulable unit: a callable plus its dependency edges.
+
+    ``device=True`` nodes contend for the SHIFU_TPU_DAG_WORKERS
+    admission slots; host-only nodes bypass them. ``done_check`` is the
+    per-node RESUME test (True → skip as ``cached``), evaluated only
+    after the node's dependencies completed."""
+
+    name: str
+    fn: Callable[[], None]
+    deps: Tuple[str, ...] = ()
+    device: bool = True
+    done_check: Optional[Callable[[], bool]] = None
+
+
+def _validate(nodes: Sequence[Node]):
+    by: Dict[str, Node] = {}
+    for n in nodes:
+        if n.name in by:
+            raise ValueError(f"duplicate DAG node {n.name!r}")
+        by[n.name] = n
+    children: Dict[str, List[str]] = {n.name: [] for n in nodes}
+    for n in nodes:
+        for d in n.deps:
+            if d not in by:
+                raise ValueError(
+                    f"DAG node {n.name!r} depends on unknown node {d!r}")
+            children[d].append(n.name)
+    # Kahn's algorithm — anything left with in-degree > 0 is on a cycle
+    indeg = {n.name: len(n.deps) for n in nodes}
+    frontier = [k for k, v in indeg.items() if v == 0]
+    seen = 0
+    while frontier:
+        k = frontier.pop()
+        seen += 1
+        for c in children[k]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                frontier.append(c)
+    if seen != len(by):
+        cyc = sorted(k for k, v in indeg.items() if v > 0)
+        raise ValueError(f"DAG has a cycle through {cyc}")
+    return by, children
+
+
+def _descendants(name: str, children: Dict[str, List[str]]) -> set:
+    out, stack = set(), [name]
+    while stack:
+        for c in children[stack.pop()]:
+            if c not in out:
+                out.add(c)
+                stack.append(c)
+    return out
+
+
+def _critical_path(order, by, run_s) -> Tuple[List[str], float]:
+    """Longest run_s chain through the dependency edges (queue time
+    excluded — the critical path is what a perfectly-provisioned
+    scheduler could not go below)."""
+    cp: Dict[str, float] = {}
+    back: Dict[str, Optional[str]] = {}
+    for name in order:                       # topological order
+        deps = by[name].deps
+        best, arg = 0.0, None
+        for d in deps:
+            if cp.get(d, 0.0) > best:
+                best, arg = cp[d], d
+        cp[name] = best + run_s.get(name, 0.0)
+        back[name] = arg
+    if not cp:
+        return [], 0.0
+    tail = max(cp, key=lambda k: cp[k])
+    chain: List[str] = []
+    cur: Optional[str] = tail
+    while cur is not None:
+        chain.append(cur)
+        cur = back[cur]
+    return chain, cp[tail]
+
+
+@dataclass
+class _RunState:
+    state: Dict[str, str] = field(default_factory=dict)
+    ready_t: Dict[str, float] = field(default_factory=dict)
+    start_t: Dict[str, float] = field(default_factory=dict)
+    end_t: Dict[str, float] = field(default_factory=dict)
+    errors: Dict[str, BaseException] = field(default_factory=dict)
+    device_running: int = 0
+    first_failure: Optional[Tuple[str, BaseException]] = None
+
+
+def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
+            root: Optional[str] = None, label: str = "dag") -> Dict:
+    """Run `nodes` respecting their dependency edges; returns the `dag`
+    report block (also attached to the surrounding step_metrics record
+    via ``set_step_extra``). Raises `DagError` after completion if any
+    node failed — every branch not downstream of a failure still ran.
+
+    `root` (the model-set dir) anchors the shared abort marker under
+    ``<root>/tmp`` so the first failure is published with the same
+    discipline `parallel/dist.py` uses for collective failures.
+    """
+    nodes = list(nodes)
+    by, children = _validate(nodes)
+    order = [n.name for n in nodes]
+    if workers is None:
+        workers = max(knob_int("SHIFU_TPU_DAG_WORKERS"), 1)
+    if root:
+        resilience.set_abort_scope(os.path.join(root, "tmp"))
+        resilience.clear_abort()
+
+    rs = _RunState()
+    dep_left = {n.name: len(n.deps) for n in nodes}
+    t0 = time.monotonic()
+    for n in nodes:
+        rs.state[n.name] = "pending"
+        if not n.deps:
+            rs.ready_t[n.name] = t0
+    cv = threading.Condition()
+
+    def _mark_ready(name: str, now: float) -> None:
+        for c in children[name]:
+            dep_left[c] -= 1
+            if dep_left[c] == 0:
+                rs.ready_t[c] = now
+
+    def _fail(name: str, err: BaseException, now: float) -> None:
+        rs.state[name] = FAILED
+        rs.errors[name] = err
+        if rs.first_failure is None:
+            rs.first_failure = (name, err)
+            resilience.publish_abort(f"dag.{name}", err)
+        for d in _descendants(name, children):
+            if rs.state[d] == "pending":
+                rs.state[d] = POISONED
+        log.error("dag[%s]: node %s failed (%s: %s) — descendants "
+                  "poisoned, independent branches continue",
+                  label, name, type(err).__name__, err)
+
+    def _finish(name: str, err: Optional[BaseException]) -> None:
+        with cv:
+            now = time.monotonic()
+            rs.end_t[name] = now
+            if by[name].device:
+                rs.device_running -= 1
+            if err is None:
+                rs.state[name] = DONE
+                _mark_ready(name, now)
+            else:
+                _fail(name, err, now)
+            cv.notify_all()
+
+    def _worker(node: Node) -> None:
+        err: Optional[BaseException] = None
+        try:
+            node.fn()
+        except BaseException as e:  # noqa: BLE001 — reported per node
+            err = e
+        _finish(node.name, err)
+
+    with cv:
+        while True:
+            progressed = True
+            while progressed:
+                progressed = False
+                for name in order:
+                    if rs.state[name] != "pending" or dep_left[name] > 0:
+                        continue
+                    node = by[name]
+                    if node.device and rs.device_running >= workers:
+                        continue
+                    now = time.monotonic()
+                    # per-node RESUME: a manifest completed by a prior
+                    # run (and still matching its inputs) skips the node
+                    if node.done_check is not None:
+                        try:
+                            cached = bool(node.done_check())
+                        except Exception:  # noqa: BLE001 — run instead
+                            cached = False
+                        if cached:
+                            rs.state[name] = CACHED
+                            rs.start_t[name] = rs.end_t[name] = now
+                            _mark_ready(name, now)
+                            progressed = True
+                            continue
+                    # deterministic chaos hook: injected faults land in
+                    # dispatch order, before the node body ever starts
+                    try:
+                        fault_point("dag.node")
+                    except BaseException as e:  # noqa: BLE001
+                        rs.start_t[name] = rs.end_t[name] = now
+                        _fail(name, e, now)
+                        progressed = True
+                        continue
+                    rs.state[name] = "running"
+                    rs.start_t[name] = now
+                    if node.device:
+                        rs.device_running += 1
+                    progressed = True
+                    threading.Thread(target=_worker, args=(node,),
+                                     name=f"dag-{name}",
+                                     daemon=True).start()
+            if all(s in (DONE, CACHED, FAILED, POISONED)
+                   for s in rs.state.values()):
+                break
+            cv.wait(timeout=1.0)
+        wall = time.monotonic() - t0
+
+    report = _report(order, by, rs, workers, wall)
+    profiling.set_step_extra("dag", report)
+    if rs.first_failure is not None:
+        name, err = rs.first_failure
+        poisoned = sorted(k for k, v in rs.state.items() if v == POISONED)
+        raise DagError(
+            f"DAG node {name!r} failed ({type(err).__name__}: {err}); "
+            f"poisoned descendants: {poisoned or 'none'}; all other "
+            "nodes completed", report) from err
+    return report
+
+
+def _report(order, by, rs: _RunState, workers: int, wall: float) -> Dict:
+    run_s = {n: max(rs.end_t.get(n, 0.0) - rs.start_t.get(n, 0.0), 0.0)
+             for n in order if n in rs.start_t}
+    chain, cp_s = _critical_path(order, by, run_s)
+    on_chain = set(chain)
+    recs = []
+    for name in order:
+        queue_s = max(rs.start_t.get(name, 0.0)
+                      - rs.ready_t.get(name, 0.0), 0.0) \
+            if name in rs.start_t else 0.0
+        # profiling.DAG_FIELDS is the pinned per-node schema — build the
+        # record from the tuple so it cannot drift from the docs
+        recs.append(dict(zip(profiling.DAG_FIELDS, (
+            name, rs.state[name], list(by[name].deps),
+            round(queue_s, 3), round(run_s.get(name, 0.0), 3),
+            name in on_chain))))
+    busy = sum(run_s.get(n, 0.0) for n in order if by[n].device)
+    return {
+        "workers": workers,
+        "wall_s": round(wall, 3),
+        "critical_path_s": round(cp_s, 3),
+        "occupancy": round(busy / (wall * workers), 3) if wall > 0 else 0.0,
+        "failed": rs.first_failure[0] if rs.first_failure else None,
+        "nodes": recs,
+    }

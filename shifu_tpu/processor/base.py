@@ -106,6 +106,25 @@ def _inputs_fingerprint(ctx: ProcessorContext) -> str:
     return h.hexdigest()
 
 
+def manifest_complete(ctx: ProcessorContext, step: str) -> bool:
+    """True when `step`'s manifest from a previous run matches the
+    current inputs fingerprint and every recorded output still exists —
+    the SHIFU_TPU_RESUME skip test, shared by `step_guard` and the
+    pipeline DAG scheduler (which must decide node-by-node whether a
+    completed step can be skipped without loading the processor)."""
+    mpath = ctx.path_finder.manifest_path(step)
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return bool(man) \
+        and man.get("fingerprint") == _inputs_fingerprint(ctx) \
+        and all(os.path.exists(p) for p in man.get("outputs", []))
+
+
 @contextmanager
 def step_guard(ctx: ProcessorContext, step: str,
                outputs: Sequence[str] = ()):
@@ -122,20 +141,12 @@ def step_guard(ctx: ProcessorContext, step: str,
     """
     pf = ctx.path_finder
     mpath = pf.manifest_path(step)
-    fp = _inputs_fingerprint(ctx)
     if knob_bool("SHIFU_TPU_RESUME") \
             and os.path.exists(mpath):
-        try:
-            with open(mpath) as f:
-                man = json.load(f)
-        except (OSError, ValueError):
-            man = None
-        if man and man.get("fingerprint") == fp \
-                and all(os.path.exists(p) for p in man.get("outputs", [])):
+        if manifest_complete(ctx, step):
             log.info("step %s: complete (manifest matches inputs and all "
-                     "%d output(s) present) — skipping; unset "
-                     "SHIFU_TPU_RESUME to force a re-run", step,
-                     len(man.get("outputs", [])))
+                     "outputs present) — skipping; unset "
+                     "SHIFU_TPU_RESUME to force a re-run", step)
             yield False
             return
         log.info("step %s: stale/mismatched manifest — re-running", step)

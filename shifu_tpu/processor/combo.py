@@ -145,6 +145,34 @@ def _train_sub(sub_dir: str) -> None:
             raise RuntimeError(f"combo sub-model step failed in {sub_dir}")
 
 
+def _train_sub_node(root: str, sub_dir: str, name: str) -> None:
+    """One sub-model's init→stats→norm→train as a subprocess (this
+    module's __main__ hook), so sibling subs scheduled concurrently
+    keep their process-global state — abort scope, stage timers, jax
+    config — as isolated as the serial loop kept it. All siblings
+    share the combo workspace's persistent compile cache."""
+    import subprocess
+    import sys
+    log_dir = os.path.join(root, "tmp", "dag_logs")
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f"{name.replace('/', '_')}.log")
+    env = dict(os.environ)
+    env["SHIFU_TPU_COMPILE_CACHE_DIR"] = \
+        os.path.join(root, "tmp", "jax_cache")
+    with open(log_path, "w") as lf:
+        rc = subprocess.call(
+            [sys.executable, "-m", "shifu_tpu.processor.combo", sub_dir],
+            stdout=lf, stderr=subprocess.STDOUT, env=env)
+    if rc != 0:
+        try:
+            with open(log_path, errors="replace") as lf:
+                tail = "".join(lf.readlines()[-15:])
+        except OSError:
+            tail = "<log unavailable>"
+        raise RuntimeError(f"combo sub-model {name} exited {rc} "
+                           f"(log: {log_path})\n{tail}")
+
+
 def _sub_scores(ctx: ProcessorContext, combo: Dict, df) -> np.ndarray:
     """(R, n_subs) ensemble-mean score of every sub-model over a raw
     frame — the DataMerger join collapses to column stacking."""
@@ -179,45 +207,56 @@ def _load_training_frame(mc: ModelConfig):
 
 
 def run(ctx: ProcessorContext, resume: bool = False) -> int:
-    """Train all sub-models, score the training data with each, then
-    train the assemble model on the (R, n_subs) score matrix."""
+    """Train all sub-models — embarrassingly parallel, so they run as
+    sibling nodes through the pipeline DAG scheduler — then score the
+    training data with each and train the assemble model on the
+    (R, n_subs) score matrix as the sink node."""
+    from shifu_tpu.pipeline.scheduler import Node, run_dag
     t0 = time.time()
     mc = ctx.model_config
     combo = _load_combo(ctx)
+    root = ctx.path_finder.root
 
+    nodes = []
+    sub_names = []
     for sub in combo["subModels"]:
         sub_dir = _sub_dir(ctx, sub["name"])
         if not os.path.exists(os.path.join(sub_dir, "ModelConfig.json")):
             raise FileNotFoundError(f"{sub_dir} not scaffolded; run "
                                     "`combo -init` first")
-        if resume and _sub_trained(sub_dir):
-            log.info("combo: resume — %s already trained", sub["name"])
-            continue
-        log.info("combo: training sub-model %s (%s)", sub["name"],
-                 sub["algorithm"])
-        _train_sub(sub_dir)
+        name = f"combo.{sub['name']}"
+        sub_names.append(name)
+        nodes.append(Node(
+            name=name,
+            fn=(lambda d=sub_dir, n=name: _train_sub_node(root, d, n)),
+            deps=(), device=True,
+            done_check=(lambda d=sub_dir: _sub_trained(d)) if resume
+            else None))
 
-    df, tags, weights = _load_training_frame(mc)
-    scores = _sub_scores(ctx, combo, df)
+    def assemble() -> None:
+        df, tags, weights = _load_training_frame(mc)
+        scores = _sub_scores(ctx, combo, df)
+        asm = combo["assemble"]
+        alg = Algorithm.parse(asm["algorithm"])
+        asm_dir = _sub_dir(ctx, asm["name"])
+        os.makedirs(os.path.join(asm_dir, "models"), exist_ok=True)
+        if alg.is_tree:
+            # tree assemble (e.g. `combo -new NN,LR,GBT`): boost/bag
+            # over the score matrix with its own tree trainer, like the
+            # reference's ComboModelProcessor trains the assemble with
+            # its configured algorithm — NOT an MLP mislabeled as a tree
+            val_err = _train_assemble_tree(ctx, asm_dir, alg, scores,
+                                           tags, weights, combo)
+        else:
+            val_err = _train_assemble_dense(ctx, asm_dir, alg, scores,
+                                            tags, weights, combo, asm)
+        log.info("combo run: %d subs + assemble (%s) in %.2fs; assemble "
+                 "val err %.6f", len(combo["subModels"]),
+                 asm["algorithm"], time.time() - t0, val_err)
 
-    asm = combo["assemble"]
-    alg = Algorithm.parse(asm["algorithm"])
-    asm_dir = _sub_dir(ctx, asm["name"])
-    os.makedirs(os.path.join(asm_dir, "models"), exist_ok=True)
-
-    if alg.is_tree:
-        # tree assemble (e.g. `combo -new NN,LR,GBT`): boost/bag over the
-        # score matrix with its own tree trainer, like the reference's
-        # ComboModelProcessor trains the assemble with its configured
-        # algorithm — NOT an MLP mislabeled as a tree
-        val_err = _train_assemble_tree(ctx, asm_dir, alg, scores, tags,
-                                       weights, combo)
-    else:
-        val_err = _train_assemble_dense(ctx, asm_dir, alg, scores, tags,
-                                        weights, combo, asm)
-    log.info("combo run: %d subs + assemble (%s) in %.2fs; assemble "
-             "val err %.6f", len(combo["subModels"]), asm["algorithm"],
-             time.time() - t0, val_err)
+    nodes.append(Node(name="combo.assemble", fn=assemble,
+                      deps=tuple(sub_names), device=True))
+    run_dag(nodes, root=root, label="combo")
     return 0
 
 
@@ -385,3 +424,12 @@ def evaluate(ctx: ProcessorContext,
         log.info("combo eval[%s]: %d rows, AUC=%.4f", ec.name, len(final),
                  perf["areaUnderRoc"])
     return 0
+
+
+if __name__ == "__main__":
+    # subprocess entry for the DAG scheduler: one sub-model's
+    # init→stats→norm→train in an isolated process (_train_sub_node)
+    import sys
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s %(message)s")
+    _train_sub(sys.argv[1])

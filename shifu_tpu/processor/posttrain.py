@@ -26,15 +26,23 @@ import numpy as np
 from shifu_tpu.eval.scorer import Scorer
 from shifu_tpu.ops import stats as stats_ops
 from shifu_tpu.processor import norm as norm_proc
-from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.processor.base import ProcessorContext, step_guard
 
 log = logging.getLogger("shifu_tpu")
 
 
 def run(ctx: ProcessorContext) -> int:
+    ctx.require_columns()
+    out = os.path.join(ctx.path_finder.root, "featureimportance.csv")
+    with step_guard(ctx, "posttrain", outputs=[out]) as go:
+        if not go:
+            return 0
+        return _run(ctx, out)
+
+
+def _run(ctx: ProcessorContext, out: str) -> int:
     t0 = time.time()
     mc = ctx.model_config
-    ctx.require_columns()
     cols = norm_proc.selected_candidates(ctx.column_configs)
     from shifu_tpu.processor.chunking import analysis_chunk_rows
     chunk_rows = analysis_chunk_rows(ctx)
@@ -106,7 +114,6 @@ def run(ctx: ProcessorContext) -> int:
             float(s / c) if c > 0 else 0.0 for s, c in zip(sums, cnts)]
 
     importance = fi.finalize()
-    out = os.path.join(ctx.path_finder.root, "featureimportance.csv")
     from shifu_tpu.resilience import atomic_write
     with atomic_write(out) as f:
         f.write("column,importance\n")

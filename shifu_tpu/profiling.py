@@ -356,6 +356,17 @@ SERVING_FIELDS = ("qps_offered", "qps_sustained", "requests",
                   "serve_warm_s", "device_step_budget_ms",
                   "compile_cache_misses_steady")
 
+# the pipeline DAG scheduler's record schema: a scheduled step attaches
+# one `dag` block to its steps.jsonl record — DAG_SUMMARY_FIELDS are
+# the block's top-level keys, DAG_FIELDS the schema of each entry in
+# its `nodes` list. pipeline/scheduler.py builds every per-node record
+# from DAG_FIELDS, and tools/check_steps_schema.py pins README docs to
+# both tuples the same way it pins ROOFLINE_FIELDS.
+DAG_FIELDS = ("node", "state", "deps", "queue_s", "run_s",
+              "critical_path")
+DAG_SUMMARY_FIELDS = ("workers", "wall_s", "critical_path_s",
+                      "occupancy", "failed", "nodes")
+
 
 def mlp_row_costs(input_dim: int, hidden_dims, n_out: int = 1,
                   train: bool = True, dtype_bytes: int = 4):

@@ -146,7 +146,7 @@ FAULT_SITES = (
     "ckpt.restore", "ckpt.reshard",
     "atomic.commit", "pipeline.fetch", "serve.request",
     "dist.init", "dist.barrier", "dist.allgather",
-    "dist.preempt_marker",
+    "dist.preempt_marker", "dag.node",
 )
 
 
@@ -881,6 +881,17 @@ def supervise(fn: Callable[[], "object"], step: str = "train",
             rec = {"step": step, "event": "restart", "restart": restarts,
                    "maxRestarts": max_restarts, "error": err,
                    "time": round(time.time(), 3)}
+            # elastic restart: re-probe the local device set before
+            # resuming — a preempted/failed chip may be gone, and the
+            # retry must build its mesh over what is still healthy
+            # (the topology-portable checkpoints from PR 8 make the
+            # resulting reshard-on-restore transparent)
+            try:
+                from shifu_tpu.parallel.mesh import reprobe_devices
+                rec["devices"] = reprobe_devices()
+            except Exception as pe:  # noqa: BLE001 — best-effort
+                log.warning("supervise[%s]: device re-probe failed: %s",
+                            step, pe)
             note_event(rec)
             _append_steps_jsonl(rec)
             time.sleep(delay)

@@ -139,7 +139,11 @@ def _run_main(monkeypatch, capsys, results):
     the headline JSON record."""
     import sys
     monkeypatch.setattr(sys, "argv", ["bench.py"])
-    monkeypatch.setattr(bench, "_resolve_backend", lambda d: ("tpu", {}))
+    monkeypatch.setattr(
+        bench, "_resolve_backend",
+        lambda d: ("tpu", {}, {"timeout_s": 60, "attempts": [
+            {"attempt": 1, "wall_s": 0.1, "ok": True,
+             "backend": "tpu"}]}))
     monkeypatch.setattr(
         bench, "_run_or_reuse",
         lambda task, backend, diags, env_extra, timeout=1200:
@@ -183,17 +187,28 @@ def test_task_nn_wide_bf16(monkeypatch, capsys):
 
 def test_task_pipeline(monkeypatch, capsys, tmp_path):
     """The CLI product-path task drives the real init→stats→norm→
-    train→eval surface and records per-phase wall-clocks."""
+    train→eval surface twice (sequential walk, then the DAG scheduler)
+    and records per-phase wall-clocks plus the scheduler comparison."""
     monkeypatch.setattr(bench, "PIPE_DIR", str(tmp_path / "pipe"))
     monkeypatch.setattr(bench, "PIPE_ROWS", 4_000)
     monkeypatch.setattr(bench, "PIPE_EPOCHS", 5)
+    # single-model / single-eval keeps the smoke test small; the full
+    # NN+GBT+WDL fan-out is covered by the real bench run and
+    # tests/test_pipeline_dag.py
+    monkeypatch.setattr(bench, "PIPE_ALGS", ("NN",))
+    monkeypatch.setattr(bench, "PIPE_EVALS", ("Eval1",))
     bench.task_pipeline()
     rec = _last_json(capsys)
+    # a single-model run keeps the plain "train" node name (no fan-out
+    # clone); eval nodes are always per-eval-set
     assert set(rec["phases"]) == {"init", "stats", "norm", "train",
-                                  "eval"}
+                                  "eval.Eval1"}
     assert all(v >= 0 for v in rec["phases"].values())
     assert rec["auc"] > 0.75
     assert rec["rows"] == 4_000
+    assert rec["bitwise_identical"] is True
+    assert rec["dag_speedup"] > 0 and rec["dag_workers"] == 1
+    assert rec["fanout_cache_misses"] == 0
 
 
 def test_headline_carries_cpu_denominator(monkeypatch, tmp_path, capsys):
@@ -371,12 +386,18 @@ def test_resolve_backend_probe_knobs(monkeypatch):
     monkeypatch.setattr(bench, "_run_task", fake_run_task)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     diags = []
-    backend, env_extra = bench._resolve_backend(diags)
+    backend, env_extra, probe = bench._resolve_backend(diags)
     assert backend == "cpu" and env_extra == {"JAX_PLATFORMS": "cpu"}
     # 2 default-backend attempts at the knob timeout, then the cpu probe
     assert [c[2] for c in calls] == [7, 7, 7]
     assert any("attempt 2/2" in d for d in diags)
     assert any("falling back" in d for d in diags)
+    # the structured probe block mirrors the diags: per-attempt
+    # outcomes plus the machine-readable fallback reason
+    assert probe["timeout_s"] == 7
+    assert [a["ok"] for a in probe["attempts"]] == [False, False, True]
+    assert probe["attempts"][0]["error"] == "probe wedged"
+    assert "fell back to cpu" in probe["fallback"]
 
 
 def test_row_cost_models_closed_form():

@@ -98,6 +98,52 @@ def test_injected_fault_never_hangs_and_is_recoverable(
     assert os.path.exists(os.path.join(model_set, "ColumnConfig.json"))
 
 
+@pytest.mark.parametrize("nth,failing,poisoned", [
+    # nth=1 → the root config node fails → every dependent check is
+    # poisoned (exactly the descendants, nothing else ran)
+    (1, "test.config", ["test.eval.Eval1", "test.filter", "test.plan"]),
+    # nth=2 → a leaf check fails → no descendants; the independent
+    # sibling checks still complete
+    (2, "test.filter", []),
+])
+def test_dag_node_fault_poisons_exactly_descendants(
+        tmp_path, rng, monkeypatch, nth, failing, poisoned):
+    """`dag.node` drill through the real `shifu test` DAG: the injected
+    fault fails exactly one node (faults land in deterministic dispatch
+    order), poisons exactly that node's descendants, lets every
+    independent branch finish, and a clean rerun succeeds."""
+    from shifu_tpu.pipeline.scheduler import DagError
+
+    model_set = _tiny_model_set(tmp_path, rng)
+    monkeypatch.setenv("SHIFU_TPU_FAULT", f"dag.node:oserror:{nth}")
+    resilience.reset_faults()
+
+    t0 = time.monotonic()
+    with pytest.raises(DagError) as ei:
+        cli_main(["--dir", model_set, "test"])
+    assert time.monotonic() - t0 < 120
+    assert "injected oserror at dag.node" in str(ei.value.__cause__)
+    rep = ei.value.report
+    states = {r["node"]: r["state"] for r in rep["nodes"]}
+    assert rep["failed"] == failing
+    assert states[failing] == "failed"
+    assert sorted(k for k, v in states.items() if v == "poisoned") \
+        == poisoned
+    done = [k for k, v in states.items() if v == "done"]
+    assert sorted(done + poisoned + [failing]) == sorted(states)
+    # the first failure was published as an abort marker (dist.py
+    # poison-pill discipline), naming the node
+    marker = resilience.check_abort()
+    assert marker is not None and marker["site"] == f"dag.{failing}"
+    resilience.set_abort_scope(None)
+    assert not _no_tmp_residue(model_set)
+
+    # recoverable: clear the fault, rerun, full DAG succeeds
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    assert cli_main(["--dir", model_set, "test"]) == 0
+
+
 def test_chaos_sites_are_registered():
     """The subset exercised above must stay a subset of the canonical
     registry the full sweep (tools/chaos_sweep.sh) iterates, so the

@@ -409,6 +409,75 @@ def test_fault_sites_all_referenced_in_package():
 
 
 # ---------------------------------------------------------------------------
+# unregistered-dag-step
+# ---------------------------------------------------------------------------
+
+def test_dag_step_positive(tmp_path):
+    src = """
+        from shifu_tpu.processor.base import step_guard
+
+        def go(ctx):
+            with step_guard(ctx, "mysterystep") as ok:
+                pass
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-dag-step"])
+    assert any("mysterystep" in f.message for f in report.findings)
+
+
+def test_dag_step_negative_registered_and_family(tmp_path):
+    src = """
+        from shifu_tpu.processor.base import step_guard
+
+        def go(ctx, name):
+            with step_guard(ctx, "train") as ok:
+                pass
+            with step_guard(ctx, f"eval.{name}") as ok:
+                pass
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-dag-step"])
+    per_file = [f for f in report.findings if f.line > 0]
+    assert not per_file
+
+
+def test_dag_step_dynamic_outside_family_flagged(tmp_path):
+    src = """
+        from shifu_tpu.processor.base import step_guard
+
+        def go(ctx, x):
+            with step_guard(ctx, f"mystery.{x}") as ok:
+                pass
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-dag-step"])
+    assert any("family prefix" in f.message for f in report.findings)
+
+
+def test_dag_step_dotted_nonfamily_flagged(tmp_path):
+    src = """
+        from shifu_tpu.processor.base import step_guard
+
+        def go(ctx):
+            with step_guard(ctx, "train.fancy") as ok:
+                pass
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unregistered-dag-step"])
+    assert any("train.fancy" in f.message for f in report.findings)
+
+
+def test_dag_registry_all_guarded_in_package():
+    """Reverse direction at package scope: every STEP_REGISTRY entry
+    with manifest=True has a live step_guard call site (the finalize
+    hook reports stale rows)."""
+    report = engine.run([os.path.join(REPO, "shifu_tpu")],
+                        rules=["unregistered-dag-step"])
+    stale = [f for f in report.findings if "stale entry" in f.message]
+    assert not stale, "\n".join(f.format() for f in stale)
+
+
+# ---------------------------------------------------------------------------
 # blocking-under-lock
 # ---------------------------------------------------------------------------
 

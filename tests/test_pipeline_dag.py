@@ -1,0 +1,219 @@
+"""Pipeline DAG scheduler (tier-1): scheduling semantics on synthetic
+graphs, and the end-to-end contract on a real model set — outputs of a
+DAG run are bitwise identical to the same nodes walked sequentially,
+RESUME parks completed nodes as ``cached``, and a failure poisons only
+the failing node's descendants while every independent branch runs.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from shifu_tpu import profiling, resilience
+from shifu_tpu.pipeline.nodes import pipeline_nodes, variant_dir
+from shifu_tpu.pipeline.scheduler import (CACHED, DONE, FAILED, POISONED,
+                                          DagError, Node, run_dag)
+
+
+def _states(report):
+    return {r["node"]: r["state"] for r in report["nodes"]}
+
+
+# ---------------------------------------------------------------------------
+# scheduler semantics (synthetic graphs, no subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_dag_failure_poisons_descendants_only(tmp_path):
+    """b fails → its descendant c is poisoned; the independent d/e
+    branch still runs; DagError carries the full report and the abort
+    marker names the failing node (dist.py discipline)."""
+    ran = []
+
+    def ok(name):
+        return lambda: ran.append(name)
+
+    def boom():
+        raise OSError("synthetic")
+
+    nodes = [
+        Node("a", ok("a")),
+        Node("b", boom, deps=("a",)),
+        Node("c", ok("c"), deps=("b",)),
+        Node("d", ok("d"), deps=("a",)),
+        Node("e", ok("e")),
+    ]
+    with pytest.raises(DagError) as ei:
+        run_dag(nodes, workers=2, root=str(tmp_path), label="t")
+    rep = ei.value.report
+    assert _states(rep) == {"a": DONE, "b": FAILED, "c": POISONED,
+                            "d": DONE, "e": DONE}
+    assert sorted(ran) == ["a", "d", "e"]
+    assert rep["failed"] == "b"
+    assert "'c'" in str(ei.value) and "all other" in str(ei.value)
+    marker = resilience.check_abort()
+    assert marker is not None and marker["site"] == "dag.b"
+    resilience.clear_abort()
+    resilience.set_abort_scope(None)
+
+
+def test_dag_report_schema_and_cached(tmp_path):
+    """Per-node records carry exactly profiling.DAG_FIELDS; a true
+    done_check parks the node as cached without calling fn; the summary
+    block carries exactly DAG_SUMMARY_FIELDS."""
+    calls = []
+    nodes = [
+        Node("a", lambda: calls.append("a")),
+        Node("b", lambda: calls.append("b"), deps=("a",),
+             done_check=lambda: True),
+        Node("c", lambda: calls.append("c"), deps=("b",)),
+    ]
+    rep = run_dag(nodes, workers=2)
+    assert _states(rep) == {"a": DONE, "b": CACHED, "c": DONE}
+    assert calls == ["a", "c"]
+    assert tuple(rep) == profiling.DAG_SUMMARY_FIELDS
+    for rec in rep["nodes"]:
+        assert tuple(rec) == profiling.DAG_FIELDS
+    # critical path covers the chain through real (non-cached) work
+    chain = [r["node"] for r in rep["nodes"] if r["critical_path"]]
+    assert "a" in chain or "c" in chain
+    assert rep["failed"] is None
+
+
+def test_dag_validation_rejects_bad_graphs():
+    with pytest.raises(ValueError, match="duplicate"):
+        run_dag([Node("a", lambda: None), Node("a", lambda: None)])
+    with pytest.raises(ValueError, match="unknown node"):
+        run_dag([Node("a", lambda: None, deps=("ghost",))])
+    with pytest.raises(ValueError, match="cycle"):
+        run_dag([Node("a", lambda: None, deps=("b",)),
+                 Node("b", lambda: None, deps=("a",))])
+
+
+def test_dag_host_nodes_bypass_device_worker_cap():
+    """With a single device slot occupied by a running trainer, a
+    host-only node must still be admitted (it unblocks the trainer
+    here; if host nodes queued behind the device cap this would time
+    out)."""
+    release = threading.Event()
+
+    def device_fn():
+        assert release.wait(timeout=30), \
+            "host-only node queued behind device worker cap"
+
+    nodes = [
+        Node("trainer", device_fn, device=True),
+        Node("host", release.set, device=False),
+    ]
+    rep = run_dag(nodes, workers=1)
+    assert _states(rep) == {"trainer": DONE, "host": DONE}
+
+
+def test_dag_device_cap_is_respected():
+    """SHIFU_TPU_DAG_WORKERS=1 → two device nodes never overlap."""
+    active, peak = [0], [0]
+    lock = threading.Lock()
+
+    def fn():
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.05)
+        with lock:
+            active[0] -= 1
+
+    rep = run_dag([Node("x", fn), Node("y", fn)], workers=1)
+    assert peak[0] == 1
+    assert rep["workers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: bitwise parity + mid-DAG resume on a real model set
+# ---------------------------------------------------------------------------
+
+def _hash_outputs(root, algs):
+    """sha256 over every byte the pipeline published: primary models/
+    + evals/, and each fan-out sibling's models/."""
+    h = hashlib.sha256()
+    roots = [("", root)] + [(f"train.{a}:", variant_dir(root, f"train.{a}"))
+                            for a in algs[1:]]
+    for prefix, base in roots:
+        for sub in ("models", "evals"):
+            top = os.path.join(base, sub)
+            for dirpath, dirs, files in os.walk(top):
+                dirs.sort()
+                for f in sorted(files):
+                    p = os.path.join(dirpath, f)
+                    h.update(f"{prefix}{sub}/{os.path.relpath(p, top)}"
+                             .encode())
+                    with open(p, "rb") as fh:
+                        h.update(fh.read())
+    return h.hexdigest()
+
+
+def _reset_outputs(root):
+    for f in ("ColumnConfig.json", "featureimportance.csv"):
+        p = os.path.join(root, f)
+        if os.path.exists(p):
+            os.remove(p)
+    for d in ("models", "modelsBackup", "evals", "tmp"):
+        shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+
+def test_pipeline_dag_bitwise_parity_and_resume(tmp_path, rng,
+                                                monkeypatch):
+    """NN+GBT fan-out + eval through the scheduler produces bitwise
+    identical outputs to the same nodes run sequentially; a rerun with
+    SHIFU_TPU_RESUME=1 parks completed nodes as ``cached`` and runs
+    only the node whose manifest was invalidated."""
+    from tests.synth import make_model_set
+
+    root = make_model_set(tmp_path, rng, n_rows=600,
+                          train_params={"NumHiddenLayers": 1,
+                                        "NumHiddenNodes": [6],
+                                        "ActivationFunc": ["tanh"],
+                                        "LearningRate": 0.1,
+                                        "Propagation": "ADAM",
+                                        "TreeNum": 8, "MaxDepth": 3})
+    mc_path = os.path.join(root, "ModelConfig.json")
+    with open(mc_path) as f:
+        mc = json.load(f)
+    mc["train"]["numTrainEpochs"] = 4
+    with open(mc_path, "w") as f:
+        json.dump(mc, f, indent=2)
+    algs = ["NN", "GBT"]
+
+    # leg 1: the same node bodies, walked sequentially in list order
+    # (pipeline_nodes returns a topological order)
+    for n in pipeline_nodes(root, eval_sets=["Eval1"], algorithms=algs,
+                            resume=False):
+        n.fn()
+    seq = _hash_outputs(root, algs)
+    assert os.path.exists(os.path.join(root, "evals", "Eval1",
+                                       "EvalPerformance.json"))
+
+    # leg 2: scheduled, 2 device workers
+    _reset_outputs(root)
+    rep = run_dag(pipeline_nodes(root, eval_sets=["Eval1"],
+                                 algorithms=algs, resume=False),
+                  workers=2, root=root, label="pipeline")
+    assert _states(rep) == {"init": DONE, "stats": DONE, "norm": DONE,
+                            "train.NN": DONE, "train.GBT": DONE,
+                            "eval.Eval1": DONE}
+    assert _hash_outputs(root, algs) == seq
+
+    # leg 3: RESUME — invalidate only the eval manifest; everything
+    # upstream must park as cached, only eval.Eval1 re-runs
+    monkeypatch.setenv("SHIFU_TPU_RESUME", "1")
+    os.remove(os.path.join(root, "tmp", "manifests", "eval.Eval1.json"))
+    rep = run_dag(pipeline_nodes(root, eval_sets=["Eval1"],
+                                 algorithms=algs, resume=True),
+                  workers=2, root=root, label="pipeline")
+    assert _states(rep) == {"init": CACHED, "stats": CACHED,
+                            "norm": CACHED, "train.NN": CACHED,
+                            "train.GBT": CACHED, "eval.Eval1": DONE}
+    assert _hash_outputs(root, algs) == seq

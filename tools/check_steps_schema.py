@@ -31,6 +31,14 @@ The serving bench record is pinned likewise: its schema is
 ``profiling.SERVING_FIELDS`` (AST-read), every field must be
 README-documented, and bench.py must build the record from the tuple.
 
+The ``dag`` block (every command routed through the pipeline DAG
+scheduler) is pinned the same way: per-node records are
+``profiling.DAG_FIELDS``, the summary is ``profiling.DAG_SUMMARY_FIELDS``,
+every member must be README-documented, and the scheduler must build
+its records from the tuple. Members of the pinned tuples are excluded
+from the stage-field heuristic — `queue_s`/`wall_s`/... are dag-block
+keys, not ``inputPipeline`` stages.
+
 Optionally pass a real steps.jsonl to ALSO verify against a live log
 (every documented field must appear in at least one record's
 ``inputPipeline`` block across the file, and any record carrying a
@@ -54,12 +62,21 @@ README = os.path.join(REPO, "README.md")
 _TOKEN = re.compile(r"`([a-z][a-z0-9_]*(?:_s|_hits|_misses))`")
 _WRITERS = ("add_stage_time", "add_stage_count")
 
+# bench.py record keys that match the stage-token shape but are not
+# steps.jsonl inputPipeline stages (like the per_s/_frac skips below)
+_BENCH_ONLY = {"fanout_cache_misses"}
+
 
 def documented_fields() -> set:
     with open(README, encoding="utf-8") as f:
         text = f.read()
+    # members of the pinned block schemas (roofline/serving/dag) are
+    # documented as those blocks' keys, not inputPipeline stages
+    pinned = set(roofline_fields()) | set(serving_fields()) | \
+        set(dag_fields()) | set(dag_summary_fields())
     return {tok for tok in _TOKEN.findall(text)
-            if "per_s" not in tok and not tok.endswith("_frac")}
+            if "per_s" not in tok and not tok.endswith("_frac")
+            and tok not in pinned and tok not in _BENCH_ONLY}
 
 
 def emitted_fields() -> set:
@@ -121,6 +138,14 @@ def serving_fields() -> tuple:
     return _profiling_tuple("SERVING_FIELDS")
 
 
+def dag_fields() -> tuple:
+    return _profiling_tuple("DAG_FIELDS")
+
+
+def dag_summary_fields() -> tuple:
+    return _profiling_tuple("DAG_SUMMARY_FIELDS")
+
+
 def check_roofline_docs() -> int:
     """Every ROOFLINE_FIELDS member must be backtick-documented in
     README (the Raw speed section) — a field added to the block without
@@ -162,6 +187,35 @@ def check_serving_docs() -> int:
         return 1
     print(f"serving bench: all {len(fields)} SERVING_FIELDS documented "
           "in README and pinned in bench.py")
+    return 0
+
+
+def check_dag_docs() -> int:
+    """Every DAG_FIELDS / DAG_SUMMARY_FIELDS member (the steps.jsonl
+    ``dag`` block the scheduler attaches) must be backtick-documented
+    in README's Pipeline DAG section, and the scheduler must build its
+    per-node records from the tuple — the literal check asserts
+    scheduler.py references `profiling.DAG_FIELDS` so the block cannot
+    silently drift from the pinned schema."""
+    fields = dag_fields() + dag_summary_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("dag schema drift: DAG_FIELDS/DAG_SUMMARY_FIELDS "
+              f"member(s) never documented in README: {missing}",
+              file=sys.stderr)
+        return 1
+    sched = os.path.join(PKG, "pipeline", "scheduler.py")
+    with open(sched, encoding="utf-8") as f:
+        uses = "DAG_FIELDS" in f.read()
+    if not uses:
+        print("pipeline/scheduler.py no longer builds the dag block "
+              "from profiling.DAG_FIELDS", file=sys.stderr)
+        return 1
+    print(f"pipeline dag: all {len(fields)} DAG_FIELDS + "
+          "DAG_SUMMARY_FIELDS documented in README and pinned in "
+          "pipeline/scheduler.py")
     return 0
 
 
@@ -219,6 +273,8 @@ def main(argv) -> int:
     if check_roofline_docs():
         return 1
     if check_serving_docs():
+        return 1
+    if check_dag_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
