@@ -6,9 +6,10 @@ ctx)` and optionally `finalize(ctx)`. Add new modules to
 """
 
 from shifu_tpu.analysis.rules import (dagsteps, deviceput, faults,
-                                      hotloop, javaprops, knobs, locks)
+                                      hotloop, javaprops, knobs, locks,
+                                      spans)
 
 RULE_MODULES = (hotloop, knobs, faults, locks, deviceput, javaprops,
-                dagsteps)
+                dagsteps, spans)
 
 ALL_RULES = tuple(r for m in RULE_MODULES for r in m.RULES)

@@ -1,0 +1,121 @@
+"""unregistered-span: span-name literals and the trace-plane registry
+(`shifu_tpu.obs.trace.SPAN_FAMILIES`) must agree, both ways.
+
+Per file: a name passed to `span("<family.stage>")` or
+`record_span("<family.stage>", ...)` that SPAN_FAMILIES does not
+declare means the trace vocabulary is no longer enumerable — the
+watchdog, `shifu top`, and any dashboard switching on span names would
+silently miss it. Dynamic names must be f-strings whose literal prefix
+is a registered `"family."`.
+
+Cross-file (finalize): a registered `family.stage` that no scanned
+file ever emits is a dead vocabulary entry — remove it from
+SPAN_FAMILIES or restore the emitting call site, so the registry stays
+an honest inventory of what traces can contain.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from shifu_tpu.analysis.engine import Finding, const_str, dotted
+
+RULES = ("unregistered-span",)
+
+_SPAN_FUNCS = {"span", "record_span"}
+
+
+def _families():
+    from shifu_tpu.obs.trace import SPAN_FAMILIES
+    return SPAN_FAMILIES
+
+
+def _name_arg(call: ast.Call):
+    """The span-name argument node of a span/record_span call, else
+    None. Only Calls whose first positional argument is a string
+    (constant or f-string) are span emissions — `span` is also a
+    common local variable name for numeric ranges."""
+    d = dotted(call.func)
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf not in _SPAN_FUNCS or not call.args:
+        return None
+    arg = call.args[0]
+    ok, _ = const_str(arg)
+    if ok or isinstance(arg, ast.JoinedStr):
+        return arg
+    return None
+
+
+def _fstring_prefix(node: ast.AST) -> str:
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and \
+                isinstance(first.value, str):
+            return first.value
+    return ""
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    fams = _families()
+    seen: Set[str] = ctx.setdefault("span-refs", set())
+    if path.replace(os.sep, "/").endswith("shifu_tpu/obs/trace.py"):
+        # dead-entry sweep only fires when the scan covered the
+        # registry's home module (i.e. a package-wide scan)
+        ctx["span-registry-scanned"] = True
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        arg = _name_arg(node)
+        if arg is None:
+            continue
+        ok, lit = const_str(arg)
+        if ok:
+            family, _, stage = lit.partition(".")
+            if stage in fams.get(family, ()):
+                seen.add(lit)
+            else:
+                findings.append(Finding(
+                    "unregistered-span", path, node.lineno,
+                    node.col_offset,
+                    f"span name '{lit}' is not a registered "
+                    "family.stage in obs.trace.SPAN_FAMILIES — declare "
+                    "it there so the trace vocabulary stays enumerable"))
+        else:
+            prefix = _fstring_prefix(arg)
+            family = prefix.split(".", 1)[0]
+            if not prefix or "." not in prefix or family not in fams:
+                findings.append(Finding(
+                    "unregistered-span", path, node.lineno,
+                    node.col_offset,
+                    "dynamic span name must start with a registered "
+                    "'family.' literal prefix from "
+                    "obs.trace.SPAN_FAMILIES; "
+                    f"got prefix '{prefix}'"))
+            else:
+                # a family-prefixed dynamic name marks every stage of
+                # that family as referenced (the stage is runtime data)
+                seen.update(f"{family}.{s}" for s in fams[family])
+    return findings
+
+
+def finalize(ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.get("span-registry-scanned"):
+        return findings
+    fams = _families()
+    seen: Set[str] = ctx.get("span-refs", set())
+    for family in sorted(fams):
+        for stage in fams[family]:
+            name = f"{family}.{stage}"
+            if name not in seen:
+                findings.append(Finding(
+                    "unregistered-span",
+                    "shifu_tpu/obs/trace.py", 0, 0,
+                    f"SPAN_FAMILIES entry '{name}' is never emitted by "
+                    "any scanned span()/record_span() call — remove "
+                    "the dead entry or restore the emitting site"))
+    return findings

@@ -327,6 +327,100 @@ def cmd_ckpt(args) -> int:
     return 0
 
 
+def _top_render(root: str) -> str:
+    """One frame of `shifu top`: the last steps.jsonl records (step,
+    rc, wall, trace block when present) plus any live span files from
+    a trace run still in flight."""
+    import glob as _glob
+    lines = []
+    steps_path = os.path.join(root, "tmp", "metrics", "steps.jsonl")
+    recs = []
+    try:
+        with open(steps_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    recs = recs[-10:]
+    if not recs:
+        lines.append(f"no step records yet ({steps_path})")
+    else:
+        lines.append(f"{'step':<12} {'rc':>3} {'wall_s':>9} "
+                     f"{'spans':>6} {'drop':>5}  top self-time")
+        for rec in recs:
+            tr = rec.get("trace") or {}
+            top = ", ".join(
+                f"{t['name']}={t['self_s']:.3f}s"
+                for t in tr.get("top_self", [])) or "-"
+            lines.append(
+                f"{str(rec.get('step', '?')):<12} "
+                f"{str(rec.get('rc', '-')):>3} "
+                f"{float(rec.get('wallSeconds', 0.0)):>9.2f} "
+                f"{str(tr.get('span_count', '-')):>6} "
+                f"{str(tr.get('dropped_spans', '-')):>5}  {top}")
+    live = []
+    for d in sorted(_glob.glob(os.path.join(root, "tmp", "trace", "*"))):
+        if not os.path.isdir(d):
+            continue
+        rid = os.path.basename(d)
+        merged = os.path.join(root, "tmp", "trace",
+                              rid + ".trace.json")
+        if os.path.exists(merged):
+            continue   # finished run, already merged
+        n = len(_glob.glob(os.path.join(d, "spans.*.jsonl")))
+        live.append(f"  {rid}: {n} span file(s), not yet merged")
+    if live:
+        lines.append("live trace runs:")
+        lines.extend(live)
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """`shifu top` — live step/trace monitor over steps.jsonl and the
+    trace workspace. Single-shot by default (scripts, tests); --watch
+    redraws every --interval seconds until interrupted."""
+    root = args.dir
+    if not args.watch:
+        print(_top_render(root))
+        return 0
+    try:
+        while True:
+            # ANSI clear + home, same contract as top(1)
+            sys.stdout.write("\x1b[2J\x1b[H")
+            print(time.strftime("%H:%M:%S"), "shifu top —", root)
+            print(_top_render(root))
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """`shifu trace ls` — pair merged span traces (tmp/trace/) with
+    maybe_profile device traces (tmp/profile/) by shared run_id."""
+    from shifu_tpu.obs import trace as obs_trace
+    if args.action != "ls":
+        raise SystemExit(f"trace: unknown action {args.action!r}")
+    rows = obs_trace.trace_ls(args.dir)
+    if not rows:
+        print("no trace artifacts under tmp/trace or tmp/profile")
+        return 0
+    rid_w = max(len(r["run_id"]) for r in rows)
+    print(f"{'run_id':<{rid_w}}  {'spans':>5}  trace / profile")
+    for r in rows:
+        paths = [p for p in (r["trace"], r["profile"]) if p]
+        print(f"{r['run_id']:<{rid_w}}  {r['span_files']:>5}  "
+              + (" + ".join(paths) or "-"))
+    return 0
+
+
 def cmd_version(args) -> int:
     import shifu_tpu
     print(f"shifu-tpu {shifu_tpu.__version__}")
@@ -496,6 +590,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit the markdown table (same as python -m "
                         "shifu_tpu.analysis --knobs-md)")
     p.set_defaults(fn=cmd_knobs)
+    p = sub.add_parser("top",
+                       help="live step/trace monitor (steps.jsonl + "
+                            "in-flight span files)")
+    p.add_argument("--watch", action="store_true",
+                   help="redraw continuously until interrupted")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="redraw period in seconds (with --watch)")
+    p.set_defaults(fn=cmd_top)
+    p = sub.add_parser("trace",
+                       help="trace artifacts: `trace ls` pairs span "
+                            "traces with device traces by run_id")
+    p.add_argument("action", choices=["ls"])
+    p.set_defaults(fn=cmd_trace)
     sub.add_parser("ckpt",
                    help="checkpoint inventory: latest step + the mesh "
                         "topology that wrote it (sharding sidecar)") \
@@ -546,11 +653,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     # every command emits one structured metrics record (and a
     # jax.profiler trace under --profile) — SURVEY §5's replacement for
     # master iteration logs / Hadoop counters / TailThread
+    from shifu_tpu.obs.trace import trace_run
     from shifu_tpu.profiling import maybe_profile, step_metrics
     root = getattr(args, "dir", ".") or "."
     from shifu_tpu import resilience
     try:
+        # trace_run sits INSIDE step_metrics (its exit attaches the
+        # span summary to the step record before the record is written)
+        # and OUTSIDE maybe_profile (so the device trace is named after
+        # the live trace run's id — `shifu trace ls` pairs them)
         with step_metrics(root, args.command) as rec, \
+                trace_run(root, args.command), \
                 maybe_profile(root, args.command,
                               getattr(args, "profile", False)):
             rc = args.fn(args)

@@ -266,6 +266,17 @@ _declare("SHIFU_TPU_FS_BLOCK_SIZE", "int", 4 * 1024 * 1024,
 # --- export ---
 _declare("SHIFU_TPU_UME_EXPORTER", "str", None,
          "pkg.module:Class hook for `export -t ume` bundles")
+# --- observability / trace plane ---
+_declare("SHIFU_TPU_TRACE", "flag", "0",
+         "1 = record host spans (obs.trace) and export a merged "
+         "Chrome-trace JSON per step; unset/0 = zero-cost no-op")
+_declare("SHIFU_TPU_TRACE_BUF", "int", 4096,
+         "span ring-buffer capacity per process; overflow drops the "
+         "oldest span and counts it in the steps.jsonl trace block")
+_declare("SHIFU_TPU_TRACE_DIR", "str", None,
+         "trace workspace for this run's span files; normally unset "
+         "(the coordinator derives tmp/trace/<run_id> and exports it "
+         "so DAG subprocess nodes land their spans in the same merge)")
 # --- bench / tools (read outside the package) ---
 _declare("SHIFU_TPU_BENCH_ATTEMPTS", "int", 2,
          "re-measure attempts per bench workload", scope="bench")

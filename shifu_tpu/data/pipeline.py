@@ -73,6 +73,7 @@ from typing import Callable, Dict, Iterable, Iterator, Sequence, TypeVar
 
 from shifu_tpu.analysis.lockcheck import make_lock
 from shifu_tpu.config.environment import knob_bool, knob_int, knob_is_set
+from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.resilience import fault_point
 
 log = logging.getLogger("shifu_tpu")
@@ -190,6 +191,7 @@ def _sync_fetch(iterable: Iterable[T], site: str) -> Iterator[T]:
             dt = time.monotonic() - t0
             add_stage_time("host_parse_s", dt)
             add_stage_time("input_stall_s", dt)
+        obs_trace.record_span("input.host_parse", t0, t0 + dt)
         add_stage_count("chunks")
         yield item
 
@@ -237,7 +239,9 @@ def prefetch(iterable: Iterable[T], depth: int | None = None,
             except BaseException as e:  # noqa: BLE001 — carried across
                 _offer(_Raised(e))
                 return
-            add_stage_time("host_parse_s", time.monotonic() - t0)
+            t1 = time.monotonic()
+            add_stage_time("host_parse_s", t1 - t0)
+            obs_trace.record_span("input.host_parse", t0, t1)
             if not _offer(item):
                 return
 
@@ -292,7 +296,10 @@ def map_prefetch(fn: Callable[[T], U], items: Sequence[T],
             fault_point(site)
             return fn(item)
         finally:
-            add_stage_time(stage, time.monotonic() - t0)
+            t1 = time.monotonic()
+            add_stage_time(stage, t1 - t0)
+            if stage == "host_assemble_s":
+                obs_trace.record_span("input.host_assemble", t0, t1)
 
     if depth <= 0 or workers <= 0 or not items:
         for item in items:
@@ -370,6 +377,9 @@ def map_stream(fn: Callable[[T], U], iterable: Iterable[T],
                 add_stage_time(stage, dt)
                 # synchronous: assembly time IS stall time
                 add_stage_time("input_stall_s", dt)
+                if stage == "host_assemble_s":
+                    obs_trace.record_span("input.host_assemble", t0,
+                                          t0 + dt)
             yield out
         return
 
@@ -380,7 +390,10 @@ def map_stream(fn: Callable[[T], U], iterable: Iterable[T],
         try:
             return fn(item)
         finally:
-            add_stage_time(stage, time.monotonic() - t0)
+            t1 = time.monotonic()
+            add_stage_time(stage, t1 - t0)
+            if stage == "host_assemble_s":
+                obs_trace.record_span("input.host_assemble", t0, t1)
 
     # futures travel through a bounded queue so the producer stays at
     # most `depth` chunks ahead of the consumer (same memory cap as
@@ -412,7 +425,9 @@ def map_stream(fn: Callable[[T], U], iterable: Iterable[T],
             except BaseException as e:  # noqa: BLE001 — carried across
                 _offer(_Raised(e))
                 return
-            add_stage_time("host_parse_s", time.monotonic() - t0)
+            t1 = time.monotonic()
+            add_stage_time("host_parse_s", t1 - t0)
+            obs_trace.record_span("input.host_parse", t0, t1)
             if not _offer(ex.submit(_timed, item)):
                 return
 

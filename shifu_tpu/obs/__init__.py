@@ -1,0 +1,8 @@
+"""Observability: the span-based flight recorder (`obs.trace`).
+
+The reference Shifu's only run-time window is Hadoop counters and log
+grep; here every layer that already keeps ad-hoc timers (DAG
+scheduler, input pipeline, serving plane, collectives, checkpoint
+writer) also emits *spans* onto one causal timeline. See
+`obs/trace.py` for the API and README "Observability" for the knobs.
+"""

@@ -43,6 +43,7 @@ import numpy as np
 
 from shifu_tpu.analysis.lockcheck import make_lock
 from shifu_tpu.config.environment import knob_float, knob_int, knob_str
+from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.resilience import fault_point
 
 log = logging.getLogger("shifu_tpu")
@@ -150,6 +151,10 @@ def _watched(tag: str, fn: Callable, timeout_s: Optional[float] = None):
         _inflight_seq += 1
         key = f"{tag}#{_inflight_seq}"
         _inflight[key] = time.monotonic()
+    # open span covering the blocked wait, so a watchdog dump (which
+    # cites obs.trace.open_spans) names the stuck collective
+    sp = obs_trace.span("dist.collective", tag=tag)
+    sp.__enter__()
     t.start()
     try:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -194,6 +199,7 @@ def _watched(tag: str, fn: Callable, timeout_s: Optional[float] = None):
         _observe_preempt(tag)
         return box.get("value")
     finally:
+        sp.__exit__(None, None, None)
         with _inflight_lock:
             _inflight.pop(key, None)
 

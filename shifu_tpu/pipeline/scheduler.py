@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from shifu_tpu import profiling, resilience
 from shifu_tpu.config.environment import knob_int
+from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.resilience import fault_point
 
 log = logging.getLogger("shifu_tpu")
@@ -265,6 +266,23 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
                 break
             cv.wait(timeout=1.0)
         wall = time.monotonic() - t0
+
+    if obs_trace.active():
+        # one retro span per node (parent = the run root) with its
+        # queue (ready→dispatch) and run (dispatch→done) children, each
+        # on a per-node Perfetto track
+        for name in order:
+            if name not in rs.start_t:
+                continue
+            ready = rs.ready_t.get(name, rs.start_t[name])
+            nid = obs_trace.record_span(
+                "dag.node", ready, rs.end_t[name],
+                track=f"dag.{name}", node=name, state=rs.state[name])
+            obs_trace.record_span("dag.queue", ready, rs.start_t[name],
+                                  parent=nid, track=f"dag.{name}")
+            obs_trace.record_span("dag.run", rs.start_t[name],
+                                  rs.end_t[name], parent=nid,
+                                  track=f"dag.{name}")
 
     report = _report(order, by, rs, workers, wall)
     profiling.set_step_extra("dag", report)

@@ -367,6 +367,14 @@ DAG_FIELDS = ("node", "state", "deps", "queue_s", "run_s",
 DAG_SUMMARY_FIELDS = ("workers", "wall_s", "critical_path_s",
                       "occupancy", "failed", "nodes")
 
+# the span tracer's per-step summary block: obs/trace.py attaches one
+# `trace` block (built from exactly this tuple) to the steps.jsonl
+# record of every traced step — total spans recorded, ring-buffer
+# drops, and the top-3 span names by accumulated self time.
+# tools/check_steps_schema.py pins README docs to this tuple the same
+# way it pins ROOFLINE_FIELDS.
+TRACE_FIELDS = ("span_count", "dropped_spans", "top_self")
+
 
 def mlp_row_costs(input_dim: int, hidden_dims, n_out: int = 1,
                   train: bool = True, dtype_bytes: int = 4):
@@ -459,13 +467,18 @@ def roofline(family: str, flops_per_row: float, bytes_per_row: float,
 
 @contextlib.contextmanager
 def maybe_profile(root: str, step: str, enabled: bool):
-    """jax.profiler trace around a step when --profile is set."""
+    """jax.profiler trace around a step when --profile is set. The
+    output dir is named by the tracer's run_id, so the device trace
+    (`tmp/profile/<run_id>/`) and the host span trace
+    (`tmp/trace/<run_id>.trace.json`) of one step are siblings that
+    `shifu trace ls` can pair."""
     if not enabled:
         yield None
         return
     import jax
+    from shifu_tpu.obs import trace as obs_trace
     out = os.path.join(root, "tmp", "profile",
-                       f"{step}-{int(time.time())}")
+                       obs_trace.current_run_id(step))
     os.makedirs(out, exist_ok=True)
     jax.profiler.start_trace(out)
     try:

@@ -146,7 +146,7 @@ FAULT_SITES = (
     "ckpt.restore", "ckpt.reshard",
     "atomic.commit", "pipeline.fetch", "serve.request",
     "dist.init", "dist.barrier", "dist.allgather",
-    "dist.preempt_marker", "dag.node",
+    "dist.preempt_marker", "dag.node", "obs.export",
 )
 
 
@@ -747,6 +747,15 @@ def dump_thread_stacks(reason: str) -> str:
     collective timeout so a hung pod leaves a diagnosable trace."""
     names = {t.ident: t.name for t in threading.enumerate()}
     parts = [f"==== thread stacks: {reason} ===="]
+    try:
+        from shifu_tpu.obs import trace as obs_trace
+        open_ = obs_trace.open_spans()
+        if open_:
+            parts.append("open spans: " + "; ".join(
+                f"{s['name']} ({s['age_s']}s, {s['thread']})"
+                for s in open_))
+    except Exception:  # noqa: BLE001 — the dump must never fail
+        pass
     for ident, frame in sys._current_frames().items():
         parts.append(f"--- thread {names.get(ident, '?')} (ident {ident}) ---")
         parts.append("".join(traceback.format_stack(frame)).rstrip())

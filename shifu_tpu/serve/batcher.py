@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, List, Optional
 from shifu_tpu import resilience
 from shifu_tpu.config import environment as env
 from shifu_tpu.data import pipeline
+from shifu_tpu.obs import trace as obs_trace
 
 SERVE_SITE = "serve.request"
 
@@ -186,6 +187,10 @@ class MicroBatcher:
         self.rows += rows
         self._occupancy_sum += rows / self.max_rows
         pipeline.add_stage_count("serve_batches")
+        # batch-formation span: opener admission → batch sealed
+        obs_trace.record_span("serve.flush", opener.t_submit, t,
+                              track="serve", requests=len(batch),
+                              rows=rows)
         return batch
 
     def stats(self) -> Dict[str, Any]:

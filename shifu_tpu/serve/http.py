@@ -11,6 +11,7 @@ bounded by the batcher, not the listener.
                    "raw_codes"?}            → scores + per-stage ms
     GET  /healthz                           → liveness
     GET  /stats                             → service counters
+    GET  /metrics                           → Prometheus text exposition
 """
 
 from __future__ import annotations
@@ -37,6 +38,47 @@ def _np_blocks(payload: Dict[str, Any]) -> Dict[str, np.ndarray]:
     return out
 
 
+def prometheus_text(service: ScorerService) -> str:
+    """Render the service's existing accruals (batcher counters +
+    latency percentiles) in the Prometheus text exposition format —
+    counters as `shifu_serve_*_total`, gauges/summaries otherwise."""
+    st = service.stats()
+    b = st.get("batcher", {})
+    lat = st.get("latency", {})
+    lines = []
+
+    def _metric(name: str, mtype: str, help_: str, value,
+                labels: str = "") -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name}{labels} {float(value):.6g}")
+
+    _metric("shifu_serve_requests_total", "counter",
+            "requests admitted by the micro-batcher",
+            b.get("requests", 0))
+    _metric("shifu_serve_batches_total", "counter",
+            "batches formed and scored", b.get("batches", 0))
+    _metric("shifu_serve_rows_total", "counter",
+            "rows scored across all batches", b.get("rows", 0))
+    _metric("shifu_serve_queue_depth", "gauge",
+            "requests waiting in the admission queue",
+            b.get("queued_now", 0))
+    _metric("shifu_serve_batch_occupancy", "gauge",
+            "mean batch fill fraction vs the top shape bucket",
+            b.get("occupancy_mean", 0.0))
+    _metric("shifu_serve_rows_per_batch", "gauge",
+            "mean rows per formed batch", b.get("rows_per_batch", 0.0))
+    lines.append("# HELP shifu_serve_latency_ms request latency "
+                 "percentiles over the recent window")
+    lines.append("# TYPE shifu_serve_latency_ms summary")
+    for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                   ("0.99", "p99_ms")):
+        if key in lat:
+            lines.append(f'shifu_serve_latency_ms{{quantile="{q}"}} '
+                         f"{float(lat[key]):.6g}")
+    return "\n".join(lines) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     service: ScorerService  # set on the server class by serve_http
 
@@ -51,11 +93,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _reply_text(self, code: int, text: str) -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
         if self.path == "/healthz":
             self._reply(200, {"ok": True})
         elif self.path == "/stats":
             self._reply(200, self.server.service.stats())
+        elif self.path == "/metrics":
+            self._reply_text(200, prometheus_text(self.server.service))
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

@@ -34,6 +34,7 @@ import numpy as np
 from shifu_tpu.config import environment as env
 from shifu_tpu.data import pipeline
 from shifu_tpu.eval.scorer import Scorer
+from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.serve import aot
 from shifu_tpu.serve.batcher import MicroBatcher, Request
 
@@ -215,9 +216,26 @@ class ScorerService:
             off += r.n
             t_done = time.monotonic()
             r.timing["d2h_s"] = t_done - t_prev
-            t_prev = t_done
             r.timing["total_s"] = t_done - r.t_submit
             self._latencies.append(r.timing["total_s"])
+            if obs_trace.active():
+                # one span per request, children cut from the exact
+                # timestamps the timing splits are computed from
+                rid = obs_trace.record_span(
+                    "serve.request", r.t_submit, t_done,
+                    track="serve", rows=r.n)
+                obs_trace.record_span("serve.queue", r.t_submit,
+                                      r.t_batched, parent=rid,
+                                      track="serve")
+                obs_trace.record_span("serve.pad", t0, t_pad,
+                                      parent=rid, track="serve")
+                obs_trace.record_span("serve.h2d", t_pad, t_h2d,
+                                      parent=rid, track="serve")
+                obs_trace.record_span("serve.device", t_h2d, t_dev,
+                                      parent=rid, track="serve")
+                obs_trace.record_span("serve.d2h", t_prev, t_done,
+                                      parent=rid, track="serve")
+            t_prev = t_done
             r.resolve(sliced)
         t_d2h = time.monotonic()
 

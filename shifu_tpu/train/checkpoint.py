@@ -66,6 +66,7 @@ import numpy as np
 from shifu_tpu.analysis.lockcheck import make_lock
 from shifu_tpu.config.environment import knob_bool, knob_int
 from shifu_tpu.data import pipeline as pipe
+from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.resilience import atomic_write, fault_point, sweep_stale_tmp
 
 log = logging.getLogger("shifu_tpu")
@@ -83,8 +84,9 @@ def _snapshot(state: Any) -> Any:
     it returns, the caller may donate/overwrite the device buffers
     (np.asarray would alias host-resident numpy leaves, letting an
     in-place update race the background serialize)."""
-    fault_point("ckpt.stage")
-    return jax.tree.map(lambda x: np.array(x), state)
+    with obs_trace.span("ckpt.stage"):
+        fault_point("ckpt.stage")
+        return jax.tree.map(lambda x: np.array(x), state)
 
 
 def _sidecar_name(step: int) -> str:
@@ -154,6 +156,12 @@ def _publish(ckpt_dir: str, step: int, snap: Any,
     thread in async mode. The sidecar commits AFTER the step itself —
     a kill between the two leaves a restorable step that falls back to
     replicated placement, never the reverse."""
+    with obs_trace.span("ckpt.publish", step=step):
+        _publish_impl(ckpt_dir, step, snap, meta)
+
+
+def _publish_impl(ckpt_dir: str, step: int, snap: Any,
+                  meta: Optional[dict] = None) -> None:
     ckpt_dir = os.path.abspath(ckpt_dir)
     sweep_stale_tmp(ckpt_dir)
     path = os.path.join(ckpt_dir, f"step_{step}")

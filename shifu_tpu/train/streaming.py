@@ -63,6 +63,7 @@ from shifu_tpu import resilience
 from shifu_tpu.config.model_config import ModelTrainConf
 from shifu_tpu.data import pipeline as pipe
 from shifu_tpu.models import nn as nn_mod
+from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.parallel import dist
 from shifu_tpu.parallel import mesh as mesh_mod
 from shifu_tpu.train.optimizers import optimizer_from_params
@@ -449,7 +450,9 @@ def train_streaming_core(train_conf: ModelTrainConf,
             placed = [mesh_mod.shard_axis(mesh, x, 0) for x in inputs]
             tail_p = mesh_mod.shard_axis(mesh, tail,
                                          axis=1 if with_bags else 0)
-        pipe.add_stage_time("h2d_s", time.monotonic() - t0)
+        t1 = time.monotonic()
+        pipe.add_stage_time("h2d_s", t1 - t0)
+        obs_trace.record_span("input.h2d", t0, t1)
         return (*placed, tail_p)
 
     # a REAL copy, not an alias: with buffer donation the first update

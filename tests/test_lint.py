@@ -478,6 +478,85 @@ def test_dag_registry_all_guarded_in_package():
 
 
 # ---------------------------------------------------------------------------
+# unregistered-span
+# ---------------------------------------------------------------------------
+
+def test_span_positive(tmp_path):
+    src = """
+        from shifu_tpu.obs.trace import span
+
+        def go():
+            with span("mystery.stage"):
+                pass
+    """
+    report = lint_source(tmp_path, src, rules=["unregistered-span"])
+    assert any("mystery.stage" in f.message for f in report.findings)
+
+
+def test_span_negative_registered_and_dynamic(tmp_path):
+    src = """
+        from shifu_tpu.obs import trace as obs_trace
+
+        def go(node, t0, t1):
+            with obs_trace.span("dag.node", node=node):
+                pass
+            obs_trace.record_span(f"serve.{node}", t0, t1)
+    """
+    report = lint_source(tmp_path, src, rules=["unregistered-span"])
+    per_file = [f for f in report.findings if f.line > 0]
+    assert not per_file
+
+
+def test_span_dynamic_outside_family_flagged(tmp_path):
+    src = """
+        from shifu_tpu.obs.trace import record_span
+
+        def go(x, t0, t1):
+            record_span(f"mystery.{x}", t0, t1)
+    """
+    report = lint_source(tmp_path, src, rules=["unregistered-span"])
+    assert any("prefix" in f.message for f in report.findings)
+
+
+def test_span_numeric_local_named_span_clean(tmp_path):
+    # the stats kernels use `span` as a numeric local (bin widths);
+    # only calls whose first argument is a string literal are span
+    # emissions
+    src = """
+        import numpy as np
+
+        def go(hi, lo, span):
+            width = np.maximum(hi - lo, 1e-9)
+            return span(width)
+    """
+    report = lint_source(tmp_path, src, rules=["unregistered-span"])
+    assert not report.findings
+
+
+def test_span_suppressed(tmp_path):
+    src = """
+        from shifu_tpu.obs.trace import span
+
+        def go():
+            with span("mystery.stage"):  # lint: disable=unregistered-span -- fixture
+                pass
+    """
+    report = lint_source(tmp_path, src, rules=["unregistered-span"])
+    assert not report.findings
+    assert any(f.rule == "unregistered-span" for f in report.suppressed)
+
+
+def test_span_registry_all_emitted_in_package():
+    """Reverse direction at package scope: every SPAN_FAMILIES entry
+    has a live span()/record_span() call site (the finalize hook
+    reports dead vocabulary rows)."""
+    report = engine.run([os.path.join(REPO, "shifu_tpu")],
+                        rules=["unregistered-span"])
+    dead = [f for f in report.findings if "never emitted" in f.message]
+    assert not dead, "\n".join(f.format() for f in dead)
+
+
+# ---------------------------------------------------------------------------
 # blocking-under-lock
 # ---------------------------------------------------------------------------
 

@@ -39,6 +39,11 @@ its records from the tuple. Members of the pinned tuples are excluded
 from the stage-field heuristic — `queue_s`/`wall_s`/... are dag-block
 keys, not ``inputPipeline`` stages.
 
+The ``trace`` block (attached to every step run with
+``SHIFU_TPU_TRACE=1``) is pinned likewise: its schema is
+``profiling.TRACE_FIELDS``, every member must be README-documented,
+and obs/trace.py must build the block from the tuple.
+
 Optionally pass a real steps.jsonl to ALSO verify against a live log
 (every documented field must appear in at least one record's
 ``inputPipeline`` block across the file, and any record carrying a
@@ -73,7 +78,8 @@ def documented_fields() -> set:
     # members of the pinned block schemas (roofline/serving/dag) are
     # documented as those blocks' keys, not inputPipeline stages
     pinned = set(roofline_fields()) | set(serving_fields()) | \
-        set(dag_fields()) | set(dag_summary_fields())
+        set(dag_fields()) | set(dag_summary_fields()) | \
+        set(trace_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -144,6 +150,10 @@ def dag_fields() -> tuple:
 
 def dag_summary_fields() -> tuple:
     return _profiling_tuple("DAG_SUMMARY_FIELDS")
+
+
+def trace_fields() -> tuple:
+    return _profiling_tuple("TRACE_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -219,6 +229,33 @@ def check_dag_docs() -> int:
     return 0
 
 
+def check_trace_docs() -> int:
+    """Every TRACE_FIELDS member (the steps.jsonl ``trace`` block the
+    span tracer attaches) must be backtick-documented in README's
+    Observability section, and obs/trace.py must build the block from
+    the tuple — the literal check asserts trace.py references
+    `TRACE_FIELDS` so the block cannot silently drift from the pinned
+    schema."""
+    fields = trace_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("trace schema drift: TRACE_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    tracer = os.path.join(PKG, "obs", "trace.py")
+    with open(tracer, encoding="utf-8") as f:
+        uses = "TRACE_FIELDS" in f.read()
+    if not uses:
+        print("obs/trace.py no longer builds the trace block from "
+              "profiling.TRACE_FIELDS", file=sys.stderr)
+        return 1
+    print(f"trace plane: all {len(fields)} TRACE_FIELDS documented in "
+          "README and pinned in obs/trace.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -275,6 +312,8 @@ def main(argv) -> int:
     if check_serving_docs():
         return 1
     if check_dag_docs():
+        return 1
+    if check_trace_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
