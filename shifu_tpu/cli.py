@@ -176,6 +176,74 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    """`shifu watch --monitor-only` — the long-running model health
+    loop: rolling PSI/KS drift over data arriving at the training
+    dataPath, SLO guardrail evaluation with alerting, everything
+    persisted to the metrics store (and span-traced, so `shifu top`
+    shows the loop live). The retrain/promote half of ROADMAP item 1
+    is a documented seam (obs.health.watch.on_breach), hence the
+    required flag."""
+    if not args.monitor_only:
+        raise SystemExit(
+            "watch: only --monitor-only is implemented — the "
+            "drift-triggered retrain/promote loop is the named seam "
+            "obs.health.watch.on_breach (ROADMAP item 1, next PR)")
+    from shifu_tpu.obs.health import watch as watch_mod
+    return watch_mod.run_monitor(
+        _ctx(args),
+        interval_s=args.interval_s,
+        iterations=args.iterations if args.iterations > 0 else None)
+
+
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values) -> str:
+    """Unicode sparkline over a value series (empty-safe)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_BARS[0] * len(vals)
+    scale = (len(_SPARK_BARS) - 1) / (hi - lo)
+    return "".join(_SPARK_BARS[int((v - lo) * scale)] for v in vals)
+
+
+def cmd_health(args) -> int:
+    """`shifu health` — current SLO state over the metrics store:
+    per-rule status with a sparkline trend of the underlying metric,
+    plus the recent breach/warn event tail. Read-only (works without
+    SHIFU_TPU_METRICS set — it inspects history already recorded)."""
+    from shifu_tpu.obs.health import slo as slo_mod
+    from shifu_tpu.obs.health import store as health_store
+    root = args.dir
+    state = slo_mod.health_state(root)
+    st = health_store.store(root)
+    print(f"status: {state['status'].upper()}  ({root})")
+    name_w = max([len(s["name"]) for s in state["slos"]] + [4])
+    met_w = max([len(s["metric"]) for s in state["slos"]] + [6])
+    print(f"{'slo':<{name_w}}  {'state':<6} {'value':>10}  "
+          f"{'metric':<{met_w}}  trend")
+    for s in state["slos"]:
+        series = st.series(s["metric"], limit=args.trend)
+        val = "-" if s["value"] is None else f"{s['value']:.4g}"
+        print(f"{s['name']:<{name_w}}  {s['state']:<6} {val:>10}  "
+              f"{s['metric']:<{met_w}}  "
+              f"{_spark([v for _, v in series])}")
+    events = state["recent_events"]
+    if events:
+        print("recent events:")
+        for ev in events:
+            tags = ev.get("tags") or {}
+            ts = time.strftime("%m-%d %H:%M:%S",
+                               time.localtime(ev.get("ts", 0)))
+            detail = " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            print(f"  {ts}  {ev.get('name', '?'):<16} {detail}")
+    return 0 if state["status"] != "breach" else 1
+
+
 def cmd_export(args) -> int:
     from shifu_tpu.processor import export as p
     return p.run(_ctx(args), export_type=args.type)
@@ -378,6 +446,23 @@ def _top_render(root: str) -> str:
     if live:
         lines.append("live trace runs:")
         lines.extend(live)
+    # health/drift tail from the persistent metrics store (absorbed —
+    # a corrupt store must not break the monitor)
+    try:
+        from shifu_tpu.obs.health import store as health_store
+        events = health_store.store(root).events(
+            limit=5, names=["drift", "breach", "warn", "recovered"])
+        if events:
+            lines.append("health/drift events:")
+            for ev in events:
+                tags = ev.get("tags") or {}
+                ts = time.strftime("%H:%M:%S",
+                                   time.localtime(ev.get("ts", 0)))
+                detail = " ".join(f"{k}={v}"
+                                  for k, v in sorted(tags.items()))
+                lines.append(f"  {ts}  {ev.get('name', '?'):<16} {detail}")
+    except Exception:  # noqa: BLE001 — monitoring must not fail top
+        pass
     return "\n".join(lines)
 
 
@@ -543,6 +628,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exit after this many seconds (0 = run until "
                         "SIGTERM/SIGINT)")
     p.set_defaults(fn=cmd_serve)
+    p = sub.add_parser("watch",
+                       help="long-running model health monitor "
+                            "(rolling drift + SLO guardrails)")
+    p.add_argument("--monitor-only", action="store_true",
+                   help="drift/SLO monitoring without the retrain "
+                        "trigger (currently the only mode)")
+    p.add_argument("--interval-s", type=float, default=None,
+                   help="tick period (default "
+                        "SHIFU_TPU_WATCH_INTERVAL_S)")
+    p.add_argument("--iterations", type=int, default=0,
+                   help="stop after N ticks (0 = run until "
+                        "SIGTERM/SIGINT)")
+    p.set_defaults(fn=cmd_watch)
+    p = sub.add_parser("health",
+                       help="SLO health over the metrics store: "
+                            "status, trends, recent breaches")
+    p.add_argument("--trend", type=int, default=30,
+                   help="points per sparkline trend")
+    p.set_defaults(fn=cmd_health)
     p = sub.add_parser("export", help="export model/stats")
     p.add_argument("-t", "--type", default="columnstats",
                    choices=["columnstats", "correlation", "woemapping",
@@ -646,7 +750,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # barrier just to copy files.
     if args.command in ("init", "stats", "norm", "normalize", "varsel",
                         "varselect", "train", "posttrain", "eval",
-                        "export", "encode", "combo", "serve"):
+                        "export", "encode", "combo", "serve", "watch"):
         from shifu_tpu.parallel import dist
         dist.initialize()
     t0 = time.time()

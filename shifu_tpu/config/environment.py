@@ -277,6 +277,29 @@ _declare("SHIFU_TPU_TRACE_DIR", "str", None,
          "trace workspace for this run's span files; normally unset "
          "(the coordinator derives tmp/trace/<run_id> and exports it "
          "so DAG subprocess nodes land their spans in the same merge)")
+# --- observability / health plane ---
+_declare("SHIFU_TPU_METRICS", "flag", "0",
+         "1 = persist metric points to tmp/metrics/metrics.jsonl "
+         "(step snapshots, drift, SLO health); unset/0 = no files "
+         "written (reads still work)")
+_declare("SHIFU_TPU_METRICS_ROLLUP", "int", 4 * 1024 * 1024,
+         "metrics.jsonl size (bytes) that triggers rollup compaction "
+         "(older half aggregated, recent half kept raw, atomic "
+         "rewrite); 0 = never compact")
+_declare("SHIFU_TPU_METRICS_FLUSH_S", "float", 30.0,
+         "period of the serving plane's background metrics flush "
+         "(serve.* gauges from ScorerService.stats)")
+_declare("SHIFU_TPU_WATCH_INTERVAL_S", "float", 30.0,
+         "tick period of the `shifu watch --monitor-only` loop")
+_declare("SHIFU_TPU_SLO_FILE", "str", None,
+         "path to slo.json; unset = <model set>/slo.json when present, "
+         "else the built-in default guardrails (obs/health/slo.py)")
+_declare("SHIFU_TPU_DRIFT_THRESHOLD", "float", 0.2,
+         "per-feature PSI above which a window emits a `drift` event "
+         "(0.2 = the conventional 'significant shift' cutoff)")
+_declare("SHIFU_TPU_ALERT_WEBHOOK", "str", None,
+         "URL the webhook alert sink POSTs SLO transition records to; "
+         "unset = sink disabled")
 # --- bench / tools (read outside the package) ---
 _declare("SHIFU_TPU_BENCH_ATTEMPTS", "int", 2,
          "re-measure attempts per bench workload", scope="bench")

@@ -1,0 +1,24 @@
+"""Model health plane: the durable half of the observability stack.
+
+PR 10's trace plane answers "what is this step doing RIGHT NOW"; this
+package answers "how has this model set been doing ACROSS runs":
+
+- `store`  — append-only, atomically-compacted per-workspace metric
+  time-series (`tmp/metrics/metrics.jsonl`) behind a small
+  counter/gauge/event API; every step flushes a snapshot at exit and
+  long-lived `shifu serve` processes flush periodically.
+- `drift`  — rolling PSI/KS monitors: incremental per-feature bin
+  counts (pure associative sums, the streaming-stats discipline) over
+  arriving data windows against the frozen training bins in
+  ColumnConfig, parity-gated against the one-shot `processor/psi.py`.
+- `slo`    — declarative `slo.json` guardrails evaluated over the
+  store with hysteresis, emitting ok/warn/breach health events to
+  pluggable alert sinks (log / file / webhook stub).
+- `watch`  — the long-running `shifu watch --monitor-only` loop that
+  ties the three together (the retrain trigger is a documented seam).
+
+Everything here is OFF unless `SHIFU_TPU_METRICS=1`, and every write
+or alert failure is absorbed through a registered fault site — the
+health plane can never fail the step it watches (the obs.export
+discipline).
+"""

@@ -1,0 +1,158 @@
+"""`shifu watch --monitor-only` — the long-running drift/SLO loop.
+
+Every ``SHIFU_TPU_WATCH_INTERVAL_S`` seconds the loop takes one tick:
+
+  1. collect the next data window — in production mode that is any
+     rows appended to the training dataPath since the last tick (the
+     arriving-data tail); tests inject windows directly;
+  2. feed the window to the `RollingDrift` monitor inside a
+     `watch.window` span + fault site — a poisoned window is logged,
+     counted, and SKIPPED, never fatal (absorbed, the chaos drill);
+  3. run the `SloEvaluator` inside a `watch.evaluate` span — drift
+     thresholds, latency/AUC guardrails, hysteresis, alert fan-out;
+  4. flush the metrics store (absorbed).
+
+The loop honors the shared preemption contract
+(`resilience.graceful_shutdown`): SIGTERM finishes the current tick
+and exits cleanly with everything flushed.
+
+RETRAIN-TRIGGER SEAM: `on_breach` is where ROADMAP item 1's second
+half plugs in — a breach of the drift SLO should schedule a
+warm-start incremental-training DAG (restore via the async-ckpt
+layer, train on the drifted window, eval-guardrail vs the incumbent,
+atomic model promotion). This PR deliberately stops at the breach
+event; `on_breach` only logs the decision point so the next PR can
+replace exactly one function.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Iterable, Optional
+
+from shifu_tpu.config.environment import knob_float
+from shifu_tpu.obs import trace as obs_trace
+from shifu_tpu.obs.health import store as health_store
+from shifu_tpu.obs.health.drift import RollingDrift
+from shifu_tpu.obs.health.slo import SloEvaluator
+
+log = logging.getLogger(__name__)
+
+
+def on_breach(record: Dict) -> None:
+    """THE SEAM (see module docstring): called once per SLO
+    transition into `breach`. Replace with the warm-start retrain
+    DAG scheduler; until then it only names the decision."""
+    log.warning("breach of %r — retrain trigger not wired yet "
+                "(ROADMAP item 1, next PR)", record.get("slo"))
+
+
+def _production_window(ctx, seen_rows: int):
+    """Rows appended to the training dataPath since the last tick
+    (None when nothing new). A rewritten-shorter table resets the
+    cursor — treat the whole table as a fresh window."""
+    from shifu_tpu.data.reader import read_raw_table
+    df = read_raw_table(ctx.model_config)
+    if len(df) < seen_rows:
+        seen_rows = 0
+    if len(df) == seen_rows:
+        return None, seen_rows
+    return df.iloc[seen_rows:].reset_index(drop=True), len(df)
+
+
+def run_monitor(ctx, interval_s: Optional[float] = None,
+                iterations: Optional[int] = None,
+                windows: Optional[Iterable] = None) -> int:
+    """The monitor loop. `iterations` bounds the run (None = until
+    SIGTERM); `windows` injects an explicit window sequence (tests,
+    replays) instead of tailing the dataPath."""
+    from shifu_tpu import resilience
+
+    root = ctx.path_finder.root
+    st = health_store.store(root)
+    interval = interval_s if interval_s is not None \
+        else knob_float("SHIFU_TPU_WATCH_INTERVAL_S")
+    drift = RollingDrift(ctx)
+    slo = SloEvaluator(root)
+    injected = iter(windows) if windows is not None else None
+    seen_rows = 0
+    ticks = windows_ok = windows_failed = 0
+    log.info("watch: monitoring %s every %.1fs (%d features with "
+             "frozen bins)", root, interval, drift.n_features)
+
+    with resilience.graceful_shutdown("watching"):
+        while not resilience.preempt_requested():
+            tick_t0 = time.monotonic()
+
+            # 1. next window
+            df = None
+            if injected is not None:
+                df = next(injected, None)
+                if df is None and iterations is None:
+                    break   # replay exhausted
+            else:
+                df, seen_rows = _production_window(ctx, seen_rows)
+
+            # 2. drift over the window — absorbed: a bad window can
+            # never kill the monitor
+            if df is not None and len(df):
+                try:
+                    with obs_trace.span("watch.window", rows=len(df)):
+                        resilience.fault_point("watch.window")
+                        snap = drift.observe(df)
+                    _emit_drift(st, snap)
+                    windows_ok += 1
+                except Exception as e:  # noqa: BLE001 — absorbed
+                    windows_failed += 1
+                    st.counter("watch.window_failed")
+                    log.warning("watch: window skipped (absorbed): %s", e)
+
+            # 3. guardrails (the evaluator alerts on transitions;
+            # breaches additionally hit the retrain seam)
+            with obs_trace.span("watch.evaluate"):
+                slo.evaluate()
+            for rec in slo.drain_transitions():
+                if rec["state"] == "breach":
+                    on_breach(rec)
+
+            # 4. persist — absorbed
+            st.counter("watch.tick")
+            try:
+                st.flush()
+            except Exception as e:  # noqa: BLE001 — absorbed
+                log.warning("watch: flush failed (absorbed): %s", e)
+
+            ticks += 1
+            if iterations is not None and ticks >= iterations:
+                break
+            spent = time.monotonic() - tick_t0
+            wait = max(0.0, interval - spent)
+            deadline = time.monotonic() + wait
+            while time.monotonic() < deadline:
+                if resilience.preempt_requested():
+                    break
+                time.sleep(min(0.2, max(0.0,
+                                        deadline - time.monotonic())))
+
+    try:
+        st.flush()
+    except Exception as e:  # noqa: BLE001 — absorbed
+        log.warning("watch: final flush failed (absorbed): %s", e)
+    log.info("watch: %d tick(s), %d window(s) ok, %d skipped",
+             ticks, windows_ok, windows_failed)
+    return 0
+
+
+def _emit_drift(st, snap: Dict) -> None:
+    """Snapshot → metric points + a `drift` event when any feature is
+    over threshold."""
+    st.emit("drift.psi_max", snap["psi_max"], window=snap["window"])
+    st.emit("drift.psi_mean", snap["psi_mean"], window=snap["window"])
+    st.emit("drift.ks_max", snap["ks_max"], window=snap["window"])
+    for name, f in snap["features"].items():
+        st.emit("drift.feature_psi", f["psi"], feature=name,
+                window=snap["window"])
+    if snap["drifted"]:
+        st.event("drift", features=",".join(snap["drifted"]),
+                 psi_max=snap["psi_max"], window=snap["window"])

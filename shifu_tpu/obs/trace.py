@@ -75,6 +75,9 @@ SPAN_FAMILIES: Dict[str, Tuple[str, ...]] = {
     "dist": ("collective",),
     # async checkpoint writer seams
     "ckpt": ("stage", "publish"),
+    # the health plane's monitor loop: one window span per ingested
+    # drift window, one evaluate span per SLO pass
+    "watch": ("window", "evaluate"),
 }
 
 

@@ -184,3 +184,7 @@ def step_guard(ctx: ProcessorContext, step: str,
             json.dump({"step": step,
                        "fingerprint": _inputs_fingerprint(ctx),
                        "outputs": list(outputs)}, f, indent=1)
+        # heartbeat the persistent metrics store (no-op unless
+        # SHIFU_TPU_METRICS=1; absorbed — never fails the step)
+        from shifu_tpu.obs.health import store as health_store
+        health_store.step_completed(pf.root, step)

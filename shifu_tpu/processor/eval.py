@@ -501,6 +501,9 @@ def run_one(ctx: ProcessorContext, ec: EvalConfig) -> Dict:
     log.info("eval[%s]: %d rows, AUC=%.4f (weighted %.4f) in %.2fs",
              ec.name, len(final), perf["areaUnderRoc"],
              perf["weightedAreaUnderRoc"], time.time() - t0)
+    from shifu_tpu.obs.health import store as health_store
+    health_store.eval_metrics(ctx.path_finder.root, ec.name, perf,
+                              model=mc.model_set_name)
     return perf
 
 
@@ -690,6 +693,9 @@ def _finish_streaming(ctx, ec, chunk_rows, t0, status, n_chunks,
              "(weighted %.4f) in %.2fs", ec.name, status["records"],
              n_chunks, perf["areaUnderRoc"],
              perf["weightedAreaUnderRoc"], time.time() - t0)
+    from shifu_tpu.obs.health import store as health_store
+    health_store.eval_metrics(ctx.path_finder.root, ec.name, perf,
+                              model=mc.model_set_name)
     return perf
 
 

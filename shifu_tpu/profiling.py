@@ -325,6 +325,13 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
                 f.write(json.dumps(rec) + "\n")
         except OSError as e:
             log.warning("metrics: could not write steps.jsonl: %s", e)
+        try:
+            # mirror the finished record into the persistent metrics
+            # store (no-op unless SHIFU_TPU_METRICS=1)
+            from shifu_tpu.obs.health import store as health_store
+            health_store.flush_step_record(root, rec)
+        except Exception as e:  # noqa: BLE001 — metrics must never fail a run
+            log.warning("metrics store flush failed (absorbed): %s", e)
 
 
 # ---------------------------------------------------------------------------
@@ -374,6 +381,23 @@ DAG_SUMMARY_FIELDS = ("workers", "wall_s", "critical_path_s",
 # tools/check_steps_schema.py pins README docs to this tuple the same
 # way it pins ROOFLINE_FIELDS.
 TRACE_FIELDS = ("span_count", "dropped_spans", "top_self")
+
+# the metrics store's point schema: every line of tmp/metrics/
+# metrics.jsonl is built from exactly this tuple
+# (obs/health/store.py:_point) — when the point was taken, the metric
+# name, its value (a number, or the count/sum/min/max/last dict for
+# `rollup` points), the point kind (counter|gauge|event|rollup), and
+# the flat tag map (step, run_id, feature, ...). Pinned in README by
+# tools/check_steps_schema.py like ROOFLINE_FIELDS.
+METRIC_FIELDS = ("ts", "name", "value", "kind", "tags")
+
+# the SLO evaluator's record schema: obs/health/slo.py builds every
+# evaluation/transition record from exactly this tuple — the rule
+# name, the store metric it reads, ok|warn|breach after hysteresis,
+# the aggregated value observed, the two thresholds, and the read
+# window. Pinned in README by tools/check_steps_schema.py.
+HEALTH_FIELDS = ("slo", "metric", "state", "value", "warn", "breach",
+                 "window_s")
 
 
 def mlp_row_costs(input_dim: int, hidden_dims, n_out: int = 1,

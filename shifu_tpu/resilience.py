@@ -147,6 +147,7 @@ FAULT_SITES = (
     "atomic.commit", "pipeline.fetch", "serve.request",
     "dist.init", "dist.barrier", "dist.allgather",
     "dist.preempt_marker", "dag.node", "obs.export",
+    "obs.metrics_flush", "obs.alert", "watch.window",
 )
 
 

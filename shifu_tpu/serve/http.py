@@ -104,7 +104,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._reply(200, {"ok": True})
+            # liveness (ok) + the workspace's SLO state when the
+            # service knows its workspace — breach does NOT flip `ok`
+            # (the process is alive; the SLO block is for routers and
+            # dashboards that want to act on degradation)
+            body: Dict[str, Any] = {"ok": True}
+            slo = self.server.service.health_state()
+            if slo is not None:
+                body["status"] = slo["status"]
+                body["slo"] = slo["slos"]
+            self._reply(200, body)
         elif self.path == "/stats":
             self._reply(200, self.server.service.stats())
         elif self.path == "/metrics":

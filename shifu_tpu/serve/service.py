@@ -25,6 +25,7 @@ happens inside `score_matrix` and is accounted under device.
 from __future__ import annotations
 
 import collections
+import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -54,6 +55,7 @@ class ScorerService:
                  queue_depth: Optional[int] = None,
                  workspace_root: Optional[str] = None,
                  aot_compile: bool = True):
+        self._workspace_root = workspace_root
         if workspace_root is not None:
             from shifu_tpu import profiling
             profiling.enable_compile_cache(workspace_root)
@@ -80,6 +82,9 @@ class ScorerService:
         # consumer-thread-appended; stats() reads racily (monitoring)
         self._latencies: collections.deque = collections.deque(maxlen=8192)
         self._schema_lock = threading.Lock()
+        self._rejected = 0
+        self._flush_stop = threading.Event()
+        self._flush_thread: Optional[threading.Thread] = None
 
     # pre-place the padded dense block on device only when every model
     # reads it as-is: an all-NN ensemble with no fused-normalize route
@@ -114,9 +119,15 @@ class ScorerService:
             pipeline.add_stage_time("serve_warm_s", self._warm_s)
         self._batcher.start()
         self._started = True
+        self._start_metrics_flusher()
         return self
 
     def close(self) -> None:
+        self._flush_stop.set()
+        if self._flush_thread is not None:
+            self._flush_thread.join(timeout=5.0)
+            self._flush_thread = None
+        self._flush_metrics()   # final snapshot before teardown
         self._batcher.close()
         self._started = False
 
@@ -155,7 +166,11 @@ class ScorerService:
         n = next(iter(blocks.values())).shape[0]
         if any(v.shape[0] != n for v in blocks.values()):
             raise ValueError("feature blocks disagree on row count")
-        return self._batcher.submit(blocks, n)
+        try:
+            return self._batcher.submit(blocks, n)
+        except queue.Full:
+            self._rejected += 1   # the 429 the front end answers with
+            raise
 
     def submit(self, dense: Optional[np.ndarray] = None,
                index: Optional[np.ndarray] = None,
@@ -258,6 +273,70 @@ class ScorerService:
             "warm_s": self._warm_s,
             "warmed_buckets": self._warmed_buckets,
             "aot_executables": len(self._aot_executables),
+            "rejected": self._rejected,
             "latency": pct,
             "batcher": self._batcher.stats(),
         }
+
+    # -- health plane --------------------------------------------------
+    def _start_metrics_flusher(self) -> None:
+        """Background thread: snapshot stats() into the persistent
+        metrics store every SHIFU_TPU_METRICS_FLUSH_S seconds, so
+        long-lived serve processes leave a time-series behind (batch
+        steps get theirs from step_metrics exit). No-op unless
+        SHIFU_TPU_METRICS=1 and the service knows its workspace."""
+        from shifu_tpu.obs.health import store as health_store
+        if self._workspace_root is None or \
+                not health_store.metrics_enabled() or \
+                self._flush_thread is not None:
+            return
+        period = float(env.knob_float("SHIFU_TPU_METRICS_FLUSH_S"))
+        self._flush_stop.clear()
+
+        def loop() -> None:
+            while not self._flush_stop.wait(period):
+                self._flush_metrics()
+
+        self._flush_thread = threading.Thread(
+            target=loop, name="serve-metrics-flush", daemon=True)
+        self._flush_thread.start()
+
+    def _flush_metrics(self) -> None:
+        """One stats() snapshot → serve.* gauges; absorbed — a metrics
+        failure can never degrade serving."""
+        try:
+            from shifu_tpu.obs.health import store as health_store
+            if self._workspace_root is None or \
+                    not health_store.metrics_enabled():
+                return
+            st = health_store.store(self._workspace_root)
+            snap = self.stats()
+            for k, v in snap["latency"].items():
+                st.emit(f"serve.{k}", round(float(v), 4))
+            b = snap["batcher"]
+            for k in ("requests", "batches", "rows", "queued_now",
+                      "occupancy_mean", "rows_per_batch"):
+                if isinstance(b.get(k), (int, float)):
+                    st.emit(f"serve.{k}", b[k])
+            st.emit("serve.rejected", self._rejected, kind="counter")
+            admitted = b.get("requests", 0) or 0
+            denom = admitted + self._rejected
+            st.emit("serve.reject_rate",
+                    round(self._rejected / denom, 6) if denom else 0.0)
+            st.flush()
+        except Exception as e:  # noqa: BLE001 — absorbed by design
+            import logging
+            logging.getLogger(__name__).warning(
+                "serve metrics flush failed (absorbed): %s", e)
+
+    def health_state(self) -> Optional[Dict[str, Any]]:
+        """The workspace's SLO state (obs.health.slo.health_state),
+        or None when the service has no workspace or the read fails —
+        /healthz stays a liveness check either way."""
+        if self._workspace_root is None:
+            return None
+        try:
+            from shifu_tpu.obs.health import slo as slo_mod
+            return slo_mod.health_state(self._workspace_root)
+        except Exception:  # noqa: BLE001 — liveness must not break
+            return None

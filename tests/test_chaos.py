@@ -54,24 +54,28 @@ def _no_tmp_residue(root):
 # one site per instrumented class: filesystem probe, data open, record
 # read, atomic commit, processor step entry, distributed runtime init,
 # checkpoint staging/publish (the async-writer seams), elastic-mesh
-# restore placement, the preempt-marker broadcast, and the span-trace
-# export (a trace-plane failure must never fail the step it watched)
+# restore placement, the preempt-marker broadcast, the span-trace
+# export, and the metrics-store flush (an observability failure must
+# never fail the step it watched)
 CHAOS_SITES = ["fs.exists", "fs.open", "reader.read",
                "atomic.commit", "step.init", "dist.init",
                "ckpt.stage", "ckpt.publish",
-               "ckpt.reshard", "dist.preempt_marker", "obs.export"]
+               "ckpt.reshard", "dist.preempt_marker", "obs.export",
+               "obs.metrics_flush"]
 
 
 @pytest.mark.parametrize("site", CHAOS_SITES)
 def test_injected_fault_never_hangs_and_is_recoverable(
         site, tmp_path, rng, monkeypatch):
-    if site == "obs.export":
-        # the export seam only runs when tracing is on; trace_run must
-        # absorb the fault (contract 2a — the step itself succeeds).
-        # Draw from a private generator: the golden-file tests
-        # downstream share the session rng stream, and this drill is
-        # new relative to their fixtures, so it must not shift it.
-        monkeypatch.setenv("SHIFU_TPU_TRACE", "1")
+    if site in ("obs.export", "obs.metrics_flush"):
+        # these observability seams only run with their knob on;
+        # trace_run / step_completed must absorb the fault (contract
+        # 2a — the step itself succeeds). Draw from a private
+        # generator: the golden-file tests downstream share the
+        # session rng stream, and these drills are new relative to
+        # their fixtures, so they must not shift it.
+        monkeypatch.setenv("SHIFU_TPU_TRACE" if site == "obs.export"
+                           else "SHIFU_TPU_METRICS", "1")
         rng = np.random.default_rng(7)
     model_set = _tiny_model_set(tmp_path, rng)
     monkeypatch.setenv("SHIFU_TPU_FAULT", f"{site}:oserror:1")

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Bench-history regression gate over BENCH_LOCAL.jsonl.
+
+For every (task, backend) series in the persisted bench log, compare
+the NEWEST record against the trailing history (the median of the
+earlier records' throughput): exit 1 when the newest throughput drops
+more than ``--threshold`` percent below that median, or when the
+newest record's roofline ``bound`` category flips (compute ↔ memory)
+relative to the previous record of the same series — a bound flip
+means the kernel moved to the other side of the ridge point, which is
+a perf-structure change worth a human look even when raw throughput
+held.
+
+Throughput is whichever of THROUGHPUT_KEYS the record carries (tasks
+measure different things: row-epochs/s for trainers, cells/s for the
+histogram kernels, sustained QPS for serving, speedup ratios for the
+DAG). Series with fewer than --min-history trailing records are
+reported but never fail the gate — one data point is not a baseline.
+
+Standing caveat (ROADMAP "Perf-claim caveat"): live `bench.py` TPU
+capture has been failing in CI (axon probe timeouts) since r01, so
+BENCH_LOCAL.jsonl records are refreshed manually on real hardware.
+This gate therefore runs as an ADVISORY pass in tools/lint.sh — it
+prints findings without failing lint — because a stale-but-consistent
+history must not block unrelated PRs; run it directly (exit code
+matters then) after refreshing the log on hardware.
+
+    python tools/bench_regress.py [--log BENCH_LOCAL.jsonl]
+                                  [--threshold 20] [--min-history 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# per-record throughput, first match wins (bigger = better for all)
+THROUGHPUT_KEYS = (
+    "row_epochs_per_sec", "row_trees_per_sec", "cells_per_sec",
+    "rows_per_s", "qps_sustained", "stream_train_rows_per_s",
+    "sens_col_rows_per_sec", "nn_row_epochs_per_sec", "dag_speedup",
+    "speedup", "scores_per_sec",
+)
+
+
+def _throughput(rec: Dict) -> Optional[Tuple[str, float]]:
+    for key in THROUGHPUT_KEYS:
+        v = rec.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            return key, float(v)
+    return None
+
+
+def _bound(rec: Dict) -> Optional[str]:
+    roof = rec.get("roofline")
+    if isinstance(roof, dict):
+        b = roof.get("bound")
+        return str(b) if b else None
+    return None
+
+
+def load_series(path: str) -> Dict[Tuple[str, str], List[Dict]]:
+    series: Dict[Tuple[str, str], List[Dict]] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            key = (str(rec.get("task", "?")), str(rec.get("backend", "?")))
+            series.setdefault(key, []).append(rec)
+    for recs in series.values():
+        recs.sort(key=lambda r: r.get("ts", 0.0))
+    return series
+
+
+def check(path: str, threshold_pct: float, min_history: int) -> int:
+    series = load_series(path)
+    if not series:
+        print(f"bench_regress: no records in {path}")
+        return 0
+    findings: List[str] = []
+    for (task, backend), recs in sorted(series.items()):
+        newest, history = recs[-1], recs[:-1]
+        tp = _throughput(newest)
+        label = f"{task}/{backend}"
+        if tp is None:
+            print(f"  {label}: no throughput key — skipped")
+            continue
+        key, value = tp
+        hist_vals = [v for _, v in
+                     filter(None, (_throughput(r) for r in history))]
+        if len(hist_vals) < min_history:
+            print(f"  {label}: {key}={value:.4g} — only "
+                  f"{len(hist_vals)} trailing record(s), no baseline")
+        else:
+            hist_vals.sort()
+            median = hist_vals[len(hist_vals) // 2]
+            floor = median * (1.0 - threshold_pct / 100.0)
+            delta = 100.0 * (value - median) / median
+            if value < floor:
+                findings.append(
+                    f"{label}: {key} {value:.4g} is {-delta:.1f}% below "
+                    f"the trailing median {median:.4g} "
+                    f"(threshold {threshold_pct:.0f}%)")
+            else:
+                print(f"  {label}: {key}={value:.4g} "
+                      f"({delta:+.1f}% vs median of {len(hist_vals)})")
+        nb, pb = _bound(newest), next(
+            (_bound(r) for r in reversed(history) if _bound(r)), None)
+        if nb and pb and nb != pb:
+            findings.append(
+                f"{label}: roofline bound flipped {pb} → {nb} "
+                "(crossed the ridge point — verify intentional)")
+    if findings:
+        print(f"bench_regress: {len(findings)} finding(s) in {path}:",
+              file=sys.stderr)
+        for f_ in findings:
+            print(f"  REGRESSION {f_}", file=sys.stderr)
+        return 1
+    print(f"bench_regress: {len(series)} series clean in {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--log",
+                    default=os.path.join(REPO, "BENCH_LOCAL.jsonl"))
+    ap.add_argument("--threshold", type=float, default=20.0,
+                    help="percent drop vs trailing median that fails")
+    ap.add_argument("--min-history", type=int, default=2,
+                    help="trailing records required to form a baseline")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.log):
+        print(f"bench_regress: {args.log} absent — nothing to gate")
+        return 0
+    return check(args.log, args.threshold, args.min_history)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

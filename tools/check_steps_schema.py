@@ -44,6 +44,12 @@ The ``trace`` block (attached to every step run with
 ``profiling.TRACE_FIELDS``, every member must be README-documented,
 and obs/trace.py must build the block from the tuple.
 
+The health plane is pinned likewise: every metrics.jsonl point is
+``profiling.METRIC_FIELDS`` (built by obs/health/store.py), every SLO
+record is ``profiling.HEALTH_FIELDS`` (built by obs/health/slo.py),
+every member must be README-documented, and both modules must
+reference their tuple.
+
 Optionally pass a real steps.jsonl to ALSO verify against a live log
 (every documented field must appear in at least one record's
 ``inputPipeline`` block across the file, and any record carrying a
@@ -79,7 +85,8 @@ def documented_fields() -> set:
     # documented as those blocks' keys, not inputPipeline stages
     pinned = set(roofline_fields()) | set(serving_fields()) | \
         set(dag_fields()) | set(dag_summary_fields()) | \
-        set(trace_fields())
+        set(trace_fields()) | set(metric_fields()) | \
+        set(health_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -154,6 +161,14 @@ def dag_summary_fields() -> tuple:
 
 def trace_fields() -> tuple:
     return _profiling_tuple("TRACE_FIELDS")
+
+
+def metric_fields() -> tuple:
+    return _profiling_tuple("METRIC_FIELDS")
+
+
+def health_fields() -> tuple:
+    return _profiling_tuple("HEALTH_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -256,6 +271,37 @@ def check_trace_docs() -> int:
     return 0
 
 
+def check_health_docs() -> int:
+    """Every METRIC_FIELDS member (the metrics.jsonl point schema) and
+    HEALTH_FIELDS member (the SLO evaluator's record schema) must be
+    backtick-documented in README's Model health section, and the
+    emitting modules must build their records from the tuples — the
+    literal checks assert obs/health/store.py references METRIC_FIELDS
+    and obs/health/slo.py references HEALTH_FIELDS so neither record
+    can silently drift from its pinned schema."""
+    fields = metric_fields() + health_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("health schema drift: METRIC_FIELDS/HEALTH_FIELDS "
+              f"member(s) never documented in README: {missing}",
+              file=sys.stderr)
+        return 1
+    for rel, tup in (("obs/health/store.py", "METRIC_FIELDS"),
+                     ("obs/health/slo.py", "HEALTH_FIELDS")):
+        path = os.path.join(PKG, *rel.split("/"))
+        with open(path, encoding="utf-8") as f:
+            if tup not in f.read():
+                print(f"shifu_tpu/{rel} no longer builds its records "
+                      f"from profiling.{tup}", file=sys.stderr)
+                return 1
+    print(f"health plane: all {len(fields)} METRIC_FIELDS + "
+          "HEALTH_FIELDS documented in README and pinned in "
+          "obs/health/store.py + obs/health/slo.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -314,6 +360,8 @@ def main(argv) -> int:
     if check_dag_docs():
         return 1
     if check_trace_docs():
+        return 1
+    if check_health_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])

@@ -13,6 +13,10 @@
 #      site that import fails would silently shrink the sweep).
 #   4. steps.jsonl schema: every stage field README documents must be
 #      in the emitted vocabulary (tools/check_steps_schema.py).
+#   5. ADVISORY (never fails lint): bench-history regression check
+#      (tools/bench_regress.py) — BENCH_LOCAL.jsonl records are
+#      hand-refreshed on hardware (the ROADMAP axon-probe caveat), so
+#      findings here are printed for a human, not gated on.
 #
 # tests/test_lint.py runs pass 1 in tier-1; this script is the full
 # pre-push/CI gate. Suppress an intentional finding inline with
@@ -60,6 +64,10 @@ PYEOF
 
 echo "== steps.jsonl schema (README vs emitted keys) =="
 python tools/check_steps_schema.py || rc=1
+
+echo "== bench regression (advisory — see ROADMAP perf-claim caveat) =="
+python tools/bench_regress.py \
+  || echo "bench_regress: findings above are ADVISORY (BENCH_LOCAL.jsonl is hand-refreshed on hardware); not failing lint"
 
 if [ "$rc" -ne 0 ]; then
   echo "lint: FAILED" >&2
