@@ -145,6 +145,24 @@ GBT_DEPTH = 6
 GBT_SMALL_ROWS = 2_000_000
 GBT_SMALL_TREES = 10
 
+# Streaming-GBT state-tier side-by-side: the SAME on-disk bins matrix
+# through build_gbt_streaming twice — resident device row state vs the
+# host-numpy tier — with the pipeline host_syncs counter as the
+# falsifiable evidence. The shape is chosen so the analytic roofline
+# bound FLIPS across the ridge (~241 flop/B): 12 cols × 64 bins ×
+# depth 6 puts the resident tier at AI≈293 (compute-bound) while the
+# host tier's per-level node i32 up+down + grad/hess f32 re-uploads
+# add 16 B/row per level pass → AI≈219 (memory-bound).
+GBT_STREAM_ROWS = 2_000_000
+GBT_STREAM_COLS = 12
+GBT_STREAM_BINS = 64
+GBT_STREAM_TREES = 6
+GBT_STREAM_DEPTH = 6
+GBT_STREAM_CHUNK_ROWS = 500_000
+GBT_STREAM_VALID_RATE = 0.05
+GBT_STREAM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tmp", "bench_gbt_stream")
+
 # RF at-scale (VERDICT r4 next #7): the vmapped-independent-trees
 # story at HIGGS row count — all trees grow in lockstep, one histogram
 # collective per level covers the whole forest. 40 trees keeps the
@@ -1059,6 +1077,133 @@ def task_rf():
     }))
 
 
+def _ensure_gbt_stream_layout():
+    """Host-generate the on-disk streaming-GBT layout once: an int32
+    bins matrix + f32 tags, deterministic seed, linear margin on the
+    bin values so the booster has something to learn. Re-runs reuse
+    the files via the sidecar (same idiom as _ensure_stream_layout,
+    minus the prefix-reuse machinery — this layout is small)."""
+    import numpy as np
+    os.makedirs(GBT_STREAM_DIR, exist_ok=True)
+    bins_p = os.path.join(GBT_STREAM_DIR, "bins.npy")
+    tags_p = os.path.join(GBT_STREAM_DIR, "tags.npy")
+    done_p = os.path.join(GBT_STREAM_DIR, "layout.json")
+    rows, cols, n_bins, seed = (GBT_STREAM_ROWS, GBT_STREAM_COLS,
+                                GBT_STREAM_BINS, 7)
+    want = {"rows": rows, "cols": cols, "bins": n_bins, "seed": seed,
+            "complete": True}
+    try:
+        with open(done_p) as f:
+            ok = json.load(f) == want
+    except (OSError, json.JSONDecodeError):
+        ok = False
+    if not ok:
+        _log(f"gbt_stream bench: writing {rows}x{cols} int32 bins "
+             f"({rows * cols * 4 / 1e6:.0f} MB) to {GBT_STREAM_DIR}...")
+        try:
+            os.remove(done_p)   # crash mid-write must not bless files
+        except OSError:
+            pass
+        rng = np.random.default_rng(seed)
+        beta = rng.normal(0, 1, cols).astype(np.float32)
+        bm = np.lib.format.open_memmap(bins_p, mode="w+",
+                                       dtype=np.int32,
+                                       shape=(rows, cols))
+        tm = np.lib.format.open_memmap(tags_p, mode="w+",
+                                       dtype=np.float32, shape=(rows,))
+        for a in range(0, rows, 1_000_000):
+            b = min(a + 1_000_000, rows)
+            x = rng.integers(0, n_bins - 1, size=(b - a, cols),
+                             dtype=np.int32)
+            margin = (x.astype(np.float32) @ beta) / np.sqrt(cols)
+            noise = rng.normal(0, 1, b - a).astype(np.float32)
+            noise *= max(float(margin.std()), 1e-6) * 0.5
+            bm[a:b] = x
+            tm[a:b] = (margin + noise > np.median(margin)) \
+                .astype(np.float32)
+        bm.flush()
+        tm.flush()
+        with open(done_p, "w") as f:
+            json.dump(want, f)
+    return (np.load(bins_p, mmap_mode="r"),
+            np.load(tags_p, mmap_mode="r"))
+
+
+def task_gbt_stream():
+    """Streaming-GBT state-tier side-by-side (the resident-row-state
+    evidence): the SAME on-disk bins matrix through
+    build_gbt_streaming twice — SHIFU_TPU_GBT_RESIDENT_STATE=1 (node/
+    pred/grad/hess live in HBM, zero device→host syncs per level, one
+    per round) vs =0 (host-numpy row state, per-chunk-per-level node
+    round-trips). The pipeline host_syncs counter is drained around
+    each run so the record CARRIES the sync counts rather than
+    asserting them rhetorically; the task hard-fails if the resident
+    tier exceeds one sync per round. Rooflines for both modes use the
+    same analytic flops; the host tier's bytes add the measured-layout
+    round-trip traffic (node i32 up+down + grad/hess f32 up = 16 B/row
+    per level pass) — the documented bound flip."""
+    import numpy as np
+
+    from shifu_tpu import profiling
+    from shifu_tpu.data import pipeline as pipe
+    from shifu_tpu.models import gbdt
+
+    bins_mm, y_mm = _ensure_gbt_stream_layout()
+    w = np.ones(GBT_STREAM_ROWS, np.float32)
+    cfg = gbdt.TreeConfig(max_depth=GBT_STREAM_DEPTH,
+                          n_bins=GBT_STREAM_BINS,
+                          learning_rate=0.2, loss="log")
+    n_val = int(GBT_STREAM_ROWS * GBT_STREAM_VALID_RATE)
+    n_train = GBT_STREAM_ROWS - n_val
+
+    def run(mode):
+        os.environ["SHIFU_TPU_GBT_RESIDENT_STATE"] = mode
+        # 1-round warm-up compiles this tier's level kernels outside
+        # the clock (mostly shared between tiers → cache hits)
+        gbdt.build_gbt_streaming(cfg, bins_mm, y_mm, w, 1,
+                                 chunk_rows=GBT_STREAM_CHUNK_ROWS,
+                                 n_val=n_val)
+        pipe.drain_stage_timers()
+        t0 = time.time()
+        _, errs = gbdt.build_gbt_streaming(
+            cfg, bins_mm, y_mm, w, GBT_STREAM_TREES,
+            chunk_rows=GBT_STREAM_CHUNK_ROWS, n_val=n_val)
+        wall = time.time() - t0
+        st = pipe.drain_stage_timers()
+        return wall, int(st.get("host_syncs", 0)), errs
+
+    res_wall, res_syncs, res_errs = run("1")
+    host_wall, host_syncs, host_errs = run("0")
+    if res_syncs > GBT_STREAM_TREES:
+        raise ValueError(
+            f"resident tier broke the sync budget: {res_syncs} syncs "
+            f"for {GBT_STREAM_TREES} rounds (contract: ≤1/round)")
+    rate = n_train * GBT_STREAM_TREES / res_wall
+    host_rate = n_train * GBT_STREAM_TREES / host_wall
+    flops, base_bytes = profiling.tree_row_costs(
+        GBT_STREAM_COLS, GBT_STREAM_BINS, GBT_STREAM_DEPTH)
+    host_bytes = base_bytes + 16.0 * (GBT_STREAM_DEPTH + 1)
+    print(json.dumps({
+        "row_trees_per_sec": rate,
+        "host_row_trees_per_sec": host_rate,
+        "resident_speedup": rate / host_rate,
+        "wall_s": res_wall, "host_wall_s": host_wall,
+        "host_syncs_resident": res_syncs,
+        "host_syncs_host_tier": host_syncs,
+        "syncs_per_round_resident": res_syncs / GBT_STREAM_TREES,
+        "rows": GBT_STREAM_ROWS, "trees": GBT_STREAM_TREES,
+        "depth": GBT_STREAM_DEPTH,
+        "val_err_final": float(res_errs[-1]),
+        "tier_parity_err_diff": float(abs(res_errs[-1] - host_errs[-1])),
+        "roofline": profiling.roofline("GBT", flops, base_bytes, rate),
+        "host_roofline": profiling.roofline("GBT", flops, host_bytes,
+                                            host_rate),
+        "note": "same disk layout, same trees; host_roofline bytes = "
+                "analytic level re-reads + 16 B/row/level host "
+                "round-trips (node i32 both ways, grad/hess f32 up)",
+    }))
+
+
 def _ensure_pipeline_set():
     """Host-generate the pipeline model set once (deterministic seed;
     ~250 MB raw pipe-delimited text + ModelConfig.json mirroring the
@@ -1604,6 +1749,11 @@ def _workload(task):
                 "depth": GBT_DEPTH},
         "gbt_small": {"rows": GBT_SMALL_ROWS, "cols": GBT_COLS,
                       "trees": GBT_SMALL_TREES, "depth": GBT_DEPTH},
+        "gbt_stream": {"rows": GBT_STREAM_ROWS, "cols": GBT_STREAM_COLS,
+                       "bins": GBT_STREAM_BINS,
+                       "trees": GBT_STREAM_TREES,
+                       "depth": GBT_STREAM_DEPTH,
+                       "chunk": GBT_STREAM_CHUNK_ROWS},
         "varsel": {"rows": VARSEL_ROWS, "cols": VARSEL_COLS,
                    "block": VARSEL_BLOCK,
                    "epochs": [VARSEL_EPOCHS_SHORT, VARSEL_EPOCHS_LONG]},
@@ -1778,6 +1928,8 @@ def main():
         return task_gbt()
     if args.task == "gbt_small":
         return task_gbt(rows=GBT_SMALL_ROWS, trees=GBT_SMALL_TREES)
+    if args.task == "gbt_stream":
+        return task_gbt_stream()
     if args.task == "streaming":
         return task_streaming()
     if args.task == "pipeline":
@@ -1852,6 +2004,9 @@ def main():
                  f"mix {SERVE_MIX})", timeout=1800)
             step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
                  f"{GBT_COLS}, {GBT_TREES} trees)", timeout=3000)
+            step("gbt_stream", "streaming GBT state-tier bench "
+                 f"({GBT_STREAM_ROWS}x{GBT_STREAM_COLS}, resident vs "
+                 "host row state)", timeout=2400)
             if knob_bool("SHIFU_TPU_BENCH_STREAMING"):
                 step("streaming", f">HBM streaming bench ({STREAM_ROWS}"
                      f"x{STREAM_FEATURES}, "
@@ -1945,6 +2100,16 @@ def main():
         extra["gbt_train_wall_s"] = round(gb["wall_s"], 2)
         extra["gbt_auc"] = round(gb["auc"], 4)
 
+    def _fill_gbt_stream(gst):
+        extra["gbt_stream_Mrow_trees_per_s"] = round(
+            gst["row_trees_per_sec"] / 1e6, 3)
+        extra["gbt_stream_resident_speedup"] = round(
+            gst["resident_speedup"], 2)
+        extra["gbt_stream_host_syncs"] = [gst["host_syncs_resident"],
+                                          gst["host_syncs_host_tier"]]
+        extra["gbt_stream_bounds"] = [gst["roofline"]["bound"],
+                                      gst["host_roofline"]["bound"]]
+
     def _fill_varsel(vs_):
         extra["varsel_lr_Mrow_epochs_per_s"] = round(
             vs_["lr_row_epochs_per_sec"] / 1e6, 3)
@@ -2030,6 +2195,7 @@ def main():
     fill("gbt_small", _fill_gbt_small)
     fill("varsel", _fill_varsel)
     fill("gbt", _fill_gbt)
+    fill("gbt_stream", _fill_gbt_stream)
     fill("serving", _fill_serving)
     fill("streaming", _fill_streaming)
 

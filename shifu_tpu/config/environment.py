@@ -233,6 +233,17 @@ _declare("SHIFU_TPU_HIST_FUSED", "bool", "0",
 _declare("SHIFU_TPU_SCORE_FUSED", "str", "auto",
          "fused normalize+first-matmul scoring kernel route: "
          "auto | pallas | xla")
+_declare("SHIFU_TPU_SPLIT_FUSED", "str", "auto",
+         "fused GBT split-search kernel route (cumsum+gain+argmax in "
+         "one pallas kernel): auto | pallas | xla")
+_declare("SHIFU_TPU_GBT_RESIDENT_STATE", "str", "auto",
+         "streaming GBT row-state tier: 1 keeps node/pred/grad/hess as "
+         "device arrays (zero host syncs per level, one per round), 0 "
+         "forces the host-numpy state path, auto picks by the "
+         "SHIFU_TPU_GBT_STATE_BUDGET_MB fit")
+_declare("SHIFU_TPU_GBT_STATE_BUDGET_MB", "int", 2048,
+         "HBM budget for resident streaming-GBT row state; auto mode "
+         "goes resident when ~24 B/train row + ~12 B/val row fits")
 # --- serving plane ---
 _declare("SHIFU_TPU_SERVE_BUCKETS", "str", "1,8,64,512",
          "padded-row shape-bucket ladder for the serving plane and "

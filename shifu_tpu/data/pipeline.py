@@ -145,8 +145,9 @@ def drain_stage_timers() -> Dict[str, float]:
 def host_fetch(x):
     """The ONE sanctioned device→host sync in hot paths: block on `x`,
     return it as a numpy array, and accrue the wait into the
-    ``host_sync_s`` stage timer so an intentional sync shows up in
-    ``steps.jsonl`` instead of hiding as generic slowness. The lint
+    ``host_sync_s`` stage timer — plus a ``host_syncs`` occurrence
+    counter — so an intentional sync shows up in ``steps.jsonl``
+    instead of hiding as generic slowness. The lint
     rule ``host-sync-in-hot-loop`` flags raw ``np.asarray``/``float``/
     ``.item()`` on device values inside loops; routing a *deliberate*
     per-chunk or per-epoch fetch through here keeps the loop clean and
@@ -155,6 +156,7 @@ def host_fetch(x):
     t0 = time.perf_counter()
     out = np.asarray(x)
     add_stage_time("host_sync_s", time.perf_counter() - t0)
+    add_stage_count("host_syncs")
     return out
 
 

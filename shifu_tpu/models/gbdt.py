@@ -298,13 +298,18 @@ def _forest_level_histograms(binsT, node_T, grad_T, hess_T, level_offset,
     return local_hists(binsT, slot_T, grad_T, hess_T)
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract"))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract",
+                                   "return_nodes"))
 def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
-                 mesh=None, subtract=None):
+                 mesh=None, subtract=None, return_nodes=False):
     """Grow T independent trees level-by-level in lockstep (the RF
-    analog of build_tree; one histogram collective per level covers
-    every tree). grad_T/hess_T: (T, R); feature_masks: (T, C).
-    Returns a stacked (T, n_nodes) tree pytree."""
+    analog of build_tree; one histogram collective AND one split
+    search per level cover every tree). grad_T/hess_T: (T, R);
+    feature_masks: (T, C). Returns a stacked (T, n_nodes) tree pytree;
+    with return_nodes=True also the (T, R) landing node of every row
+    per tree (growth already routed rows to their final nodes — see
+    build_tree — so lockstep boosting gathers leaf_value[node] instead
+    of re-walking T trees)."""
     c, r = binsT.shape
     n_trees = grad_T.shape[0]
     trees = jax.tree.map(
@@ -317,9 +322,8 @@ def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
         g, h = _forest_child_histograms(cfg, binsT, node_T, grad_T,
                                         hess_T, depth, prev_g, prev_h,
                                         trees, mesh, subtract)
-        trees = jax.vmap(
-            lambda t, gh, hh, fm: _apply_level(cfg, t, gh, hh, fm, depth)
-        )(trees, g, h, feature_masks)
+        trees = _forest_apply_level(cfg, trees, g, h, feature_masks,
+                                    depth)
         node_T = jax.vmap(
             lambda t, n: _route_level(cfg, t, binsT, n, depth)
         )(trees, node_T)
@@ -328,8 +332,11 @@ def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
     g, h = _forest_child_histograms(cfg, binsT, node_T, grad_T, hess_T,
                                     cfg.max_depth, prev_g, prev_h,
                                     trees, mesh, subtract)
-    return jax.vmap(lambda t, gh, hh: _final_leaves(cfg, t, gh, hh)
-                    )(trees, g, h)
+    trees = jax.vmap(lambda t, gh, hh: _final_leaves(cfg, t, gh, hh)
+                     )(trees, g, h)
+    if return_nodes:
+        return trees, node_T
+    return trees
 
 
 def _forest_child_histograms(cfg: TreeConfig, binsT, node_T, grad_T,
@@ -358,10 +365,30 @@ def _best_splits(gh, cfg: TreeConfig, feature_mask):
     """Pick the best (feature, bin, missing-direction) per node.
 
     gh: (G, H) each (N, C, B) with the missing bin LAST (index B-1).
-    feature_mask: (C,) 1/0 — RF feature subsetting.
-    Returns dict of per-node arrays: feature, bin, gain, default_left.
+    feature_mask: (C,) 1/0 shared by every node (RF feature
+    subsetting), or (N, C) per node — the lockstep forest flattens
+    (T, P) level nodes to N = T·P and carries each tree's own mask.
+    Routed by SHIFU_TPU_SPLIT_FUSED: "pallas" runs the whole
+    cumsum+gain+argmax chain as one fused kernel
+    (ops/pallas_split.py); this XLA chain is the parity reference.
+    Both routes break gain ties identically — lowest flat
+    feature·(B-1)+bin index wins (jnp.argmax first-occurrence
+    semantics; the kernel docstring explains how it reproduces that
+    across column tiles).
+    Returns dict of per-node arrays: feature, bin, gain, default_left,
+    plus g_tot/h_tot ((N, C) here; (N,) from the fused kernel — the
+    per-feature copies are redundant, totals match feature 0's).
     """
     g, h = gh
+    from shifu_tpu.ops.pallas_split import (best_splits_pallas,
+                                            split_fused_mode)
+    if split_fused_mode() == "pallas":
+        mask2 = feature_mask if feature_mask.ndim == 2 else \
+            jnp.broadcast_to(feature_mask[None, :], g.shape[:2])
+        return best_splits_pallas(
+            g, h, mask2, float(cfg.reg_lambda),
+            float(cfg.min_instances_per_node),
+            interpret=jax.default_backend() != "tpu")
     lam = cfg.reg_lambda
     g_miss = g[:, :, -1]
     h_miss = h[:, :, -1]
@@ -386,7 +413,9 @@ def _best_splits(gh, cfg: TreeConfig, feature_mask):
     gain_right = gain_of(gl, hl)
     default_left = gain_left >= gain_right          # (N, C, B-1)
     gain = jnp.maximum(gain_left, gain_right)
-    gain = jnp.where(feature_mask[None, :, None] > 0, gain, -jnp.inf)
+    mask3 = feature_mask[None, :, None] if feature_mask.ndim == 1 \
+        else feature_mask[:, :, None]
+    gain = jnp.where(mask3 > 0, gain, -jnp.inf)
     # the last main bin as split point sends everything left — exclude
     gain = gain.at[:, :, -1].set(-jnp.inf)
 
@@ -417,9 +446,17 @@ def _apply_level(cfg: TreeConfig, tree, g_hist, h_hist, feature_mask,
     """Fold one level's histograms into the tree state: pick best
     splits, turn no-gain nodes into leaves (value -G/(H+λ)). Shared by
     the resident builder and the out-of-core chunked builder."""
+    s = _best_splits((g_hist, h_hist), cfg, feature_mask)
+    return _fold_splits(cfg, tree, s, depth)
+
+
+def _fold_splits(cfg: TreeConfig, tree, s, depth: int):
+    """Write one level's chosen splits (a `_best_splits` dict) into the
+    flat tree arrays. Split off from _apply_level so the lockstep
+    forest can run ONE split search over all trees and fold the
+    reshaped results per tree (_forest_apply_level)."""
     level_offset = 2 ** depth - 1
     n_level = 2 ** depth
-    s = _best_splits((g_hist, h_hist), cfg, feature_mask)
     can_split = (s["gain"] > cfg.min_info_gain) & jnp.isfinite(s["gain"])
     ids = level_offset + jnp.arange(n_level)
     tree = dict(tree)
@@ -430,12 +467,32 @@ def _apply_level(cfg: TreeConfig, tree, g_hist, h_hist, feature_mask,
         s["default_left"])
     tree["gain"] = tree["gain"].at[ids].set(
         jnp.where(can_split, s["gain"], 0.0))
-    # g_tot/h_tot are identical across features — take feature 0
-    val = -s["g_tot"][:, 0] / (s["h_tot"][:, 0] + cfg.reg_lambda)
+    # g_tot/h_tot are identical across features — the XLA chain hands
+    # back per-feature copies (take feature 0), the fused kernel (N,)
+    g_tot = s["g_tot"] if s["g_tot"].ndim == 1 else s["g_tot"][:, 0]
+    h_tot = s["h_tot"] if s["h_tot"].ndim == 1 else s["h_tot"][:, 0]
+    val = -g_tot / (h_tot + cfg.reg_lambda)
     tree["is_leaf"] = tree["is_leaf"].at[ids].set(~can_split)
     tree["leaf_value"] = tree["leaf_value"].at[ids].set(
         jnp.where(can_split, 0.0, val))
     return tree
+
+
+def _forest_apply_level(cfg: TreeConfig, trees, g, h, feature_masks,
+                        depth: int):
+    """One split search for ALL T trees of a lockstep level: the
+    (T, P, C, B) histograms flatten to T·P nodes so the search — fused
+    kernel or XLA chain — launches once per level instead of once per
+    tree; each tree's RF feature mask rides along per node. This is
+    the split-search half of lockstep sharing (the histogram half is
+    _forest_level_histograms)."""
+    t, p, c, b = g.shape
+    mask2 = jnp.repeat(feature_masks, p, axis=0)           # (T·P, C)
+    s = _best_splits((g.reshape(t * p, c, b), h.reshape(t * p, c, b)),
+                     cfg, mask2)
+    s_T = jax.tree.map(lambda a: a.reshape((t, p) + a.shape[1:]), s)
+    return jax.vmap(lambda tr, sv: _fold_splits(cfg, tr, sv, depth)
+                    )(trees, s_T)
 
 
 def _final_leaves(cfg: TreeConfig, tree, g_hist, h_hist):
@@ -662,11 +719,40 @@ def leaf_indices(trees, binsT, max_depth: int, n_bins: int):
 # ---------------------------------------------------------------------------
 
 def gbt_gradients(y, pred_raw, weights, loss: str):
-    """First/second-order gradients (dt/Loss.java squared/log)."""
+    """First/second-order gradients (dt/Loss.java squared/log).
+    Elementwise, so broadcasting y (R,) or (1, R) against (T, R)
+    predictions/weights yields per-bag gradients for the lockstep
+    bagged build."""
     if loss.startswith("log"):
         p = jax.nn.sigmoid(pred_raw)
         return (p - y) * weights, p * (1 - p) * weights
     return (pred_raw - y) * weights, jnp.ones_like(y) * weights
+
+
+@partial(jax.jit, static_argnames=("loss",))
+def _val_error(vraw, vy, vw, loss: str):
+    """THE early-stop validation metric — weighted mean squared error
+    on (sigmoid-squashed, for log loss) raw scores. One shared jitted
+    definition (same dtype, same f32 jnp reduction) for build_gbt, the
+    lockstep bagged builder, and BOTH streaming tiers, so an
+    early-stop decision can never diverge between builders on metric
+    arithmetic. vraw broadcasts: (R,) → scalar, (T, R) → per-bag (T,)
+    errors in one dispatch."""
+    vp = jax.nn.sigmoid(vraw) if loss.startswith("log") else vraw
+    return (jnp.sum((vp - vy) ** 2 * vw, axis=-1)
+            / jnp.maximum(jnp.sum(vw), 1e-12))
+
+
+def _pace_dispatch(x) -> None:
+    """Sync via a LOCALLY-addressable shard of a device array: `x` is
+    row-sharded, and indexing x[0] on a multi-host mesh raises "spans
+    non-addressable devices" on the processes that don't hold shard 0.
+    The sync IS the point — it paces the grouped-scan dispatch loops to
+    one long execute in flight (block_until_ready is a no-op on the
+    tunneled transport: 0.3 ms wall observed for a 100 s computation; a
+    device→host value round-trip is not), so the lint rule is wrong to
+    want it hoisted."""
+    np.asarray(x.addressable_shards[0].data[:1])  # lint: disable=host-sync-in-hot-loop -- deliberate scalar fetch paces device dispatch
 
 
 def _gbt_round_core(cfg: TreeConfig, binsT, y, weights, pred_raw,
@@ -799,14 +885,7 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
                                      k, mesh=hist_mesh,
                                      subtract=subtract)
             if start + k < n_trees:
-                # sync via a LOCALLY-addressable shard: pred is
-                # row-sharded, and indexing pred[0] on a multi-host
-                # mesh raises "spans non-addressable devices" on the
-                # processes that don't hold shard 0. The sync IS the
-                # point — it paces dispatch to one long execute in
-                # flight (see group comment above), so the lint rule
-                # is wrong to want it hoisted.
-                np.asarray(pred.addressable_shards[0].data[:1])  # lint: disable=host-sync-in-hot-loop -- deliberate scalar fetch paces device dispatch
+                _pace_dispatch(pred)
             parts.append(part)
         new_stacked = parts[0] if len(parts) == 1 else jax.tree.map(
             lambda *a: jnp.concatenate(a), *parts)
@@ -825,12 +904,11 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
             vraw = vraw + cfg.learning_rate * predict_trees(
                 jax.tree.map(lambda a: a[None], tree), vb,
                 cfg.max_depth, cfg.n_bins)[0]
-            vp = jax.nn.sigmoid(vraw) if cfg.loss.startswith("log") else vraw
-            # weighted mean so zero-weight padding rows don't bias it;
-            # the early-stop decision is a per-round host branch, so
-            # this sync is intentional — host_fetch times it
-            err = float(host_fetch(jnp.sum((vp - vy) ** 2 * vw) /
-                                   jnp.maximum(jnp.sum(vw), 1e-12)))
+            # weighted mean (_val_error) so zero-weight padding rows
+            # don't bias it; the early-stop decision is a per-round
+            # host branch, so this sync is intentional — host_fetch
+            # times and counts it
+            err = float(host_fetch(_val_error(vraw, vy, vw, cfg.loss)))
             val_errs.append(err)
             if err < best_val - 1e-9:
                 best_val, bad = err, 0
@@ -840,6 +918,137 @@ def build_gbt(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
                     break
     stacked = jax.tree.map(lambda *a: jnp.stack(a), *trees)
     return jax.tree.map(np.asarray, stacked), val_errs
+
+
+def _gbt_bagged_round_core(cfg: TreeConfig, binsT, y, w_T, pred_T,
+                           fm_T, mesh=None, subtract=None):
+    grad_T, hess_T = gbt_gradients(y[None, :], pred_T, w_T, cfg.loss)
+    trees_T, node_T = build_forest(cfg, binsT, grad_T, hess_T, fm_T,
+                                   mesh=mesh, subtract=subtract,
+                                   return_nodes=True)
+    contrib_T = jax.vmap(lambda tr, n: tr["leaf_value"][n]
+                         )(trees_T, node_T)
+    return trees_T, pred_T + cfg.learning_rate * contrib_T
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "subtract"))
+def _gbt_bagged_round(cfg: TreeConfig, binsT, y, w_T, pred_T, fm_T,
+                      mesh=None, subtract=None):
+    return _gbt_bagged_round_core(cfg, binsT, y, w_T, pred_T, fm_T,
+                                  mesh=mesh, subtract=subtract)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_rounds", "mesh", "subtract"))
+def _gbt_bagged_rounds(cfg: TreeConfig, binsT, y, w_T, pred_T, fm_T,
+                       n_rounds: int, mesh=None, subtract=None):
+    def body(pred, _):
+        trees_T, pred2 = _gbt_bagged_round_core(
+            cfg, binsT, y, w_T, pred, fm_T, mesh=mesh, subtract=subtract)
+        return pred2, trees_T
+    pred_out, trees = jax.lax.scan(body, pred_T, None, length=n_rounds)
+    return trees, pred_out
+
+
+def build_gbt_bagged(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
+                     weights_T: np.ndarray, n_trees: int,
+                     feature_mask: Optional[np.ndarray] = None,
+                     val_data: Optional[Tuple] = None,
+                     early_stop_window: int = 0):
+    """Lockstep bagged boosting: grow the round-t tree of ALL n_bags
+    sibling ensembles at once through the forest kernels — one
+    histogram collective and one split search per level cover every
+    bag, where the per-bag sequential loop (processor/train_tree)
+    dispatched them T times. Bags stay mathematically independent
+    (each sees only its own weight row of `weights_T` (T, R)), so each
+    bag's ensemble is parity-gated against a sequential build_gbt with
+    the same weights (tests/test_gbt_device.py).
+
+    Early stop is per bag: every bag keeps building in lockstep (a
+    stopped bag's extra rounds cost nothing extra — they ride the same
+    dispatch) and its ensemble/val history is truncated to its own
+    stop round afterwards, which is exactly what the sequential loop
+    would have kept. Returns a list of (stacked trees pytree,
+    val_errs) per bag."""
+    from shifu_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.default_mesh()
+    hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
+    n_bags = int(weights_T.shape[0])
+    if isinstance(bins, jax.Array):
+        jb, jy = bins, jnp.asarray(y)
+        jw_T = jnp.asarray(weights_T)
+    else:
+        jb = mesh_mod.shard_axis(
+            mesh, np.ascontiguousarray(np.asarray(bins, np.int32).T), 1,
+            pad_value=0)
+        jy = mesh_mod.shard_rows(mesh, np.asarray(y, np.float32))
+        jw_T = mesh_mod.shard_axis(
+            mesh, np.asarray(weights_T, np.float32), 1)
+    fm = np.asarray(feature_mask if feature_mask is not None
+                    else np.ones(int(jb.shape[0]), np.float32),
+                    np.float32)
+    fm_T = jnp.asarray(np.broadcast_to(fm[None, :], (n_bags, fm.size)))
+    subtract = _use_hist_subtract()
+    pred_T = jnp.zeros((n_bags, jb.shape[1]), jnp.float32)
+
+    if val_data is None and n_trees > 0:
+        # no per-round host decision → scan rounds device-side in
+        # SHIFU_TPU_GBT_SCAN_GROUP-sized dispatches (see build_gbt)
+        group = knob_int("SHIFU_TPU_GBT_SCAN_GROUP")
+        group = n_trees if group <= 0 else min(group, n_trees)
+        parts = []
+        for start in range(0, n_trees, group):
+            k = min(group, n_trees - start)
+            part, pred_T = _gbt_bagged_rounds(
+                cfg, jb, jy, jw_T, pred_T, fm_T, k, mesh=hist_mesh,
+                subtract=subtract)
+            if start + k < n_trees:
+                _pace_dispatch(pred_T)
+            parts.append(part)
+        rounds_T = parts[0] if len(parts) == 1 else jax.tree.map(
+            lambda *a: jnp.concatenate(a), *parts)   # (rounds, T, nodes)
+        rounds_np = jax.tree.map(np.asarray, rounds_T)
+        return [(jax.tree.map(lambda a, b=b: a[:, b], rounds_np), [])
+                for b in range(n_bags)]
+
+    vb, vy = val_data
+    n_val = vb.shape[0]
+    vb = mesh_mod.shard_axis(
+        mesh, np.ascontiguousarray(np.asarray(vb, np.int32).T), 1)
+    vy, vw = mesh_mod.shard_rows(
+        mesh, np.asarray(vy, np.float32), np.ones(n_val, np.float32))
+    vraw_T = jnp.zeros((n_bags, vb.shape[1]), jnp.float32)
+    round_trees: List[Any] = []
+    val_errs = [[] for _ in range(n_bags)]
+    best_val = np.full(n_bags, np.inf)
+    bad = np.zeros(n_bags, np.int64)
+    stop_round = np.full(n_bags, 0)
+    for t in range(n_trees):
+        trees_T, pred_T = _gbt_bagged_round(
+            cfg, jb, jy, jw_T, pred_T, fm_T, mesh=hist_mesh,
+            subtract=subtract)
+        round_trees.append(trees_T)
+        vraw_T = vraw_T + cfg.learning_rate * predict_trees(
+            trees_T, vb, cfg.max_depth, cfg.n_bins)
+        # ONE fetch decides every bag's round: (T,) error vector
+        errs = host_fetch(_val_error(vraw_T, vy, vw, cfg.loss))
+        for b in range(n_bags):
+            if stop_round[b]:
+                continue
+            err = float(errs[b])
+            val_errs[b].append(err)
+            if err < best_val[b] - 1e-9:
+                best_val[b], bad[b] = err, 0
+            else:
+                bad[b] += 1
+                if early_stop_window and bad[b] >= early_stop_window:
+                    stop_round[b] = t + 1
+        if early_stop_window and stop_round.all():
+            break
+    stop_round[stop_round == 0] = len(round_trees)
+    stacked = jax.tree.map(lambda *a: jnp.stack(a), *round_trees)
+    stacked = jax.tree.map(np.asarray, stacked)  # (rounds, T, nodes)
+    return [(jax.tree.map(lambda a, b=b: a[:stop_round[b], b], stacked),
+             val_errs[b]) for b in range(n_bags)]
 
 
 def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
@@ -896,6 +1105,23 @@ def build_rf(cfg: TreeConfig, bins: np.ndarray, y: np.ndarray,
 # Out-of-core (>HBM) builders — chunked histogram accumulation
 # ---------------------------------------------------------------------------
 
+def gbt_resident_state_mode(n_train: int, n_val: int = 0) -> bool:
+    """Row-state tier for the streaming GBT builder.
+    SHIFU_TPU_GBT_RESIDENT_STATE = 1 forces device-resident state, 0
+    forces the host-numpy path, auto (default) goes resident when the
+    state fits SHIFU_TPU_GBT_STATE_BUDGET_MB. Footprint ≈ 24 B per
+    train row (node i32 + pred/grad/hess f32 + the y/w f32 copies that
+    let gradients compute on device) + 12 B per val row (vraw/vy/vw
+    f32) — the bins matrix itself still streams from disk either way."""
+    mode = knob_str("SHIFU_TPU_GBT_RESIDENT_STATE").lower()
+    if mode in ("0", "off", "false"):
+        return False
+    if mode in ("1", "on", "true"):
+        return True
+    budget = knob_int("SHIFU_TPU_GBT_STATE_BUDGET_MB") << 20
+    return n_train * 24 + n_val * 12 <= budget
+
+
 @partial(jax.jit, static_argnames=("cfg", "depth", "mesh", "half"))
 def _stream_level_chunk(cfg: TreeConfig, tree, binsT_c, node_c, grad_c,
                         hess_c, depth: int, mesh=None, half=False):
@@ -936,6 +1162,43 @@ def _predict_chunk(cfg: TreeConfig, tree, binsT_c):
     return predict_trees(jax.tree.map(lambda a: a[None], tree),
                          binsT_c.astype(jnp.int32),
                          cfg.max_depth, cfg.n_bins)[0]
+
+
+@partial(jax.jit, static_argnames=("loss",))
+def _grad_chunk(y_c, pred_c, w_c, loss: str):
+    """On-device gradient refresh for one resident state chunk — the
+    device twin of build_gbt_streaming's host `grad_of_chunk` (same
+    f32 math; the log-loss sigmoid is jax.nn.sigmoid vs numpy exp, a
+    documented ulp-level difference)."""
+    return gbt_gradients(y_c, pred_c, w_c, loss)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _apply_contrib_chunk(cfg: TreeConfig, tree, node_c, pred_c):
+    """Boosting update for a resident chunk: gather leaf values at the
+    routed nodes (_leaf_contrib_chunk) and shrink-add — predictions
+    never leave the device."""
+    return pred_c + cfg.learning_rate * _leaf_contrib_chunk(
+        cfg, tree, node_c)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _add_predict_chunk(cfg: TreeConfig, tree, binsT_c, vraw_c):
+    """Add one tree's shrunk prediction on a freshly-streamed bins
+    chunk to a device-resident raw-score chunk (val scores / resume)."""
+    return vraw_c + cfg.learning_rate * _predict_chunk(cfg, tree,
+                                                       binsT_c)
+
+
+@partial(jax.jit, static_argnames=("loss",))
+def _val_error_parts(vraw, vy, vw, loss: str):
+    """Per-chunk partial sums of the _val_error numerator/denominator —
+    device-accumulated across val chunks so the round's early-stop
+    decision costs ONE host fetch (the PR-4 deferred-metric pattern).
+    For a single val chunk the quotient is bit-identical to
+    _val_error."""
+    vp = jax.nn.sigmoid(vraw) if loss.startswith("log") else vraw
+    return jnp.sum((vp - vy) ** 2 * vw), jnp.sum(vw)
 
 
 def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
@@ -1004,6 +1267,159 @@ def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
     return tree
 
 
+def _build_tree_streaming_device(cfg: TreeConfig, bins_put, n_chunks: int,
+                                 node_state, grad_state, hess_state,
+                                 feature_mask, hist_mesh):
+    """Resident-state analog of _build_tree_streaming: per-row state
+    (node/grad/hess) lives on device between levels, only the bins
+    chunks stream host→HBM, and the routed nodes are KEPT on device —
+    a whole level runs with ZERO device→host syncs (the host loop only
+    queues async dispatches; tests/test_gbt_device.py pins this with
+    the pipeline `host_syncs` counter). node_state is a list of
+    per-chunk device arrays, updated in place with each level's
+    routing so the caller can gather leaf contributions afterwards."""
+    tree = _empty_tree(cfg)
+    fm = jnp.asarray(feature_mask)
+    prev_g = prev_h = None
+    subtract = _use_hist_subtract()
+    for depth in range(cfg.max_depth + 1):
+        half = subtract and depth > 0 and prev_g is not None
+        g_acc = h_acc = None
+        cur = bins_put(0)
+        for ci in range(n_chunks):
+            node_c, g, h = _stream_level_chunk(
+                cfg, tree, cur, node_state[ci], grad_state[ci],
+                hess_state[ci], depth=depth, mesh=hist_mesh, half=half)
+            if ci + 1 < n_chunks:
+                cur = bins_put(ci + 1)  # h2d overlaps device compute
+            node_state[ci] = node_c
+            g_acc = g if g_acc is None else g_acc + g
+            h_acc = h if h_acc is None else h_acc + h
+        if half:
+            split = _parent_split_mask(tree["is_leaf"], tree["feature"],
+                                       depth)
+            g_acc, h_acc = _subtract_siblings(prev_g, prev_h, g_acc,
+                                              h_acc, split, 2 ** depth)
+        prev_g, prev_h = (g_acc, h_acc) if subtract else (None, None)
+        if depth < cfg.max_depth:
+            tree = _apply_level(cfg, tree, g_acc, h_acc, fm, depth)
+        else:
+            tree = _final_leaves(cfg, tree, g_acc, h_acc)
+    return tree
+
+
+def _build_gbt_streaming_resident(cfg: TreeConfig, bins_mm, y_mm, w_mm,
+                                  n_trees: int, chunk_rows: int, fm,
+                                  init_trees, early_stop_window: int,
+                                  n_train: int, n_val: int, mesh,
+                                  hist_mesh):
+    """Device-resident row-state tier of build_gbt_streaming (see
+    gbt_resident_state_mode): node/pred/grad/hess (plus the y/w inputs
+    the gradients need) live as per-chunk sharded device arrays for
+    the whole build, bins still stream from disk. Gradients and the
+    log-loss sigmoid compute on device; the boosting update is a leaf
+    gather on the resident routed nodes; the early-stop val metric is
+    device-accumulated per chunk and fetched ONCE per round at the
+    decision point. Host syncs: zero inside a level, ≤1 per round."""
+    from shifu_tpu.parallel import mesh as mesh_mod
+    r = n_train + n_val
+    bounds = [(s, min(s + chunk_rows, n_train))
+              for s in range(0, n_train, chunk_rows)]
+    n_chunks = len(bounds)
+
+    def put_bins(a, b):
+        pad = chunk_rows - (b - a)
+        binsT_c = np.ascontiguousarray(bins_mm[a:b].T)   # (C, chunk)
+        if pad:  # fixed chunk shape → one compile; padding is inert
+            binsT_c = np.pad(binsT_c, ((0, 0), (0, pad)))
+        return mesh_mod.shard_axis(mesh, binsT_c, 1)
+
+    def bins_put(ci):
+        return put_bins(*bounds[ci])
+
+    # row state placed ONCE: labels/weights (gradient inputs), raw
+    # predictions, and a reusable node-reset template. Pad rows park
+    # at node -1 (the histogram dump slot) with weight 0, so their
+    # gradients/hessians are exactly zero and they can never leak into
+    # histograms, leaf values, or the val metric.
+    y_dev, w_dev, pred_dev, node_init = [], [], [], []
+    for a, b in bounds:
+        pad = chunk_rows - (b - a)
+        y_c = np.pad(np.asarray(y_mm[a:b], np.float32), (0, pad))
+        w_c = np.pad(np.asarray(w_mm[a:b], np.float32), (0, pad))
+        n_c = np.full(chunk_rows, -1, np.int32)
+        n_c[:b - a] = 0
+        y_dev.append(mesh_mod.shard_axis(mesh, y_c, 0))
+        w_dev.append(mesh_mod.shard_axis(mesh, w_c, 0))
+        pred_dev.append(jnp.zeros_like(y_dev[-1]))
+        node_init.append(mesh_mod.shard_axis(mesh, n_c, 0, pad_value=-1))
+
+    vbounds = [(s, min(s + chunk_rows, r))
+               for s in range(n_train, r, chunk_rows)]
+    vraw_dev, vy_dev, vw_dev = [], [], []
+    for a, b in vbounds:
+        pad = chunk_rows - (b - a)
+        vy_c = np.pad(np.asarray(y_mm[a:b], np.float32), (0, pad))
+        # unit val weights — parity with build_gbt (zero on pads)
+        vw_c = np.pad(np.ones(b - a, np.float32), (0, pad))
+        vy_dev.append(mesh_mod.shard_axis(mesh, vy_c, 0))
+        vw_dev.append(mesh_mod.shard_axis(mesh, vw_c, 0))
+        vraw_dev.append(jnp.zeros_like(vy_dev[-1]))
+
+    trees: List[Any] = []
+    if init_trees is not None:
+        n_prev = init_trees["feature"].shape[0]
+        prev = [jax.tree.map(lambda a_, i=i: jnp.asarray(a_[i]),
+                             init_trees)
+                for i in range(n_prev)]
+        trees.extend(prev)
+        for tree in prev:   # warm train+val scores, all device-side
+            for ci in range(n_chunks):
+                pred_dev[ci] = _add_predict_chunk(
+                    cfg, tree, bins_put(ci), pred_dev[ci])
+            for vi, (a, b) in enumerate(vbounds):
+                vraw_dev[vi] = _add_predict_chunk(
+                    cfg, tree, put_bins(a, b), vraw_dev[vi])
+
+    grad_state: List[Any] = [None] * n_chunks
+    hess_state: List[Any] = [None] * n_chunks
+    val_errs: List[float] = []
+    best_val, bad = np.inf, 0
+    for t in range(n_trees):
+        node_state = list(node_init)
+        for ci in range(n_chunks):  # on-device gradient refresh
+            grad_state[ci], hess_state[ci] = _grad_chunk(
+                y_dev[ci], pred_dev[ci], w_dev[ci], loss=cfg.loss)
+        tree = _build_tree_streaming_device(
+            cfg, bins_put, n_chunks, node_state, grad_state, hess_state,
+            fm, hist_mesh)
+        trees.append(tree)
+        for ci in range(n_chunks):  # leaf gather — no IO, no sync
+            pred_dev[ci] = _apply_contrib_chunk(
+                cfg, tree, node_state[ci], pred_dev[ci])
+        if n_val:
+            num = den = None
+            for vi, (a, b) in enumerate(vbounds):
+                vraw_dev[vi] = _add_predict_chunk(
+                    cfg, tree, put_bins(a, b), vraw_dev[vi])
+                nm, dn = _val_error_parts(vraw_dev[vi], vy_dev[vi],
+                                          vw_dev[vi], loss=cfg.loss)
+                num = nm if num is None else num + nm
+                den = dn if den is None else den + dn
+            # THE round's single device→host sync: the early-stop
+            # branch is a host decision — host_fetch times+counts it
+            err = float(host_fetch(num / jnp.maximum(den, 1e-12)))
+            val_errs.append(err)
+            if err < best_val - 1e-9:
+                best_val, bad = err, 0
+            else:
+                bad += 1
+                if early_stop_window and bad >= early_stop_window:
+                    break
+    stacked = jax.tree.map(lambda *a_: jnp.stack(a_), *trees)
+    return jax.tree.map(np.asarray, stacked), val_errs
+
+
 def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
                         valid_rate: float = 0.0,
                         chunk_rows: int = 1 << 20,
@@ -1012,14 +1428,17 @@ def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
                         early_stop_window: int = 0,
                         n_val: Optional[int] = None):
     """Out-of-core boosting: the bin matrix streams from disk chunk by
-    chunk (max_depth+1 passes per tree), per-row state (node, raw
-    prediction) lives on the host at 8 bytes/row. The resident
-    build_gbt path covers data that fits HBM; this is the TPU answer
-    to the reference's disk-spill dataset feeding DTWorker
-    (MemoryDiskFloatMLDataSet + dt/DTWorker.java:578). Validation is
-    the trailing valid_rate fraction — ≈ random because `norm` writes
-    the streaming layout in seeded-shuffled row order (like
-    train/streaming.py)."""
+    chunk (max_depth+1 passes per tree). Per-row state has two tiers
+    (gbt_resident_state_mode): when it fits the HBM budget, node/pred/
+    grad/hess live as device arrays for the whole build — zero host
+    syncs per level, one per round (_build_gbt_streaming_resident);
+    otherwise state lives on the host at 8 bytes/row as before. The
+    resident build_gbt path covers data whose BINS fit HBM; this is
+    the TPU answer to the reference's disk-spill dataset feeding
+    DTWorker (MemoryDiskFloatMLDataSet + dt/DTWorker.java:578).
+    Validation is the trailing valid_rate fraction — ≈ random because
+    `norm` writes the streaming layout in seeded-shuffled row order
+    (like train/streaming.py)."""
     from shifu_tpu.parallel import mesh as mesh_mod
     r, c = bins_mm.shape
     if n_val is None:
@@ -1034,6 +1453,11 @@ def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
     hist_mesh = mesh if mesh.shape.get("data", 1) > 1 else None
     fm = feature_mask if feature_mask is not None \
         else np.ones(c, np.float32)
+    if gbt_resident_state_mode(n_train, n_val):
+        return _build_gbt_streaming_resident(
+            cfg, bins_mm, y_mm, w_mm, n_trees, chunk_rows, fm,
+            init_trees, early_stop_window, n_train, n_val, mesh,
+            hist_mesh)
 
     pred = np.zeros(n_train, np.float32)
     vraw = np.zeros(n_val, np.float32)
@@ -1080,12 +1504,12 @@ def build_gbt_streaming(cfg: TreeConfig, bins_mm, y_mm, w_mm, n_trees: int,
                     cfg.learning_rate * host_fetch(contrib)
             vy = np.asarray(y_mm[n_train:r], np.float32)
             # unit val weights — parity with build_gbt (and keeps any
-            # caller-side bagging weight view out of the val metric)
-            vw = np.ones_like(vy)
-            vp = 1.0 / (1.0 + np.exp(-vraw)) if cfg.loss.startswith("log") \
-                else vraw
-            err = float(np.sum((vp - vy) ** 2 * vw) /
-                        max(np.sum(vw), 1e-12))
+            # caller-side bagging weight view out of the val metric),
+            # computed through the SAME jitted _val_error as the
+            # resident builders so early-stop arithmetic can't diverge
+            err = float(host_fetch(_val_error(
+                jnp.asarray(vraw), jnp.asarray(vy),
+                jnp.asarray(np.ones_like(vy)), cfg.loss)))
             val_errs.append(err)
             if err < best_val - 1e-9:
                 best_val, bad = err, 0

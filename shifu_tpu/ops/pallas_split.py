@@ -1,0 +1,164 @@
+"""Pallas TPU kernel: fused GBT split search (cumsum + gain + argmax).
+
+`models/gbdt._best_splits` is a chain of XLA ops over the level's
+`(nodes, C, B)` G/H histograms — two cumulative sums, two gain tensors,
+masking, and a flat argmax — each materializing an `(N, C, B)` f32
+intermediate in HBM. This kernel fuses the whole chain: each column
+tile's histograms are cumulative-summed, gain-scored (including the
+min-instances mask, the feature mask, and the last-main-bin exclusion)
+and arg-reduced in-register; only an (8, N) packed result block ever
+leaves VMEM. The XLA path in `_best_splits` stays as-is and is the
+reference the parity suite (tests/test_pallas_split.py) checks against.
+
+Tie-breaking is deterministic and matches `jnp.argmax`'s
+first-occurrence rule exactly: within a column tile the winner among
+equal-gain cells is the minimum flat index (feature·(B-1) + bin), and
+across tiles a later tile only takes over on a STRICTLY greater gain —
+tiles visit columns in ascending order, so the earliest flat maximum
+always wins. An all-masked node (every gain -inf) resolves to flat
+index 0, again matching `jnp.argmax` on an all-equal row.
+
+The packed (8, N) f32 output rides sublanes [best_gain, best_flat_idx,
+default_left, g_tot, h_tot] — flat indices are exact in f32 (C·B is
+far below 2^24). Routing: SHIFU_TPU_SPLIT_FUSED = auto (Pallas on TPU,
+XLA elsewhere) | pallas | xla, mirroring SHIFU_TPU_SCORE_FUSED.
+`interpret=True` runs the kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from shifu_tpu.config.environment import knob_int, knob_str
+
+__all__ = ["split_fused_mode", "best_splits_pallas"]
+
+_BIG = 3.0e38  # > any flat index; sentinel for the min-index reduce
+
+
+def split_fused_mode() -> str:
+    """Fused split-search route: "pallas" | "xla"; "auto" resolves by
+    backend (Pallas on TPU, XLA fallback elsewhere)."""
+    mode = knob_str("SHIFU_TPU_SPLIT_FUSED").lower()
+    if mode in ("pallas", "xla"):
+        return mode
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _derive_col_tile(n_nodes: int, n_cols: int, n_bins: int) -> int:
+    """Column tile from the shared SHIFU_TPU_HIST_VMEM_MB budget: the
+    kernel keeps ~8 live f32 copies of the (N, TC, B) block (G/H blocks,
+    cumsums, two gain tensors, scratch)."""
+    budget = max(1, knob_int("SHIFU_TPU_HIST_VMEM_MB")) << 20
+    per_col = max(1, n_nodes * n_bins * 4 * 8)
+    tc = max(1, min(n_cols, budget // per_col))
+    if tc >= 8:
+        tc = (tc // 8) * 8  # sublane-align full tiles
+    return tc
+
+
+def _split_kernel(g_ref, h_ref, m_ref, out_ref, *, lam, min_inst, bm, tc):
+    # grid = (col_tiles,) ascending — ordering is what makes the strict
+    # `>` take-over rule equal jnp.argmax's first-occurrence tie-break
+    j = pl.program_id(0)
+    g = g_ref[...]                       # (N, TC, B), missing bin last
+    h = h_ref[...]
+    mask = m_ref[...]                    # (N, TC) f32 0/1 (0 on pads)
+    g_miss = g[:, :, bm]
+    h_miss = h[:, :, bm]
+    gl = jnp.cumsum(g[:, :, :bm], axis=2)    # left sums after bin b
+    hl = jnp.cumsum(h[:, :, :bm], axis=2)
+    g_tot = gl[:, :, -1] + g_miss            # (N, TC)
+    h_tot = hl[:, :, -1] + h_miss
+
+    def gain_of(gl_, hl_):
+        gr_ = g_tot[:, :, None] - gl_
+        hr_ = h_tot[:, :, None] - hl_
+        score = (gl_ ** 2 / (hl_ + lam) + gr_ ** 2 / (hr_ + lam)
+                 - (g_tot ** 2 / (h_tot + lam))[:, :, None])
+        ok = (hl_ >= min_inst) & (hr_ >= min_inst)
+        return jnp.where(ok, score, -jnp.inf)
+
+    gain_left = gain_of(gl + g_miss[:, :, None], hl + h_miss[:, :, None])
+    gain_right = gain_of(gl, hl)
+    dl = (gain_left >= gain_right).astype(jnp.float32)
+    gain = jnp.maximum(gain_left, gain_right)
+    gain = jnp.where(mask[:, :, None] > 0, gain, -jnp.inf)
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, gain.shape, 2)
+    # the last main bin as split point sends everything left — exclude
+    gain = jnp.where(bin_ids == bm - 1, -jnp.inf, gain)
+
+    col_ids = j * tc + jax.lax.broadcasted_iota(jnp.int32, gain.shape, 1)
+    flat = (col_ids * bm + bin_ids).astype(jnp.float32)
+
+    tile_max = jnp.max(gain, axis=(1, 2))                      # (N,)
+    at_max = gain == tile_max[:, None, None]
+    tile_idx = jnp.min(jnp.where(at_max, flat, _BIG), axis=(1, 2))
+    sel = flat == tile_idx[:, None, None]
+    tile_dl = jnp.max(jnp.where(sel, dl, 0.0), axis=(1, 2))
+    zero = jnp.zeros_like(tile_max)
+
+    @pl.when(j == 0)
+    def _init():
+        # tile 0's local column 0 IS global column 0: its total matches
+        # the XLA path's g_tot[:, 0] (totals are identical across
+        # features — every feature's histogram sums the same rows)
+        out_ref[...] = jnp.stack(
+            [tile_max, tile_idx, tile_dl, g_tot[:, 0], h_tot[:, 0],
+             zero, zero, zero])
+
+    @pl.when(j > 0)
+    def _accum():
+        old = out_ref[...]
+        better = tile_max > old[0, :]
+        cand = jnp.stack(
+            [tile_max, tile_idx, tile_dl, old[3, :], old[4, :],
+             zero, zero, zero])
+        out_ref[...] = jnp.where(better[None, :], cand, old)
+
+
+def best_splits_pallas(g, h, feature_mask, lam: float, min_inst: float,
+                       col_tile: int = 0, interpret: bool = False):
+    """Best (feature, bin, missing-direction) per node, fused.
+
+    g/h: (N, C, B) f32 level histograms, missing bin LAST (index B-1).
+    feature_mask: (N, C) — per-NODE masks so a flattened lockstep
+    forest level (T·N nodes) runs as ONE kernel launch.
+    Returns the `_best_splits` dict; `g_tot`/`h_tot` come back as (N,)
+    scalars (the XLA path's per-feature copies are redundant).
+    """
+    n, c, b = g.shape
+    bm = b - 1
+    tc = col_tile or _derive_col_tile(n, c, b)
+    pad_c = (-c) % tc
+    gp = jnp.pad(g.astype(jnp.float32), ((0, 0), (0, pad_c), (0, 0)))
+    hp = jnp.pad(h.astype(jnp.float32), ((0, 0), (0, pad_c), (0, 0)))
+    # zero-padded mask columns score -inf and can never win the argmax
+    mp = jnp.pad(feature_mask.astype(jnp.float32), ((0, 0), (0, pad_c)))
+    grid = ((c + pad_c) // tc,)
+
+    out = pl.pallas_call(
+        functools.partial(_split_kernel, lam=float(lam),
+                          min_inst=float(min_inst), bm=bm, tc=tc),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, tc, b), lambda j: (0, j, 0)),
+            pl.BlockSpec((n, tc, b), lambda j: (0, j, 0)),
+            pl.BlockSpec((n, tc), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((8, n), lambda j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.float32),
+        interpret=interpret,
+    )(gp, hp, mp)
+
+    best = out[1].astype(jnp.int32)
+    return {"feature": (best // bm).astype(jnp.int32),
+            "bin": (best % bm).astype(jnp.int32),
+            "gain": out[0],
+            "default_left": out[2] > 0.5,
+            "g_tot": out[3],
+            "h_tot": out[4]}

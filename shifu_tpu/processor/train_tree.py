@@ -161,6 +161,36 @@ def run_tree(ctx: ProcessorContext, seed: int = 12306):
         mc.train.baggingWithReplacement, seed,
         labels=np.asarray(y[tr_mask]),
         stratified=_strat, neg_only=_neg)
+    lockstep = (alg is Algorithm.GBT and n_bags > 1 and bag_w is not None
+                and not mc.train.isContinuous
+                and not gbdt.hist_fused_enabled())
+    if lockstep:
+        # bagged GBT rounds build in LOCKSTEP: round t of every bag
+        # grows as one forest level dispatch (one histogram collective
+        # + one split search cover all bags — build_gbt_bagged), with
+        # per-bag early stop. Continuous resume stays on the
+        # sequential loop (each bag restores its own ensemble, so
+        # round shapes differ); the fused-bins path ships FusedBins
+        # which the bagged builder doesn't shard yet.
+        w_T = np.stack([w[tr_mask] * bag_w[bag] for bag in range(n_bags)])
+        bag_results = gbdt.build_gbt_bagged(
+            cfg, bins[tr_mask], y[tr_mask], w_T, n_trees,
+            val_data=(bins[val_mask], y[val_mask])
+            if val_mask.any() else None,
+            early_stop_window=int(mc.train.get_param(
+                "EnableEarlyStop", 0) and 10))
+        for bag, (trees, val_errs) in enumerate(bag_results):
+            path = ctx.path_finder.model_path(bag, "gbt")
+            ctx.path_finder.ensure(path)
+            save_model(path, "gbt", spec_meta,
+                       {"trees": trees, "tables": tables})
+            if val_errs:
+                log.info("tree bag %d: %d trees, final val err %.6f",
+                         bag, trees["feature"].shape[0], val_errs[-1])
+        log.info("train[GBT]: %d bag(s) × %d trees lockstep, depth %d, "
+                 "%d bins in %.2fs", n_bags, n_trees, cfg.max_depth,
+                 n_bins, time.time() - t0)
+        return None
     for bag in range(n_bags):
         if alg is Algorithm.GBT:
             init_trees = _continuous_trees(ctx, mc, bag)

@@ -1,0 +1,220 @@
+"""GBT on-device state tiers and lockstep bagging.
+
+Pins the PR-12 contracts: (1) the resident row-state tier of
+`build_gbt_streaming` grows the SAME ensemble as the host-numpy tier —
+and does it with ZERO device→host syncs inside a level and at most one
+per boosting round, asserted via the pipeline `host_syncs` counter,
+not eyeballed; (2) lockstep bagged boosting (`build_gbt_bagged`)
+matches per-bag sequential `build_gbt` including per-bag early stop;
+(3) the early-stop val metric is the shared `_val_error` on every
+builder, so decisions can't diverge on metric arithmetic.
+
+Parity notes: tree STRUCTURE (feature/bin/is_leaf/default_left) is
+exact. Leaf values/gains are allclose at f32-ulp tolerances — the
+resident tier computes the log-loss sigmoid with jax.nn.sigmoid where
+the host tier uses numpy exp, and the lockstep build stacks per-bag
+scatters that XLA may reassociate differently from the single-tree
+build. Squared-loss gradients are the same f32 expression on both
+tiers, so streaming parity there is bitwise.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.data.pipeline import drain_stage_timers
+from shifu_tpu.models import gbdt
+from shifu_tpu.models.gbdt import TreeConfig
+
+
+def _case(rng, n=900, c=7, n_bins=16, miss=0.05):
+    bins = rng.integers(0, n_bins - 1, size=(n, c)).astype(np.int32)
+    bins[rng.random((n, c)) < miss] = n_bins - 1
+    y = (bins[:, 0] >= (n_bins - 1) // 2).astype(np.float32)
+    flip = rng.random(n) < 0.1
+    return bins, np.where(flip, 1 - y, y).astype(np.float32)
+
+
+def _cfg(loss="squared", depth=3):
+    return TreeConfig(max_depth=depth, n_bins=16,
+                      min_instances_per_node=2, min_info_gain=0.0,
+                      reg_lambda=1.0, learning_rate=0.1, loss=loss)
+
+
+def _assert_tree_parity(a, b, leaf_rtol=1e-5, leaf_atol=1e-6):
+    for k in ("feature", "bin", "is_leaf", "default_left"):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+    for k in ("leaf_value", "gain"):
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                   rtol=leaf_rtol, atol=leaf_atol,
+                                   err_msg=k)
+
+
+@pytest.mark.parametrize("loss", ["squared", "log"])
+def test_resident_streaming_matches_host_tier(rng, monkeypatch, loss):
+    bins, y = _case(rng)
+    w = np.ones_like(y)
+    cfg = _cfg(loss)
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "0")
+    host_t, host_e = gbdt.build_gbt_streaming(
+        cfg, bins, y, w, 4, valid_rate=0.2, chunk_rows=256,
+        early_stop_window=3)
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "1")
+    res_t, res_e = gbdt.build_gbt_streaming(
+        cfg, bins, y, w, 4, valid_rate=0.2, chunk_rows=256,
+        early_stop_window=3)
+    # log loss: sigmoid ulp noise in the gradients amplifies through
+    # the gain's sum-of-squares — wider (still f32-ulp-scale) band
+    tol = dict(leaf_rtol=1e-4, leaf_atol=5e-5) if loss == "log" else {}
+    _assert_tree_parity(host_t, res_t, **tol)
+    assert len(host_e) == len(res_e)
+    np.testing.assert_allclose(host_e, res_e, rtol=1e-6, atol=1e-7)
+
+
+def test_resident_sync_budget(rng, monkeypatch):
+    """THE acceptance gate: a resident-tier level performs zero
+    device→host syncs and a round at most one — counted by the
+    pipeline host_syncs counter that host_fetch bumps. A no-val build
+    must show ZERO syncs total; with validation, exactly one per
+    round (the early-stop decision fetch)."""
+    bins, y = _case(rng, n=700)
+    w = np.ones_like(y)
+    cfg = _cfg()
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "1")
+
+    drain_stage_timers()
+    gbdt.build_gbt_streaming(cfg, bins, y, w, 3, chunk_rows=256)
+    t = drain_stage_timers()
+    assert t.get("host_syncs", 0) == 0, t
+
+    n_rounds = 4
+    gbdt.build_gbt_streaming(cfg, bins, y, w, n_rounds, valid_rate=0.2,
+                             chunk_rows=256)
+    t = drain_stage_timers()
+    assert t.get("host_syncs", 0) == n_rounds, t
+
+    # the host tier, same workload, syncs per chunk per level — the
+    # counter is what makes the resident win falsifiable
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "0")
+    gbdt.build_gbt_streaming(cfg, bins, y, w, n_rounds, valid_rate=0.2,
+                             chunk_rows=256)
+    t = drain_stage_timers()
+    assert t.get("host_syncs", 0) > n_rounds * (cfg.max_depth + 1), t
+
+
+def test_resident_state_mode_gating(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "1")
+    assert gbdt.gbt_resident_state_mode(10 ** 12)
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "0")
+    assert not gbdt.gbt_resident_state_mode(10)
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "auto")
+    monkeypatch.setenv("SHIFU_TPU_GBT_STATE_BUDGET_MB", "1")
+    # 24 B/train row + 12 B/val row vs a 1 MiB budget
+    assert gbdt.gbt_resident_state_mode(40_000)
+    assert not gbdt.gbt_resident_state_mode(40_000, 20_000)
+    assert not gbdt.gbt_resident_state_mode(50_000)
+
+
+def test_resident_resume_matches_host_tier(rng, monkeypatch):
+    """init_trees (continuous training) warms predictions device-side
+    on the resident tier — the appended trees must match the host
+    tier's."""
+    bins, y = _case(rng, n=600)
+    w = np.ones_like(y)
+    cfg = _cfg()
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "0")
+    first, _ = gbdt.build_gbt_streaming(cfg, bins, y, w, 2,
+                                        chunk_rows=256)
+    host_t, _ = gbdt.build_gbt_streaming(cfg, bins, y, w, 2,
+                                         chunk_rows=256,
+                                         init_trees=first)
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "1")
+    res_t, _ = gbdt.build_gbt_streaming(cfg, bins, y, w, 2,
+                                        chunk_rows=256,
+                                        init_trees=first)
+    _assert_tree_parity(host_t, res_t)
+
+
+def test_lockstep_bagged_matches_sequential(rng):
+    """Each bag of the lockstep build must equal a sequential
+    build_gbt run with the same bag weights — including per-bag early
+    stop (different bags may stop at different rounds; each keeps
+    exactly what its sequential loop would have kept)."""
+    bins, y = _case(rng)
+    vb, vy = _case(rng, n=300)
+    cfg = _cfg()
+    w_T = rng.poisson(1.0, size=(3, len(y))).astype(np.float32)
+    w_T[w_T.sum(axis=1) == 0] = 1.0
+    bag_out = gbdt.build_gbt_bagged(cfg, bins, y, w_T, 5,
+                                    val_data=(vb, vy),
+                                    early_stop_window=2)
+    for b in range(3):
+        seq_t, seq_e = gbdt.build_gbt(cfg, bins, y, w_T[b], 5,
+                                      val_data=(vb, vy),
+                                      early_stop_window=2)
+        lk_t, lk_e = bag_out[b]
+        assert seq_t["feature"].shape == lk_t["feature"].shape
+        _assert_tree_parity(seq_t, lk_t)
+        assert len(seq_e) == len(lk_e)
+        np.testing.assert_allclose(seq_e, lk_e, rtol=1e-6, atol=1e-7)
+
+
+def test_lockstep_bagged_noval_scan_matches_sequential(rng, monkeypatch):
+    """The no-val lockstep path scans rounds device-side (grouped by
+    SHIFU_TPU_GBT_SCAN_GROUP like build_gbt) — same ensembles."""
+    monkeypatch.setenv("SHIFU_TPU_GBT_SCAN_GROUP", "2")
+    bins, y = _case(rng, n=600)
+    cfg = _cfg()
+    w_T = rng.poisson(1.0, size=(2, len(y))).astype(np.float32)
+    w_T[w_T.sum(axis=1) == 0] = 1.0
+    bag_out = gbdt.build_gbt_bagged(cfg, bins, y, w_T, 3)
+    for b in range(2):
+        seq_t, _ = gbdt.build_gbt(cfg, bins, y, w_T[b], 3)
+        _assert_tree_parity(seq_t, bag_out[b][0])
+
+
+def test_forest_return_nodes_land_on_leaves(rng):
+    """build_forest(return_nodes=True): per-tree landing nodes gather
+    the same leaf values as the predict_trees re-walk — the lockstep
+    boosting update's one-gather shortcut."""
+    bins, y = _case(rng, n=800, c=5)
+    cfg = _cfg(depth=4)
+    binsT = jnp.asarray(bins.T)
+    grad_T = jnp.asarray(np.stack([-y, -y * 0.5]).astype(np.float32))
+    hess_T = jnp.ones_like(grad_T)
+    masks = jnp.ones((2, 5), jnp.float32)
+    trees, node_T = gbdt.build_forest(cfg, binsT, grad_T, hess_T, masks,
+                                      return_nodes=True)
+    via_nodes = np.asarray(jax.vmap(
+        lambda tr, n: tr["leaf_value"][n])(trees, node_T))
+    via_walk = np.asarray(gbdt.predict_trees(trees, binsT,
+                                             cfg.max_depth, cfg.n_bins))
+    np.testing.assert_array_equal(via_nodes, via_walk)
+
+
+def test_val_metric_aligned_across_builders(rng, monkeypatch):
+    """Satellite gate: build_gbt and both streaming tiers report the
+    same per-round val errors (one shared _val_error definition) —
+    early-stop decisions cannot diverge between builders."""
+    bins, y = _case(rng, n=800)
+    w = np.ones_like(y)
+    cfg = _cfg(loss="log")
+    n_val = 160
+    n_train = len(y) - n_val
+    # build_gbt takes an explicit (val_bins, val_y) split; streaming
+    # takes the trailing fraction of the same layout
+    _, res_e = gbdt.build_gbt(
+        cfg, bins[:n_train], y[:n_train], w[:n_train], 3,
+        val_data=(bins[n_train:], y[n_train:]))
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "0")
+    _, host_e = gbdt.build_gbt_streaming(cfg, bins, y, w, 3,
+                                         chunk_rows=256, n_val=n_val)
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "1")
+    _, dev_e = gbdt.build_gbt_streaming(cfg, bins, y, w, 3,
+                                        chunk_rows=256, n_val=n_val)
+    np.testing.assert_allclose(res_e, host_e, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(host_e, dev_e, rtol=1e-5, atol=1e-6)

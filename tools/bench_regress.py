@@ -56,8 +56,8 @@ def _throughput(rec: Dict) -> Optional[Tuple[str, float]]:
     return None
 
 
-def _bound(rec: Dict) -> Optional[str]:
-    roof = rec.get("roofline")
+def _bound(rec: Dict, key: str = "roofline") -> Optional[str]:
+    roof = rec.get(key)
     if isinstance(roof, dict):
         b = roof.get("bound")
         return str(b) if b else None
@@ -120,6 +120,24 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
             findings.append(
                 f"{label}: roofline bound flipped {pb} → {nb} "
                 "(crossed the ridge point — verify intentional)")
+        # side-by-side records (gbt_stream) carry a second roofline for
+        # the comparison mode — gate its bound the same way
+        nhb = _bound(newest, "host_roofline")
+        phb = next((_bound(r, "host_roofline") for r in reversed(history)
+                    if _bound(r, "host_roofline")), None)
+        if nhb and phb and nhb != phb:
+            findings.append(
+                f"{label}: host-tier roofline bound flipped "
+                f"{phb} → {nhb} (comparison mode crossed the ridge)")
+        # on the accelerator the device-resident state tier beating the
+        # host tier IS the perf structure under test; losing it is a
+        # regression even when headline throughput held. (CPU records
+        # are exempt — both tiers live in host memory there.)
+        sp = newest.get("resident_speedup")
+        if backend == "tpu" and isinstance(sp, (int, float)) and sp < 1.0:
+            findings.append(
+                f"{label}: resident_speedup {sp:.2f} < 1 — the "
+                "device-resident state tier lost to the host tier")
     if findings:
         print(f"bench_regress: {len(findings)} finding(s) in {path}:",
               file=sys.stderr)
