@@ -1620,6 +1620,230 @@ def task_serving():
     print(json.dumps(record))
 
 
+def task_fleet():
+    """Multi-tenant fleet bench: N registry-published models (mixed
+    priority classes) behind one `FleetService` under shifted
+    sinusoidal (diurnal) per-model load plus a low-priority burst.
+    Demonstrates, in one run: routed-vs-standalone bitwise parity,
+    LRU evict + re-warm under an HBM budget that fits only N-1
+    models (with zero steady-state compile-cache misses — re-warms
+    hit the persistent compile cache), low-priority shedding holding
+    the high-priority p99 inside a measured SLO, and one SLO
+    autotuner pass recording before/after admission deadlines."""
+    import math
+    import queue as queue_mod
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import jax
+
+    from shifu_tpu import profiling, registry
+    from shifu_tpu.config.environment import knob_float, knob_int
+    from shifu_tpu.data import pipeline
+    from shifu_tpu.models import nn as nn_mod
+    from shifu_tpu.models.spec import save_model
+    from shifu_tpu.serve.fleet import (FleetService, ShedReject,
+                                       SloAutotuner)
+    from shifu_tpu.serve.service import ScorerService
+
+    n_models = max(int(knob_int("SHIFU_TPU_FLEET_BENCH_MODELS")), 2)
+    duration = knob_float("SHIFU_TPU_FLEET_BENCH_SECONDS")
+    qps_total = knob_float("SHIFU_TPU_SERVE_BENCH_QPS")
+
+    root = tempfile.mkdtemp(prefix="shifu_fleet_bench_")
+    # the autotuner steers from metrics-store history — record it
+    os.environ["SHIFU_TPU_METRICS"] = "1"
+    reg_root = os.path.join(root, "registry")
+    rng = np.random.default_rng(0)
+    pool = rng.normal(0, 1, (max(SERVE_MIX), SERVE_FEATURES)) \
+        .astype(np.float32)
+
+    names = []
+    for i in range(n_models):
+        spec = nn_mod.MLPSpec(input_dim=SERVE_FEATURES,
+                              hidden_dims=SERVE_HIDDEN,
+                              activations=("relu",) * len(SERVE_HIDDEN))
+        params = nn_mod.init_params(spec, jax.random.PRNGKey(i))
+        mdir = os.path.join(root, f"m{i}", "models")
+        save_model(os.path.join(mdir, "model0.npz"), "nn",
+                   {"spec": {"input_dim": SERVE_FEATURES,
+                             "hidden_dims": list(SERVE_HIDDEN),
+                             "activations": ["relu"] * len(SERVE_HIDDEN)}},
+                   jax.tree.map(np.asarray, params))
+        # the last model is the sheddable class
+        priority = "low" if i == n_models - 1 else "high"
+        registry.publish(reg_root, f"m{i}", mdir, priority=priority)
+        names.append(f"m{i}")
+    low_name = names[-1]
+    high_names = names[:-1]
+
+    # HBM budget sized to fit N-1 of the N (identically-sized) models,
+    # so serving all N forces LRU evict + re-warm traffic
+    footprints = []
+    for n_ in names:
+        m = registry.read_manifest(reg_root, n_)
+        footprints.append(m["param_bytes"]
+                          + m["ladder"][-1] * m["working_row_bytes"])
+    budget_mb = (sum(footprints) - min(footprints) / 2) / float(1 << 20)
+
+    fleet = FleetService(reg_root, workspace_root=root,
+                         hbm_budget_mb=budget_mb)
+    t0 = time.monotonic()
+    fleet.start()   # the last warm LRU-evicts the first model
+    warm_s = time.monotonic() - t0
+    _log(f"[fleet] {n_models} models warm in {warm_s:.2f}s, budget "
+         f"{budget_mb:.2f}MB, resident={fleet.resident()}")
+
+    # bitwise parity: routed through the fleet == a standalone service
+    # on the same registry version dir (same ladder, same dtype path)
+    parity = True
+    for n_ in names:
+        _, vdir, manifest = registry.resolve(reg_root, n_)
+        x = pool[:SERVE_MIX[2]]
+        routed = fleet.submit(n_, dense=x)
+        with ScorerService(models_dir=vdir,
+                           ladder=tuple(manifest["ladder"]),
+                           workspace_root=root) as solo:
+            want = solo.submit(dense=x)
+        for key in want:
+            if not np.array_equal(np.asarray(routed[key]),
+                                  np.asarray(want[key])):
+                parity = False
+    _log(f"[fleet] routed == standalone bitwise: {parity}")
+
+    # constrained-budget churn: round-robin sweeps across all N force
+    # repeated LRU evict + re-warm cycles under the N-1 budget
+    for _ in range(2):
+        for n_ in names:
+            fleet.submit(n_, dense=pool[:SERVE_MIX[1]])
+    evictions_constrained = fleet.stats()["fleet"]["evictions"]
+    _log(f"[fleet] constrained budget: {evictions_constrained} "
+         "evictions (round-robin under N-1 residency)")
+
+    # SLO/shed phases run unconstrained — re-warm stalls belong to the
+    # budget demo above, not to the latency story
+    fleet.set_hbm_budget(0)
+    fleet.start()
+
+    # everything above (publish, first warms, parity solos, budget
+    # churn) compiles or re-warms; steady state starts here
+    pipeline.drain_stage_timers()
+
+    ex = ThreadPoolExecutor(max_workers=64)
+    counts = {"ok": 0, "shed": 0, "rejected": 0}
+    clock = threading.Lock()
+
+    def fire(name, size):
+        try:
+            fleet.submit_timed(name, dense=pool[:size])
+            k = "ok"
+        except ShedReject:
+            k = "shed"
+        except queue_mod.Full:
+            k = "rejected"
+        except TimeoutError:
+            k = "rejected"
+        with clock:
+            counts[k] += 1
+
+    def run_phase(seconds, rate_fn):
+        """Open-loop slot-based arrivals: rate_fn(t, name) → req/s."""
+        slot = 0.02
+        futs = []
+        t_start = time.monotonic()
+        t = 0.0
+        while t < seconds:
+            for n_ in names:
+                lam = rate_fn(t, n_) * slot
+                for _ in range(rng.poisson(lam) if lam > 0 else 0):
+                    size = int(rng.choice(SERVE_MIX))
+                    futs.append(ex.submit(fire, n_, size))
+            t += slot
+            lag = (t_start + t) - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+        for f in futs:
+            f.result()
+        return len(futs), time.monotonic() - t_start
+
+    # calibration: high-priority-only load → the SLO is anchored to
+    # this machine's own uncontended p99, not a hardcoded number
+    base_rate = qps_total / max(len(high_names), 1)
+    run_phase(min(1.5, duration / 3),
+              lambda t, n_: base_rate if n_ in high_names else 0.0)
+    # 1.5x keeps the hysteresis release point (0.7x SLO) ABOVE the
+    # uncontended baseline, so the shed switch can actually disengage
+    base_p99 = fleet.stats()["fleet"]["p99_ms_by_class"]["high"] or 5.0
+    slo_ms = max(base_p99 * 1.5, base_p99 + 1.0)
+    fleet.set_slo(slo_ms)
+    _log(f"[fleet] high-only p99 {base_p99:.2f}ms -> SLO {slo_ms:.2f}ms")
+
+    # diurnal load: shifted sinusoids per model, plus a mid-window
+    # low-priority burst that pushes contention past the SLO
+    period = max(duration, 1.0)
+    phase_of = {n_: 2.0 * math.pi * i / n_models
+                for i, n_ in enumerate(names)}
+
+    def diurnal(t, n_):
+        lam = (qps_total / n_models) * (
+            1.0 + 0.9 * math.sin(2.0 * math.pi * t / period
+                                 + phase_of[n_]))
+        if n_ == low_name and duration / 3 <= t < 2 * duration / 3:
+            lam += 3.0 * qps_total   # the burst the shed switch eats
+        return max(lam, 0.0)
+
+    n_req, elapsed = run_phase(duration, diurnal)
+    fleet.flush_metrics()   # store history for the autotuner
+
+    tuner = SloAutotuner(fleet, slo_p99_ms=slo_ms)
+    tune_records = tuner.step()
+
+    # post-tune re-measurement under the calibration load: the
+    # before/after p99 pair the autotuner's adjustment is judged by
+    run_phase(min(1.5, duration / 3),
+              lambda t, n_: base_rate if n_ in high_names else 0.0)
+    ex.shutdown(wait=True)
+
+    st = fleet.stats()
+    fl = st["fleet"]
+    fleet.close()
+    steady = pipeline.drain_stage_timers()
+    misses = int(steady.get("compile_cache_misses", 0))
+
+    if misses:
+        _log(f"[fleet] WARNING: {misses} steady-state compile-cache "
+             "misses — re-warms should hit the persistent cache")
+    if fl["evictions"] == 0:
+        _log("[fleet] WARNING: no evictions — the HBM budget did not "
+             "constrain residency")
+    if counts["shed"] == 0:
+        _log("[fleet] WARNING: burst never engaged the shed switch")
+    p99_high = (fl["p99_ms_by_class"] or {}).get("high")
+    if p99_high is not None and p99_high > slo_ms:
+        _log(f"[fleet] WARNING: final high p99 {p99_high:.2f}ms over "
+             f"SLO {slo_ms:.2f}ms")
+
+    record = {k: fl[k] for k in profiling.FLEET_FIELDS}
+    record.update({
+        "models": n_models,
+        "qps_offered": round(qps_total, 2),
+        "qps_sustained": round(n_req / elapsed, 2),
+        "requests": n_req,
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "rejected": counts["rejected"],
+        "parity_bitwise": parity,
+        "slo_p99_ms": round(slo_ms, 3),
+        "fleet_warm_s": round(warm_s, 3),
+        "compile_cache_misses_steady": misses,
+        "autotune": tune_records,
+    })
+    print(json.dumps(record))
+
+
 def task_cpu_denom():
     """Measured same-host CPU denominator: nn / nn_wide / gbt bench
     shapes on the JAX CPU backend (this host), giving vs_baseline a
@@ -1936,6 +2160,8 @@ def main():
         return task_pipeline()
     if args.task == "serving":
         return task_serving()
+    if args.task == "fleet":
+        return task_fleet()
     if args.task == "rf":
         return task_rf()
     if args.task == "cpu_denom":

@@ -139,25 +139,42 @@ def cmd_serve(args) -> int:
     """`shifu serve` — persistent low-latency scorer over the trained
     model set: AOT-warms every shape bucket, micro-batches submits
     behind a bounded-latency admission queue, and (unless --no-http)
-    answers POST /score on a stdlib HTTP/JSON listener. SIGTERM/SIGINT
-    drain and stop the service (the graceful_shutdown contract the
-    trainers use); --duration-s bounds the run for scripted use."""
+    answers POST /score on a stdlib HTTP/JSON listener. With
+    --registry the process instead hosts a model FLEET: every
+    published model (or just --models) behind POST /score/<model>,
+    sharing the compile cache, LRU-evicting under the HBM budget, and
+    shedding low-priority load when the high-priority p99 breaches
+    the SLO. SIGTERM/SIGINT drain and stop the service (the
+    graceful_shutdown contract the trainers use); --duration-s bounds
+    the run for scripted use."""
     import json as _json
     import time as _time
 
     from shifu_tpu import resilience
-    from shifu_tpu.serve.service import ScorerService
 
-    ctx = _ctx(args)
-    service = ScorerService(models_dir=ctx.path_finder.models_path(),
-                            workspace_root=args.dir)
-    service.start()
-    log.info("scorer service warm: %s", service.stats())
+    owner = None
     front = None
-    if not args.no_http:
-        from shifu_tpu.serve.http import HttpFrontEnd
-        front = HttpFrontEnd(service, port=args.port).start()
-        log.info("serving HTTP on %s:%d", *front.address)
+    if args.registry:
+        from shifu_tpu.serve.fleet import FleetService
+        names = [n for n in (args.models or "").split(",") if n] or None
+        owner = FleetService(args.registry, names=names,
+                             workspace_root=args.dir).start()
+        log.info("fleet warm: %s", owner.stats()["fleet"])
+        if not args.no_http:
+            from shifu_tpu.serve.http import HttpFrontEnd
+            front = HttpFrontEnd(fleet=owner, port=args.port).start()
+            log.info("serving fleet HTTP on %s:%d", *front.address)
+    else:
+        from shifu_tpu.serve.service import ScorerService
+        ctx = _ctx(args)
+        owner = ScorerService(models_dir=ctx.path_finder.models_path(),
+                              workspace_root=args.dir)
+        owner.start()
+        log.info("scorer service warm: %s", owner.stats())
+        if not args.no_http:
+            from shifu_tpu.serve.http import HttpFrontEnd
+            front = HttpFrontEnd(owner, port=args.port).start()
+            log.info("serving HTTP on %s:%d", *front.address)
     deadline = _time.monotonic() + args.duration_s if args.duration_s \
         else None
     try:
@@ -171,9 +188,54 @@ def cmd_serve(args) -> int:
     finally:
         if front is not None:
             front.close()
-        service.close()
-    print(_json.dumps(service.stats()))
+        owner.close()
+    print(_json.dumps(owner.stats()))
     return 0
+
+
+def cmd_registry(args) -> int:
+    """`shifu registry` — versioned model publishing: publish the
+    workspace's trained model set as an immutable version (atomic
+    HEAD flip), list what's registered, roll HEAD back, or gc old
+    versions. Pure file operations — no device is touched."""
+    import json as _json
+
+    from shifu_tpu import registry as reg
+
+    root = args.registry or os.path.join(
+        getattr(args, "dir", ".") or ".", "registry")
+    if args.action == "publish":
+        if not args.name:
+            raise SystemExit("registry publish: --name is required")
+        models_dir = args.models or \
+            _ctx(args).path_finder.models_path()
+        version = reg.publish(root, args.name, models_dir,
+                              priority=args.priority,
+                              max_delay_ms=args.max_delay_ms)
+        print(_json.dumps({"name": args.name, "version": version,
+                           "head": reg.head(root, args.name)}))
+        return 0
+    if args.action == "ls":
+        print(_json.dumps(reg.ls(root), indent=1))
+        return 0
+    if args.action == "rollback":
+        if not args.name:
+            raise SystemExit("registry rollback: --name is required")
+        version = reg.rollback(root, args.name, to=args.to)
+        print(_json.dumps({"name": args.name, "head": version}))
+        return 0
+    if args.action == "gc":
+        # no --name sweeps every registered model
+        names = [args.name] if args.name else \
+            [row["name"] for row in reg.ls(root)]
+        out = []
+        for name in names:
+            removed = reg.gc(root, name, keep=args.keep)
+            out.append({"name": name, "removed": removed,
+                        "versions": reg.versions(root, name)})
+        print(_json.dumps(out if args.name is None else out[0]))
+        return 0
+    raise SystemExit(f"registry: unknown action {args.action!r}")
 
 
 def cmd_watch(args) -> int:
@@ -627,7 +689,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--duration-s", type=float, default=0.0,
                    help="exit after this many seconds (0 = run until "
                         "SIGTERM/SIGINT)")
+    p.add_argument("--registry", default=None, metavar="DIR",
+                   help="serve a model fleet from this registry root "
+                        "(POST /score/<model>) instead of the "
+                        "workspace model set")
+    p.add_argument("--models", default=None, metavar="NAME,NAME",
+                   help="fleet mode: host only these registry models "
+                        "(default: every published model)")
     p.set_defaults(fn=cmd_serve)
+    p = sub.add_parser("registry",
+                       help="versioned model registry: "
+                            "publish/ls/rollback/gc")
+    p.add_argument("action",
+                   choices=["publish", "ls", "rollback", "gc"])
+    p.add_argument("--registry", default=None, metavar="DIR",
+                   help="registry root (default <workspace>/registry)")
+    p.add_argument("--name", default=None,
+                   help="registered model name (publish/rollback/gc)")
+    p.add_argument("--models", default=None, metavar="DIR",
+                   help="publish: model-spec dir (default the "
+                        "workspace's trained model set)")
+    p.add_argument("--priority", default="high",
+                   choices=["high", "low"],
+                   help="publish: admission class for fleet serving")
+    p.add_argument("--max-delay-ms", type=float, default=None,
+                   help="publish: pin this model's micro-batch "
+                        "admission deadline")
+    p.add_argument("--to", default=None, metavar="vNNN",
+                   help="rollback: target version (default: the one "
+                        "before HEAD)")
+    p.add_argument("--keep", type=int, default=None,
+                   help="gc: versions to keep (default "
+                        "SHIFU_TPU_REGISTRY_KEEP)")
+    p.set_defaults(fn=cmd_registry)
     p = sub.add_parser("watch",
                        help="long-running model health monitor "
                             "(rolling drift + SLO guardrails)")

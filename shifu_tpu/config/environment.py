@@ -263,6 +263,21 @@ _declare("SHIFU_TPU_EVAL_PAD_BUCKETS", "bool", "1",
          "SHIFU_TPU_SERVE_BUCKETS ladder so the final short chunk "
          "reuses an already-compiled executable instead of compiling "
          "its own")
+# --- model fleet (registry + multi-tenant serving) ---
+_declare("SHIFU_TPU_REGISTRY_KEEP", "int", 3,
+         "registry gc retention: versions kept per model (the HEAD "
+         "version is always kept regardless)")
+_declare("SHIFU_TPU_FLEET_HBM_MB", "int", 4096,
+         "device-HBM budget for resident fleet models (manifest param "
+         "bytes + bucket-ladder working set per model); exceeding it "
+         "LRU-evicts the coldest resident model back to host")
+_declare("SHIFU_TPU_FLEET_SLO_P99_MS", "float", 50.0,
+         "high-priority p99 latency SLO (ms): admission sheds "
+         "low-priority load at 429 above it, and the SLO autotuner "
+         "steers each model's admission deadline toward it")
+_declare("SHIFU_TPU_FLEET_SHED_WINDOW", "int", 64,
+         "recent high-priority request latencies the fleet admission "
+         "controller computes its rolling p99 over")
 _declare("SHIFU_TPU_CKPT_SLOTS", "int", 1,
          "staged async checkpoint writes allowed in flight; >1 lets "
          "very short save intervals overlap serializes instead of "
@@ -350,6 +365,10 @@ _declare("SHIFU_TPU_SERVE_BENCH_QPS", "float", 200.0,
 _declare("SHIFU_TPU_SERVE_BENCH_SECONDS", "float", 8.0,
          "open-loop load duration for the serving bench",
          scope="bench")
+_declare("SHIFU_TPU_FLEET_BENCH_MODELS", "int", 3,
+         "registry models served by the fleet bench", scope="bench")
+_declare("SHIFU_TPU_FLEET_BENCH_SECONDS", "float", 6.0,
+         "diurnal load duration for the fleet bench", scope="bench")
 
 
 # ---------------------------------------------------------------------------

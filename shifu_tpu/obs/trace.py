@@ -70,6 +70,9 @@ SPAN_FAMILIES: Dict[str, Tuple[str, ...]] = {
     # children, plus one flush span per formed batch
     "serve": ("request", "queue", "pad", "h2d", "device", "d2h",
               "flush"),
+    # model fleet: one warm span per (re-)warm of a registry model
+    # into residency, one evict span per LRU eviction back to host
+    "fleet": ("warm", "evict"),
     # watched collectives (barrier/allgather/init distinguished by the
     # `tag` attr so watchdog dumps can cite the open span)
     "dist": ("collective",),

@@ -363,6 +363,15 @@ SERVING_FIELDS = ("qps_offered", "qps_sustained", "requests",
                   "serve_warm_s", "device_step_budget_ms",
                   "compile_cache_misses_steady")
 
+# the fleet bench / FleetService summary schema: serve/fleet.py builds
+# its stats()["fleet"] block (and bench.py task_fleet its JSON record)
+# from exactly these keys — resident model count, LRU evictions, total
+# re-warm seconds, the low-priority shed fraction, and per-priority-
+# class p99 latency. tools/check_steps_schema.py pins README docs to
+# this tuple the same way it pins SERVING_FIELDS.
+FLEET_FIELDS = ("models_resident", "evictions", "rewarm_s",
+                "shed_rate", "p99_ms_by_class")
+
 # the pipeline DAG scheduler's record schema: a scheduled step attaches
 # one `dag` block to its steps.jsonl record — DAG_SUMMARY_FIELDS are
 # the block's top-level keys, DAG_FIELDS the schema of each entry in

@@ -1,0 +1,20 @@
+"""Versioned model registry — immutable version dirs + atomic HEAD.
+
+The promotion seam ROADMAP item 1's hot model swap rides: publishes
+are crash-atomic (write-tmp-then-rename, `registry.publish` fault
+site), readers always see a complete version, and rollback is one
+HEAD pointer commit.
+"""
+
+from shifu_tpu.registry.registry import (  # noqa: F401
+    HEAD_FILE,
+    MANIFEST_FILE,
+    gc,
+    head,
+    ls,
+    publish,
+    read_manifest,
+    resolve,
+    rollback,
+    versions,
+)

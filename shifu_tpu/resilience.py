@@ -145,6 +145,7 @@ FAULT_SITES = (
     "ckpt.save", "ckpt.stage", "ckpt.publish", "ckpt.saved",
     "ckpt.restore", "ckpt.reshard",
     "atomic.commit", "pipeline.fetch", "serve.request",
+    "serve.route", "registry.publish",
     "dist.init", "dist.barrier", "dist.allgather",
     "dist.preempt_marker", "dag.node", "obs.export",
     "obs.metrics_flush", "obs.alert", "watch.window",

@@ -54,7 +54,15 @@ class ScorerService:
                  max_delay: Optional[float] = None,
                  queue_depth: Optional[int] = None,
                  workspace_root: Optional[str] = None,
-                 aot_compile: bool = True):
+                 aot_compile: bool = True,
+                 priority: str = "high",
+                 metrics_tags: Optional[Dict[str, str]] = None):
+        if priority not in ("high", "low"):
+            raise ValueError(
+                f"priority must be high|low, got {priority!r}")
+        self.priority = priority
+        # fleet mode labels this service's metric points (model=...)
+        self._metrics_tags = dict(metrics_tags or {})
         self._workspace_root = workspace_root
         if workspace_root is not None:
             from shifu_tpu import profiling
@@ -82,7 +90,9 @@ class ScorerService:
         # consumer-thread-appended; stats() reads racily (monitoring)
         self._latencies: collections.deque = collections.deque(maxlen=8192)
         self._schema_lock = threading.Lock()
-        self._rejected = 0
+        # 429s by the rejected request's priority class (the fleet's
+        # admission shed bumps "low" here too via note_rejected)
+        self.rejected_by_class: Dict[str, int] = {"high": 0, "low": 0}
         self._flush_stop = threading.Event()
         self._flush_thread: Optional[threading.Thread] = None
 
@@ -169,8 +179,17 @@ class ScorerService:
         try:
             return self._batcher.submit(blocks, n)
         except queue.Full:
-            self._rejected += 1   # the 429 the front end answers with
+            self.note_rejected()  # the 429 the front end answers with
             raise
+
+    def note_rejected(self, priority: Optional[str] = None) -> None:
+        """Count one 429 against a priority class (default: this
+        service's own class)."""
+        self.rejected_by_class[priority or self.priority] += 1
+
+    @property
+    def _rejected(self) -> int:
+        return sum(self.rejected_by_class.values())
 
     def submit(self, dense: Optional[np.ndarray] = None,
                index: Optional[np.ndarray] = None,
@@ -270,10 +289,12 @@ class ScorerService:
         return {
             "models": [kind for kind, _, _ in self.scorer.models],
             "ladder": list(self.ladder),
+            "priority": self.priority,
             "warm_s": self._warm_s,
             "warmed_buckets": self._warmed_buckets,
             "aot_executables": len(self._aot_executables),
             "rejected": self._rejected,
+            "rejected_by_class": dict(self.rejected_by_class),
             "latency": pct,
             "batcher": self._batcher.stats(),
         }
@@ -311,18 +332,24 @@ class ScorerService:
                 return
             st = health_store.store(self._workspace_root)
             snap = self.stats()
+            tags = self._metrics_tags
             for k, v in snap["latency"].items():
-                st.emit(f"serve.{k}", round(float(v), 4))
+                st.emit(f"serve.{k}", round(float(v), 4), **tags)
             b = snap["batcher"]
             for k in ("requests", "batches", "rows", "queued_now",
                       "occupancy_mean", "rows_per_batch"):
                 if isinstance(b.get(k), (int, float)):
-                    st.emit(f"serve.{k}", b[k])
-            st.emit("serve.rejected", self._rejected, kind="counter")
+                    st.emit(f"serve.{k}", b[k], **tags)
+            st.emit("serve.rejected", self._rejected, kind="counter",
+                    **tags)
+            for cls, n in self.rejected_by_class.items():
+                st.emit("serve.rejected_by_class", n, kind="counter",
+                        priority=cls, **tags)
             admitted = b.get("requests", 0) or 0
             denom = admitted + self._rejected
             st.emit("serve.reject_rate",
-                    round(self._rejected / denom, 6) if denom else 0.0)
+                    round(self._rejected / denom, 6) if denom else 0.0,
+                    **tags)
             st.flush()
         except Exception as e:  # noqa: BLE001 — absorbed by design
             import logging

@@ -243,6 +243,78 @@ def test_kill_during_background_save_falls_back_to_previous_step(
 
 @pytest.mark.parametrize("kind,exc", [("oserror", OSError),
                                       ("timeout", TimeoutError)])
+def test_fleet_route_fault_never_hangs_and_is_recoverable(
+        tmp_path, monkeypatch, kind, exc):
+    """`serve.route` (the fleet's routing seam, upstream of the
+    per-model admission queue): an injected fault fails exactly one
+    routed submit, promptly and naming the site; the fleet keeps
+    serving and closes cleanly."""
+    from tests.test_serve import _tiny_nn_dir
+    from shifu_tpu import registry
+    from shifu_tpu.serve.fleet import FleetService
+
+    assert "serve.route" in resilience.FAULT_SITES
+    src = _tiny_nn_dir(str(tmp_path / "src"))
+    reg = str(tmp_path / "reg")
+    registry.publish(reg, "m", src, ladder=(1, 4))
+    fleet = FleetService(reg, workspace_root=str(tmp_path),
+                         hbm_budget_mb=0).start()
+    try:
+        monkeypatch.setenv("SHIFU_TPU_FAULT", f"serve.route:{kind}:1")
+        resilience.reset_faults()
+        x = np.zeros((2, 12), np.float32)
+
+        t0 = time.monotonic()
+        with pytest.raises(exc, match=f"injected {kind} at serve.route"):
+            fleet.submit("m", dense=x)
+        assert time.monotonic() - t0 < 60, "faulted route hung"
+
+        out = fleet.submit("m", dense=x)   # fleet still healthy
+        assert np.asarray(out["mean"]).shape == (2,)
+        assert not _no_tmp_residue(str(tmp_path))
+    finally:
+        monkeypatch.delenv("SHIFU_TPU_FAULT", raising=False)
+        resilience.reset_faults()
+        t0 = time.monotonic()
+        fleet.close()
+        assert time.monotonic() - t0 < 60, "fleet close hung"
+
+
+def test_registry_publish_fault_through_cli_is_recoverable(
+        tmp_path, monkeypatch):
+    """`registry.publish` through the CLI verb: the injected fault
+    fails the publish naming the site, the previous HEAD stays
+    servable, no dot-temp residue survives the rerun, and the clean
+    rerun commits the next version."""
+    from tests.test_serve import _tiny_nn_dir
+    from shifu_tpu import registry
+
+    assert "registry.publish" in resilience.FAULT_SITES
+    src = _tiny_nn_dir(str(tmp_path / "src"))
+    reg = str(tmp_path / "reg")
+    args = ["--dir", str(tmp_path), "registry", "publish",
+            "--registry", reg, "--name", "m", "--models", src]
+    assert cli_main(args) == 0
+    assert registry.head(reg, "m") == "v001"
+
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "registry.publish:oserror:1")
+    resilience.reset_faults()
+    t0 = time.monotonic()
+    with pytest.raises(OSError,
+                       match="injected oserror at registry.publish"):
+        cli_main(args)
+    assert time.monotonic() - t0 < 120
+    assert registry.head(reg, "m") == "v001"   # previous HEAD intact
+
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    assert cli_main(args) == 0
+    assert registry.head(reg, "m") == "v002"
+    assert not _no_tmp_residue(reg)
+
+
+@pytest.mark.parametrize("kind,exc", [("oserror", OSError),
+                                      ("timeout", TimeoutError)])
 def test_serving_fault_never_hangs_and_is_recoverable(
         tmp_path, monkeypatch, kind, exc):
     """An injected fault at `serve.request` fails exactly one submit,

@@ -1,0 +1,464 @@
+"""Model fleet plane (shifu_tpu/registry + shifu_tpu/serve/fleet).
+
+Four contracts:
+
+- REGISTRY ATOMICITY: publish commits an immutable version dir, then
+  the HEAD pointer, via two atomic renames; a fault or SIGKILL at
+  either `registry.publish` point leaves the previous HEAD intact and
+  the registry readable, and a clean rerun succeeds. gc keeps the
+  last K versions and never the HEAD; rollback is one HEAD commit.
+- ROUTING PARITY: a score routed through `FleetService` bit-matches a
+  standalone `ScorerService` on the same registry version dir — the
+  fleet layer adds residency and admission, never arithmetic.
+- RESIDENCY: under an HBM budget smaller than the fleet, the
+  least-recently-used model is evicted and transparently re-warmed on
+  its next hit, with identical scores across the round trip.
+- ADMISSION + AUTOTUNING: when the rolling high-priority p99 breaches
+  the SLO, low-priority submits shed (`ShedReject` → HTTP 429 +
+  Retry-After) while high-priority traffic keeps flowing, and the
+  hysteresis releases once the p99 recovers; the SLO autotuner halves
+  / grows each model's admission deadline from its own metrics-store
+  history and converges (no-op) inside the band.
+"""
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from shifu_tpu import registry, resilience
+from tests.test_serve import _tiny_nn_dir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LADDER = (1, 4)   # two tiny buckets keep warms cheap in tier-1
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    resilience.reset_faults()
+    yield
+    resilience.reset_faults()
+
+
+def _publish(reg, name, tmp_path, seed=0, priority="high",
+             ladder=LADDER, **kw):
+    src = str(tmp_path / f"src_{name}_{seed}")
+    _tiny_nn_dir(src, seed=seed)
+    return registry.publish(reg, name, src, priority=priority,
+                            ladder=ladder, **kw)
+
+
+def _no_tmp_residue(root):
+    stranded = []
+    for dirpath, dirs, files in os.walk(root):
+        stranded += [os.path.join(dirpath, e)
+                     for e in list(dirs) + list(files)
+                     if e.startswith(".tmp.")]
+    return stranded
+
+
+def _budget_mb_fitting(reg, names, fit):
+    """An HBM budget that fits exactly `fit` of these (identically
+    sized) models, with half a model of slack."""
+    per = []
+    for n in names:
+        m = registry.read_manifest(reg, n)
+        per.append(m["param_bytes"]
+                   + m["ladder"][-1] * m["working_row_bytes"])
+    return (sum(sorted(per)[:fit]) + min(per) / 2.0) / float(1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# registry: publish / rollback / gc
+# ---------------------------------------------------------------------------
+
+def test_publish_creates_versions_and_flips_head(tmp_path):
+    reg = str(tmp_path / "reg")
+    assert _publish(reg, "a", tmp_path, seed=0) == "v001"
+    assert _publish(reg, "a", tmp_path, seed=1,
+                    priority="low", max_delay_ms=3.5) == "v002"
+    assert registry.versions(reg, "a") == ["v001", "v002"]
+    assert registry.head(reg, "a") == "v002"
+    v, vdir, manifest = registry.resolve(reg, "a")
+    assert v == "v002" and os.path.isdir(vdir)
+    assert manifest["family"] == ["nn"]
+    assert manifest["priority"] == "low"
+    assert manifest["max_delay_ms"] == 3.5
+    assert tuple(manifest["ladder"]) == LADDER
+    assert manifest["param_bytes"] > 0
+    assert manifest["input_dim"] == 12
+    assert set(manifest["files"]) == {"model0.npz"}
+    assert all(len(sha) == 64 for sha in manifest["files"].values())
+    rows = registry.ls(reg)
+    assert [r["name"] for r in rows] == ["a"]
+    assert rows[0]["head"] == "v002"
+    assert not _no_tmp_residue(reg)
+
+
+def test_rollback_and_gc_keep_head(tmp_path):
+    reg = str(tmp_path / "reg")
+    for seed in range(3):
+        _publish(reg, "a", tmp_path, seed=seed)
+    assert registry.rollback(reg, "a") == "v002"
+    assert registry.head(reg, "a") == "v002"
+    # keep=1 would keep only the newest, but HEAD (v002) is pinned
+    removed = registry.gc(reg, "a", keep=1)
+    assert removed == ["v001"]
+    assert registry.versions(reg, "a") == ["v002", "v003"]
+    assert registry.head(reg, "a") == "v002"
+    # roll forward is just another rollback
+    assert registry.rollback(reg, "a", to="v003") == "v003"
+    with pytest.raises(FileNotFoundError):
+        registry.rollback(reg, "a", to="v999")
+    assert not _no_tmp_residue(reg)
+
+
+@pytest.mark.parametrize("nth", [1, 2])
+def test_publish_fault_leaves_previous_head_intact(
+        tmp_path, monkeypatch, nth):
+    """`registry.publish` fires before EACH of the two commit renames;
+    an injected fault at either leaves HEAD on the previous version
+    and the registry fully readable, and a clean rerun succeeds."""
+    reg = str(tmp_path / "reg")
+    _publish(reg, "a", tmp_path, seed=0)
+    monkeypatch.setenv("SHIFU_TPU_FAULT", f"registry.publish:oserror:{nth}")
+    resilience.reset_faults()
+    with pytest.raises(OSError,
+                       match="injected oserror at registry.publish"):
+        _publish(reg, "a", tmp_path, seed=1)
+    assert registry.head(reg, "a") == "v001"
+    assert registry.resolve(reg, "a")[0] == "v001"
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    v = _publish(reg, "a", tmp_path, seed=1)
+    assert registry.head(reg, "a") == v
+    assert not _no_tmp_residue(reg)
+
+
+_KILL_DRILL = textwrap.dedent("""\
+    import sys
+    from shifu_tpu import registry
+    from tests.test_serve import _tiny_nn_dir
+    reg, src, nth = sys.argv[1], sys.argv[2], sys.argv[3]
+    registry.publish(reg, "a", src, ladder=(1, 4))   # v001, 2 sites
+    import os
+    os.environ["SHIFU_TPU_FAULT"] = "registry.publish:kill:" + nth
+    registry.publish(reg, "a", src, ladder=(1, 4))   # killed mid-commit
+    print("UNREACHABLE")
+""")
+
+
+@pytest.mark.parametrize("nth", [1, 2])
+def test_sigkill_mid_publish_previous_head_survives(tmp_path, nth):
+    """SIGKILL at either commit point of the SECOND publish (the fault
+    env goes live after the first, so its calls are nth 1-2): HEAD
+    must still name v001, resolve() must return the intact v001, and
+    a rerun publish must recover — including scrubbing any stage dir
+    the kill stranded."""
+    reg = str(tmp_path / "reg")
+    src = _tiny_nn_dir(str(tmp_path / "src"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-c", _KILL_DRILL, reg, src, str(nth)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stdout,
+                                             r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert registry.head(reg, "a") == "v001"
+    v, vdir, manifest = registry.resolve(reg, "a")
+    assert v == "v001" and manifest["name"] == "a"
+    # recoverable: the next publish scrubs stage residue and commits
+    assert registry.publish(reg, "a", src, ladder=LADDER) \
+        not in (None, "v001")
+    assert registry.head(reg, "a") != "v001"
+    assert not _no_tmp_residue(reg)
+
+
+# ---------------------------------------------------------------------------
+# fleet: routing parity + LRU residency
+# ---------------------------------------------------------------------------
+
+def test_fleet_routes_bitwise_equal_to_standalone(tmp_path):
+    from shifu_tpu.serve.fleet import FleetService
+    from shifu_tpu.serve.service import ScorerService
+
+    reg = str(tmp_path / "reg")
+    _publish(reg, "a", tmp_path, seed=0)
+    _publish(reg, "b", tmp_path, seed=1)
+    x = np.random.default_rng(3).normal(0, 1, (3, 12)) \
+        .astype(np.float32)
+    with FleetService(reg, workspace_root=str(tmp_path),
+                      hbm_budget_mb=0) as fleet:
+        got_a = np.asarray(fleet.submit("a", dense=x)["mean"])
+        got_b = np.asarray(fleet.submit("b", dense=x)["mean"])
+        with pytest.raises(KeyError):
+            fleet.submit("nope", dense=x)
+    for name, got in (("a", got_a), ("b", got_b)):
+        _, vdir, manifest = registry.resolve(reg, name)
+        with ScorerService(models_dir=vdir,
+                           ladder=tuple(manifest["ladder"]),
+                           workspace_root=str(tmp_path)) as solo:
+            want = np.asarray(solo.submit(dense=x)["mean"])
+        np.testing.assert_array_equal(got, want)
+    # the router really routes: two different models, two answers
+    assert not np.array_equal(got_a, got_b)
+
+
+def test_fleet_lru_evict_and_rewarm_roundtrip(tmp_path):
+    from shifu_tpu.serve.fleet import FleetService
+
+    reg = str(tmp_path / "reg")
+    for i, name in enumerate(["a", "b", "c"]):
+        _publish(reg, name, tmp_path, seed=i)
+    budget = _budget_mb_fitting(reg, ["a", "b", "c"], fit=2)
+    x = np.random.default_rng(4).normal(0, 1, (2, 12)) \
+        .astype(np.float32)
+    fleet = FleetService(reg, workspace_root=str(tmp_path),
+                         hbm_budget_mb=budget)
+    try:
+        fleet.start()   # warms a, b, c in order; c's warm evicts a
+        assert fleet.resident() == ["b", "c"]
+        before = np.asarray(fleet.submit("a", dense=x)["mean"])
+        # re-warming a evicted b (the least recently used resident)
+        assert "a" in fleet.resident()
+        assert "b" not in fleet.resident()
+        fl = fleet.stats()["fleet"]
+        assert fl["models_resident"] == 2
+        assert fl["evictions"] == 2
+        assert fl["rewarm_s"] > 0.0
+        # b round-trips through its own evict + re-warm bitwise clean,
+        # and a second hit on a (still resident) re-warms nothing
+        b_scores = np.asarray(fleet.submit("b", dense=x)["mean"])
+        again = np.asarray(fleet.submit("a", dense=x)["mean"])
+        np.testing.assert_array_equal(before, again)
+        assert np.asarray(b_scores).shape == (2,)
+        assert fleet.stats()["fleet"]["evictions"] >= 3
+    finally:
+        fleet.close()
+
+
+def test_promote_then_evict_hot_swaps_model_version(tmp_path):
+    """A registry publish while the fleet runs takes effect at the
+    model's next re-warm: HEAD is re-resolved, so promote-then-evict
+    hot-swaps the version without a process restart."""
+    from shifu_tpu.serve.fleet import FleetService
+
+    reg = str(tmp_path / "reg")
+    for i, name in enumerate(["a", "b"]):
+        _publish(reg, name, tmp_path, seed=i)
+    budget = _budget_mb_fitting(reg, ["a", "b"], fit=1)
+    x = np.random.default_rng(7).normal(0, 1, (2, 12)) \
+        .astype(np.float32)
+    fleet = FleetService(reg, workspace_root=str(tmp_path),
+                         hbm_budget_mb=budget)
+    try:
+        fleet.start()   # warms a then b; b's warm evicts a
+        assert fleet.resident() == ["b"]
+        old = np.asarray(fleet.submit("a", dense=x)["mean"])
+        assert fleet.stats()["models"]["a"]["version"] == "v001"
+        # promote a new version of a, then force its evict by
+        # touching b (a becomes LRU) — next hit re-warms at HEAD
+        assert _publish(reg, "a", tmp_path, seed=9) == "v002"
+        fleet.submit("b", dense=x)
+        assert fleet.resident() == ["b"]
+        new = np.asarray(fleet.submit("a", dense=x)["mean"])
+        assert fleet.stats()["models"]["a"]["version"] == "v002"
+        assert not np.array_equal(old, new)
+    finally:
+        fleet.close()
+
+
+def test_fleet_route_fault_names_site_and_recovers(tmp_path,
+                                                   monkeypatch):
+    from shifu_tpu.serve.fleet import FleetService
+
+    reg = str(tmp_path / "reg")
+    _publish(reg, "a", tmp_path, seed=0)
+    x = np.zeros((2, 12), np.float32)
+    with FleetService(reg, workspace_root=str(tmp_path),
+                      hbm_budget_mb=0) as fleet:
+        monkeypatch.setenv("SHIFU_TPU_FAULT", "serve.route:oserror:1")
+        resilience.reset_faults()
+        with pytest.raises(OSError,
+                           match="injected oserror at serve.route"):
+            fleet.submit("a", dense=x)
+        monkeypatch.delenv("SHIFU_TPU_FAULT")
+        resilience.reset_faults()
+        out = fleet.submit("a", dense=x)
+        assert np.asarray(out["mean"]).shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# admission: priority shed + hysteresis
+# ---------------------------------------------------------------------------
+
+def test_low_priority_sheds_while_high_keeps_flowing(tmp_path):
+    from shifu_tpu.serve.fleet import FleetService, ShedReject
+
+    reg = str(tmp_path / "reg")
+    _publish(reg, "hi", tmp_path, seed=0, priority="high")
+    _publish(reg, "lo", tmp_path, seed=1, priority="low")
+    x = np.zeros((2, 12), np.float32)
+    with FleetService(reg, workspace_root=str(tmp_path),
+                      hbm_budget_mb=0, slo_p99_ms=50.0) as fleet:
+        # breach: a window of 200ms high-priority latencies
+        for _ in range(32):
+            fleet._note_latency("high", 0.2)
+        with pytest.raises(ShedReject) as ei:
+            fleet.submit("lo", dense=x)
+        assert isinstance(ei.value, queue.Full)   # uniform 429 path
+        assert ei.value.retry_after_s > 0
+        # high-priority traffic is never shed
+        out = fleet.submit("hi", dense=x)
+        assert np.asarray(out["mean"]).shape == (2,)
+        st = fleet.stats()
+        assert st["shedding"] is True
+        assert st["fleet"]["shed_rate"] > 0
+        assert st["rejected_by_class"]["low"] >= 1
+        assert st["fleet"]["p99_ms_by_class"]["high"] > 50.0
+        # recovery: fill the rolling window with sub-SLO latencies —
+        # the hysteresis releases below 0.7x SLO and low flows again
+        for _ in range(64):
+            fleet._note_latency("high", 0.001)
+        out = fleet.submit("lo", dense=x)
+        assert np.asarray(out["mean"]).shape == (2,)
+        assert fleet.stats()["shedding"] is False
+
+
+# ---------------------------------------------------------------------------
+# SLO autotuner
+# ---------------------------------------------------------------------------
+
+def test_autotuner_steers_and_converges(tmp_path, monkeypatch):
+    from shifu_tpu.obs.health import store as health_store
+    from shifu_tpu.serve.fleet import FleetService, SloAutotuner
+
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    root = str(tmp_path)
+    reg = os.path.join(root, "reg")
+    _publish(reg, "a", tmp_path, seed=0, ladder=(1, 4, 16),
+             max_delay_ms=4.0)
+    st = health_store.store(root)
+
+    def feed(p99_ms, n=25):
+        for _ in range(n):
+            st.emit("serve.p99_ms", p99_ms, model="a")
+        st.flush()
+
+    with FleetService(reg, workspace_root=root,
+                      hbm_budget_mb=0) as fleet:
+        entry = fleet._entries["a"]
+        tuner = SloAutotuner(fleet, slo_p99_ms=50.0)
+
+        feed(120.0)            # way over SLO → halve the deadline
+        (rec,) = tuner.step()
+        assert rec["p99_ms_before"] == 120.0
+        assert rec["max_delay_ms_before"] == 4.0
+        assert rec["max_delay_ms_after"] == 2.0
+        # applied live, not just recorded
+        assert entry.max_delay_s == pytest.approx(0.002)
+        assert entry.service._batcher.max_delay == pytest.approx(0.002)
+
+        feed(5.0)              # far under SLO → grow 1.25x
+        (rec,) = tuner.step()
+        assert rec["max_delay_ms_after"] == pytest.approx(2.5)
+
+        feed(30.0)             # inside the band → converged, no-op
+        (rec,) = tuner.step()
+        assert rec["max_delay_ms_after"] == rec["max_delay_ms_before"]
+        (rec2,) = tuner.step()
+        assert rec2["max_delay_ms_after"] == rec["max_delay_ms_after"]
+
+        # observed sizes never left the bottom rung → the proposal
+        # trims the ladder (one rung of headroom) for the next re-warm
+        fleet.submit("a", dense=np.zeros((1, 12), np.float32))
+        (rec,) = tuner.step()
+        assert rec["ladder"] == [1, 4]
+        assert entry.ladder == (1, 4)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end: routing, 429 + Retry-After, labeled metrics
+# ---------------------------------------------------------------------------
+
+def test_http_fleet_routing_shed_and_metrics(tmp_path):
+    from shifu_tpu.serve.fleet import FleetService
+    from shifu_tpu.serve.http import HttpFrontEnd
+
+    reg = str(tmp_path / "reg")
+    _publish(reg, "a", tmp_path, seed=0, priority="high")
+    _publish(reg, "lo", tmp_path, seed=1, priority="low")
+    x = np.random.default_rng(6).normal(0, 1, (3, 12)) \
+        .astype(np.float32)
+    body = json.dumps({"dense": x.tolist()}).encode()
+    hdrs = {"Content-Type": "application/json"}
+    with FleetService(reg, workspace_root=str(tmp_path),
+                      hbm_budget_mb=0, slo_p99_ms=50.0) as fleet:
+        want = np.asarray(fleet.submit("a", dense=x)["mean"])
+        front = HttpFrontEnd(fleet=fleet, host="127.0.0.1",
+                             port=0).start()
+        try:
+            host, port = front.address
+            base = f"http://{host}:{port}"
+
+            req = urllib.request.Request(base + "/score/a", data=body,
+                                         headers=hdrs)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+            np.testing.assert_allclose(
+                np.asarray(payload["scores"]["mean"], np.float64),
+                want, rtol=1e-6, atol=1e-7)   # json float round-trip
+
+            # unknown model and the un-routed /score both 404 in
+            # fleet mode (routing is explicit)
+            for path in ("/score/nope", "/score"):
+                bad = urllib.request.Request(base + path, data=body,
+                                             headers=hdrs)
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(bad, timeout=10)
+                assert ei.value.code == 404
+
+            # engage the shed switch → low-priority POST answers 429
+            # with a Retry-After hint
+            for _ in range(32):
+                fleet._note_latency("high", 0.2)
+            shed = urllib.request.Request(base + "/score/lo",
+                                          data=body, headers=hdrs)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(shed, timeout=10)
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+
+            with urllib.request.urlopen(base + "/stats",
+                                        timeout=10) as resp:
+                stats = json.loads(resp.read())
+            from shifu_tpu import profiling
+            assert set(stats["fleet"]) == set(profiling.FLEET_FIELDS)
+            assert stats["models"]["a"]["priority"] == "high"
+
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            assert "shifu_fleet_models_resident" in text
+            assert 'shifu_serve_requests_total{model="a",' \
+                   'priority="high"}' in text
+            assert 'shifu_serve_rejected_total{priority="low"}' in text
+
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as resp:
+                health = json.loads(resp.read())
+            assert health["ok"] is True
+            assert set(health["models"]) == {"a", "lo"}
+        finally:
+            front.close()

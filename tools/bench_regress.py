@@ -56,6 +56,15 @@ def _throughput(rec: Dict) -> Optional[Tuple[str, float]]:
     return None
 
 
+def _fleet_p99(rec: Dict, cls: str) -> Optional[float]:
+    by_class = rec.get("p99_ms_by_class")
+    if isinstance(by_class, dict):
+        v = by_class.get(cls)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
 def _bound(rec: Dict, key: str = "roofline") -> Optional[str]:
     roof = rec.get(key)
     if isinstance(roof, dict):
@@ -138,6 +147,41 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
             findings.append(
                 f"{label}: resident_speedup {sp:.2f} < 1 — the "
                 "device-resident state tier lost to the host tier")
+        # fleet records: per-priority-class p99 is lower-is-better
+        # (the generic throughput gate above covers qps_sustained),
+        # and the shed rate must not creep — both vs trailing medians,
+        # advisory below --min-history like everything else
+        if isinstance(newest.get("p99_ms_by_class"), dict):
+            for cls in sorted(newest["p99_ms_by_class"]):
+                nv = _fleet_p99(newest, cls)
+                hv = sorted(v for v in (_fleet_p99(r, cls)
+                                        for r in history)
+                            if v is not None)
+                if nv is None or len(hv) < min_history:
+                    continue
+                median = hv[len(hv) // 2]
+                ceil = median * (1.0 + threshold_pct / 100.0)
+                if nv > ceil:
+                    findings.append(
+                        f"{label}: p99_ms_by_class[{cls}] {nv:.4g} is "
+                        f"{100.0 * (nv - median) / median:.1f}% above "
+                        f"the trailing median {median:.4g} "
+                        f"(threshold {threshold_pct:.0f}%)")
+        sr = newest.get("shed_rate")
+        if isinstance(sr, (int, float)):
+            hv = sorted(float(r["shed_rate"]) for r in history
+                        if isinstance(r.get("shed_rate"), (int, float)))
+            if len(hv) >= min_history:
+                median = hv[len(hv) // 2]
+                # absolute headroom too: a 0 → 0.05 move shouldn't trip
+                ceil = max(median * (1.0 + threshold_pct / 100.0),
+                           median + 0.05)
+                if sr > ceil:
+                    findings.append(
+                        f"{label}: shed_rate {sr:.4g} exceeds the "
+                        f"trailing median {median:.4g} by more than "
+                        f"{threshold_pct:.0f}% — low-priority traffic "
+                        "is being shed harder than history")
     if findings:
         print(f"bench_regress: {len(findings)} finding(s) in {path}:",
               file=sys.stderr)

@@ -31,6 +31,11 @@ The serving bench record is pinned likewise: its schema is
 ``profiling.SERVING_FIELDS`` (AST-read), every field must be
 README-documented, and bench.py must build the record from the tuple.
 
+The fleet summary block is pinned likewise: ``stats()["fleet"]`` from
+serve/fleet.py and the bench.py task_fleet record are both
+``profiling.FLEET_FIELDS``, every field must be README-documented,
+and both builders must reference the tuple.
+
 The ``dag`` block (every command routed through the pipeline DAG
 scheduler) is pinned the same way: per-node records are
 ``profiling.DAG_FIELDS``, the summary is ``profiling.DAG_SUMMARY_FIELDS``,
@@ -84,9 +89,9 @@ def documented_fields() -> set:
     # members of the pinned block schemas (roofline/serving/dag) are
     # documented as those blocks' keys, not inputPipeline stages
     pinned = set(roofline_fields()) | set(serving_fields()) | \
-        set(dag_fields()) | set(dag_summary_fields()) | \
-        set(trace_fields()) | set(metric_fields()) | \
-        set(health_fields())
+        set(fleet_fields()) | set(dag_fields()) | \
+        set(dag_summary_fields()) | set(trace_fields()) | \
+        set(metric_fields()) | set(health_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -151,6 +156,10 @@ def serving_fields() -> tuple:
     return _profiling_tuple("SERVING_FIELDS")
 
 
+def fleet_fields() -> tuple:
+    return _profiling_tuple("FLEET_FIELDS")
+
+
 def dag_fields() -> tuple:
     return _profiling_tuple("DAG_FIELDS")
 
@@ -212,6 +221,34 @@ def check_serving_docs() -> int:
         return 1
     print(f"serving bench: all {len(fields)} SERVING_FIELDS documented "
           "in README and pinned in bench.py")
+    return 0
+
+
+def check_fleet_docs() -> int:
+    """Every FLEET_FIELDS member (the ``stats()["fleet"]`` block and
+    bench.py task_fleet's record schema) must be backtick-documented
+    in README's Model fleet section, and both builders must construct
+    their dicts from the tuple — the literal checks assert
+    serve/fleet.py and bench.py reference `FLEET_FIELDS` so neither
+    can silently drift from the pinned schema."""
+    fields = fleet_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("fleet schema drift: FLEET_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    for path, what in ((os.path.join(PKG, "serve", "fleet.py"),
+                        "shifu_tpu/serve/fleet.py"),
+                       (os.path.join(REPO, "bench.py"), "bench.py")):
+        with open(path, encoding="utf-8") as f:
+            if "FLEET_FIELDS" not in f.read():
+                print(f"{what} no longer builds the fleet block from "
+                      "profiling.FLEET_FIELDS", file=sys.stderr)
+                return 1
+    print(f"model fleet: all {len(fields)} FLEET_FIELDS documented in "
+          "README and pinned in serve/fleet.py + bench.py")
     return 0
 
 
@@ -356,6 +393,8 @@ def main(argv) -> int:
     if check_roofline_docs():
         return 1
     if check_serving_docs():
+        return 1
+    if check_fleet_docs():
         return 1
     if check_dag_docs():
         return 1
