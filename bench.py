@@ -1906,6 +1906,153 @@ def task_cpu_denom():
     print(json.dumps(out))
 
 
+def _mh_stats_run(nproc, ws, env_extra, timeout=900):
+    """Launch `nproc` stats workers over the gloo/localhost rig — the
+    SAME harness tests/test_multihost.py drills use — and wait."""
+    import socket
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "multihost_worker.py")
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, "--port", str(port),
+             "--nproc", str(nproc), "--pid", str(i), "--out", ws,
+             "--local-devices", "1", "--mode", "stats"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(nproc)
+    ]
+    cpu_s = []
+    for p in procs:
+        so, se = p.communicate(timeout=timeout)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"stats worker rc={p.returncode}:\n{se[-2000:]}")
+        for ln in so.splitlines():
+            if ln.startswith("STATS_CPU_S "):
+                cpu_s.append(float(ln.split()[1]))
+    if len(cpu_s) != nproc:
+        raise RuntimeError(f"expected {nproc} STATS_CPU_S lines, "
+                           f"got {len(cpu_s)}")
+    return max(cpu_s)
+
+
+def _stats_step_metrics(ws):
+    """(wallSeconds, dist_merge_s) of the LAST 'stats' record in the
+    workspace's steps.jsonl — the in-step wall, excluding interpreter
+    and jax.distributed startup."""
+    wall, merge = None, 0.0
+    with open(os.path.join(ws, "tmp", "metrics", "steps.jsonl")) as f:
+        for ln in f:
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("step") == "stats" and "wallSeconds" in rec:
+                wall = float(rec["wallSeconds"])
+                merge = float(
+                    (rec.get("inputPipeline") or {}).get("dist_merge_s",
+                                                         0.0))
+    if wall is None:
+        raise RuntimeError(f"no stats record in {ws}/tmp/metrics")
+    return wall, merge
+
+
+def task_dist_stats():
+    """Pod-scale sharded stats: `shifu stats` at 1 host vs N hosts
+    (real subprocesses, gloo CPU collectives over localhost — the
+    tests/test_multihost.py rig) over one multi-file text table.
+    Reports rows/s both ways (in-step wall basis), the
+    merge-collective seconds, and the sha256 bitwise-parity verdict on
+    ColumnConfig.json. scaling_efficiency = c1/(N·cN) over per-host
+    CPU seconds of the step — the work split the data plane actually
+    controls. On a real pod every host owns its cores so CPU and wall
+    basis coincide; on this rig the N simulated hosts timeshare the
+    same cores, so wall clock cannot show the split. Record keys are
+    pinned by profiling.SHARD_FIELDS."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.profiling import SHARD_FIELDS
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.synth import make_model_set
+
+    rows = knob_int("SHIFU_TPU_DIST_STATS_ROWS")
+    hosts = knob_int("SHIFU_TPU_DIST_STATS_HOSTS")
+    tmp = tempfile.mkdtemp(prefix="shifu_dist_stats_")
+    try:
+        rng = np.random.default_rng(20260807)
+        base = make_model_set(os.path.join(tmp, "base"), rng,
+                              n_rows=rows)
+        data_dir = os.path.join(base, "data")
+        src = os.path.join(data_dir, "part-00000")
+        with open(src) as f:
+            lines = f.readlines()
+        os.remove(src)
+        n_parts = hosts * 4   # several files per shard
+        per = (len(lines) + n_parts - 1) // n_parts
+        for i in range(n_parts):
+            with open(os.path.join(data_dir, f"part-{i:05d}"),
+                      "w") as f:
+                f.writelines(lines[i * per:(i + 1) * per])
+        if cli_main(["--dir", base, "init"]) != 0:
+            raise RuntimeError("init failed")
+        ws1 = os.path.join(tmp, "ws1", "ModelSet")
+        wsN = os.path.join(tmp, "wsN", "ModelSet")
+        shutil.copytree(base, ws1)
+        shutil.copytree(base, wsN)
+        # same parser (native reader bypasses itself when sharded) and
+        # same streaming path + chunk grid on both sides — the bitwise
+        # contract is same-code-path, sequential-equivalent folding
+        env = {"SHIFU_TPU_NATIVE_READER": "0",
+               "SHIFU_TPU_STATS_CHUNK_ROWS":
+                   str(max(rows // (n_parts * 2), 5_000))}
+        _log(f"[dist_stats] 1-host run over {rows} rows "
+             f"({n_parts} part files)...")
+        c1 = _mh_stats_run(1, ws1, env)
+        _log(f"[dist_stats] {hosts}-host run...")
+        cn = _mh_stats_run(hosts, wsN, env)
+        t1, _ = _stats_step_metrics(ws1)
+        tn, merge_s = _stats_step_metrics(wsN)
+        _log(f"[dist_stats] wall {t1:.2f}s → {tn:.2f}s, per-host cpu "
+             f"{c1:.2f}s → {cn:.2f}s, merge {merge_s:.2f}s")
+
+        def sha(root):
+            with open(os.path.join(root, "ColumnConfig.json"),
+                      "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+
+        rec = {
+            "hosts": hosts,
+            "rows": rows,
+            "rows_per_s": round(rows / tn, 1),
+            "rows_per_s_1host": round(rows / t1, 1),
+            "scaling_efficiency": round(c1 / (hosts * cn), 3),
+            "merge_collective_s": round(merge_s, 3),
+            "bitwise_identical": sha(ws1) == sha(wsN),
+        }
+        assert set(rec) == set(SHARD_FIELDS), (
+            "dist_stats record drifted from profiling.SHARD_FIELDS")
+        _persist("dist_stats", "cpu", rec)
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -2166,6 +2313,8 @@ def main():
         return task_rf()
     if args.task == "cpu_denom":
         return task_cpu_denom()
+    if args.task == "dist_stats":
+        return task_dist_stats()
 
     diags = []
     extra = {}

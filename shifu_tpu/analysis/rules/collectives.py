@@ -1,0 +1,99 @@
+"""unwatched-collective: process-spanning collectives outside
+`parallel/dist.py` can hang a pod forever.
+
+A direct `multihost_utils.*` / `jax.distributed.*` /
+`jax.make_array_from_process_local_data` / host-level `jax.lax.p*`
+call is a blocking rendezvous with every other process. If a peer died
+(OOM, preemption, SIGKILL) the call never returns — no timeout, no
+poison barrier, no preemption marker, just a silent wedge that keeps
+the whole pod's chips allocated. Every process-spanning collective
+must go through `parallel/dist.py`'s watched wrappers
+(`single_writer`, `global_row_array`, `allreduce_tree`,
+`broadcast_tree`, ...), which run the rendezvous on a watcher thread
+that polls the abort/preempt markers and a deadline, and exit with the
+documented rc instead of hanging.
+
+`jax.lax.p*` INSIDE a jit/shard_map/pmap-decorated function is not a
+host-level rendezvous (it compiles to an on-device collective whose
+liveness the runtime owns) — any enclosing FunctionDef carrying such a
+decorator exempts the call. `parallel/dist.py` itself is exempt: it is
+the one place allowed to touch the raw primitives.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("unwatched-collective",)
+
+# dotted-path substrings that mark a host-level collective entry point
+_COLLECTIVE_MARKS = ("multihost_utils", "jax.distributed")
+_COLLECTIVE_LEAVES = {"make_array_from_process_local_data"}
+
+
+def _is_collective(d: str) -> bool:
+    if any(m in d for m in _COLLECTIVE_MARKS):
+        return True
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf in _COLLECTIVE_LEAVES:
+        return True
+    # jax.lax.psum / pmean / pmax / pmin / ppermute / pshuffle / all_*
+    if ("lax." in d or d.startswith("lax")) and leaf.startswith("p") \
+            and leaf[1:] and leaf in ("psum", "pmean", "pmax", "pmin",
+                                      "ppermute", "pshuffle",
+                                      "psum_scatter"):
+        return True
+    return False
+
+
+def _compiled_scope(stack: List[ast.AST]) -> bool:
+    """True when any enclosing function is jit/shard_map/pmap-compiled
+    — its collectives are on-device ops, not host rendezvous."""
+    for fn in stack:
+        for dec in getattr(fn, "decorator_list", ()):
+            targets = [dec]
+            if isinstance(dec, ast.Call):
+                # @partial(shard_map, ...) wraps the compiler as the
+                # call's first argument, not its func
+                targets = [dec.func, *dec.args]
+            for t in targets:
+                d = dotted(t)
+                if any(w in d for w in ("jit", "shard_map", "pmap")):
+                    return True
+    return False
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    norm = path.replace(os.sep, "/")
+    if norm.endswith("shifu_tpu/parallel/dist.py"):
+        return []   # the watched wrappers live here, on raw primitives
+    findings: List[Finding] = []
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST) -> None:
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_fn:
+            stack.append(node)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if _is_collective(d) and not _compiled_scope(stack):
+                findings.append(Finding(
+                    "unwatched-collective", path, node.lineno,
+                    node.col_offset,
+                    f"direct collective `{d}` outside parallel/dist.py "
+                    "blocks forever if a peer process died — route it "
+                    "through a watched dist wrapper (allreduce_tree, "
+                    "broadcast_tree, global_row_array, single_writer) "
+                    "so it honors the poison barrier, preemption "
+                    "marker and deadline"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if is_fn:
+            stack.pop()
+
+    visit(tree)
+    return findings

@@ -71,9 +71,15 @@ def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
             continue
         ok, lit = const_str(arg)
         if ok:
-            base = lit.split(".", 1)[0]
-            spec = reg.get(lit) or reg.get(base)
-            if spec is None or ("." in lit and not spec.family):
+            if lit in reg:           # exact entry (dotted names like
+                seen.add(lit)        # "stats.segmerge" are their own)
+                continue
+            # else: longest registered dotted prefix must be a family
+            key = lit
+            while key not in reg and "." in key:
+                key = key.rsplit(".", 1)[0]
+            spec = reg.get(key)
+            if spec is None or not spec.family:
                 findings.append(Finding(
                     "unregistered-dag-step", path, node.lineno,
                     node.col_offset,
@@ -82,11 +88,14 @@ def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
                     "so the DAG scheduler can schedule, resume-skip "
                     "and poison it"))
             else:
-                seen.add(lit if spec is reg.get(lit) else base)
+                seen.add(key)
         elif isinstance(arg, ast.JoinedStr):
             prefix = _fstring_prefix(arg)
-            base = prefix.split(".", 1)[0]
-            spec = reg.get(base)
+            key = prefix[:-1] if prefix.endswith(".") else ""
+            spec = reg.get(key)
+            while spec is None and "." in key:
+                key = key.rsplit(".", 1)[0]
+                spec = reg.get(key)
             if not prefix.endswith(".") or spec is None or \
                     not spec.family:
                 findings.append(Finding(
@@ -97,7 +106,7 @@ def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
                     "pipeline.nodes.STEP_REGISTRY; "
                     f"got prefix '{prefix}'"))
             else:
-                seen.add(base)
+                seen.add(key)
     return findings
 
 

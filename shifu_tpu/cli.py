@@ -87,7 +87,11 @@ def cmd_stats(args) -> int:
         return p.run_rebin(ctx, request_vars=args.vars,
                            expect_bin_num=args.n,
                            iv_keep_ratio=args.ivr, min_inst_cnt=args.bic)
-    return p.run(ctx)
+    if args.seg is not None:
+        return p.run_segment(ctx, args.seg)
+    if args.seg_merge:
+        return p.run_segment_merge(ctx)
+    return p.run(ctx, base_only=args.base_only)
 
 
 def cmd_norm(args) -> int:
@@ -639,6 +643,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="IV keep ratio while shrinking bins")
     p.add_argument("-bic", type=int, default=0,
                    help="minimum instance count per bin")
+    p.add_argument("-seg", type=int, default=None,
+                   help="compute stats for ONE segment expression "
+                        "(1-based index) into a tmp partial — a DAG "
+                        "sibling of the base stats step")
+    p.add_argument("-seg-merge", "--seg-merge", action="store_true",
+                   help="merge base + per-segment partials into "
+                        "ColumnConfig.json")
+    p.add_argument("-base-only", "--base-only", action="store_true",
+                   help="skip segment expansion (the DAG runs segments "
+                        "as sibling -seg steps)")
     p.set_defaults(fn=cmd_stats)
     for alias in ("norm", "normalize"):
         sub.add_parser(alias, help="normalize data").set_defaults(fn=cmd_norm)

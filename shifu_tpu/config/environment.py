@@ -187,6 +187,9 @@ _declare("SHIFU_TPU_PREFETCH_WORKERS", "int", 2,
          "host-assembly threads for map_prefetch; 0 = sequential")
 _declare("SHIFU_TPU_NATIVE_READER", "bool", "1",
          "use the native C fast reader when the .so is present")
+_declare("SHIFU_TPU_DATA_SHARD", "str", "auto",
+         "pod-scale data shard: auto/1 = split stats/norm/psi/"
+         "correlation/eval reads across hosts, 0 = replicated reads")
 # --- streaming chunk triggers ---
 _declare("SHIFU_TPU_STATS_CHUNK_ROWS", "int", None,
          "explicit stats streaming chunk rows; 0 forces resident")
@@ -340,6 +343,11 @@ _declare("SHIFU_TPU_BENCH_REFRESH", "flag", "0",
          scope="bench")
 _declare("SHIFU_TPU_BENCH_STREAMING", "bool", "1",
          "0 = skip the streaming-trainer bench workload",
+         scope="bench")
+_declare("SHIFU_TPU_DIST_STATS_ROWS", "int", 400_000,
+         "row count for the dist_stats bench table", scope="bench")
+_declare("SHIFU_TPU_DIST_STATS_HOSTS", "int", 2,
+         "subprocess host count for the dist_stats bench",
          scope="bench")
 _declare("SHIFU_TPU_RF_ROWS", "int", 11_000_000,
          "row count for the RF bench workload", scope="bench")

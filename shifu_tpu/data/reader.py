@@ -7,6 +7,27 @@ host side just needs a fast columnar parse — pandas' C reader — after
 which everything moves to device as a columnar matrix
 (`shifu_tpu/data/dataset.py`). Multi-host sharded ingestion slices the
 file list per process (`shifu_tpu/parallel/dist.py`).
+
+Pod-scale data plane (SHIFU_TPU_DATA_SHARD, `dist.data_shard()`):
+
+- `read_raw_table(sharded=True)` extends the `file_shard` split to a
+  contiguous ROW-RANGE shard — each host parses rows
+  ``[p·N/P, (p+1)·N/P)`` of the concatenated table (per-file counts
+  exchanged through a watched collective), then the partial frames are
+  all-gathered and reassembled in original order, so every host holds
+  a frame bitwise-interchangeable with the sequential parse while the
+  parse cost itself scales with hosts.
+- `iter_raw_table_keyed(local_only=True)` gives each host only its own
+  files' chunks, each tagged with a global ``(file_idx, chunk_idx)``
+  key and raw-row offset — the identity that lets partial sufficient
+  statistics be replayed in sequential chunk order after the merge
+  (bitwise parity for float64 accumulators).
+- `iter_raw_table_bcast` shards the parse per file but broadcasts
+  every chunk, so all hosts see the identical full stream.
+
+Sharded text parse assumes part files without blank lines (row counts
+come from newline counts, as the Hadoop part-file layout guarantees)
+and bypasses the native fast reader (the parse is split instead).
 """
 
 from __future__ import annotations
@@ -155,8 +176,8 @@ def read_raw_table(mc: ModelConfig,
                    ds: Optional[ModelSourceDataConf] = None,
                    file_shard: Optional[tuple] = None,
                    max_rows: Optional[int] = None,
-                   numeric_columns: Optional[Sequence[str]] = None
-                   ) -> pd.DataFrame:
+                   numeric_columns: Optional[Sequence[str]] = None,
+                   sharded: bool = False) -> pd.DataFrame:
     """Read the raw dataset as a DataFrame with the header's column
     names — all-string, except that `numeric_columns` (when the caller
     knows the types, i.e. after init) may come back float32 via the
@@ -167,9 +188,24 @@ def read_raw_table(mc: ModelConfig,
     `file_shard=(index, count)` reads only every count-th file starting
     at index — the multi-host ingestion split (each JAX process reads a
     disjoint file subset; replaces per-worker HDFS splits).
+
+    `sharded=True` opts into the pod-scale row-range shard when
+    `dist.data_shard()` is active: each host parses a disjoint
+    contiguous row range, the partials are exchanged through a watched
+    collective and reassembled in original order — the returned frame
+    is identical on every host (and to the single-process parse), but
+    the parse cost is split across the pod. Every process of the pod
+    must make the call (it is a collective).
     """
     ds, header, files, first_file, has_header_line, simple = \
         _table_layout(mc, ds, file_shard)
+    if sharded and file_shard is None and max_rows is None:
+        from shifu_tpu.parallel import dist
+        shard = dist.data_shard()
+        if shard is not None:
+            return _read_raw_table_sharded(
+                ds, header, files, first_file, has_header_line, simple,
+                numeric_columns, shard)
 
     if numeric_columns and max_rows is None and \
             not any(fs_mod.has_scheme(p) for p in files) and \
@@ -261,31 +297,232 @@ def iter_raw_table(mc: ModelConfig,
     ds, header, files, first_file, has_header_line, simple = \
         _table_layout(mc, ds, file_shard)
     for path in files:
-        if is_parquet(path):
-            # row-group-bounded batches: the columnar analog of the
-            # chunked CSV reader (never materializes the file)
-            import pyarrow as pa
-            for batch in _parquet_file(path).iter_batches(
-                    batch_size=chunk_rows):
-                df = _table_to_contract(pa.Table.from_batches([batch]),
-                                        header, simple)
-                if simple is not None:
-                    df.columns = simple
-                yield df.reset_index(drop=True)
-            continue
         skip = 1 if (has_header_line and path == first_file) else 0
-        # retry covers the remote open; a failure mid-chunk-iteration
-        # surfaces to the caller (restarting a half-consumed stream
-        # would double-count rows)
-        reader = _read_csv(
-            path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
-            names=header, skiprows=skip, na_filter=False,
-            engine="c", compression="infer", quoting=3,
-            chunksize=chunk_rows)
-        for df in reader:
+        yield from _iter_file_chunks(ds, header, simple, path, skip,
+                                     chunk_rows)
+
+
+def _iter_file_chunks(ds, header, simple, path: str, skip: int,
+                      chunk_rows: int):
+    """Chunk stream of ONE part file — the per-file body of
+    iter_raw_table, shared with the keyed/broadcast sharded iterators
+    so chunk boundaries (hence float64 fold order) are identical no
+    matter which host owns the file."""
+    if is_parquet(path):
+        # row-group-bounded batches: the columnar analog of the
+        # chunked CSV reader (never materializes the file)
+        import pyarrow as pa
+        for batch in _parquet_file(path).iter_batches(
+                batch_size=chunk_rows):
+            df = _table_to_contract(pa.Table.from_batches([batch]),
+                                    header, simple)
             if simple is not None:
                 df.columns = simple
             yield df.reset_index(drop=True)
+        return
+    # retry covers the remote open; a failure mid-chunk-iteration
+    # surfaces to the caller (restarting a half-consumed stream
+    # would double-count rows)
+    reader = _read_csv(
+        path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
+        names=header, skiprows=skip, na_filter=False,
+        engine="c", compression="infer", quoting=3,
+        chunksize=chunk_rows)
+    for df in reader:
+        if simple is not None:
+            df.columns = simple
+        yield df.reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# pod-scale sharded reads (SHIFU_TPU_DATA_SHARD / dist.data_shard())
+# ---------------------------------------------------------------------------
+
+def _count_data_rows(path: str, header_rows: int) -> int:
+    """Data rows in one part file without parsing it: parquet footer
+    metadata, else newline count (trailing unterminated line included).
+    Assumes no blank lines — the Hadoop part-file layout."""
+    if is_parquet(path):
+        return max(int(_parquet_file(path).metadata.num_rows), 0)
+    if not fs_mod.has_scheme(path) and \
+            not path.endswith((".gz", ".bz2")):
+        n, last = 0, b"\n"
+        with open(path, "rb") as f:
+            while True:
+                blk = f.read(1 << 20)
+                if not blk:
+                    break
+                n += blk.count(b"\n")
+                last = blk[-1:]
+        if last != b"\n":
+            n += 1
+        return max(n - header_rows, 0)
+    n = 0
+    with _opener_for(path)(path) as f:
+        for _ in f:
+            n += 1
+    return max(n - header_rows, 0)
+
+
+def _sharded_row_counts(files, first_file, has_header_line,
+                        shard) -> np.ndarray:
+    """Per-file data-row counts for the whole table, counted
+    cooperatively: each host counts its ``fi % count == index`` files,
+    then the integer vectors merge through the watched allreduce
+    (exact in any order). Every process must call this together."""
+    from shifu_tpu.parallel import dist
+    idx, count = shard
+    local = np.zeros(len(files), np.int64)
+    for fi in range(idx, len(files), count):
+        path = files[fi]
+        skip = 1 if (has_header_line and path == first_file) else 0
+        local[fi] = _count_data_rows(path, skip)
+    return np.asarray(dist.allreduce_tree("reader.row_counts", local),
+                      np.int64)
+
+
+def _read_file_rows(ds, header, path: str, header_skip: int,
+                    start: int, n_rows: int,
+                    numeric_columns=None) -> pd.DataFrame:
+    """Rows [start, start+n_rows) of one part file (data rows, i.e.
+    after any in-file header line)."""
+    if is_parquet(path):
+        import pyarrow as pa
+        pf = _parquet_file(path)
+        batches, seen = [], 0
+        for b in pf.iter_batches(batch_size=65536):
+            lo, hi = seen, seen + len(b)
+            seen = hi
+            s, e = max(start, lo), min(start + n_rows, hi)
+            if s < e:
+                batches.append(b.slice(s - lo, e - s))
+            if hi >= start + n_rows:
+                break
+        tbl = pa.Table.from_batches(batches, schema=pf.schema_arrow)
+        return _table_to_contract(tbl, header, None, numeric_columns)
+    return _read_csv(
+        path, sep=ds.dataDelimiter or "|", header=None, dtype=str,
+        names=header, skiprows=header_skip + start, na_filter=False,
+        engine="c", compression="infer", quoting=3, nrows=n_rows)
+
+
+def _read_raw_table_sharded(ds, header, files, first_file,
+                            has_header_line, simple, numeric_columns,
+                            shard) -> pd.DataFrame:
+    """Row-range sharded resident read: host p parses global data rows
+    [p·N/P, (p+1)·N/P), the partial frames all-gather through the
+    watched collective, and every host reassembles them in process
+    (= row) order — same values, same order as the sequential parse,
+    at 1/P of the parse cost per host."""
+    from shifu_tpu.parallel import dist
+    idx, count = shard
+    counts = _sharded_row_counts(files, first_file, has_header_line,
+                                 shard)
+    offsets = np.concatenate([np.zeros(1, np.int64), np.cumsum(counts)])
+    total = int(offsets[-1])
+    lo = (total * idx) // count
+    hi = (total * (idx + 1)) // count
+    pq_numeric = numeric_columns \
+        if all(is_parquet(p) for p in files) else None
+    frames = []
+    for fi, path in enumerate(files):
+        a = max(lo, int(offsets[fi]))
+        b = min(hi, int(offsets[fi + 1]))
+        if a >= b:
+            continue
+        skip = 1 if (has_header_line and path == first_file) else 0
+        frames.append(_read_file_rows(ds, header, path, skip,
+                                      a - int(offsets[fi]), b - a,
+                                      pq_numeric))
+    mine = pd.concat(frames, ignore_index=True) if frames else None
+    parts = [p for p in dist.allgather_obj("reader.row_shard", mine)
+             if p is not None and len(p)]
+    if not parts:
+        out = pd.DataFrame({c: pd.Series(dtype=str) for c in header})
+    else:
+        out = parts[0] if len(parts) == 1 \
+            else pd.concat(parts, ignore_index=True)
+    if simple is not None:
+        out.columns = simple
+    return out
+
+
+def iter_raw_table_keyed(mc: ModelConfig,
+                         ds: Optional[ModelSourceDataConf] = None,
+                         chunk_rows: int = 2_000_000,
+                         local_only: bool = False):
+    """Yield ``((file_idx, chunk_idx), start_raw_row, df)`` — the chunk
+    stream of iter_raw_table plus each chunk's global identity and the
+    global raw-row index of its first row (what splitmix64-keyed
+    sampling needs).
+
+    With ``local_only=True`` and an active `dist.data_shard()`, each
+    host gets only its own files' chunks (``file_idx % count ==
+    index``), with offsets taken from the cooperative row-count
+    exchange; chunk keys and boundaries are identical to the full
+    stream, so per-chunk float64 contributions can be merged and
+    replayed in ascending key order to reproduce the sequential
+    accumulation bit for bit. Otherwise the full stream with locally
+    accumulated offsets — exactly iter_raw_table's chunks."""
+    ds, header, files, first_file, has_header_line, simple = \
+        _table_layout(mc, ds, None)
+    shard = None
+    if local_only:
+        from shifu_tpu.parallel import dist
+        shard = dist.data_shard()
+    offsets = None
+    if shard is not None:
+        counts = _sharded_row_counts(files, first_file,
+                                     has_header_line, shard)
+        offsets = np.concatenate([np.zeros(1, np.int64),
+                                  np.cumsum(counts)])
+    pos = 0
+    for fi, path in enumerate(files):
+        if shard is not None:
+            if fi % shard[1] != shard[0]:
+                continue
+            pos = int(offsets[fi])
+        skip = 1 if (has_header_line and path == first_file) else 0
+        for ci, df in enumerate(_iter_file_chunks(ds, header, simple,
+                                                  path, skip,
+                                                  chunk_rows)):
+            yield (fi, ci), pos, df
+            pos += len(df)
+
+
+def iter_raw_table_bcast(mc: ModelConfig,
+                         ds: Optional[ModelSourceDataConf] = None,
+                         chunk_rows: int = 2_000_000):
+    """The identical full chunk stream on every host, with the PARSE
+    sharded per file: file ``fi`` is parsed only by host ``fi % count``
+    and each chunk is broadcast through the watched collective. With
+    no active data shard this is exactly iter_raw_table (no
+    collectives). Every process must consume the stream to the same
+    depth — it is a sequence of collectives."""
+    from shifu_tpu.parallel import dist
+    shard = dist.data_shard()
+    if shard is None:
+        yield from iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)
+        return
+    idx, count = shard
+    ds, header, files, first_file, has_header_line, simple = \
+        _table_layout(mc, ds, None)
+    for fi, path in enumerate(files):
+        owner = fi % count
+        if owner == idx:
+            skip = 1 if (has_header_line and path == first_file) else 0
+            for df in _iter_file_chunks(ds, header, simple, path, skip,
+                                        chunk_rows):
+                dist.allgather_obj("reader.bcast", ("chunk", df))
+                yield df
+            dist.allgather_obj("reader.bcast", ("end",))
+        else:
+            while True:
+                parts = dist.allgather_obj("reader.bcast", None)
+                msg = parts[owner]
+                if msg is None or msg[0] == "end":
+                    break
+                yield msg[1]
 
 
 def missing_mask(values: np.ndarray, missing_values: Sequence[str]) -> np.ndarray:

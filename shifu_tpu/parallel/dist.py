@@ -27,7 +27,16 @@ is gone and `Preempted` raises directly — cluster-wide preemption
 consensus. `initialize` itself runs under the same watchdog with its
 own deadline (SHIFU_TPU_INIT_TIMEOUT_S + margin). Fault sites
 ``dist.init``, ``dist.barrier``, ``dist.allgather``,
-``dist.preempt_marker`` make all of this testable single-process.
+``dist.allreduce_tree``, ``dist.preempt_marker`` make all of this
+testable single-process.
+
+Pod-scale data plane (SHIFU_TPU_DATA_SHARD): `data_shard()` decides
+whether the stats/norm/PSI/correlation/eval readers split the input
+across hosts; `allgather_obj` / `allreduce_tree` / `broadcast_tree`
+are the watched host-object collectives their partial-result merges
+run through — same watchdog/poison/preempt machinery as the barriers,
+so a host dying mid-merge surfaces as DistTimeout/DistAborted on the
+survivors instead of a hang.
 """
 
 from __future__ import annotations
@@ -366,3 +375,106 @@ def global_row_array(mesh, local_rows: np.ndarray, spec=None):
     if _multi_process() and jax.process_count() > 1:
         return _watched("global_row_array", _make)
     return _make()
+
+
+# ---------------------------------------------------------------------------
+# pod-scale data plane: shard decision + watched host-object collectives
+# ---------------------------------------------------------------------------
+
+def data_shard() -> Optional[tuple]:
+    """(index, count) when the pod-scale data shard is active, else
+    None. Active means: SHIFU_TPU_DATA_SHARD is not "0", a multi-host
+    runtime is up, and there is more than one process — the sharded
+    readers then stream disjoint row ranges and merge partials through
+    the watched collectives below. "0" forces today's replicated-read
+    behavior exactly; "auto" (default) and "1" shard whenever the pod
+    has peers to shard across."""
+    mode = (knob_str("SHIFU_TPU_DATA_SHARD") or "auto").strip().lower()
+    if mode in ("0", "off", "false", "no"):
+        return None
+    if not _multi_process():
+        return None
+    count = jax.process_count()
+    if count <= 1:
+        return None
+    return jax.process_index(), count
+
+
+def _exchange_bytes(tag: str, payload: bytes):
+    """All-gather one variable-length byte string per process, watched.
+    Two fixed-shape collectives: lengths first, then the payloads
+    padded to the longest — `process_allgather` needs every process to
+    contribute the same shape."""
+    from jax.experimental import multihost_utils
+
+    def _gather():
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(payload)], np.int64))).reshape(-1)
+        width = max(int(lens.max()), 1)
+        buf = np.zeros(width, np.uint8)
+        if payload:
+            buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+        mat = np.asarray(multihost_utils.process_allgather(buf)) \
+            .reshape(len(lens), -1)
+        return [mat[p, :int(lens[p])].tobytes() for p in range(len(lens))]
+
+    return _watched(tag, _gather)
+
+
+def allgather_obj(tag: str, obj):
+    """Watched all-gather of one picklable host object per process;
+    returns the objects in process order (so a fold over the result is
+    deterministic). Single-process: ``[obj]``. This is the primitive
+    under every data-plane partial merge; the ``dist.allreduce_tree``
+    fault site makes it drillable (oserror/timeout/kill/preempt)."""
+    fault_point("dist.allreduce_tree")
+    if not (_multi_process() and jax.process_count() > 1):
+        return [obj]
+    import pickle
+    t0 = time.monotonic()
+    payloads = _exchange_bytes(tag, pickle.dumps(obj, protocol=4))
+    out = [pickle.loads(p) for p in payloads]
+    from shifu_tpu.data import pipeline as _pipe
+    _pipe.add_stage_time("dist_merge_s", time.monotonic() - t0)
+    _pipe.add_stage_count("dist_merges")
+    return out
+
+
+def _tree_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, dict):
+        return {k: _tree_add(a.get(k), b.get(k))
+                for k in {**a, **b}}
+    if isinstance(a, (list, tuple)):
+        return type(a)(_tree_add(x, y) for x, y in zip(a, b))
+    return a + b
+
+
+def allreduce_tree(tag: str, tree):
+    """Sum per-host partial sufficient statistics across the pod: a
+    watched all-gather of the host trees (dict/list/tuple structure,
+    ndarray/number leaves, None = identity) folded in ascending process
+    order. Exact for integer leaves (bin counts, confusion cells);
+    float leaves must be float64 host accumulators whose sum order the
+    caller has already made deterministic — for bitwise parity with
+    the sequential path, exchange per-chunk contributions via
+    `allgather_obj` and replay them in chunk order instead."""
+    parts = allgather_obj(tag, tree)
+    acc = parts[0]
+    for p in parts[1:]:
+        acc = _tree_add(acc, p)
+    return acc
+
+
+def broadcast_tree(tag: str, tree):
+    """Watched `broadcast_one_to_all`: process 0's pytree of arrays to
+    every process (all processes must supply matching shapes/dtypes).
+    Single-process: returns ``tree`` unchanged."""
+    if not (_multi_process() and jax.process_count() > 1):
+        return tree
+    from jax.experimental import multihost_utils
+    return _watched(
+        tag, lambda: multihost_utils.broadcast_one_to_all(tree))

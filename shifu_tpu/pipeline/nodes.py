@@ -59,6 +59,10 @@ STEP_REGISTRY: Dict[str, StepSpec] = {
                           "raw header → ColumnConfig.json"),
     "stats":     StepSpec(("init",), True, True, False,
                           "column stats, binning, KS/IV"),
+    "stats.seg": StepSpec(("stats",), True, True, True,
+                          "one segment expression's stats partial"),
+    "stats.segmerge": StepSpec(("stats.seg",), False, True, False,
+                               "merge base + segment partials"),
     "norm":      StepSpec(("stats",), True, True, False,
                           "normalized + cleaned training data"),
     "varselect": StepSpec(("norm",), True, True, False,
@@ -128,7 +132,12 @@ def _resume_enabled(resume: Optional[bool]) -> bool:
 def _node(root: str, step: str, cmd: Sequence[str], deps: Tuple[str, ...],
           resume: bool, name: Optional[str] = None,
           env_extra: Optional[Dict[str, str]] = None) -> Node:
-    spec = STEP_REGISTRY[step.split(".", 1)[0]]
+    # longest registered dotted prefix: "eval.Eval1" → "eval",
+    # "stats.seg.3" → "stats.seg" (family entries keep their own spec)
+    key = step
+    while key not in STEP_REGISTRY and "." in key:
+        key = key.rsplit(".", 1)[0]
+    spec = STEP_REGISTRY[key]
     name = name or step
     if not resume:
         done = None
@@ -145,6 +154,38 @@ def _node(root: str, step: str, cmd: Sequence[str], deps: Tuple[str, ...],
 # builders
 # ---------------------------------------------------------------------------
 
+def _segment_count(root: str) -> int:
+    try:
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.data import segment
+        return len(segment.segment_expressions(ModelConfig.load(root)))
+    except Exception:  # noqa: BLE001 - no config yet → no seg fan-out
+        return 0
+
+
+def _stats_nodes(root: str, res: bool) -> Tuple[List[Node], str]:
+    """Stats as DAG nodes. Without segment expressions: the single
+    inline node. With K expressions: base-only stats, then one
+    ``stats.seg.<k>`` SIBLING per expression (each re-reads the frame
+    and fills only its block into a tmp partial), then a host-only
+    ``stats.segmerge`` that stitches base + partials into
+    ColumnConfig.json — identical content to the inline expansion,
+    with the per-segment work schedulable concurrently. Returns the
+    nodes and the name downstream steps must depend on."""
+    n_seg = _segment_count(root)
+    if not n_seg:
+        return [_node(root, "stats", ["stats"], ("init",), res)], "stats"
+    nodes = [_node(root, "stats", ["stats", "-base-only"], ("init",), res)]
+    for k in range(1, n_seg + 1):
+        nodes.append(_node(root, f"stats.seg.{k}", ["stats", "-seg",
+                                                    str(k)],
+                           ("stats",), res))
+    nodes.append(_node(root, "stats.segmerge", ["stats", "-seg-merge"],
+                       tuple(f"stats.seg.{k}"
+                             for k in range(1, n_seg + 1)), res))
+    return nodes, "stats.segmerge"
+
+
 def pipeline_nodes(root: str, eval_sets: Sequence[str] = (),
                    algorithms: Sequence[str] = (),
                    posttrain: bool = False,
@@ -155,10 +196,11 @@ def pipeline_nodes(root: str, eval_sets: Sequence[str] = (),
     trains in the model-set workspace, the rest in clone workspaces
     sharing the parent's normalized data and compile cache."""
     res = _resume_enabled(resume)
+    stats_nodes, stats_dep = _stats_nodes(root, res)
     nodes = [
         _node(root, "init", ["init"], (), res),
-        _node(root, "stats", ["stats"], ("init",), res),
-        _node(root, "norm", ["norm"], ("stats",), res),
+        *stats_nodes,
+        _node(root, "norm", ["norm"], (stats_dep,), res),
     ]
     algorithms = list(algorithms)
     if len(algorithms) > 1:
@@ -189,10 +231,11 @@ def grid_nodes(root: str, grid_params: Sequence[Dict],
     per concrete parameter dict (see `train.grid_search.expand`), each
     in its own clone workspace off the shared norm output."""
     res = _resume_enabled(resume)
+    stats_nodes, stats_dep = _stats_nodes(root, res)
     nodes = [
         _node(root, "init", ["init"], (), res),
-        _node(root, "stats", ["stats"], ("init",), res),
-        _node(root, "norm", ["norm"], ("stats",), res),
+        *stats_nodes,
+        _node(root, "norm", ["norm"], (stats_dep,), res),
     ]
     cache_env = {"SHIFU_TPU_COMPILE_CACHE_DIR":
                  os.path.join(root, "tmp", "jax_cache")}

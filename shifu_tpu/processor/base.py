@@ -59,12 +59,16 @@ class ProcessorContext:
                 f"ModelConfig validation failed for step {step.value}: "
                 + "; ".join(res.causes))
 
-    def save_column_configs(self) -> None:
+    def save_column_configs(self, tag: str = "save_column_configs"
+                            ) -> None:
         # multi-host: identical content on every process, but only one
         # may hold the pen on shared storage; barrier so no host reads
-        # a half-written file in a later step of the same run
+        # a half-written file in a later step of the same run. `tag`
+        # names the step committing (the merge-then-write seam of the
+        # sharded data plane: partials merge BEFORE this call, the
+        # single_writer here guards only the final artifact write)
         from shifu_tpu.parallel import dist
-        with dist.single_writer("save_column_configs") as w:
+        with dist.single_writer(tag) as w:
             if w:
                 save_column_configs(self.column_configs,
                                     self.path_finder.column_config_path())

@@ -10,6 +10,15 @@ Like the reference's mapper (which emits per-split partial sums merged
 exactly by the reducer), a >RAM dataset streams chunk-by-chunk: each
 chunk contributes its pairwise co-valid count / sum / sum-of-squares /
 cross-product matrices, which add exactly — no sampling anywhere.
+
+Pod-scale (`dist.data_shard()` active): the chunked path computes
+moments only for this host's part files' chunks, keeps them keyed by
+global chunk identity, and after the loop all-gathers and replays the
+f64 additions in ascending chunk order — the sequential fold's exact
+operation sequence, so the merged matrix is bitwise identical to a
+single-host run. The resident path shards the PARSE
+(`load_dataset_for_columns(..., sharded=True)` reassembles the
+identical frame everywhere) and computes locally as before.
 """
 
 from __future__ import annotations
@@ -58,14 +67,14 @@ def pearson_from_moments(n, s, ss, p) -> np.ndarray:
     return np.clip(cov / denom, -1.0, 1.0)
 
 
-def _feature_block(ctx, cols, df):
+def _feature_block(ctx, cols, df, sharded: bool = False):
     """(x, names): numeric raw values + categorical posRate encodings
     (like NormPearson mode correlating normalized values) for one
     resident frame / chunk. Categorical codes are pinned to the stats
     vocabularies, so chunks encode identically."""
     mc = ctx.model_config
     dset = norm_proc.load_dataset_for_columns(mc, ctx.column_configs, cols,
-                                              df=df)
+                                              df=df, sharded=sharded)
     blocks, names = [], []
     if dset.numeric.shape[1]:
         blocks.append(dset.numeric)
@@ -99,30 +108,47 @@ def run(ctx: ProcessorContext) -> int:
     from shifu_tpu.parallel import mesh as mesh_mod
     mesh = mesh_mod.default_mesh()
 
+    from shifu_tpu.parallel import dist
+    shard = dist.data_shard()
     if chunk_rows:
         log.info("correlation: dataset exceeds the resident threshold — "
                  "exact streaming accumulation in %d-row chunks", chunk_rows)
         from shifu_tpu.data.pipeline import prefetch
-        from shifu_tpu.data.reader import iter_raw_table
-        frames = prefetch(iter_raw_table(mc, chunk_rows=chunk_rows))
+        from shifu_tpu.data.reader import iter_raw_table_keyed
+        frames = prefetch(iter_raw_table_keyed(mc, chunk_rows=chunk_rows,
+                                               local_only=True))
     else:
-        frames = [None]      # one resident read through the same path
+        frames = [((0, 0), 0, None)]   # one resident read, same path
 
     acc = None
     names = None
-    for df in frames:
-        x, names = _feature_block(ctx, cols, df)
+    pending = []
+    for key, _pos, df in frames:
+        x, names = _feature_block(ctx, cols, df, sharded=df is None)
         parts = pearson_moments(mesh_mod.shard_axis(mesh, x, 0,
                                                     pad_value=np.nan))
         # accumulate on host in f64: partial sums of f32 GEMMs merge
         # without growing rounding error across many chunks
         parts = [np.asarray(m, np.float64) for m in parts]
-        acc = parts if acc is None else [a + b for a, b in zip(acc, parts)]
+        if chunk_rows and shard is not None:
+            pending.append((key, parts))
+        else:
+            acc = parts if acc is None else \
+                [a + b for a, b in zip(acc, parts)]
+    if chunk_rows and shard is not None:
+        # replay every host's per-chunk moments in ascending global
+        # chunk order — the sequential fold's addition sequence
+        gathered = dist.allgather_obj("correlation.moments",
+                                      (names, pending))
+        names = next((nm for nm, _ in gathered if nm is not None), None)
+        for _key, parts in sorted((kp for _, ps in gathered for kp in ps),
+                                  key=lambda kp: kp[0]):
+            acc = parts if acc is None else \
+                [a + b for a, b in zip(acc, parts)]
     corr = pearson_from_moments(*acc)
 
     out = ctx.path_finder.correlation_path()
     ctx.path_finder.ensure(out)
-    from shifu_tpu.parallel import dist
     with dist.single_writer("correlation") as w:
         if w:   # all hosts computed via psum; one writes
             from shifu_tpu.resilience import atomic_write

@@ -180,7 +180,10 @@ def _build_eval_dataset(ctx: ProcessorContext, ec: EvalConfig,
     dset = norm_proc.load_dataset_for_columns(
         eval_mc, ctx.column_configs, cols, ds_conf=ds,
         extra_columns=(score_meta_columns(ctx, ec) if want_meta else None),
-        df=df, apply_filter=apply_filter)
+        df=df, apply_filter=apply_filter,
+        # resident reads shard the parse across hosts (every host runs
+        # eval — non-writers just score into _opath scratch)
+        sharded=df is None)
     return dset, cols
 
 
@@ -243,7 +246,7 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
     A full-dataset transform: always processed in chunks so >RAM eval
     sets export with bounded memory (normalization is row-local; all
     tables come from ColumnConfig)."""
-    from shifu_tpu.data.reader import iter_raw_table
+    from shifu_tpu.data.reader import iter_raw_table_bcast
     from shifu_tpu.eval import csv_out
 
     mc = ctx.model_config
@@ -287,7 +290,7 @@ def run_norm(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
                 for dset, cols in map_stream(
                         lambda df: _build_eval_dataset(
                             ctx, ec, df=df, want_meta=False),
-                        iter_raw_table(mc, ds=ds, chunk_rows=chunk)):
+                        iter_raw_table_bcast(mc, ds=ds, chunk_rows=chunk)):
                     if not len(dset.tags):
                         continue
                     n_rows += _write_chunk(f, dset, cols, n_rows == 0)
@@ -337,6 +340,10 @@ def run_audit(ctx: ProcessorContext, eval_name: Optional[str] = None,
         eval_mc = copy.copy(mc)
         eval_mc.dataSet = ds
         frames, have = [], 0
+        # deliberately NOT the sharded bcast stream: this read breaks
+        # early once N rows survive, and abandoning a collective stream
+        # mid-flight under prefetch would desync hosts; a bounded
+        # sample read is cheap everywhere
         for df in prefetch(iter_raw_table(
                 mc, ds=ds, chunk_rows=max(4 * n_records, 4096))):
             if purifier is not None:
@@ -529,7 +536,7 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     (`EvalModelProcessor.java:942-1110`, `ConfusionMatrix.java:255-284`)
     for eval sets larger than RAM. VERDICT r2 Weak #3 / Next #5.
     """
-    from shifu_tpu.data.reader import iter_raw_table
+    from shifu_tpu.data.reader import iter_raw_table_bcast
 
     mc = ctx.model_config
     ds = effective_dataset_conf(mc, ec)
@@ -562,7 +569,7 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
         # trainer's map_prefetch host assembly
         for dset, norm_cols in map_stream(
                 lambda df: _build_eval_dataset(ctx, ec, df=df),
-                iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)):
+                iter_raw_table_bcast(mc, ds=ds, chunk_rows=chunk_rows)):
             if not len(dset.tags):
                 continue
             scores = _score_dataset(mc, scorer, dset, norm_cols)
@@ -736,7 +743,7 @@ def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
     merged matrix — the reference's sort-based streaming confusion
     matrix (`ConfusionMatrix.java:255-284`) computes the same counts
     for any class count. EvalScore.csv appends per chunk."""
-    from shifu_tpu.data.reader import iter_raw_table
+    from shifu_tpu.data.reader import iter_raw_table_bcast
 
     mc = ctx.model_config
     ds = effective_dataset_conf(mc, ec)
@@ -757,7 +764,7 @@ def _run_multiclass_streaming(ctx: ProcessorContext, ec: EvalConfig,
                       + ",predicted\n")
         for dset, norm_cols in map_stream(
                 lambda df: _build_eval_dataset(ctx, ec, df=df),
-                iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)):
+                iter_raw_table_bcast(mc, ds=ds, chunk_rows=chunk_rows)):
             if not len(dset.tags):
                 continue
             scores = _score_dataset(mc, scorer, dset, norm_cols)
@@ -916,12 +923,13 @@ def run_score(ctx: ProcessorContext, eval_name: Optional[str] = None) -> int:
         with atomic_write(out_path) as f:
             w = _ScoreCsvWriter(f)
             if chunk_rows and not mc.is_multi_classification:
-                from shifu_tpu.data.reader import iter_raw_table
+                from shifu_tpu.data.reader import iter_raw_table_bcast
                 ds = effective_dataset_conf(mc, ec)
                 for dset, cols in map_stream(
                         lambda df: _build_eval_dataset(
                             ctx, ec, df=df, want_meta=False),
-                        iter_raw_table(mc, ds=ds, chunk_rows=chunk_rows)):
+                        iter_raw_table_bcast(mc, ds=ds,
+                                             chunk_rows=chunk_rows)):
                     if not len(dset.tags):
                         continue
                     scores = _score_dataset(mc, scorer, dset, cols)

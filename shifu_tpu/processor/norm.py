@@ -75,17 +75,22 @@ def load_dataset_for_columns(mc: ModelConfig, ccs: List[ColumnConfig],
                              extra_columns: Optional[List[str]] = None,
                              df=None,
                              norm_sampling: bool = False,
-                             sample_seed: int = 12306) -> ColumnarDataset:
+                             sample_seed: int = 12306,
+                             sharded: bool = False) -> ColumnarDataset:
     """Read raw data and build columnar blocks for `cols`, with
     categorical vocabularies pinned to ColumnConfig binCategory so codes
     line up with the stats phase. `df` short-circuits the read — the
     streaming eval path feeds pre-read chunks through the same build.
     `norm_sampling` applies normalize.sampleRate (norm step only — eval
-    reuses this loader and must see every row)."""
+    reuses this loader and must see every row). `sharded` opts the read
+    into the pod-scale row-range shard (each host parses ~1/P of the
+    rows, frames all-gather into the identical full table everywhere —
+    only call sites where EVERY host reaches this loader may set it)."""
     if df is None:
         df = read_raw_table(mc, ds=ds_conf, numeric_columns=[
             c.columnName for c in ccs
-            if c.is_candidate and not c.is_categorical and not c.is_segment])
+            if c.is_candidate and not c.is_categorical and not c.is_segment],
+            sharded=sharded)
     ds_conf = ds_conf or mc.dataSet
     keep = np.ones(len(df), bool)
     if apply_filter and ds_conf.filterExpressions:
@@ -312,7 +317,7 @@ def _run(ctx: ProcessorContext,
         if chunk:
             return norm_streaming.run_streaming(ctx, chunk)
         dataset = load_dataset_for_columns(mc, ctx.column_configs, cols,
-                                           norm_sampling=True)
+                                           norm_sampling=True, sharded=True)
     result = normalize_columns(mc, cols, dataset)
     out = ctx.path_finder.normalized_data_path()
     save_normalized(out, result, dataset.tags, dataset.weights,

@@ -99,25 +99,46 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
     purifier = DataPurifier(mc.dataSet.filterExpressions) \
         if mc.dataSet.filterExpressions else None
     from shifu_tpu.parallel import dist
-    with dist.single_writer("norm_streaming") as w:
-        # the mmap layout is written once on shared storage; hosts >= 1
-        # park at the exit barrier until host 0's passes finish
-        if w:
-            return _writer_passes(ctx, chunk_rows, seed, t0, mc,
-                                  norm_proc, cols, purifier)
-    return 0
+    if dist.data_shard() is None:
+        with dist.single_writer("norm_streaming") as w:
+            # the mmap layout is written once on shared storage; hosts
+            # >= 1 park at the exit barrier until host 0's passes finish
+            if w:
+                return _writer_passes(ctx, chunk_rows, seed, t0, mc,
+                                      norm_proc, cols, purifier)
+        return 0
+    # pod-scale: every host parses only ITS part files and broadcasts
+    # the frames (iter_raw_table_bcast), so the chunk stream — and the
+    # written layout — is identical to a single-host run while parse
+    # cost splits ~1/P. All hosts must enter (the stream is collective);
+    # only the writer host materializes mmaps, and the meta.json commit
+    # barriers at the end.
+    return _writer_passes(ctx, chunk_rows, seed, t0, mc, norm_proc,
+                          cols, purifier, sharded=True)
 
 
 def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
-                   t0: float, mc, norm_proc, cols, purifier) -> int:
-    """The two chunked passes + mmap writes — host 0 only (the barrier
-    discipline lives in run_streaming)."""
+                   t0: float, mc, norm_proc, cols, purifier,
+                   sharded: bool = False) -> int:
+    """The two chunked passes + mmap writes. Unsharded: host 0 only,
+    no collectives inside (the barrier discipline lives in
+    run_streaming). Sharded: every host iterates the broadcast chunk
+    stream; non-writers parse/broadcast their files and discard."""
+    from shifu_tpu.parallel import dist
+    writer = (not sharded) or dist.is_writer()
+
+    def _stream():
+        if sharded:
+            from shifu_tpu.data.reader import iter_raw_table_bcast
+            return prefetch(iter_raw_table_bcast(mc, chunk_rows=chunk_rows))
+        return prefetch(iter_raw_table(mc, chunk_rows=chunk_rows))
+
     val_rate = max(float(mc.train.validSetRate or 0.0), 0.0)
 
     # ---- pass 1: exact region sizes -----------------------------------
     n_train = n_val = 0
     raw_row = 0
-    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
+    for df in _stream():
         start = raw_row
         raw_row += len(df)
         keep = np.ones(len(df), bool)
@@ -135,6 +156,15 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
         raise ValueError(
             f"no row's {mc.dataSet.targetColumnName!r} value matches "
             f"posTags {mc.pos_tags} / negTags {mc.neg_tags} in any chunk")
+
+    if not writer:
+        # keep parsing/broadcasting this host's part files through pass
+        # 2, then park at the meta-commit barrier — write nothing
+        for _df in _stream():
+            pass
+        with dist.single_writer("norm_streaming.meta"):
+            pass
+        return 0
 
     # ---- probe for the output schema (first chunk with valid rows) ----
     probe = None
@@ -194,7 +224,7 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
 
     # ---- pass 2: normalize + write ------------------------------------
     raw_row = 0
-    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
+    for df in _stream():
         start = raw_row
         raw_row += len(df)
         keep = np.ones(len(df), bool)
@@ -248,22 +278,30 @@ def _writer_passes(ctx: ProcessorContext, chunk_rows: int, seed: int,
             f"streaming norm wrote {wn.cursors}/{wc.cursors} rows but "
             f"counted [{n_train}, {n_rows}] — pass-1/pass-2 drift")
 
-    for path, names, vocab_sizes in (
-            (norm_dir, (probe_norm.dense_names, probe_norm.index_names,
-                        probe_norm.index_vocab_sizes), None),
-            (clean_dir, (probe.num_names, probe.cat_names,
-                         [int(v) + 1 for v in vlen]), None)):
-        dn, ixn, ivs = names
-        from shifu_tpu.resilience import atomic_write
-        with atomic_write(os.path.join(path, "meta.json")) as f:
-            json.dump({"denseNames": list(dn), "indexNames": list(ixn),
-                       "indexVocabSizes": list(ivs),
-                       "precisionType": ptype, "streaming": True,
-                       "streamingNorm": True,
-                       # the split is EXACT: trailing n_val rows are a
-                       # uniform-random sample (splitmix64 row hash)
-                       "validSplit": {"nTrain": n_train, "nVal": n_val,
-                                      "seed": seed}}, f, indent=1)
+    def _commit_meta():
+        for path, names, vocab_sizes in (
+                (norm_dir, (probe_norm.dense_names, probe_norm.index_names,
+                            probe_norm.index_vocab_sizes), None),
+                (clean_dir, (probe.num_names, probe.cat_names,
+                             [int(v) + 1 for v in vlen]), None)):
+            dn, ixn, ivs = names
+            from shifu_tpu.resilience import atomic_write
+            with atomic_write(os.path.join(path, "meta.json")) as f:
+                json.dump({"denseNames": list(dn), "indexNames": list(ixn),
+                           "indexVocabSizes": list(ivs),
+                           "precisionType": ptype, "streaming": True,
+                           "streamingNorm": True,
+                           # the split is EXACT: trailing n_val rows are a
+                           # uniform-random sample (splitmix64 row hash)
+                           "validSplit": {"nTrain": n_train, "nVal": n_val,
+                                          "seed": seed}}, f, indent=1)
+
+    if sharded:
+        with dist.single_writer("norm_streaming.meta") as w:
+            if w:
+                _commit_meta()
+    else:
+        _commit_meta()
     log.info("streaming norm: %d rows (%d train + %d val regions) → "
              "dense %s in 2 chunked passes, %.2fs", n_rows, n_train,
              n_val, (n_rows, f_dense), time.time() - t0)

@@ -11,6 +11,14 @@ Per-cohort bin counts are pure sums, so a >RAM dataset streams
 chunk-by-chunk and merges exactly — the same semantics as the
 reference's full-data Pig group-by (`PSICalculatorUDF.java`), with no
 sampling.
+
+Pod-scale (`dist.data_shard()` active): the chunked path counts only
+this host's part files and the integer per-cohort bincounts all-gather
+and sum after the loop — integer sums are order-free, so the merged
+counts (and every derived PSI float) are bitwise identical to a
+single-host run. The resident path shards the PARSE instead
+(`read_raw_table(sharded=True)` reassembles the identical full frame
+on every host).
 """
 
 from __future__ import annotations
@@ -46,10 +54,11 @@ def run(ctx: ProcessorContext) -> int:
         log.info("psi: dataset exceeds the resident threshold — exact "
                  "streaming accumulation in %d-row chunks", chunk_rows)
         from shifu_tpu.data.pipeline import prefetch
-        from shifu_tpu.data.reader import iter_raw_table
-        frames = prefetch(iter_raw_table(mc, chunk_rows=chunk_rows))
+        from shifu_tpu.data.reader import iter_raw_table_keyed
+        frames = prefetch(df for _key, _pos, df in iter_raw_table_keyed(
+            mc, chunk_rows=chunk_rows, local_only=True))
     else:
-        frames = [read_raw_table(mc)]
+        frames = [read_raw_table(mc, sharded=True)]
 
     from shifu_tpu.data.dataset import build_columnar, parse_tags
     from shifu_tpu.ops.normalize import build_numeric_table
@@ -113,6 +122,29 @@ def run(ctx: ProcessorContext) -> int:
                               for j in range(bin_idx.shape[1])])
                 slot[which] = c if slot[which] is None else slot[which] + c
 
+    from shifu_tpu.parallel import dist
+    if chunk_rows and dist.data_shard() is not None:
+        # each host counted only its own files' chunks — merge the
+        # integer per-cohort bincounts and the bin-layout metadata
+        # (a host may own zero part files and still hold None)
+        parts = dist.allgather_obj(
+            "psi.counts", (counts, num_slots, num_column_nums,
+                           cat_slots, cat_column_nums))
+        counts = {}
+        for pc, pns, pnum, pcs, pcat in parts:
+            num_slots = num_slots or pns
+            cat_slots = cat_slots or pcs
+            if num_column_nums is None:
+                num_column_nums = pnum
+            if cat_column_nums is None:
+                cat_column_nums = pcat
+            for u, slot in pc.items():
+                dst = counts.setdefault(u, [None, None])
+                for which in (0, 1):
+                    if slot[which] is not None:
+                        dst[which] = slot[which] if dst[which] is None \
+                            else dst[which] + slot[which]
+
     uniq = sorted(counts.keys())
     cc_by_num = {c.columnNum: c for c in ctx.column_configs}
     rows: List[str] = []
@@ -145,14 +177,13 @@ def run(ctx: ProcessorContext) -> int:
 
     out = ctx.path_finder.psi_path()
     ctx.path_finder.ensure(out)
-    from shifu_tpu.parallel import dist
     with dist.single_writer("psi") as w:
         if w:   # identical rows on every host; one pen
             from shifu_tpu.resilience import atomic_write
             with atomic_write(out) as f:
                 f.write("column,psi," + ",".join(uniq) + "\n")
                 f.write("\n".join(rows) + "\n")
-    ctx.save_column_configs()
+    ctx.save_column_configs(tag="psi.columns")
     log.info("psi: %d cohorts × %d columns → %s in %.2fs", len(uniq),
              len(rows), out, time.time() - t0)
     return 0

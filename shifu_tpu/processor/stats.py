@@ -18,6 +18,7 @@ weighted variants.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Optional
 
@@ -37,16 +38,43 @@ log = logging.getLogger("shifu_tpu")
 
 
 def run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
-        seed: int = 12306) -> int:
+        seed: int = 12306, base_only: bool = False) -> int:
     with step_guard(ctx, "stats", outputs=[
             ctx.path_finder.column_config_path()]) as go:
         if not go:
             return 0
-        return _run(ctx, dataset, seed)
+        return _run(ctx, dataset, seed, base_only=base_only)
+
+
+def _resident_frame(ctx: ProcessorContext, seed: int) -> "object":
+    """The filtered + sampled resident frame every stats variant (base,
+    inline segments, per-segment DAG siblings) computes over — one code
+    path, so their row sets are identical by construction. The raw
+    read is pod-sharded (row ranges split across hosts, reassembled
+    identically everywhere) when `dist.data_shard()` is active."""
+    mc = ctx.model_config
+    ccs = ctx.column_configs
+    df = read_raw_table(mc, numeric_columns=[
+        c.columnName for c in ccs
+        if c.is_candidate and not c.is_categorical and not c.is_segment],
+        sharded=True)
+    keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
+    if mc.stats.sampleRate < 1.0:
+        # stateless per-raw-row flags (data/sampling): the resident
+        # read starts at row 0, so the sampled set is IDENTICAL to
+        # the streaming stats path's for the same data
+        from shifu_tpu.data.sampling import (positive_tag_mask,
+                                             sample_flags)
+        keep_pos = positive_tag_mask(mc, df) \
+            if mc.stats.sampleNegOnly else None
+        keep &= sample_flags(mc.stats.sampleRate, seed, 0, len(df),
+                             purpose="stats-sample",
+                             keep_pos=keep_pos)
+    return df[keep].reset_index(drop=True)
 
 
 def _run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
-         seed: int = 12306) -> int:
+         seed: int = 12306, base_only: bool = False) -> int:
     t0 = time.time()
     mc = ctx.model_config
     ctx.validate(ModelStep.STATS)
@@ -72,22 +100,7 @@ def _run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
                 chunk = 0
         if chunk:
             return stats_streaming.run_streaming(ctx, chunk, seed=seed)
-        df = read_raw_table(mc, numeric_columns=[
-            c.columnName for c in ccs
-            if c.is_candidate and not c.is_categorical and not c.is_segment])
-        keep = DataPurifier(mc.dataSet.filterExpressions).apply(df)
-        if mc.stats.sampleRate < 1.0:
-            # stateless per-raw-row flags (data/sampling): the resident
-            # read starts at row 0, so the sampled set is IDENTICAL to
-            # the streaming stats path's for the same data
-            from shifu_tpu.data.sampling import (positive_tag_mask,
-                                                 sample_flags)
-            keep_pos = positive_tag_mask(mc, df) \
-                if mc.stats.sampleNegOnly else None
-            keep &= sample_flags(mc.stats.sampleRate, seed, 0, len(df),
-                                 purpose="stats-sample",
-                                 keep_pos=keep_pos)
-        df = df[keep].reset_index(drop=True)
+        df = _resident_frame(ctx, seed)
         dataset = build_columnar(mc, [c for c in ccs if not c.is_segment],
                                  df)
 
@@ -104,6 +117,13 @@ def _run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
         # expressions removed since the last run: drop orphaned copies
         ccs = [c for c in ccs if not c.is_segment]
         ctx.column_configs = ccs
+    if base_only and exprs:
+        # DAG mode: the per-segment siblings (`stats -seg K`) own the
+        # segment blocks; this run commits base columns only, and the
+        # merge node re-attaches the blocks from their partial files
+        ccs = [c for c in ccs if not c.is_segment]
+        ctx.column_configs = ccs
+        exprs = []
     if exprs and df is not None:
         # rebuild seg configs from scratch each run — the expression
         # list may have changed, and stats refills them anyway
@@ -121,7 +141,9 @@ def _run(ctx: ProcessorContext, dataset: Optional[ColumnarDataset] = None,
             compute_stats(ctx, dset_k, cc_map=cc_map)
             log.info("segment %d (%s): %d/%d rows", k, expr,
                      int(mask.sum()), len(df))
-    ctx.save_column_configs()
+    # sharded runs reach here with identical merged configs on every
+    # host; single_writer("stats") guards only this final artifact write
+    ctx.save_column_configs(tag="stats")
 
     # per-date per-column stats job analog, config-driven like the
     # reference (runs when dataSet#dateColumnName is set,
@@ -341,4 +363,88 @@ def run_rebin(ctx: ProcessorContext, request_vars: Optional[str] = None,
             n_done += 1
     ctx.save_column_configs()
     log.info("rebin: %d column(s) re-binned", n_done)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# per-segment stats as DAG siblings (`stats -seg K` / `stats -seg-merge`)
+# ---------------------------------------------------------------------------
+
+def _seg_partial_path(ctx: ProcessorContext, k: int) -> str:
+    return os.path.join(ctx.path_finder.root, "tmp", "stats_seg",
+                        f"seg_{k}.json")
+
+
+def run_segment(ctx: ProcessorContext, k: int, seed: int = 12306) -> int:
+    """`shifu stats -seg K` — compute stats for segment copy block K
+    only and write them to a partial file under tmp/stats_seg/. Each
+    segment is an independent DAG sibling of the base `stats -base-only`
+    node; `stats -seg-merge` folds the partials back into
+    ColumnConfig.json, bitwise identical to the inline expansion."""
+    from shifu_tpu.config.column_config import save_column_configs \
+        as save_ccs
+    from shifu_tpu.data import segment
+    from shifu_tpu.parallel import dist
+    t0 = time.time()
+    mc = ctx.model_config
+    ctx.validate(ModelStep.STATS)
+    ctx.require_columns()
+    exprs = segment.segment_expressions(mc)
+    if not 1 <= k <= len(exprs):
+        raise ValueError(
+            f"stats -seg {k}: segment index out of range (the "
+            f"segExpressionFile defines {len(exprs)} expression(s))")
+    out = _seg_partial_path(ctx, k)
+    with step_guard(ctx, f"stats.seg.{k}", outputs=[out]) as go:
+        if not go:
+            return 0
+        df = _resident_frame(ctx, seed)
+        base = [c for c in ctx.column_configs if not c.is_segment]
+        n_base = len(base)
+        seg_ccs = segment.expand_column_configs(base, exprs)
+        block = [c for c in seg_ccs
+                 if k * n_base <= c.columnNum < (k + 1) * n_base]
+        expr = exprs[k - 1]
+        mask = DataPurifier(expr).apply(df)
+        sub = df[mask].reset_index(drop=True)
+        dset_k = build_columnar(mc, base, sub)
+        by_num = {c.columnNum: c for c in block}
+        cc_map = {c.columnNum: by_num[k * n_base + c.columnNum]
+                  for c in base}
+        compute_stats(ctx, dset_k, cc_map=cc_map)
+        with dist.single_writer(f"stats.seg.{k}") as w:
+            if w:
+                os.makedirs(os.path.dirname(out), exist_ok=True)
+                save_ccs(block, out)
+        log.info("stats -seg %d (%s): %d/%d rows in %.2fs", k, expr,
+                 int(mask.sum()), len(df), time.time() - t0)
+    return 0
+
+
+def run_segment_merge(ctx: ProcessorContext) -> int:
+    """`shifu stats -seg-merge` — re-attach every segment block's
+    partial ColumnConfigs (written by the `stats -seg K` siblings) to
+    the base configs and commit ColumnConfig.json."""
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.data import segment
+    mc = ctx.model_config
+    ctx.require_columns()
+    exprs = segment.segment_expressions(mc)
+    with step_guard(ctx, "stats.segmerge", outputs=[
+            ctx.path_finder.column_config_path()]) as go:
+        if not go:
+            return 0
+        merged = [c for c in ctx.column_configs if not c.is_segment]
+        for k in range(1, len(exprs) + 1):
+            p = _seg_partial_path(ctx, k)
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"segment partial {p} missing — run "
+                    f"`shifu stats -seg {k}` first")
+            merged.extend(load_column_configs(p))
+        ctx.column_configs = merged
+        ctx.save_column_configs(tag="stats.segmerge")
+        log.info("stats -seg-merge: %d base + %d segment configs",
+                 len([c for c in merged if not c.is_segment]),
+                 len([c for c in merged if c.is_segment]))
     return 0

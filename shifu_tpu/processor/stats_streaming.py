@@ -26,6 +26,16 @@ Activated by SHIFU_TPU_STATS_CHUNK_ROWS / -Dshifu.stats.chunkRows or
 automatically when the raw files exceed SHIFU_TPU_STATS_STREAM_BYTES
 (default 2 GB). Segment expansion and date-stats require the resident
 path (they re-filter the frame per expression) and raise/skip clearly.
+
+Pod-scale sharding (`dist.data_shard()` active): each host runs both
+passes over only ITS part files' chunks (`iter_raw_table_keyed`), but
+keeps every chunk's float64 CONTRIBUTION (the per-chunk `+=`
+right-hand sides) keyed by the chunk's global ``(file, chunk)``
+identity. The contributions all-gather through the watched collective
+and every host replays them in ascending key order from zeros — the
+exact addition sequence of the sequential pass, so the merged
+accumulators (and ColumnConfig.json) are bitwise identical to a
+single-host run while each host parses ~1/P of the data.
 """
 
 from __future__ import annotations
@@ -43,7 +53,8 @@ from shifu_tpu.config.model_config import BinningMethod
 from shifu_tpu.data.dataset import build_columnar
 from shifu_tpu.data.pipeline import prefetch
 from shifu_tpu.data.purifier import DataPurifier
-from shifu_tpu.data.reader import expand_data_files, iter_raw_table
+from shifu_tpu.data.reader import (expand_data_files, iter_raw_table,  # noqa: F401 — iter_raw_table re-exported for tests
+                                   iter_raw_table_keyed)
 from shifu_tpu.ops import stats as stats_ops
 from shifu_tpu.processor.base import ProcessorContext
 
@@ -80,17 +91,19 @@ def _sample_mask(rng_seed: int, start: int, n: int, rate: float,
 
 
 def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
-                    seed: int):
-    """Yield per-chunk ColumnarDatasets with filter + sampling applied
-    (build_columnar drops invalid-tag rows itself)."""
+                    seed: int, local_only: bool = False):
+    """Yield (key, ColumnarDataset) per chunk with filter + sampling
+    applied (build_columnar drops invalid-tag rows itself). `key` is
+    the chunk's global ``(file_idx, chunk_idx)`` identity; with
+    ``local_only`` and an active data shard only this host's files'
+    chunks appear (offsets still global, so sampling flags match the
+    sequential pass exactly)."""
     mc = ctx.model_config
     purifier = DataPurifier(mc.dataSet.filterExpressions) \
         if mc.dataSet.filterExpressions else None
-    global_row = 0
     from shifu_tpu.data.dataset import valid_tag_mask
-    for df in prefetch(iter_raw_table(mc, chunk_rows=chunk_rows)):
-        start = global_row
-        global_row += len(df)
+    for key, start, df in prefetch(iter_raw_table_keyed(
+            mc, chunk_rows=chunk_rows, local_only=local_only)):
         # sample on the RAW global row index BEFORE filtering, so the
         # sampled set is identical for any chunking even with
         # filterExpressions configured
@@ -114,7 +127,124 @@ def _chunk_datasets(ctx: ProcessorContext, ccs, chunk_rows: int,
         dset = build_columnar(mc, [c for c in ccs if not c.is_segment],
                               df)
         if dset.num_rows:
-            yield dset
+            yield key, dset
+
+
+def _contrib_a(dset) -> Dict[str, object]:
+    """One chunk's Pass-A accumulator increments — exactly the
+    right-hand sides of the sequential pass's `+=` statements, so
+    replaying them in ascending chunk order from zeros reproduces the
+    sequential float64 results bit for bit."""
+    v = dset.numeric.astype(np.float64)
+    ok = ~np.isnan(v)
+    pos_rows = (dset.tags > 0.5)[:, None]
+    wcol = dset.weights.astype(np.float64)[:, None]
+    vz = np.where(ok, v, 0.0)
+    c: Dict[str, object] = {
+        "n_rows": dset.num_rows,
+        "n": ok.sum(axis=0),
+        "miss": (~ok).sum(axis=0),
+        "miss_pos_n": (~ok & pos_rows).sum(axis=0),
+        "miss_neg_n": (~ok & ~pos_rows).sum(axis=0),
+        "miss_pos_w": np.where(~ok & pos_rows, wcol, 0.0).sum(axis=0),
+        "miss_neg_w": np.where(~ok & ~pos_rows, wcol, 0.0).sum(axis=0),
+        "s1": vz.sum(axis=0),
+        "s2": (vz ** 2).sum(axis=0),
+        "s3": (vz ** 3).sum(axis=0),
+        "s4": (vz ** 4).sum(axis=0),
+    }
+    with np.errstate(all="ignore"):
+        c["min"] = np.nanmin(np.where(ok, v, np.inf), axis=0)
+        c["max"] = np.nanmax(np.where(ok, v, -np.inf), axis=0)
+    pos = dset.tags > 0.5
+    w = dset.weights.astype(np.float64)
+    cat_miss = np.zeros((len(dset.cat_names), 4))
+    cat_rows: List[Dict[str, np.ndarray]] = []
+    for j in range(len(dset.cat_names)):
+        codes = dset.cat_codes[:, j]
+        vocab = dset.vocabs[j]
+        miss = codes < 0
+        cat_miss[j] = (float((pos & miss).sum()),
+                       float((~pos & miss).sum()),
+                       float(w[pos & miss].sum()),
+                       float(w[~pos & miss].sum()))
+        d: Dict[str, np.ndarray] = {}
+        for arr, k in ((pos & ~miss, 0), (~pos & ~miss, 1)):
+            if not arr.any():
+                continue
+            cnt = np.bincount(codes[arr], minlength=len(vocab))
+            wcnt = np.bincount(codes[arr], weights=w[arr],
+                               minlength=len(vocab))
+            for ci in np.nonzero(cnt)[0]:
+                row = d.get(vocab[ci])
+                if row is None:
+                    row = d[vocab[ci]] = np.zeros(4)
+                row[k] += cnt[ci]
+                row[2 + k] += wcnt[ci]
+        cat_rows.append(d)
+    c["cat_missing"] = cat_miss
+    c["cat"] = cat_rows
+    return c
+
+
+def _fold_a(state, meta, c):
+    """Apply one chunk contribution to the running Pass-A state,
+    lazily initializing zeros from `meta` (the column layout). Within
+    a chunk each accumulator element receives at most one addend, so
+    element-wise `+=` of the contribution replays the sequential
+    addition sequence exactly."""
+    num_names, _num_nums, cat_names, _cat_nums = meta
+    if state is None:
+        cn = len(num_names)
+        A = {k: np.zeros(cn, np.float64) for k in
+             ("n", "miss", "s1", "s2", "s3", "s4",
+              "miss_pos_n", "miss_neg_n", "miss_pos_w", "miss_neg_w")}
+        A["min"] = np.full(cn, np.inf)
+        A["max"] = np.full(cn, -np.inf)
+        state = (A, [dict() for _ in cat_names],
+                 np.zeros((len(cat_names), 4), np.float64))
+    A, cat_counts, cat_missing = state
+    for k in ("n", "miss", "s1", "s2", "s3", "s4",
+              "miss_pos_n", "miss_neg_n", "miss_pos_w", "miss_neg_w"):
+        A[k] += c[k]
+    A["min"] = np.minimum(A["min"], c["min"])
+    A["max"] = np.maximum(A["max"], c["max"])
+    cat_missing += c["cat_missing"]
+    for j, d in enumerate(c["cat"]):
+        tgt = cat_counts[j]
+        for val, row in d.items():
+            acc = tgt.get(val)
+            if acc is None:
+                acc = tgt[val] = np.zeros(4)
+            acc += row
+    return state
+
+
+def _contrib_b(dset, A, span, cn: int) -> np.ndarray:
+    """One chunk's (4, C, K) fine-histogram increment (Pass B)."""
+    v = dset.numeric.astype(np.float64)
+    ok = ~np.isnan(v)
+    # all-missing columns leave A["min"] at +inf — substitute a
+    # finite base so inf-inf can't NaN into the int cast (those
+    # rows are masked out of the bincount anyway)
+    fmin = np.where(np.isfinite(A["min"]), A["min"], 0.0)
+    vq = np.where(ok, v, fmin[None, :])
+    idx = np.clip(((vq - fmin[None, :]) / span[None, :]
+                   * FINE_BINS).astype(np.int64), 0, FINE_BINS - 1)
+    pos = dset.tags > 0.5
+    w = dset.weights.astype(np.float64)
+    flat = (idx + np.arange(cn)[None, :] * FINE_BINS)
+    out = np.zeros((4, cn, FINE_BINS), np.float64)
+    for k, (rows, wv) in enumerate((
+            (pos, None), (~pos, None), (pos, w), (~pos, w))):
+        sel = ok & rows[:, None]
+        f = flat[sel]
+        wts = None if wv is None else \
+            np.broadcast_to(wv[:, None], sel.shape)[sel]
+        out[k] = np.bincount(f, weights=wts,
+                             minlength=cn * FINE_BINS) \
+            .reshape(cn, FINE_BINS)
+    return out
 
 
 def run_streaming(ctx: ProcessorContext, chunk_rows: int,
@@ -131,111 +261,68 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
             "shifu.stats.chunkRows / SHIFU_TPU_STATS_CHUNK_ROWS or raise "
             "SHIFU_TPU_STATS_STREAM_BYTES for this model set")
 
+    from shifu_tpu.parallel import dist
+    shard = dist.data_shard()
+
     # ---- Pass A: moments + categorical value counts -------------------
-    num_names: List[str] = []
-    num_nums: Optional[np.ndarray] = None
-    cat_names: List[str] = []
-    cat_nums: Optional[np.ndarray] = None
-    A: Dict[str, np.ndarray] = {}
-    cat_counts: List[Dict[str, np.ndarray]] = []
-    cat_missing: Optional[np.ndarray] = None   # (Cc, 4)
+    # Each chunk's accumulator updates are computed as a CONTRIBUTION
+    # (`_contrib_a`) and folded by `_fold_a` — unsharded, immediately
+    # (today's addition sequence verbatim); sharded, the per-chunk
+    # contributions all-gather and replay in ascending global chunk
+    # order from zeros, reproducing the same sequence bit for bit.
+    meta = None
+    state = None        # (A, cat_counts, cat_missing)
     n_rows = 0
+    pending: List[tuple] = []
+    for key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
+                                     local_only=True):
+        if meta is None:
+            meta = (dset.num_names, dset.num_column_nums,
+                    dset.cat_names, dset.cat_column_nums)
+        c = _contrib_a(dset)
+        if shard is None:
+            state = _fold_a(state, meta, c)
+            n_rows += c["n_rows"]
+        else:
+            pending.append((key, c))
+    if shard is not None:
+        parts = dist.allgather_obj("stats.passA", (meta, pending))
+        meta = next((m for m, _ in parts if m is not None), None)
+        for key, c in sorted((kc for _, cs in parts for kc in cs),
+                             key=lambda kc: kc[0]):
+            state = _fold_a(state, meta, c)
+            n_rows += c["n_rows"]
 
-    for dset in _chunk_datasets(ctx, ccs, chunk_rows, seed):
-        if num_nums is None:
-            num_names, num_nums = dset.num_names, dset.num_column_nums
-            cat_names, cat_nums = dset.cat_names, dset.cat_column_nums
-            cn = len(num_names)
-            A = {k: np.zeros(cn, np.float64) for k in
-                 ("n", "miss", "s1", "s2", "s3", "s4",
-                  "miss_pos_n", "miss_neg_n", "miss_pos_w",
-                  "miss_neg_w")}
-            A["min"] = np.full(cn, np.inf)
-            A["max"] = np.full(cn, -np.inf)
-            cat_counts = [dict() for _ in cat_names]
-            cat_missing = np.zeros((len(cat_names), 4), np.float64)
-        n_rows += dset.num_rows
-        v = dset.numeric.astype(np.float64)
-        ok = ~np.isnan(v)
-        A["n"] += ok.sum(axis=0)
-        A["miss"] += (~ok).sum(axis=0)
-        pos_rows = (dset.tags > 0.5)[:, None]
-        wcol = dset.weights.astype(np.float64)[:, None]
-        A["miss_pos_n"] += (~ok & pos_rows).sum(axis=0)
-        A["miss_neg_n"] += (~ok & ~pos_rows).sum(axis=0)
-        A["miss_pos_w"] += np.where(~ok & pos_rows, wcol, 0.0).sum(axis=0)
-        A["miss_neg_w"] += np.where(~ok & ~pos_rows, wcol, 0.0).sum(axis=0)
-        vz = np.where(ok, v, 0.0)
-        A["s1"] += vz.sum(axis=0)
-        A["s2"] += (vz ** 2).sum(axis=0)
-        A["s3"] += (vz ** 3).sum(axis=0)
-        A["s4"] += (vz ** 4).sum(axis=0)
-        with np.errstate(all="ignore"):
-            A["min"] = np.minimum(A["min"], np.nanmin(
-                np.where(ok, v, np.inf), axis=0))
-            A["max"] = np.maximum(A["max"], np.nanmax(
-                np.where(ok, v, -np.inf), axis=0))
-        pos = dset.tags > 0.5
-        w = dset.weights.astype(np.float64)
-        for j in range(len(cat_names)):
-            codes = dset.cat_codes[:, j]
-            vocab = dset.vocabs[j]
-            miss = codes < 0
-            cat_missing[j] += (float((pos & miss).sum()),
-                               float((~pos & miss).sum()),
-                               float(w[pos & miss].sum()),
-                               float(w[~pos & miss].sum()))
-            d = cat_counts[j]
-            for arr, k in ((pos & ~miss, 0), (~pos & ~miss, 1)):
-                if not arr.any():
-                    continue
-                cnt = np.bincount(codes[arr], minlength=len(vocab))
-                wcnt = np.bincount(codes[arr], weights=w[arr],
-                                   minlength=len(vocab))
-                for ci in np.nonzero(cnt)[0]:
-                    row = d.get(vocab[ci])
-                    if row is None:
-                        row = d[vocab[ci]] = np.zeros(4)
-                    row[k] += cnt[ci]
-                    row[2 + k] += wcnt[ci]
-
-    if n_rows == 0:
+    if n_rows == 0 or meta is None:
         raise ValueError(
             f"no row's {mc.dataSet.targetColumnName!r} value matches "
             f"posTags {mc.pos_tags} / negTags {mc.neg_tags} in any chunk")
+    num_names, num_nums, cat_names, cat_nums = meta
+    A, cat_counts, cat_missing = state
 
     cn = len(num_names)
     span = np.where(A["max"] > A["min"], A["max"] - A["min"], 1.0)
 
     # ---- Pass B: fine histograms for numeric columns ------------------
     fine = np.zeros((4, cn, FINE_BINS), np.float64)  # pos_n/neg_n/pos_w/neg_w
-    for dset in _chunk_datasets(ctx, ccs, chunk_rows, seed):
-        v = dset.numeric.astype(np.float64)
-        ok = ~np.isnan(v)
-        # all-missing columns leave A["min"] at +inf — substitute a
-        # finite base so inf-inf can't NaN into the int cast (those
-        # rows are masked out of the bincount anyway)
-        fmin = np.where(np.isfinite(A["min"]), A["min"], 0.0)
-        vq = np.where(ok, v, fmin[None, :])
-        idx = np.clip(((vq - fmin[None, :]) / span[None, :]
-                       * FINE_BINS).astype(np.int64), 0, FINE_BINS - 1)
-        pos = dset.tags > 0.5
-        w = dset.weights.astype(np.float64)
-        flat = (idx + np.arange(cn)[None, :] * FINE_BINS)
-        for k, (rows, wv) in enumerate((
-                (pos, None), (~pos, None), (pos, w), (~pos, w))):
-            sel = ok & rows[:, None]
-            f = flat[sel]
-            wts = None if wv is None else \
-                np.broadcast_to(wv[:, None], sel.shape)[sel]
-            fine[k] += np.bincount(f, weights=wts,
-                                   minlength=cn * FINE_BINS) \
-                .reshape(cn, FINE_BINS)
+    pending_b: List[tuple] = []
+    for key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
+                                     local_only=True):
+        fc = _contrib_b(dset, A, span, cn)
+        if shard is None:
+            fine += fc
+        else:
+            pending_b.append((key, fc))
+    if shard is not None:
+        parts = dist.allgather_obj("stats.passB", pending_b)
+        for key, fc in sorted((kc for p in parts for kc in p),
+                              key=lambda kc: kc[0]):
+            fine += fc
 
     _fill_from_sketch(ctx, mc, num_names, num_nums, A, fine, n_rows)
     _fill_cats_from_dicts(ctx, mc, cat_names, cat_nums, cat_counts,
                           cat_missing, n_rows)
-    ctx.save_column_configs()
+    ctx.save_column_configs(tag="stats")
     from shifu_tpu.processor import datestat
     if datestat.date_column_name(mc):
         log.warning("streaming stats: per-date stats need the resident "

@@ -408,6 +408,21 @@ METRIC_FIELDS = ("ts", "name", "value", "kind", "tags")
 HEALTH_FIELDS = ("slo", "metric", "state", "value", "warn", "breach",
                  "window_s")
 
+# the pod-scale data plane bench's record schema: bench.py
+# task_dist_stats builds its JSON line from exactly these keys —
+# subprocess-host count, rows processed, N-host and 1-host stats
+# throughput (in-step wall), scaling efficiency c_1 / (N · c_N) over
+# PER-HOST CPU SECONDS of the step (1.0 = perfect work split; CPU
+# basis because the bench rig's simulated hosts timeshare one
+# machine's cores, where wall clock cannot show the split — on a real
+# pod the two bases coincide), seconds spent in the watched merge
+# collectives (dist_merge_s stage timer), and whether the sharded
+# ColumnConfig.json hashed identical to the single-host run. Pinned
+# in README by tools/check_steps_schema.py.
+SHARD_FIELDS = ("hosts", "rows", "rows_per_s", "rows_per_s_1host",
+                "scaling_efficiency", "merge_collective_s",
+                "bitwise_identical")
+
 
 def mlp_row_costs(input_dim: int, hidden_dims, n_out: int = 1,
                   train: bool = True, dtype_bytes: int = 4):

@@ -147,6 +147,7 @@ FAULT_SITES = (
     "atomic.commit", "pipeline.fetch", "serve.request",
     "serve.route", "registry.publish",
     "dist.init", "dist.barrier", "dist.allgather",
+    "dist.allreduce_tree",
     "dist.preempt_marker", "dag.node", "obs.export",
     "obs.metrics_flush", "obs.alert", "watch.window",
 )

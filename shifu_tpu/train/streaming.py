@@ -499,17 +499,19 @@ def train_streaming_core(train_conf: ModelTrainConf,
             # then re-place through the same reshard path as
             # single-process (the mesh may be a different shape than
             # the one that wrote the checkpoint — elastic restarts).
-            from jax.experimental import multihost_utils
+            # Both broadcasts go through the watched collective so a
+            # host lost mid-restore surfaces as DistTimeout, not a hang.
             restored = ckpt_mod.restore_latest(
                 checkpoint_dir, _like,
                 max_step=train_conf.numTrainEpochs) if proc == 0 else None
-            step = int(multihost_utils.broadcast_one_to_all(
+            step = int(dist.broadcast_tree(
+                "ckpt.restore_step",
                 np.int64(restored[0] if restored else -1)))
             st = None
             if step > 0:
                 st = restored[1] if proc == 0 \
                     else jax.tree.map(np.asarray, _like(step))
-                st = multihost_utils.broadcast_one_to_all(st)
+                st = dist.broadcast_tree("ckpt.restore_state", st)
                 st = ckpt_mod.place_resharded(
                     st, ckpt_mod.load_sharding_meta(checkpoint_dir, step),
                     mesh=mesh, like=_like(step))

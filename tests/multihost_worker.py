@@ -35,6 +35,16 @@ collectives = the test-rig stand-in for DCN), then one of two modes:
   drill's 2×2), it clears the stale marker the way step_guard does and
   `restore_resharded`s the drill's checkpoint onto the 1-device mesh,
   verifying the values bitwise.
+- ``--mode stats``: the pod-scale data-plane drill. --out is a
+  ModelSet root (already ``shifu init``-ed); every process runs
+  ``shifu stats`` over it. With SHIFU_TPU_DATA_SHARD=auto each host
+  reads only its shard and the partials merge through the watched
+  collectives; ColumnConfig.json must come out bitwise identical to a
+  1-process run.
+- ``--mode stats-kill``: same, but process 1 arms
+  SHIFU_TPU_FAULT=dist.allreduce_tree:kill:1 and SIGKILLs itself at
+  the first watched merge. The survivor must exit rc 17 (DistTimeout)
+  or rc 18 (fast collective failure) instead of hanging.
 
 Usage: python multihost_worker.py --port P --nproc N --pid I --out F
 """
@@ -51,7 +61,8 @@ ap.add_argument("--out", required=True)
 ap.add_argument("--local-devices", type=int, default=2)
 ap.add_argument("--mode",
                 choices=("train", "barrier-kill", "barrier-stall",
-                         "preempt-drill", "preempt-resume"),
+                         "preempt-drill", "preempt-resume",
+                         "stats", "stats-kill"),
                 default="train")
 args = ap.parse_args()
 
@@ -157,6 +168,36 @@ if args.mode in ("preempt-drill", "preempt-resume"):
     print("drill loop exhausted without preemption", file=sys.stderr,
           flush=True)
     os._exit(20)
+
+if args.mode in ("stats", "stats-kill"):
+    from shifu_tpu.cli import main as cli_main  # noqa: E402
+    from shifu_tpu.parallel import dist  # noqa: E402
+
+    if args.mode == "stats-kill" and args.pid == 1:
+        # die at the FIRST watched merge collective of the run — the
+        # mid-merge SIGKILL drill; the survivor must exit through the
+        # watchdog/poison machinery, never hang
+        os.environ["SHIFU_TPU_FAULT"] = "dist.allreduce_tree:kill:1"
+    import time
+    t0 = time.process_time()
+    try:
+        rc = cli_main(["--dir", args.out, "stats"])
+        # this process's CPU seconds for the step — bench.py's
+        # dist_stats scaling-efficiency basis (robust to a test rig
+        # with fewer cores than simulated hosts, where wall clock
+        # cannot show the work split)
+        print(f"STATS_CPU_S {time.process_time() - t0:.3f}", flush=True)
+    except dist.DistTimeout as e:
+        print(f"DIST_TIMEOUT: {e}", file=sys.stderr, flush=True)
+        os._exit(17)
+    except BaseException as e:  # noqa: BLE001 — any fast failure
+        print(f"DIST_FAIL {type(e).__name__}: {e}", file=sys.stderr,
+              flush=True)
+        os._exit(18)
+    print(f"STATS_DONE rc={rc}", file=sys.stderr, flush=True)
+    # os._exit: the distributed runtime's atexit teardown could block
+    # if a peer already exited
+    os._exit(int(rc or 0))
 
 import numpy as np  # noqa: E402
 

@@ -60,7 +60,8 @@ def _no_tmp_residue(root):
 CHAOS_SITES = ["fs.exists", "fs.open", "reader.read",
                "atomic.commit", "step.init", "dist.init",
                "ckpt.stage", "ckpt.publish",
-               "ckpt.reshard", "dist.preempt_marker", "obs.export",
+               "ckpt.reshard", "dist.preempt_marker",
+               "dist.allreduce_tree", "obs.export",
                "obs.metrics_flush"]
 
 

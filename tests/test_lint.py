@@ -557,6 +557,84 @@ def test_span_registry_all_emitted_in_package():
 
 
 # ---------------------------------------------------------------------------
+# unwatched-collective
+# ---------------------------------------------------------------------------
+
+def test_unwatched_collective_positive(tmp_path):
+    src = """
+        from jax.experimental import multihost_utils
+        import jax
+
+        def merge(tree):
+            return multihost_utils.process_allgather(tree)
+
+        def assemble(mesh, spec, arrs):
+            return jax.make_array_from_process_local_data(spec, arrs)
+
+        def reduce_host(x):
+            return jax.lax.psum(x, "data")
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unwatched-collective"])
+    assert len(report.findings) == 3, rule_names(report)
+    assert all("watched dist wrapper" in f.message
+               for f in report.findings)
+
+
+def test_unwatched_collective_negative_compiled_and_wrapped(tmp_path):
+    src = """
+        import functools
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        from shifu_tpu.parallel import dist
+
+        @jax.jit
+        def device_sum(x):
+            return jax.lax.psum(x, "data")
+
+        @functools.partial(shard_map, mesh=None,
+                           in_specs=None, out_specs=None)
+        def mapped(x):
+            return jax.lax.pmean(x, "data")
+
+        def merge(tree):
+            return dist.allreduce_tree("fixture.merge", tree)
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unwatched-collective"])
+    assert not report.findings, rule_names(report)
+
+
+def test_unwatched_collective_dist_module_exempt(tmp_path):
+    (tmp_path / "shifu_tpu" / "parallel").mkdir(parents=True)
+    src = """
+        from jax.experimental import multihost_utils
+
+        def _gather(tree):
+            return multihost_utils.process_allgather(tree)
+    """
+    report = lint_source(tmp_path, src,
+                         name="shifu_tpu/parallel/dist.py",
+                         rules=["unwatched-collective"])
+    assert not report.findings, rule_names(report)
+
+
+def test_unwatched_collective_suppressed(tmp_path):
+    src = """
+        from jax.experimental import multihost_utils
+
+        def merge(tree):
+            return multihost_utils.process_allgather(tree)  # lint: disable=unwatched-collective -- fixture
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["unwatched-collective"])
+    assert not report.findings
+    assert any(f.rule == "unwatched-collective"
+               for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
 # blocking-under-lock
 # ---------------------------------------------------------------------------
 

@@ -30,7 +30,8 @@ def _free_port() -> int:
     return port
 
 
-def _run(nproc: int, out: str, local_devices: int, timeout=420):
+def _run(nproc: int, out: str, local_devices: int, timeout=420,
+         mode=None, env_extra=None):
     """Launch `nproc` worker processes and wait; return proc-0 output."""
     port = _free_port()
     env = dict(os.environ)
@@ -39,11 +40,14 @@ def _run(nproc: int, out: str, local_devices: int, timeout=420):
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if env_extra:
+        env.update(env_extra)
+    cmd_tail = ["--mode", mode] if mode else []
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, "--port", str(port),
              "--nproc", str(nproc), "--pid", str(i), "--out", out,
-             "--local-devices", str(local_devices)],
+             "--local-devices", str(local_devices)] + cmd_tail,
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True)
         for i in range(nproc)
@@ -278,3 +282,116 @@ def test_writer_guard_never_initializes_backend(monkeypatch):
     dist.writer_barrier("t")   # no-op, no backend touch
     with dist.single_writer("t2") as w:
         assert w is True
+
+
+def _stats_workspace(tmp_path):
+    """An init-ed synthetic model set whose raw table spans several
+    part files, so a 2-host shard genuinely splits the read."""
+    from tests.synth import make_model_set
+    from shifu_tpu.cli import main as cli_main
+
+    rng = np.random.default_rng(20260807)
+    root = make_model_set(tmp_path, rng, n_rows=2000)
+    data_dir = os.path.join(root, "data")
+    src = os.path.join(data_dir, "part-00000")
+    with open(src) as f:
+        lines = f.readlines()
+    os.remove(src)
+    n_parts = 4
+    per = (len(lines) + n_parts - 1) // n_parts
+    for i in range(n_parts):
+        with open(os.path.join(data_dir, f"part-{i:05d}"), "w") as f:
+            f.writelines(lines[i * per:(i + 1) * per])
+    assert cli_main(["--dir", root, "init"]) == 0
+    return root
+
+
+# both sides must run the SAME parser (the native reader bypasses
+# itself in sharded mode) and the SAME code path (streaming, small
+# chunks → several per-chunk contributions per host, so the f64
+# replay merge is actually exercised, not a single-chunk triviality)
+_STATS_ENV = {"SHIFU_TPU_NATIVE_READER": "0",
+              "SHIFU_TPU_STATS_CHUNK_ROWS": "300"}
+
+
+def test_two_process_sharded_stats_bitwise_identical(tmp_path):
+    """Pod-scale data-plane acceptance: `shifu stats` sharded over 2
+    processes (each host streams only its own part files, partial
+    sufficient statistics merged through the watched collectives) must
+    write a ColumnConfig.json BITWISE identical to the 1-process
+    sequential run — same bytes, not just close floats."""
+    import hashlib
+    import shutil
+
+    base = _stats_workspace(tmp_path / "base")
+    ws1 = str(tmp_path / "ws1" / "ModelSet")
+    ws2 = str(tmp_path / "ws2" / "ModelSet")
+    shutil.copytree(base, ws1)
+    shutil.copytree(base, ws2)
+    _run(1, ws1, local_devices=1, mode="stats", env_extra=_STATS_ENV)
+    _run(2, ws2, local_devices=1, mode="stats", env_extra=_STATS_ENV)
+
+    def sha(root):
+        with open(os.path.join(root, "ColumnConfig.json"), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+
+    assert sha(ws1) == sha(ws2), \
+        "sharded stats diverged from the sequential run"
+
+
+def test_two_process_stats_survivor_escapes_midmerge_kill(tmp_path):
+    """Mid-merge SIGKILL drill: process 1 dies INSIDE the first watched
+    stats merge (fault site dist.allreduce_tree). The survivor must
+    exit via the watchdog (rc 17, DistTimeout) or a fast collective
+    failure (rc 18) — never hang. A clean rerun on the same workspace
+    then succeeds."""
+    import json
+    import shutil
+    import signal
+    import time
+
+    base = _stats_workspace(tmp_path / "base")
+    ws = str(tmp_path / "ws" / "ModelSet")
+    shutil.copytree(base, ws)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(_STATS_ENV)
+    env["SHIFU_TPU_BARRIER_TIMEOUT_S"] = "6"
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--port", str(port),
+             "--nproc", "2", "--pid", str(i), "--out", ws,
+             "--local-devices", "1", "--mode", "stats-kill"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            so, se = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("stats survivor hung after peer SIGKILL "
+                        "(watched merge failed to escape)")
+        outs.append((p.returncode, so, se))
+    elapsed = time.monotonic() - t0
+    rc1, _, se1 = outs[1]
+    assert rc1 == -signal.SIGKILL, f"victim rc={rc1}:\n{se1[-2000:]}"
+    rc0, _, se0 = outs[0]
+    assert rc0 in (17, 18), f"survivor rc={rc0}:\n{se0[-3000:]}"
+    assert "DIST_TIMEOUT" in se0 or "DIST_FAIL" in se0, se0[-3000:]
+    assert elapsed < 150, f"took {elapsed:.0f}s — watchdog too slow"
+
+    # the workspace is not poisoned: a clean sharded rerun completes
+    # and commits a stats-filled ColumnConfig.json
+    _run(2, ws, local_devices=1, mode="stats", env_extra=_STATS_ENV)
+    with open(os.path.join(ws, "ColumnConfig.json")) as f:
+        cols = json.load(f)
+    assert any((c.get("columnStats") or {}).get("mean") is not None
+               for c in cols), "rerun produced no stats"

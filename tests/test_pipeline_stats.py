@@ -267,3 +267,40 @@ def test_norm_precision_types(statsed, ptype, decimals):
         assert np.allclose(d, np.round(d.astype(np.float64), 6), atol=1e-7)
     else:
         assert data["dense"].dtype == np.float64
+
+
+def test_segment_stats_dag_siblings_bitwise(tmp_path, rng):
+    """The segment DAG split (`stats -base-only` → one `stats -seg K`
+    sibling per expression → `stats -seg-merge`) commits a
+    ColumnConfig.json byte-identical to the inline single-node
+    expansion, and pipeline_nodes wires norm to the merge sink."""
+    import shutil
+    from tests.synth import make_model_set
+    from shifu_tpu.pipeline.nodes import pipeline_nodes
+
+    root = make_model_set(tmp_path / "inline", rng, n_rows=1200,
+                          seg_expressions=["num_1 > 0", "num_0 > 0"])
+    ctx = ProcessorContext.load(root)
+    assert init_proc.run(ctx) == 0
+    twin = os.path.join(str(tmp_path), "dag", "ModelSet")
+    os.makedirs(os.path.dirname(twin), exist_ok=True)
+    shutil.copytree(root, twin)  # dataPath is absolute → same raw rows
+
+    ctx = ProcessorContext.load(root)
+    assert stats_proc.run(ctx) == 0
+
+    assert stats_proc.run(ProcessorContext.load(twin),
+                          base_only=True) == 0
+    for k in (1, 2):
+        assert stats_proc.run_segment(ProcessorContext.load(twin), k) == 0
+    assert stats_proc.run_segment_merge(ProcessorContext.load(twin)) == 0
+
+    inline = open(os.path.join(root, "ColumnConfig.json"), "rb").read()
+    dag = open(os.path.join(twin, "ColumnConfig.json"), "rb").read()
+    assert dag == inline
+
+    nodes = {n.name: n for n in pipeline_nodes(twin, resume=False)}
+    assert {"stats.seg.1", "stats.seg.2", "stats.segmerge"} <= set(nodes)
+    assert nodes["stats.seg.1"].deps == ("stats",)
+    assert nodes["stats.segmerge"].deps == ("stats.seg.1", "stats.seg.2")
+    assert nodes["norm"].deps == ("stats.segmerge",)

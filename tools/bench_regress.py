@@ -182,6 +182,33 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
                         f"trailing median {median:.4g} by more than "
                         f"{threshold_pct:.0f}% — low-priority traffic "
                         "is being shed harder than history")
+        # pod-scale data plane (dist_stats): scaling efficiency has an
+        # ABSOLUTE acceptance floor (0.7 at 2 hosts, ISSUE-14) on top
+        # of the usual newest-vs-trailing-median gate, and the bitwise
+        # parity verdict is a hard invariant, not a trend
+        eff = newest.get("scaling_efficiency")
+        if isinstance(eff, (int, float)):
+            if eff < 0.7:
+                findings.append(
+                    f"{label}: scaling_efficiency {eff:.3f} below the "
+                    "0.7 acceptance floor — the sharded data plane is "
+                    "not splitting the work")
+            hv = sorted(
+                float(r["scaling_efficiency"]) for r in history
+                if isinstance(r.get("scaling_efficiency"), (int, float)))
+            if len(hv) >= min_history:
+                median = hv[len(hv) // 2]
+                floor = median * (1.0 - threshold_pct / 100.0)
+                if eff < floor:
+                    findings.append(
+                        f"{label}: scaling_efficiency {eff:.3f} is "
+                        f"{100.0 * (median - eff) / median:.1f}% below "
+                        f"the trailing median {median:.3f} "
+                        f"(threshold {threshold_pct:.0f}%)")
+        if newest.get("bitwise_identical") is False:
+            findings.append(
+                f"{label}: bitwise_identical=false — sharded output "
+                "diverged from the single-host run")
     if findings:
         print(f"bench_regress: {len(findings)} finding(s) in {path}:",
               file=sys.stderr)

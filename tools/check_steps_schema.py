@@ -49,6 +49,11 @@ The ``trace`` block (attached to every step run with
 ``profiling.TRACE_FIELDS``, every member must be README-documented,
 and obs/trace.py must build the block from the tuple.
 
+The pod-scale data plane bench is pinned likewise: bench.py
+task_dist_stats builds its record from ``profiling.SHARD_FIELDS``,
+every member must be README-documented, and bench.py must reference
+the tuple.
+
 The health plane is pinned likewise: every metrics.jsonl point is
 ``profiling.METRIC_FIELDS`` (built by obs/health/store.py), every SLO
 record is ``profiling.HEALTH_FIELDS`` (built by obs/health/slo.py),
@@ -91,7 +96,8 @@ def documented_fields() -> set:
     pinned = set(roofline_fields()) | set(serving_fields()) | \
         set(fleet_fields()) | set(dag_fields()) | \
         set(dag_summary_fields()) | set(trace_fields()) | \
-        set(metric_fields()) | set(health_fields())
+        set(metric_fields()) | set(health_fields()) | \
+        set(shard_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -178,6 +184,10 @@ def metric_fields() -> tuple:
 
 def health_fields() -> tuple:
     return _profiling_tuple("HEALTH_FIELDS")
+
+
+def shard_fields() -> tuple:
+    return _profiling_tuple("SHARD_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -339,6 +349,33 @@ def check_health_docs() -> int:
     return 0
 
 
+def check_shard_docs() -> int:
+    """Every SHARD_FIELDS member (bench.py task_dist_stats' record
+    schema, the pod-scale data plane bench) must be backtick-documented
+    in README's Pod-scale data plane section, and task_dist_stats must
+    build its record from the tuple — the literal check asserts
+    bench.py references `SHARD_FIELDS` so the record cannot silently
+    drift from the pinned schema."""
+    fields = shard_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("shard schema drift: SHARD_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "SHARD_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the dist_stats record from "
+              "profiling.SHARD_FIELDS", file=sys.stderr)
+        return 1
+    print(f"pod-scale data plane: all {len(fields)} SHARD_FIELDS "
+          "documented in README and pinned in bench.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -401,6 +438,8 @@ def main(argv) -> int:
     if check_trace_docs():
         return 1
     if check_health_docs():
+        return 1
+    if check_shard_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
