@@ -168,6 +168,11 @@ _declare("SHIFU_TPU_INIT_TIMEOUT_S", "float", None,
          "bound on the jax.distributed coordinator handshake")
 _declare("SHIFU_TPU_BARRIER_TIMEOUT_S", "float", None,
          "collective watchdog deadline; unset = block forever")
+_declare("SHIFU_TPU_STREAM_TIMEOUT_S", "float", None,
+         "watchdog deadline for streaming data-plane collectives "
+         "(reader.bcast, striped partial merges) where a peer does "
+         "chunk-sized work between rounds; unset = 10x the barrier "
+         "timeout")
 _declare("SHIFU_TPU_MESH_DEVICES", "int", None,
          "cap the device count in the default mesh (None = all)")
 _declare("SHIFU_TPU_MESH_MODEL", "int", 1,
@@ -189,7 +194,10 @@ _declare("SHIFU_TPU_NATIVE_READER", "bool", "1",
          "use the native C fast reader when the .so is present")
 _declare("SHIFU_TPU_DATA_SHARD", "str", "auto",
          "pod-scale data shard: auto/1 = split stats/norm/psi/"
-         "correlation/eval reads across hosts, 0 = replicated reads")
+         "correlation/eval reads across hosts, 0 = replicated reads; "
+         "other values raise. Sharded reads always use the pandas "
+         "parser, so bitwise parity vs an unsharded run needs "
+         "SHIFU_TPU_NATIVE_READER=0 on the unsharded side")
 # --- streaming chunk triggers ---
 _declare("SHIFU_TPU_STATS_CHUNK_ROWS", "int", None,
          "explicit stats streaming chunk rows; 0 forces resident")

@@ -195,7 +195,12 @@ def read_raw_table(mc: ModelConfig,
     collective and reassembled in original order — the returned frame
     is identical on every host (and to the single-process parse), but
     the parse cost is split across the pod. Every process of the pod
-    must make the call (it is a collective).
+    must make the call (it is a collective). The sharded parse always
+    takes the pandas path, so bitwise parity against an UNSHARDED run
+    that used the native .so (which may parse `numeric_columns`
+    straight to float32) requires SHIFU_TPU_NATIVE_READER=0 on the
+    unsharded side — the parity drills pin it; see README "Pod-scale
+    data plane".
     """
     ds, header, files, first_file, has_header_line, simple = \
         _table_layout(mc, ds, file_shard)
@@ -447,6 +452,16 @@ def _read_raw_table_sharded(ds, header, files, first_file,
     return out
 
 
+def data_file_count(mc: ModelConfig,
+                    ds: Optional[ModelSourceDataConf] = None) -> int:
+    """Number of part files under the dataSet's dataPath — the stripe
+    count every host must agree on for `dist.merge_keyed_striped`
+    (same expansion `_table_layout` uses, so file indices match
+    `iter_raw_table_keyed` keys)."""
+    ds = ds or mc.dataSet
+    return len(expand_data_files(mc.resolve_path(ds.dataPath)))
+
+
 def iter_raw_table_keyed(mc: ModelConfig,
                          ds: Optional[ModelSourceDataConf] = None,
                          chunk_rows: int = 2_000_000,
@@ -507,18 +522,26 @@ def iter_raw_table_bcast(mc: ModelConfig,
     idx, count = shard
     ds, header, files, first_file, has_header_line, simple = \
         _table_layout(mc, ds, None)
+    # the stream deadline, not the barrier's: between two bcast steps a
+    # consumer legitimately does chunk-sized work (the norm writer
+    # normalizes and writes mmaps) — drained peers must not DistTimeout
+    # on one slow chunk while the writer is provably making progress
+    timeout = dist.stream_timeout_s()
     for fi, path in enumerate(files):
         owner = fi % count
         if owner == idx:
             skip = 1 if (has_header_line and path == first_file) else 0
             for df in _iter_file_chunks(ds, header, simple, path, skip,
                                         chunk_rows):
-                dist.allgather_obj("reader.bcast", ("chunk", df))
+                dist.allgather_obj("reader.bcast", ("chunk", df),
+                                   timeout_s=timeout)
                 yield df
-            dist.allgather_obj("reader.bcast", ("end",))
+            dist.allgather_obj("reader.bcast", ("end",),
+                               timeout_s=timeout)
         else:
             while True:
-                parts = dist.allgather_obj("reader.bcast", None)
+                parts = dist.allgather_obj("reader.bcast", None,
+                                           timeout_s=timeout)
                 msg = parts[owner]
                 if msg is None or msg[0] == "end":
                     break

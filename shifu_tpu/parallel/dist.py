@@ -36,7 +36,12 @@ across hosts; `allgather_obj` / `allreduce_tree` / `broadcast_tree`
 are the watched host-object collectives their partial-result merges
 run through — same watchdog/poison/preempt machinery as the barriers,
 so a host dying mid-merge surfaces as DistTimeout/DistAborted on the
-survivors instead of a hang.
+survivors instead of a hang. `merge_keyed_striped` is the
+bounded-memory merge protocol on top: per-chunk contributions
+exchange one file-stripe per round and fold in global chunk order, so
+>RAM datasets never materialize every host's whole contribution list.
+Streaming collectives (those with per-chunk work between rounds) run
+on the longer `stream_timeout_s` deadline instead of the barrier's.
 """
 
 from __future__ import annotations
@@ -388,10 +393,18 @@ def data_shard() -> Optional[tuple]:
     readers then stream disjoint row ranges and merge partials through
     the watched collectives below. "0" forces today's replicated-read
     behavior exactly; "auto" (default) and "1" shard whenever the pod
-    has peers to shard across."""
+    has peers to shard across. Anything else raises — a typo ("ture")
+    or an attempted shard count ("2") silently enabling sharding would
+    be indistinguishable from the operator's intent."""
     mode = (knob_str("SHIFU_TPU_DATA_SHARD") or "auto").strip().lower()
     if mode in ("0", "off", "false", "no"):
         return None
+    if mode not in ("auto", "1", "on", "true", "yes"):
+        raise ValueError(
+            f"SHIFU_TPU_DATA_SHARD={mode!r}: want auto (shard when the "
+            "pod has peers), 1/on/true/yes (same, asserted) or "
+            "0/off/false/no (replicated reads) — the shard count always "
+            "comes from jax.process_count()")
     if not _multi_process():
         return None
     count = jax.process_count()
@@ -400,7 +413,24 @@ def data_shard() -> Optional[tuple]:
     return jax.process_index(), count
 
 
-def _exchange_bytes(tag: str, payload: bytes):
+def stream_timeout_s() -> Optional[float]:
+    """Watchdog deadline for the STREAMING data-plane collectives
+    (`reader.bcast`, the striped partial merges): between two of these
+    a peer legitimately does chunk-sized work — parsing a part file,
+    normalizing and writing a chunk's mmaps — so the barrier deadline
+    (sized for "everyone arrives together") fires spuriously on a slow
+    chunk. SHIFU_TPU_STREAM_TIMEOUT_S when set; else 10× the barrier
+    timeout (the peer is provably alive and making per-chunk progress;
+    abort/preempt markers still poll at the same cadence); else None."""
+    v = knob_float("SHIFU_TPU_STREAM_TIMEOUT_S")
+    if v is not None and v > 0:
+        return v
+    bt = barrier_timeout_s()
+    return bt * 10.0 if bt is not None else None
+
+
+def _exchange_bytes(tag: str, payload: bytes,
+                    timeout_s: Optional[float] = None):
     """All-gather one variable-length byte string per process, watched.
     Two fixed-shape collectives: lengths first, then the payloads
     padded to the longest — `process_allgather` needs every process to
@@ -418,21 +448,25 @@ def _exchange_bytes(tag: str, payload: bytes):
             .reshape(len(lens), -1)
         return [mat[p, :int(lens[p])].tobytes() for p in range(len(lens))]
 
-    return _watched(tag, _gather)
+    return _watched(tag, _gather, timeout_s=timeout_s)
 
 
-def allgather_obj(tag: str, obj):
+def allgather_obj(tag: str, obj, timeout_s: Optional[float] = None):
     """Watched all-gather of one picklable host object per process;
     returns the objects in process order (so a fold over the result is
     deterministic). Single-process: ``[obj]``. This is the primitive
     under every data-plane partial merge; the ``dist.allreduce_tree``
-    fault site makes it drillable (oserror/timeout/kill/preempt)."""
+    fault site makes it drillable (oserror/timeout/kill/preempt).
+    `timeout_s` overrides the barrier deadline — streaming callers pass
+    `stream_timeout_s()` because a peer does per-chunk work between
+    their collectives."""
     fault_point("dist.allreduce_tree")
     if not (_multi_process() and jax.process_count() > 1):
         return [obj]
     import pickle
     t0 = time.monotonic()
-    payloads = _exchange_bytes(tag, pickle.dumps(obj, protocol=4))
+    payloads = _exchange_bytes(tag, pickle.dumps(obj, protocol=4),
+                               timeout_s=timeout_s)
     out = [pickle.loads(p) for p in payloads]
     from shifu_tpu.data import pipeline as _pipe
     _pipe.add_stage_time("dist_merge_s", time.monotonic() - t0)
@@ -467,6 +501,55 @@ def allreduce_tree(tag: str, tree):
     for p in parts[1:]:
         acc = _tree_add(acc, p)
     return acc
+
+
+def merge_keyed_striped(tag: str, shard: tuple, n_files: int, items,
+                        fold, acc=None, extra_fn=None):
+    """Bounded-memory ordered-replay merge for the sharded streaming
+    passes. `items` yields ``(key, contribution)`` with key =
+    ``(file_idx, chunk_idx)`` ascending over THIS host's files
+    (``file_idx % count == index``, `iter_raw_table_keyed` ownership).
+    Files merge in stripes of `count` (stripe ``s`` covers files
+    ``[s·count, (s+1)·count)`` — exactly one file per host per round,
+    so parsing stays parallel): each round all-gathers only that
+    stripe's per-chunk contributions and folds them in ascending key
+    order. Stripes partition the file list contiguously, so the fold
+    visits every chunk in the sequential pass's exact order — bitwise
+    replay — while each host holds one stripe of contributions instead
+    of the whole table (the difference between bounded memory and a
+    multi-GB pickle per merge on >RAM datasets).
+
+    ``fold(acc, key, contribution, extra) -> acc``; `extra_fn` (host
+    metadata such as the column layout, re-sent every round — a host
+    may see its first chunk late) merges to the first non-None in
+    (round, process) order. Returns ``(acc, extra)``. Runs on the
+    stream deadline (`stream_timeout_s`): hosts parse a file between
+    rounds, which the barrier deadline does not budget for."""
+    idx, count = shard
+    n_stripes = max(-(-n_files // count), 1)
+    timeout = stream_timeout_s()
+    it = iter(items)
+    nxt = next(it, None)
+    extra = None
+    for s in range(n_stripes):
+        batch = []
+        while nxt is not None and nxt[0][0] // count == s:
+            batch.append(nxt)
+            nxt = next(it, None)
+        parts = allgather_obj(f"{tag}.stripe{s}",
+                              (batch, extra_fn() if extra_fn else None),
+                              timeout_s=timeout)
+        if extra is None:
+            extra = next((e for _b, e in parts if e is not None), None)
+        for key, c in sorted((kc for b, _e in parts for kc in b),
+                             key=lambda kc: kc[0]):
+            acc = fold(acc, key, c, extra)
+    if nxt is not None:
+        raise RuntimeError(
+            f"merge {tag!r}: host {idx} produced chunk key {nxt[0]} "
+            f"beyond the declared {n_files}-file range — the file list "
+            "changed mid-run?")
+    return acc, extra
 
 
 def broadcast_tree(tag: str, tree):

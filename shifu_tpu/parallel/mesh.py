@@ -111,16 +111,10 @@ def default_rules() -> MeshRules:
     return MeshRules(_parse_rules_env(raw) if raw else None)
 
 
-def default_mesh() -> Mesh:
-    """The process-wide data mesh every processor executes over by
-    default — the round-2 replacement for 'workers': on one chip it is
-    a 1-device mesh (the reference's LOCAL mode), on a TPU host it is
-    all chips, multi-host it is all global devices (DCN via
-    parallel/dist.initialize). SHIFU_TPU_MESH_DEVICES=N caps the
-    device count (tests use it to compare 8-device vs 1-device runs).
-    """
+def _knobbed_mesh(devs, cache_tag: str) -> Mesh:
+    """The shared default_mesh/local_mesh body: apply the device-count
+    cap and model-axis carve knobs to `devs` and cache the result."""
     cap = knob_int("SHIFU_TPU_MESH_DEVICES")
-    devs = jax.devices()
     n = min(int(cap), len(devs)) if cap else len(devs)
     # SHIFU_TPU_MESH_MODEL=K carves K devices onto the 'model' axis for
     # vocab-heavy WDL/MTL configs (embedding tables sharded instead of
@@ -131,13 +125,38 @@ def default_mesh() -> Mesh:
         raise ValueError(
             f"SHIFU_TPU_MESH_MODEL={n_model} must divide the device "
             f"count {n}")
-    key = (n, n_model, tuple(d.id for d in devs[:n]))
+    key = (cache_tag, n, n_model, tuple(d.id for d in devs[:n]))
     m = _MESH_CACHE.get(key)
     if m is None:
         m = make_mesh(n_data=n // n_model, n_model=n_model,
                       devices=devs[:n])
         _MESH_CACHE[key] = m
     return m
+
+
+def default_mesh() -> Mesh:
+    """The process-wide data mesh every processor executes over by
+    default — the round-2 replacement for 'workers': on one chip it is
+    a 1-device mesh (the reference's LOCAL mode), on a TPU host it is
+    all chips, multi-host it is all global devices (DCN via
+    parallel/dist.initialize). SHIFU_TPU_MESH_DEVICES=N caps the
+    device count (tests use it to compare 8-device vs 1-device runs).
+    """
+    return _knobbed_mesh(jax.devices(), "global")
+
+
+def local_mesh() -> Mesh:
+    """default_mesh restricted to THIS process's addressable devices
+    (same cap and model-axis knobs; single-host the two coincide). The
+    sharded streaming data plane computes per-chunk partials on this
+    mesh: hosts iterate DISJOINT chunk streams, so a global-mesh
+    computation — an SPMD program every process must enter in lockstep
+    with matching shapes — would desync the pod; a fully-addressable
+    mesh keeps each chunk's math local, and identical to what a
+    single-host run does for that chunk (bitwise parity of the replay
+    merge, given equal per-host device counts — the same assumption
+    the trainer's 2×2-vs-1×4 drill pins)."""
+    return _knobbed_mesh(jax.local_devices(), "local")
 
 
 def reprobe_devices() -> int:

@@ -12,13 +12,18 @@ chunk contributes its pairwise co-valid count / sum / sum-of-squares /
 cross-product matrices, which add exactly — no sampling anywhere.
 
 Pod-scale (`dist.data_shard()` active): the chunked path computes
-moments only for this host's part files' chunks, keeps them keyed by
-global chunk identity, and after the loop all-gathers and replays the
-f64 additions in ascending chunk order — the sequential fold's exact
-operation sequence, so the merged matrix is bitwise identical to a
-single-host run. The resident path shards the PARSE
-(`load_dataset_for_columns(..., sharded=True)` reassembles the
-identical frame everywhere) and computes locally as before.
+moments only for this host's part files' chunks — on the HOST-LOCAL
+mesh (`mesh.local_mesh`), never the global one: hosts hold different
+chunks with different shapes, and a global-mesh GEMM is an SPMD
+program every process must enter in lockstep, so sharing the resident
+path's mesh here would desync the pod. The per-chunk f64 moments then
+merge through `dist.merge_keyed_striped`, which replays the additions
+in ascending global chunk order one file-stripe at a time — the
+sequential fold's exact operation sequence at bounded memory, so the
+merged matrix is bitwise identical to a single-host run. The resident
+path DOES use the global mesh: `load_dataset_for_columns(...,
+sharded=True)` reassembles the identical frame everywhere, so every
+host enters the same computation.
 """
 
 from __future__ import annotations
@@ -110,41 +115,62 @@ def run(ctx: ProcessorContext) -> int:
 
     from shifu_tpu.parallel import dist
     shard = dist.data_shard()
-    if chunk_rows:
-        log.info("correlation: dataset exceeds the resident threshold — "
-                 "exact streaming accumulation in %d-row chunks", chunk_rows)
-        from shifu_tpu.data.pipeline import prefetch
-        from shifu_tpu.data.reader import iter_raw_table_keyed
-        frames = prefetch(iter_raw_table_keyed(mc, chunk_rows=chunk_rows,
-                                               local_only=True))
-    else:
-        frames = [((0, 0), 0, None)]   # one resident read, same path
-
-    acc = None
-    names = None
-    pending = []
-    for key, _pos, df in frames:
-        x, names = _feature_block(ctx, cols, df, sharded=df is None)
-        parts = pearson_moments(mesh_mod.shard_axis(mesh, x, 0,
-                                                    pad_value=np.nan))
-        # accumulate on host in f64: partial sums of f32 GEMMs merge
-        # without growing rounding error across many chunks
-        parts = [np.asarray(m, np.float64) for m in parts]
-        if chunk_rows and shard is not None:
-            pending.append((key, parts))
-        else:
-            acc = parts if acc is None else \
-                [a + b for a, b in zip(acc, parts)]
     if chunk_rows and shard is not None:
-        # replay every host's per-chunk moments in ascending global
-        # chunk order — the sequential fold's addition sequence
-        gathered = dist.allgather_obj("correlation.moments",
-                                      (names, pending))
-        names = next((nm for nm, _ in gathered if nm is not None), None)
-        for _key, parts in sorted((kp for _, ps in gathered for kp in ps),
-                                  key=lambda kp: kp[0]):
+        # sharded streaming: disjoint per-host chunk streams, so every
+        # chunk's moments compute on the HOST-LOCAL mesh (a global-mesh
+        # GEMM would be a lockstep SPMD step over mismatched shapes —
+        # pod desync), then replay in ascending global chunk order one
+        # file-stripe at a time (bounded memory, sequential fold order)
+        log.info("correlation: sharded streaming accumulation in %d-row "
+                 "chunks (host %d/%d)", chunk_rows, *shard)
+        from shifu_tpu.data.pipeline import prefetch
+        from shifu_tpu.data.reader import data_file_count, iter_raw_table_keyed
+        lmesh = mesh_mod.local_mesh()
+        names_box = [None]
+
+        def _moments():
+            for key, _pos, df in prefetch(iter_raw_table_keyed(
+                    mc, chunk_rows=chunk_rows, local_only=True)):
+                x, names_box[0] = _feature_block(ctx, cols, df)
+                parts = pearson_moments(mesh_mod.shard_axis(
+                    lmesh, x, 0, pad_value=np.nan))
+                # host f64 like the sequential fold — partial sums of
+                # f32 GEMMs merge without growing rounding error
+                yield key, [np.asarray(m, np.float64) for m in parts]
+
+        def _fold(acc, _key, parts, _nm):
+            return parts if acc is None else \
+                [a + b for a, b in zip(acc, parts)]
+
+        acc, names = dist.merge_keyed_striped(
+            "correlation.moments", shard, data_file_count(mc),
+            _moments(), _fold, extra_fn=lambda: names_box[0])
+    else:
+        if chunk_rows:
+            log.info("correlation: dataset exceeds the resident "
+                     "threshold — exact streaming accumulation in "
+                     "%d-row chunks", chunk_rows)
+            from shifu_tpu.data.pipeline import prefetch
+            from shifu_tpu.data.reader import iter_raw_table_keyed
+            frames = prefetch(iter_raw_table_keyed(
+                mc, chunk_rows=chunk_rows, local_only=True))
+        else:
+            frames = [((0, 0), 0, None)]   # one resident read, same path
+        acc = None
+        names = None
+        for _key, _pos, df in frames:
+            x, names = _feature_block(ctx, cols, df, sharded=df is None)
+            parts = pearson_moments(mesh_mod.shard_axis(mesh, x, 0,
+                                                        pad_value=np.nan))
+            # accumulate on host in f64: partial sums of f32 GEMMs merge
+            # without growing rounding error across many chunks
+            parts = [np.asarray(m, np.float64) for m in parts]
             acc = parts if acc is None else \
                 [a + b for a, b in zip(acc, parts)]
+    if acc is None:
+        raise ValueError(
+            "correlation: no chunk produced any valid rows — check "
+            "filterExpressions / pos+neg tags against the data")
     corr = pearson_from_moments(*acc)
 
     out = ctx.path_finder.correlation_path()

@@ -28,14 +28,20 @@ automatically when the raw files exceed SHIFU_TPU_STATS_STREAM_BYTES
 path (they re-filter the frame per expression) and raise/skip clearly.
 
 Pod-scale sharding (`dist.data_shard()` active): each host runs both
-passes over only ITS part files' chunks (`iter_raw_table_keyed`), but
-keeps every chunk's float64 CONTRIBUTION (the per-chunk `+=`
+passes over only ITS part files' chunks (`iter_raw_table_keyed`),
+computing every chunk's float64 CONTRIBUTION (the per-chunk `+=`
 right-hand sides) keyed by the chunk's global ``(file, chunk)``
-identity. The contributions all-gather through the watched collective
-and every host replays them in ascending key order from zeros — the
+identity. The contributions exchange through
+`dist.merge_keyed_striped` — one file-stripe of chunks per watched
+round, folded by every host in ascending key order from zeros — the
 exact addition sequence of the sequential pass, so the merged
 accumulators (and ColumnConfig.json) are bitwise identical to a
-single-host run while each host parses ~1/P of the data.
+single-host run while each host parses ~1/P of the data and holds one
+stripe (not the whole table) of contributions. Pass B's dense
+(4, C, 8192) per-chunk histograms additionally travel sparse
+(nonzero bins only, bounded by the chunk's rows) so the exchange
+payload scales with data seen, not with C×K — the bounded-memory
+contract the streaming path exists for.
 """
 
 from __future__ import annotations
@@ -220,6 +226,33 @@ def _fold_a(state, meta, c):
     return state
 
 
+def _encode_b(fc: np.ndarray):
+    """Sparse wire encoding of one chunk's Pass-B increment for the
+    striped merge: the dense (4, C, K) array is ~256 KB per numeric
+    column per chunk, but its nonzero count is bounded by the chunk's
+    rows × weight kinds — shipping only (flat index, value) pairs
+    keeps merge payloads proportional to data actually seen. Falls
+    back to dense when a chunk genuinely fills the histogram (sparse
+    would be bigger). Bitwise-exact either way: the accumulator starts
+    at +0.0 and can never reach -0.0, so `+= 0.0` on a skipped element
+    is the identity."""
+    nz = np.flatnonzero(fc)
+    if nz.size * 2 >= fc.size:
+        return ("dense", fc)
+    return ("sparse", nz, fc.ravel()[nz])
+
+
+def _apply_b(fine: np.ndarray, enc) -> None:
+    """Replay one encoded Pass-B increment into the running histogram
+    — element-wise identical to the sequential ``fine += fc`` (flat
+    indices within one chunk are unique, so each element receives its
+    single addend exactly as the dense add would deliver it)."""
+    if enc[0] == "dense":
+        fine += enc[1]
+    else:
+        fine.reshape(-1)[enc[1]] += enc[2]
+
+
 def _contrib_b(dset, A, span, cn: int) -> np.ndarray:
     """One chunk's (4, C, K) fine-histogram increment (Pass B)."""
     v = dset.numeric.astype(np.float64)
@@ -263,35 +296,50 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
 
     from shifu_tpu.parallel import dist
     shard = dist.data_shard()
+    from shifu_tpu.data.reader import data_file_count
+    n_files = data_file_count(mc) if shard is not None else 0
 
     # ---- Pass A: moments + categorical value counts -------------------
     # Each chunk's accumulator updates are computed as a CONTRIBUTION
     # (`_contrib_a`) and folded by `_fold_a` — unsharded, immediately
     # (today's addition sequence verbatim); sharded, the per-chunk
-    # contributions all-gather and replay in ascending global chunk
-    # order from zeros, reproducing the same sequence bit for bit.
+    # contributions exchange one file-stripe per watched round
+    # (`merge_keyed_striped`) and replay in ascending global chunk
+    # order from zeros, reproducing the same sequence bit for bit at
+    # one stripe of host memory.
     meta = None
     state = None        # (A, cat_counts, cat_missing)
     n_rows = 0
-    pending: List[tuple] = []
-    for key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
-                                     local_only=True):
-        if meta is None:
-            meta = (dset.num_names, dset.num_column_nums,
-                    dset.cat_names, dset.cat_column_nums)
-        c = _contrib_a(dset)
-        if shard is None:
+    if shard is None:
+        for _key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
+                                          local_only=True):
+            if meta is None:
+                meta = (dset.num_names, dset.num_column_nums,
+                        dset.cat_names, dset.cat_column_nums)
+            c = _contrib_a(dset)
             state = _fold_a(state, meta, c)
             n_rows += c["n_rows"]
-        else:
-            pending.append((key, c))
-    if shard is not None:
-        parts = dist.allgather_obj("stats.passA", (meta, pending))
-        meta = next((m for m, _ in parts if m is not None), None)
-        for key, c in sorted((kc for _, cs in parts for kc in cs),
-                             key=lambda kc: kc[0]):
-            state = _fold_a(state, meta, c)
-            n_rows += c["n_rows"]
+    else:
+        meta_box = [None]
+
+        def _contribs_a():
+            for key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
+                                             local_only=True):
+                if meta_box[0] is None:
+                    meta_box[0] = (dset.num_names, dset.num_column_nums,
+                                   dset.cat_names, dset.cat_column_nums)
+                yield key, _contrib_a(dset)
+
+        counted = [0]
+
+        def _fold(st, _key, c, m):
+            counted[0] += c["n_rows"]
+            return _fold_a(st, m, c)
+
+        state, meta = dist.merge_keyed_striped(
+            "stats.passA", shard, n_files, _contribs_a(), _fold,
+            extra_fn=lambda: meta_box[0])
+        n_rows = counted[0]
 
     if n_rows == 0 or meta is None:
         raise ValueError(
@@ -305,19 +353,23 @@ def run_streaming(ctx: ProcessorContext, chunk_rows: int,
 
     # ---- Pass B: fine histograms for numeric columns ------------------
     fine = np.zeros((4, cn, FINE_BINS), np.float64)  # pos_n/neg_n/pos_w/neg_w
-    pending_b: List[tuple] = []
-    for key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
-                                     local_only=True):
-        fc = _contrib_b(dset, A, span, cn)
-        if shard is None:
-            fine += fc
-        else:
-            pending_b.append((key, fc))
-    if shard is not None:
-        parts = dist.allgather_obj("stats.passB", pending_b)
-        for key, fc in sorted((kc for p in parts for kc in p),
-                              key=lambda kc: kc[0]):
-            fine += fc
+    if shard is None:
+        for _key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
+                                          local_only=True):
+            fine += _contrib_b(dset, A, span, cn)
+    else:
+        # sparse per-chunk increments, one file-stripe per round —
+        # never the dense C×K array per chunk for the whole table
+        def _contribs_b():
+            for key, dset in _chunk_datasets(ctx, ccs, chunk_rows, seed,
+                                             local_only=True):
+                yield key, _encode_b(_contrib_b(dset, A, span, cn))
+
+        def _fold_b(_acc, _key, enc, _m):
+            _apply_b(fine, enc)
+
+        dist.merge_keyed_striped("stats.passB", shard, n_files,
+                                 _contribs_b(), _fold_b)
 
     _fill_from_sketch(ctx, mc, num_names, num_nums, A, fine, n_rows)
     _fill_cats_from_dicts(ctx, mc, cat_names, cat_nums, cat_counts,

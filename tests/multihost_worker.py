@@ -45,6 +45,11 @@ collectives = the test-rig stand-in for DCN), then one of two modes:
   SHIFU_TPU_FAULT=dist.allreduce_tree:kill:1 and SIGKILLs itself at
   the first watched merge. The survivor must exit rc 17 (DistTimeout)
   or rc 18 (fast collective failure) instead of hanging.
+- ``--mode corr``: like ``stats`` but runs ``shifu stats
+  -correlation`` over an already stats-filled ModelSet. The sharded
+  streaming path computes per-chunk Pearson moments on the host-LOCAL
+  mesh and replays them through the striped merge; correlation.csv
+  must come out bitwise identical to a 1-process run.
 
 Usage: python multihost_worker.py --port P --nproc N --pid I --out F
 """
@@ -62,7 +67,7 @@ ap.add_argument("--local-devices", type=int, default=2)
 ap.add_argument("--mode",
                 choices=("train", "barrier-kill", "barrier-stall",
                          "preempt-drill", "preempt-resume",
-                         "stats", "stats-kill"),
+                         "stats", "stats-kill", "corr"),
                 default="train")
 args = ap.parse_args()
 
@@ -169,7 +174,7 @@ if args.mode in ("preempt-drill", "preempt-resume"):
           flush=True)
     os._exit(20)
 
-if args.mode in ("stats", "stats-kill"):
+if args.mode in ("stats", "stats-kill", "corr"):
     from shifu_tpu.cli import main as cli_main  # noqa: E402
     from shifu_tpu.parallel import dist  # noqa: E402
 
@@ -180,8 +185,11 @@ if args.mode in ("stats", "stats-kill"):
         os.environ["SHIFU_TPU_FAULT"] = "dist.allreduce_tree:kill:1"
     import time
     t0 = time.process_time()
+    cmd = ["--dir", args.out, "stats"]
+    if args.mode == "corr":
+        cmd.append("-correlation")
     try:
-        rc = cli_main(["--dir", args.out, "stats"])
+        rc = cli_main(cmd)
         # this process's CPU seconds for the step — bench.py's
         # dist_stats scaling-efficiency basis (robust to a test rig
         # with fewer cores than simulated hosts, where wall clock

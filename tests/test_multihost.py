@@ -284,6 +284,51 @@ def test_writer_guard_never_initializes_backend(monkeypatch):
         assert w is True
 
 
+def test_data_shard_rejects_junk_values(monkeypatch):
+    """A typo ('ture') or an attempted shard count ('2') must raise,
+    not silently ENABLE sharding — only the documented spellings are
+    accepted; the shard count always comes from jax.process_count()."""
+    from shifu_tpu.parallel import dist
+
+    for bad in ("ture", "2", "both"):
+        monkeypatch.setenv("SHIFU_TPU_DATA_SHARD", bad)
+        with pytest.raises(ValueError, match="SHIFU_TPU_DATA_SHARD"):
+            dist.data_shard()
+    for off in ("0", "off", "false", "no", "OFF"):
+        monkeypatch.setenv("SHIFU_TPU_DATA_SHARD", off)
+        assert dist.data_shard() is None
+    monkeypatch.setenv("SHIFU_TPU_DATA_SHARD", "auto")
+    assert dist.data_shard() is None   # single process: no peers
+
+
+def test_merge_keyed_striped_single_process_fold_order():
+    """One-host contract of the striped merge: contributions replay in
+    ascending global (file, chunk) key order, the extra payload
+    reaches the fold, and a chunk key beyond the declared file range
+    raises instead of being silently dropped."""
+    from shifu_tpu.parallel import dist
+
+    items = [((0, 0), 1.0), ((0, 1), 2.0), ((1, 0), 4.0), ((2, 0), 8.0)]
+    seen = []
+
+    def fold(acc, key, c, extra):
+        assert extra == "names"
+        seen.append(key)
+        return (acc or 0.0) + c
+
+    acc, extra = dist.merge_keyed_striped(
+        "t.merge", (0, 1), 3, iter(items), fold,
+        extra_fn=lambda: "names")
+    assert acc == 15.0
+    assert extra == "names"
+    assert seen == [k for k, _ in items]
+
+    with pytest.raises(RuntimeError, match="beyond the declared"):
+        dist.merge_keyed_striped(
+            "t.merge2", (0, 1), 1, iter(items),
+            lambda acc, key, c, extra: acc)
+
+
 def _stats_workspace(tmp_path):
     """An init-ed synthetic model set whose raw table spans several
     part files, so a 2-host shard genuinely splits the read."""
@@ -337,6 +382,44 @@ def test_two_process_sharded_stats_bitwise_identical(tmp_path):
 
     assert sha(ws1) == sha(ws2), \
         "sharded stats diverged from the sequential run"
+
+
+def test_two_process_sharded_correlation_bitwise_identical(tmp_path):
+    """Correlation's sharded streaming path (per-chunk Pearson moments
+    on the host-LOCAL mesh, striped f64 replay merge) must write a
+    correlation.csv BITWISE identical to the 1-process streaming run.
+    Also the regression test for the pod-desync bug: with 2 processes
+    each host owns a different number of chunks, so any global-mesh
+    step inside the per-chunk loop would hang or corrupt the merge."""
+    import hashlib
+    import shutil
+
+    from shifu_tpu.cli import main as cli_main
+
+    base = _stats_workspace(tmp_path / "base")
+    # fill stats once, unsharded and in-process — both copies then
+    # start from the identical stats-filled ColumnConfig (correlation
+    # needs the binning vocabularies to encode categoricals)
+    assert cli_main(["--dir", base, "stats"]) == 0
+    ws1 = str(tmp_path / "ws1" / "ModelSet")
+    ws2 = str(tmp_path / "ws2" / "ModelSet")
+    shutil.copytree(base, ws1)
+    shutil.copytree(base, ws2)
+    env = dict(_STATS_ENV)
+    # force the streaming path with several chunks per part file, so
+    # both the local-mesh moment compute and the striped replay merge
+    # are genuinely exercised
+    env["SHIFU_TPU_ANALYSIS_CHUNK_ROWS"] = "300"
+    _run(1, ws1, local_devices=1, mode="corr", env_extra=env)
+    _run(2, ws2, local_devices=1, mode="corr", env_extra=env)
+
+    def sha(root):
+        p = os.path.join(root, "tmp", "Stats", "correlation.csv")
+        with open(p, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+
+    assert sha(ws1) == sha(ws2), \
+        "sharded correlation diverged from the sequential run"
 
 
 def test_two_process_stats_survivor_escapes_midmerge_kill(tmp_path):

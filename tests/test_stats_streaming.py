@@ -142,3 +142,34 @@ def test_streaming_stats_segment_rejected(tmp_path, rng, monkeypatch):
     ctx = ProcessorContext.load(root)
     with pytest.raises(ValueError, match="resident stats"):
         stats_proc.run(ctx)
+
+
+def test_pass_b_sparse_encoding_bitwise(rng):
+    """The sharded Pass-B exchange ships sparse (indices, values) when
+    a chunk's fine-histogram contribution is mostly zeros. Applying
+    the encoding must equal the dense `fine += fc` BITWISE: the
+    accumulator never holds -0.0, skipped zero addends are the
+    identity, and each chunk's indices are unique, so the fancy-index
+    scatter-add is the same operation sequence as the dense add."""
+    from shifu_tpu.processor.stats_streaming import _apply_b, _encode_b
+
+    shape = (4, 5, 64)
+    fc = np.zeros(shape, np.float64)
+    idx = rng.choice(fc.size, size=40, replace=False)
+    fc.reshape(-1)[idx] = rng.normal(size=40)
+    enc = _encode_b(fc)
+    assert enc[0] == "sparse"
+    base = np.abs(rng.normal(size=shape))   # counts-like accumulator
+    dense, sparse = base.copy(), base.copy()
+    dense += fc
+    _apply_b(sparse, enc)
+    assert dense.tobytes() == sparse.tobytes()
+
+    # mostly-nonzero chunk: encoding falls back to the dense array
+    fd = np.asarray(rng.normal(size=shape))
+    enc2 = _encode_b(fd)
+    assert enc2[0] == "dense"
+    d2, s2 = base.copy(), base.copy()
+    d2 += fd
+    _apply_b(s2, enc2)
+    assert d2.tobytes() == s2.tobytes()
