@@ -124,6 +124,12 @@ SERVE_FEATURES = 30
 SERVE_HIDDEN = (64, 32)
 SERVE_MIX = (1, 4, 16, 64)
 
+# closed-loop refresh bench (breach → retrain → guardrail → promote →
+# hot swap): sized so the warm-start retrain is the dominant term, as
+# in production, while the whole loop stays CPU-runnable
+REFRESH_BENCH_ROWS = 2000
+REFRESH_BENCH_EPOCHS = 12
+
 # v5e HBM bandwidth (GB/s) for the roofline estimate in extra
 TPU_HBM_GBPS = 819.0
 
@@ -1844,6 +1850,127 @@ def task_fleet():
     print(json.dumps(record))
 
 
+def task_refresh():
+    """Continuous-refresh bench: train + publish an incumbent, warm a
+    `FleetService`, then drive ONE drift-breach refresh end to end —
+    warm-start challenger retrain on the accumulated window, eval
+    guardrail vs the incumbent, atomic registry promote, hot in-place
+    param swap — and price that swap against the evict + re-warm
+    fallback it replaces. Record keys are pinned by
+    profiling.REFRESH_FIELDS; tools/bench_regress.py gates the hard
+    invariants (swap_s <= rewarm_s, ZERO compile-cache misses during
+    the swap, guardrail verdict `promote`)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    import jax
+
+    from shifu_tpu import registry
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.data import pipeline
+    from shifu_tpu.obs.health.refresh import RefreshController
+    from shifu_tpu.processor.base import ProcessorContext
+    from shifu_tpu.profiling import REFRESH_FIELDS
+    from shifu_tpu.serve.fleet import FleetService
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.synth import make_model_set
+
+    tmp = tempfile.mkdtemp(prefix="shifu_refresh_bench_")
+    try:
+        rng = np.random.default_rng(15)
+        ms = make_model_set(os.path.join(tmp, "set"), rng,
+                            n_rows=REFRESH_BENCH_ROWS)
+        cfg_path = os.path.join(ms, "ModelConfig.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        cfg["train"]["numTrainEpochs"] = REFRESH_BENCH_EPOCHS
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f, indent=2)
+        for cmd in ("init", "stats", "norm", "train"):
+            if cli_main(["--dir", ms, cmd]) != 0:
+                raise RuntimeError(f"refresh bench: {cmd} failed")
+        reg = os.path.join(tmp, "registry")
+        registry.publish(reg, "m", os.path.join(ms, "models"),
+                         ladder=(1, 16))
+        hdr = open(os.path.join(ms, "data", ".pig_header")) \
+            .read().strip().split("|")
+        df = pd.read_csv(os.path.join(ms, "data", "part-00000"),
+                         sep="|", names=hdr, dtype=str)
+
+        with FleetService(reg, workspace_root=ms,
+                          hbm_budget_mb=0) as fleet:
+            _, _, man = registry.resolve(reg, "m")
+            x = rng.normal(0, 1, (8, man["input_dim"])) \
+                .astype(np.float32)
+            fleet.submit("m", dense=x)   # resident + AOT-warm
+            ctl = RefreshController(ProcessorContext.load(ms),
+                                    registry_root=reg, model_name="m",
+                                    fleet=fleet, tolerance=0.5,
+                                    cooldown_s=0.0)
+            ctl.note_window(df)
+            t0 = time.monotonic()
+            outcome = ctl.handle_breach({"slo": "drift",
+                                         "state": "breach"})
+            breach_to_promoted_s = time.monotonic() - t0
+            if outcome != "promoted":
+                raise RuntimeError(f"refresh bench: outcome={outcome} "
+                                   f"({ctl.stats()})")
+            v, vdir, man2 = registry.resolve(reg, "m")
+            _log(f"[refresh] breach→promoted({v}) in "
+                 f"{breach_to_promoted_s:.2f}s (incumbent auc "
+                 f"{man2['refresh']['incumbent_auc']:.4f} → challenger "
+                 f"{man2['refresh']['challenger_auc']:.4f})")
+            guardrail = {
+                "decision": "promote",
+                "incumbent_auc": round(man2["refresh"]["incumbent_auc"],
+                                       6),
+                "challenger_auc": round(
+                    man2["refresh"]["challenger_auc"], 6)}
+
+            # pure-swap cost + compile hygiene: republish the promoted
+            # params as one more version and hot-swap it in isolation —
+            # everything upstream (train, warm) already compiled, so
+            # ANY miss here is the swap recompiling
+            pipeline.drain_stage_timers()
+            registry.publish(reg, "m", vdir,
+                             ladder=tuple(man2["ladder"]))
+            t0 = time.monotonic()
+            how = fleet.swap_in_place("m")
+            swap_s = time.monotonic() - t0
+            steady = pipeline.drain_stage_timers()
+            misses = int(steady.get("compile_cache_misses", 0))
+            if how != "swapped":
+                raise RuntimeError(
+                    f"refresh bench: swap fell back to {how!r}")
+
+        # the fallback price: a cold FleetService re-warming the same
+        # HEAD from scratch (same process, same compile cache — this
+        # is the best case the evict+re-warm path can manage)
+        t0 = time.monotonic()
+        with FleetService(reg, workspace_root=ms,
+                          hbm_budget_mb=0) as fleet2:
+            fleet2.start(["m"])
+            rewarm_s = time.monotonic() - t0
+        _log(f"[refresh] swap {swap_s * 1e3:.1f}ms vs re-warm "
+             f"{rewarm_s:.2f}s, {misses} swap compile misses")
+
+        rec = {"breach_to_promoted_s": round(breach_to_promoted_s, 3),
+               "swap_s": round(swap_s, 4),
+               "rewarm_s": round(rewarm_s, 4),
+               "swap_compile_misses": misses,
+               "guardrail": guardrail}
+        assert set(rec) == set(REFRESH_FIELDS), (
+            "refresh record drifted from profiling.REFRESH_FIELDS")
+        _persist("refresh", jax.default_backend(), rec)
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def task_cpu_denom():
     """Measured same-host CPU denominator: nn / nn_wide / gbt bench
     shapes on the JAX CPU backend (this host), giving vs_baseline a
@@ -2309,6 +2436,8 @@ def main():
         return task_serving()
     if args.task == "fleet":
         return task_fleet()
+    if args.task == "refresh":
+        return task_refresh()
     if args.task == "rf":
         return task_rf()
     if args.task == "cpu_denom":

@@ -243,23 +243,29 @@ def cmd_registry(args) -> int:
 
 
 def cmd_watch(args) -> int:
-    """`shifu watch --monitor-only` — the long-running model health
-    loop: rolling PSI/KS drift over data arriving at the training
-    dataPath, SLO guardrail evaluation with alerting, everything
-    persisted to the metrics store (and span-traced, so `shifu top`
-    shows the loop live). The retrain/promote half of ROADMAP item 1
-    is a documented seam (obs.health.watch.on_breach), hence the
-    required flag."""
-    if not args.monitor_only:
-        raise SystemExit(
-            "watch: only --monitor-only is implemented — the "
-            "drift-triggered retrain/promote loop is the named seam "
-            "obs.health.watch.on_breach (ROADMAP item 1, next PR)")
+    """`shifu watch` — the long-running model health loop: rolling
+    PSI/KS drift over data arriving at the training dataPath, SLO
+    guardrail evaluation with alerting, everything persisted to the
+    metrics store (and span-traced, so `shifu top` shows the loop
+    live). Full mode additionally closes ROADMAP item 1's loop: every
+    breach schedules a warm-start retrain in a challenger workspace,
+    an eval guardrail vs the incumbent, an atomic registry promotion
+    and — when --registry/--model-name bind it to a published model —
+    instant rollback on a failed swap. `--monitor-only` keeps the old
+    alert-only behavior."""
     from shifu_tpu.obs.health import watch as watch_mod
+    ctx = _ctx(args)
+    refresh = None
+    if not args.monitor_only:
+        from shifu_tpu.obs.health.refresh import RefreshController
+        refresh = RefreshController(
+            ctx, registry_root=args.registry, model_name=args.model_name,
+            eval_name=args.eval_set)
     return watch_mod.run_monitor(
-        _ctx(args),
+        ctx,
         interval_s=args.interval_s,
-        iterations=args.iterations if args.iterations > 0 else None)
+        iterations=args.iterations if args.iterations > 0 else None,
+        refresh=refresh)
 
 
 _SPARK_BARS = "▁▂▃▄▅▆▇█"
@@ -517,7 +523,8 @@ def _top_render(root: str) -> str:
     try:
         from shifu_tpu.obs.health import store as health_store
         events = health_store.store(root).events(
-            limit=5, names=["drift", "breach", "warn", "recovered"])
+            limit=5, names=["drift", "breach", "warn", "recovered",
+                            "refresh"])
         if events:
             lines.append("health/drift events:")
             for ev in events:
@@ -740,8 +747,16 @@ def build_parser() -> argparse.ArgumentParser:
                        help="long-running model health monitor "
                             "(rolling drift + SLO guardrails)")
     p.add_argument("--monitor-only", action="store_true",
-                   help="drift/SLO monitoring without the retrain "
-                        "trigger (currently the only mode)")
+                   help="drift/SLO monitoring without the "
+                        "drift-triggered retrain loop")
+    p.add_argument("--registry", default=None,
+                   help="registry root to promote refreshed models "
+                        "into (with --model-name)")
+    p.add_argument("--model-name", default=None,
+                   help="registry model name bound to this model set")
+    p.add_argument("--eval-set", default=None,
+                   help="eval set for the refresh guardrail (default: "
+                        "first configured)")
     p.add_argument("--interval-s", type=float, default=None,
                    help="tick period (default "
                         "SHIFU_TPU_WATCH_INTERVAL_S)")

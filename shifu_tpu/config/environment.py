@@ -337,6 +337,23 @@ _declare("SHIFU_TPU_DRIFT_THRESHOLD", "float", 0.2,
 _declare("SHIFU_TPU_ALERT_WEBHOOK", "str", None,
          "URL the webhook alert sink POSTs SLO transition records to; "
          "unset = sink disabled")
+_declare("SHIFU_TPU_ALERT_WEBHOOK_TIMEOUT_S", "float", 3.0,
+         "per-attempt connect+read timeout of the webhook alert POST "
+         "(bounded so a dead webhook can never stall a watch tick; "
+         "retried with resilience backoff, then absorbed)")
+_declare("SHIFU_TPU_REFRESH_WINDOW_ROWS", "int", 100_000,
+         "max drifted-window rows the refresh controller keeps (newest "
+         "kept) as the incremental-training window a breach retrains "
+         "on")
+_declare("SHIFU_TPU_REFRESH_TOLERANCE", "float", 0.005,
+         "eval-guardrail tolerance: a challenger whose guardrail "
+         "metric (AUC) is below incumbent - tolerance is HELD, not "
+         "promoted; within-tolerance or better promotes")
+_declare("SHIFU_TPU_REFRESH_COOLDOWN_S", "float", 900.0,
+         "min seconds between breach-scheduled refreshes; breaches "
+         "during an in-flight refresh or inside the cooldown are "
+         "coalesced (counted, visible in `shifu health`), so a "
+         "flapping PSI signal cannot stack retrains")
 # --- bench / tools (read outside the package) ---
 _declare("SHIFU_TPU_BENCH_ATTEMPTS", "int", 2,
          "re-measure attempts per bench workload", scope="bench")

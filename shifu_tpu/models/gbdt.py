@@ -1173,19 +1173,28 @@ def _grad_chunk(y_c, pred_c, w_c, loss: str):
     return gbt_gradients(y_c, pred_c, w_c, loss)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def _apply_contrib_chunk(cfg: TreeConfig, tree, node_c, pred_c):
     """Boosting update for a resident chunk: gather leaf values at the
     routed nodes (_leaf_contrib_chunk) and shrink-add — predictions
-    never leave the device."""
+    never leave the device.
+
+    Deliberately NOT jitted as a whole: under one jit XLA:CPU fuses
+    the shrink-multiply and the accumulate into an FMA, which rounds
+    differently (1 ulp) from the host tier's separate numpy multiply
+    then add — enough to flip a later round's split argmax on ~10% of
+    datasets (the resume-parity failure). Eager mul/add are single-op
+    XLA programs, exactly rounded like numpy, and stay device-side
+    (no host sync); only the gather is worth a jit."""
     return pred_c + cfg.learning_rate * _leaf_contrib_chunk(
         cfg, tree, node_c)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def _add_predict_chunk(cfg: TreeConfig, tree, binsT_c, vraw_c):
     """Add one tree's shrunk prediction on a freshly-streamed bins
-    chunk to a device-resident raw-score chunk (val scores / resume)."""
+    chunk to a device-resident raw-score chunk (val scores / resume).
+    Not jitted for the same FMA-parity reason as
+    `_apply_contrib_chunk` — the host tier computes `lr * predict`
+    and the add as two exactly-rounded ops."""
     return vraw_c + cfg.learning_rate * _predict_chunk(cfg, tree,
                                                        binsT_c)
 

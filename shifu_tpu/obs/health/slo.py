@@ -38,7 +38,7 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
-from shifu_tpu.config.environment import knob_str
+from shifu_tpu.config.environment import knob_float, knob_str
 from shifu_tpu.obs.health import store as health_store
 
 log = logging.getLogger(__name__)
@@ -121,16 +121,38 @@ def file_sink(record: Dict, root: Optional[str] = None) -> None:
 
 
 def webhook_sink(record: Dict) -> None:
-    """POST the record to SHIFU_TPU_ALERT_WEBHOOK (stdlib urllib; a
-    stub for PagerDuty/Slack-style receivers). No knob → no-op."""
+    """POST the record to SHIFU_TPU_ALERT_WEBHOOK (PagerDuty/Slack-
+    style receivers). No knob → no-op.
+
+    Each attempt is a bounded-timeout HTTP POST
+    (SHIFU_TPU_ALERT_WEBHOOK_TIMEOUT_S connect+read) retried through
+    `resilience.retrying` (`obs.webhook` site: exponential backoff,
+    SHIFU_TPU_RETRY_ATTEMPTS tries) — then the final failure raises
+    OUT of this sink and is absorbed by `SloEvaluator.alert`'s
+    per-sink `obs.alert` guard, so an unreachable webhook can never
+    fail a watch tick, only log."""
     url = knob_str("SHIFU_TPU_ALERT_WEBHOOK")
     if not url:
         return
-    import urllib.request
-    req = urllib.request.Request(
-        url, data=json.dumps(record).encode(),
-        headers={"Content-Type": "application/json"})
-    urllib.request.urlopen(req, timeout=5.0).close()
+    from shifu_tpu.resilience import retrying
+
+    timeout_s = float(knob_float("SHIFU_TPU_ALERT_WEBHOOK_TIMEOUT_S"))
+    body = json.dumps(record).encode()
+
+    def _post() -> None:
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=timeout_s)
+        try:
+            status = getattr(resp, "status", 200)
+            if int(status) >= 400:   # paranoid: urlopen raises on 4xx/5xx
+                raise OSError(f"webhook POST returned {status}")
+        finally:
+            resp.close()
+
+    retrying("obs.webhook", _post)
 
 
 class SloEvaluator:
@@ -261,5 +283,6 @@ def health_state(root: str) -> Dict:
         slos.append(dict(name=slo["name"], metric=slo["metric"],
                          state=state, value=value,
                          samples=len(series)))
-    events = st.events(limit=5, names=["breach", "warn", "recovered"])
+    events = st.events(limit=5, names=["breach", "warn", "recovered",
+                                       "refresh"])
     return {"status": worst, "slos": slos, "recent_events": events}

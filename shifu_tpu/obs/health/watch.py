@@ -16,13 +16,12 @@ The loop honors the shared preemption contract
 (`resilience.graceful_shutdown`): SIGTERM finishes the current tick
 and exits cleanly with everything flushed.
 
-RETRAIN-TRIGGER SEAM: `on_breach` is where ROADMAP item 1's second
-half plugs in — a breach of the drift SLO should schedule a
-warm-start incremental-training DAG (restore via the async-ckpt
-layer, train on the drifted window, eval-guardrail vs the incumbent,
-atomic model promotion). This PR deliberately stops at the breach
-event; `on_breach` only logs the decision point so the next PR can
-replace exactly one function.
+RETRAIN TRIGGER (ROADMAP item 1, closed): pass a
+`refresh.RefreshController` as `run_monitor(..., refresh=...)` and a
+breach schedules the warm-start retrain → eval-guardrail → atomic
+promote → in-place hot-swap pipeline; every observed drift window is
+also fed to the controller as retrain fodder. Without a controller
+`on_breach` only logs that the loop is open (`--monitor-only`).
 """
 
 from __future__ import annotations
@@ -40,12 +39,18 @@ from shifu_tpu.obs.health.slo import SloEvaluator
 log = logging.getLogger(__name__)
 
 
-def on_breach(record: Dict) -> None:
-    """THE SEAM (see module docstring): called once per SLO
-    transition into `breach`. Replace with the warm-start retrain
-    DAG scheduler; until then it only names the decision."""
-    log.warning("breach of %r — retrain trigger not wired yet "
-                "(ROADMAP item 1, next PR)", record.get("slo"))
+def on_breach(record: Dict, refresh=None) -> Optional[str]:
+    """Called once per SLO transition into `breach`. With a
+    `RefreshController` attached this schedules the warm-start
+    retrain → guardrail → promote → swap run (coalesced under
+    cooldown/in-flight hysteresis) and returns its outcome; without
+    one it only logs that the loop is open (`--monitor-only`)."""
+    if refresh is not None:
+        return refresh.handle_breach(record)
+    log.warning("breach of %r — no refresh controller attached "
+                "(monitor-only; run `shifu watch` with --registry/"
+                "--model-name to close the loop)", record.get("slo"))
+    return None
 
 
 def _production_window(ctx, seen_rows: int):
@@ -63,10 +68,12 @@ def _production_window(ctx, seen_rows: int):
 
 def run_monitor(ctx, interval_s: Optional[float] = None,
                 iterations: Optional[int] = None,
-                windows: Optional[Iterable] = None) -> int:
+                windows: Optional[Iterable] = None,
+                refresh=None) -> int:
     """The monitor loop. `iterations` bounds the run (None = until
     SIGTERM); `windows` injects an explicit window sequence (tests,
-    replays) instead of tailing the dataPath."""
+    replays) instead of tailing the dataPath; `refresh` attaches a
+    `RefreshController` so breaches retrain instead of just alert."""
     from shifu_tpu import resilience
 
     root = ctx.path_finder.root
@@ -102,6 +109,8 @@ def run_monitor(ctx, interval_s: Optional[float] = None,
                         resilience.fault_point("watch.window")
                         snap = drift.observe(df)
                     _emit_drift(st, snap)
+                    if refresh is not None:
+                        refresh.note_window(df)
                     windows_ok += 1
                 except Exception as e:  # noqa: BLE001 — absorbed
                     windows_failed += 1
@@ -114,7 +123,7 @@ def run_monitor(ctx, interval_s: Optional[float] = None,
                 slo.evaluate()
             for rec in slo.drain_transitions():
                 if rec["state"] == "breach":
-                    on_breach(rec)
+                    on_breach(rec, refresh)
 
             # 4. persist — absorbed
             st.counter("watch.tick")

@@ -71,8 +71,10 @@ SPAN_FAMILIES: Dict[str, Tuple[str, ...]] = {
     "serve": ("request", "queue", "pad", "h2d", "device", "d2h",
               "flush"),
     # model fleet: one warm span per (re-)warm of a registry model
-    # into residency, one evict span per LRU eviction back to host
-    "fleet": ("warm", "evict"),
+    # into residency, one evict span per LRU eviction back to host,
+    # one swap span per in-place param hot-swap into resident
+    # executables (the refresh loop's zero-recompile promotion)
+    "fleet": ("warm", "evict", "swap"),
     # watched collectives (barrier/allgather/init distinguished by the
     # `tag` attr so watchdog dumps can cite the open span)
     "dist": ("collective",),
@@ -81,6 +83,11 @@ SPAN_FAMILIES: Dict[str, Tuple[str, ...]] = {
     # the health plane's monitor loop: one window span per ingested
     # drift window, one evaluate span per SLO pass
     "watch": ("window", "evaluate"),
+    # drift-triggered refresh: one run span per breach-scheduled
+    # retrain→guardrail→promote cycle, one guardrail span per
+    # challenger-vs-incumbent eval decision, one rollback span per
+    # registry rollback + live re-swap
+    "refresh": ("run", "guardrail", "rollback"),
 }
 
 

@@ -370,7 +370,17 @@ SERVING_FIELDS = ("qps_offered", "qps_sustained", "requests",
 # class p99 latency. tools/check_steps_schema.py pins README docs to
 # this tuple the same way it pins SERVING_FIELDS.
 FLEET_FIELDS = ("models_resident", "evictions", "rewarm_s",
-                "shed_rate", "p99_ms_by_class")
+                "shed_rate", "p99_ms_by_class", "swaps", "swap_s")
+
+# the continuous-refresh bench record schema: bench.py --task refresh
+# builds its JSON record from exactly these keys — wall seconds from
+# the injected breach to the promoted challenger, the in-place swap
+# vs a cold re-warm of the same version, compile-cache misses during
+# the swap (must be zero — the hot path never recompiles), and the
+# guardrail verdict. tools/check_steps_schema.py pins README docs to
+# this tuple the same way it pins FLEET_FIELDS.
+REFRESH_FIELDS = ("breach_to_promoted_s", "swap_s", "rewarm_s",
+                  "swap_compile_misses", "guardrail")
 
 # the pipeline DAG scheduler's record schema: a scheduled step attaches
 # one `dag` block to its steps.jsonl record — DAG_SUMMARY_FIELDS are

@@ -149,7 +149,9 @@ FAULT_SITES = (
     "dist.init", "dist.barrier", "dist.allgather",
     "dist.allreduce_tree",
     "dist.preempt_marker", "dag.node", "obs.export",
-    "obs.metrics_flush", "obs.alert", "watch.window",
+    "obs.metrics_flush", "obs.alert", "obs.webhook", "watch.window",
+    "refresh.schedule", "refresh.guardrail", "refresh.promote",
+    "refresh.swap",
 )
 
 

@@ -143,15 +143,26 @@ def warm_scores(scorer: Any, proto: Dict[str, Optional[np.ndarray]],
 
 
 def aot_compile(scorer: Any, input_dim: int,
-                ladder: Tuple[int, ...]) -> Dict[Tuple[int, int], Any]:
+                ladder: Tuple[int, ...]) -> Tuple[
+                    Dict[Tuple[int, int], Any], Dict[int, Any]]:
     """`jit(forward).lower().compile()` per NN-family model × bucket.
 
-    Returns {(model_index, bucket): compiled_executable}.  Non-jit
-    model kinds (tree walks, external SavedModels) have no persistent
-    executable to pre-build and are skipped — `warm_scores` covers
-    them.  The lowered computation hashes into the persistent XLA
-    compile cache when `profiling.enable_compile_cache` is active, so
-    the next process start of the same service compiles nothing.
+    Returns ``(executables, device_params)``:
+    ``executables[(model_index, bucket)]`` is a compiled executable
+    whose signature is ``exe(params, x)`` — the param pytree is a
+    RUNTIME ARGUMENT, not a baked closure constant — and
+    ``device_params[model_index]`` is the incumbent's pytree already
+    placed on device.  Because the executable only fixes the params'
+    tree structure/shapes/dtypes, a model refresh can place new
+    same-shaped params into the resident executables without touching
+    XLA (`serve.service.ScorerService.swap_params`); shape or dtype
+    changes fail the structural check there and fall back to a full
+    evict/re-warm.  Non-jit model kinds (tree walks, external
+    SavedModels) have no persistent executable to pre-build and are
+    skipped — `warm_scores` covers them.  The lowered computation
+    hashes into the persistent XLA compile cache when
+    `profiling.enable_compile_cache` is active, so the next process
+    start of the same service compiles nothing.
     """
     import jax
     import jax.numpy as jnp
@@ -159,6 +170,7 @@ def aot_compile(scorer: Any, input_dim: int,
     from shifu_tpu.models import nn as nn_mod
 
     out: Dict[Tuple[int, int], Any] = {}
+    dev_params: Dict[int, Any] = {}
     for i, (kind, meta, params) in enumerate(scorer.models):
         if kind not in ("nn", "lr"):
             continue
@@ -167,30 +179,40 @@ def aot_compile(scorer: Any, input_dim: int,
         sd["activations"] = tuple(sd.get("activations", ()))
         spec = nn_mod.MLPSpec(**sd)
         d_params = jax.tree.map(jnp.asarray, params)
+        dev_params[i] = d_params
 
-        def fwd(x, _spec=spec, _params=d_params):
-            return nn_mod.forward(_spec, _params, x)
+        def fwd(p, x, _spec=spec):
+            return nn_mod.forward(_spec, p, x)
 
         # once-per-model AOT compile at service start — the loop IS the
         # compile site, not a hot path
         jitted = jax.jit(fwd)  # lint: disable=jit-in-loop -- AOT warmup compiles each model once at startup
+        p_struct = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), d_params)
         for bucket in ladder:
             shape = jax.ShapeDtypeStruct((bucket, input_dim), jnp.float32)
-            out[(i, bucket)] = jitted.lower(shape).compile()
-    return out
+            out[(i, bucket)] = jitted.lower(p_struct, shape).compile()
+    return out, dev_params
 
 
-def aot_selfcheck(executables: Dict[Tuple[int, int], Any], scorer: Any,
+def aot_selfcheck(executables: Dict[Tuple[int, int], Any],
+                  params_by_model: Dict[int, Any], scorer: Any,
                   proto: Dict[str, Optional[np.ndarray]]) -> None:
     """Assert each AOT executable agrees with the interpretive scoring
     path on the warm-up batch — the compiled artifact doubles as a
-    parity probe for the compile layer."""
+    parity probe for the compile layer.  ``params_by_model`` may carry
+    CANDIDATE params (the refresh swap's parity gate runs challenger
+    params through the resident executables before they go live) — the
+    interpretive reference is recomputed with the same params, so the
+    check is exactly 'resident executable == what a cold re-warm of
+    these params would score'."""
     from shifu_tpu.eval.scorer import score_matrix
 
     for (i, bucket), exe in executables.items():
-        kind, meta, params = scorer.models[i]
+        kind, meta, _ = scorer.models[i]
+        params = params_by_model[i]
         dense = pad_rows(np.asarray(proto["dense"], np.float32), bucket)
-        got = np.asarray(exe(dense)).reshape(-1)
+        got = np.asarray(exe(params, dense)).reshape(-1)
         want = np.asarray(score_matrix(kind, meta, params, dense)).reshape(-1)
         if not np.allclose(got, want, rtol=1e-5, atol=1e-6):
             raise AssertionError(

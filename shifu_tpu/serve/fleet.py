@@ -140,6 +140,8 @@ class FleetService:
         self._admitted = {p: 0 for p in PRIORITIES}
         self._evictions = 0
         self._rewarm_s = 0.0
+        self._swaps = 0
+        self._swap_s = 0.0
 
     # -- residency (HBM budget + LRU) ----------------------------------
     def models(self) -> List[str]:
@@ -211,6 +213,61 @@ class FleetService:
             entry.warmed_once = True
             entry.service = svc
             return svc
+
+    def swap_in_place(self, name: str) -> str:
+        """Hot-promote `name`'s registry HEAD into the running fleet
+        WITHOUT restart or recompile: the new version's params are
+        placed into the resident service's live AOT executables
+        (`ScorerService.swap_params`), parity-gated against a cold
+        re-warm before going live.  Returns what happened:
+
+        - ``"swapped"``  — in-place param swap into the resident
+          executables (zero compile misses; in-flight requests score
+          wholly old-or-new, never mixed);
+        - ``"rewarmed"`` — shapes/dtypes/kinds changed, so the entry
+          was evicted and re-warmed against the new HEAD (the PR-13
+          promote-then-evict seam, now automatic);
+        - ``"cold"``     — the model was not resident; the new HEAD is
+          adopted and warms on its next hit;
+        - ``"noop"``     — already serving HEAD.
+
+        The `refresh.swap` fault point fires before any mutation, so
+        an injected fault here leaves the incumbent version serving
+        untouched.  A parity-gate failure propagates (nothing was
+        mutated) — the refresh controller answers it by rolling the
+        registry HEAD back, keeping HEAD == what is actually serving.
+        """
+        fault_point("refresh.swap")
+        with self._lock:
+            entry = self._entries[name]
+            version, vdir, manifest = registry.resolve(
+                self._registry_root, name)
+            if entry.service is None:
+                fresh = _Entry(name, version, vdir, manifest)
+                fresh.warmed_once = entry.warmed_once
+                self._entries[name] = fresh
+                return "cold"
+            if version == entry.version:
+                return "noop"
+            t0 = time.monotonic()
+            with obs_trace.span("fleet.swap", model=name,
+                                version=version,
+                                from_version=entry.version):
+                swapped = entry.service.swap_params(vdir)
+            if swapped:
+                entry.version = version
+                entry.vdir = vdir
+                entry.manifest = manifest
+                self._swaps += 1
+                self._swap_s += time.monotonic() - t0
+                pipeline.add_stage_time("fleet_swap_s",
+                                        time.monotonic() - t0)
+                return "swapped"
+            # structural change — fall back to evict + re-warm (which
+            # re-resolves HEAD and recompiles/selfchecks from scratch)
+            self._evict_locked(entry)
+            self._ensure_resident(name)
+            return "rewarmed"
 
     def start(self, names: Optional[List[str]] = None) -> "FleetService":
         """Warm `names` (default: every model, in declaration order) up
@@ -336,6 +393,8 @@ class FleetService:
             "models_resident": resident,
             "evictions": self._evictions,
             "rewarm_s": round(self._rewarm_s, 4),
+            "swaps": self._swaps,
+            "swap_s": round(self._swap_s, 4),
             "shed_rate": round(self.shed_rate(), 6),
             "p99_ms_by_class": {
                 p: (round(v, 3) if (v := self._class_p99_ms(p))

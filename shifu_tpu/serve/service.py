@@ -61,6 +61,8 @@ class ScorerService:
             raise ValueError(
                 f"priority must be high|low, got {priority!r}")
         self.priority = priority
+        self._score_selector = score_selector
+        self._gbt_convert = gbt_convert
         # fleet mode labels this service's metric points (model=...)
         self._metrics_tags = dict(metrics_tags or {})
         self._workspace_root = workspace_root
@@ -79,6 +81,11 @@ class ScorerService:
         self.ladder = tuple(ladder) if ladder else aot.bucket_ladder()
         self._aot_enabled = aot_compile
         self._aot_executables: Dict[Tuple[int, int], Any] = {}
+        # incumbent device param pytrees, keyed like the executables'
+        # model index — the swappable half of the AOT artifacts
+        self._aot_params: Dict[int, Any] = {}
+        self._proto: Optional[Dict[str, np.ndarray]] = None
+        self.swaps = 0
         self._batcher = MicroBatcher(self._score_batch,
                                      max_rows=self.ladder[-1],
                                      max_delay=max_delay,
@@ -119,10 +126,12 @@ class ScorerService:
             proto = {k: np.asarray(v) for k, v in proto.items()
                      if v is not None}
             self._schema = frozenset(proto)
+            self._proto = proto
             if self._aot_enabled and "dense" in proto:
-                self._aot_executables = aot.aot_compile(
+                self._aot_executables, self._aot_params = aot.aot_compile(
                     self.scorer, int(proto["dense"].shape[1]), self.ladder)
-                aot.aot_selfcheck(self._aot_executables, self.scorer, proto)
+                aot.aot_selfcheck(self._aot_executables, self._aot_params,
+                                  self.scorer, proto)
             self._warmed_buckets = aot.warm_scores(
                 self.scorer, proto, self.ladder, norm=self.norm)
             self._warm_s = time.monotonic() - t0
@@ -206,6 +215,76 @@ class ScorerService:
                      ) -> Tuple[Dict[str, np.ndarray], Dict[str, float]]:
         req = self.submit_async(**blocks)
         return req.wait(timeout), dict(req.timing)
+
+    # -- hot refresh ----------------------------------------------------
+    def swap_params(self, models_dir: str,
+                    model_paths: Optional[List[str]] = None) -> bool:
+        """In-place hot swap: load the challenger ensemble from
+        `models_dir` and place its params into the RESIDENT compiled
+        executables — no recompile, no restart, no dropped request.
+
+        Structural gate first: same model count, same kinds, same
+        NN-family spec, and per-model param pytrees with identical tree
+        structure + leaf shapes + dtypes.  Any mismatch returns False
+        and mutates NOTHING — the caller falls back to the evict/
+        re-warm path.  A candidate that passes is then parity-gated
+        through `aot.aot_selfcheck` with the NEW params: the resident
+        executables must score them exactly as a cold re-warm would
+        (`score_matrix` recomputed with the same params) before the
+        swap goes live.  The flip itself is one attribute store of the
+        new models list, so a concurrently-scoring batch reads wholly
+        old or wholly new params — never a mix.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        challenger = Scorer.from_dir(models_dir, model_paths,
+                                     score_selector=self._score_selector,
+                                     gbt_convert=self._gbt_convert)
+        old = self.scorer.models
+        new = challenger.models
+        if len(old) != len(new):
+            return False
+        for (ok_, om, op), (nk, nm, np_) in zip(old, new):
+            if ok_ != nk:
+                return False
+            if ok_ in ("nn", "lr") and om.get("spec") != nm.get("spec"):
+                return False
+            try:
+                ot = jax.tree_util.tree_structure(op)
+                nt = jax.tree_util.tree_structure(np_)
+            except Exception:  # noqa: BLE001 — unhashable/foreign params
+                return False
+            if ot != nt:
+                return False
+            ol = jax.tree_util.tree_leaves(op)
+            nl = jax.tree_util.tree_leaves(np_)
+            for a, b in zip(ol, nl):
+                a, b = np.asarray(a), np.asarray(b)
+                if a.shape != b.shape or a.dtype != b.dtype:
+                    return False
+
+        # device-place the challenger params for every model the AOT
+        # layer compiled; parity-gate through the LIVE executables
+        cand: Dict[int, Any] = {}
+        for i, (kind, meta, params) in enumerate(new):
+            if i in self._aot_params or (self._aot_enabled and
+                                         kind in ("nn", "lr")):
+                cand[i] = jax.tree.map(jnp.asarray, params)
+        if self._aot_executables and self._proto is not None \
+                and "dense" in self._proto:
+            check = dict(self._aot_params)
+            check.update(cand)
+            aot.aot_selfcheck(self._aot_executables, check,
+                              self.scorer, self._proto)
+
+        new_list = [(kind, meta, cand.get(i, params))
+                    for i, (kind, meta, params) in enumerate(new)]
+        # one store — concurrent _score_batch reads old-or-new, never mixed
+        self.scorer.models = new_list
+        self._aot_params.update(cand)
+        self.swaps += 1
+        return True
 
     # -- device consumer (batcher thread) ------------------------------
     def _score_batch(self, batch: List[Request]) -> None:
@@ -293,6 +372,7 @@ class ScorerService:
             "warm_s": self._warm_s,
             "warmed_buckets": self._warmed_buckets,
             "aot_executables": len(self._aot_executables),
+            "swaps": self.swaps,
             "rejected": self._rejected,
             "rejected_by_class": dict(self.rejected_by_class),
             "latency": pct,

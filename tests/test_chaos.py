@@ -9,8 +9,13 @@ CLI pipeline and hold the hang-proofing contract:
   injected crash is always recoverable by rerunning).
 
 ``tools/chaos_sweep.sh`` runs the full matrix — every registered site,
-a complete init→stats→norm→train→eval pipeline per site; this module
-is the in-tree subset kept fast enough for tier-1.
+a complete init→stats→norm→train→eval pipeline per site (the
+``refresh.*`` sites get a closed-loop breach→promote drill there
+instead, since the batch pipeline never reaches them); this module is
+the in-tree subset kept fast enough for tier-1. The ``refresh.*``
+class is drilled per-site in ``tests/test_refresh.py`` (in-process
+fault, rerun-recovers, swap rollback, and SIGKILL across a process
+boundary) — also tier-1.
 """
 
 import os

@@ -340,8 +340,8 @@ def test_watch_drill_breach_visible_in_health_top_and_trace(
         assert cli_main(["--dir", model_set, "watch", "--monitor-only",
                          "--iterations", "1", "--interval-s", "0"]) == 0
     monkeypatch.delenv("SHIFU_TPU_TRACE")
-    # the breach hit the documented retrain seam (ROADMAP item 1)
-    assert "retrain trigger not wired yet" in caplog.text
+    # monitor-only leaves the retrain loop open and says so
+    assert "no refresh controller attached" in caplog.text
 
     # 1. persisted: drift + breach events and the psi gauge on DISK
     # (a fresh store instance — restart-visible, not buffer state)
@@ -377,10 +377,37 @@ def test_watch_drill_breach_visible_in_health_top_and_trace(
     assert win["args"]["rows"] == len(df)
 
 
-def test_watch_without_monitor_only_names_the_seam(tmp_path):
+def test_watch_full_mode_routes_breach_to_refresh(tmp_path, monkeypatch):
+    """`shifu watch` (no --monitor-only) attaches a RefreshController
+    and a breach lands in its handle_breach — the loop is closed."""
+    from shifu_tpu.obs.health import refresh as refresh_mod
+
     model_set = _tiny_model_set(tmp_path)
-    with pytest.raises(SystemExit, match="obs.health.watch.on_breach"):
-        cli_main(["--dir", model_set, "watch"])
+    for cmd in (["init"], ["stats"]):
+        assert cli_main(["--dir", model_set] + cmd) == 0
+    df, _ = _raw_frame(model_set)
+    _shift_numerics(df).to_csv(
+        os.path.join(model_set, "data", "part-00000"), sep="|",
+        header=False, index=False)
+    with open(os.path.join(model_set, "slo.json"), "w") as f:
+        json.dump({"slos": [
+            {"name": "drift", "metric": "drift.psi_max", "op": "<=",
+             "warn": 0.05, "breach": 0.2, "window_s": 86400.0,
+             "agg": "last"}]}, f)
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    calls = []
+    monkeypatch.setattr(
+        refresh_mod.RefreshController, "handle_breach",
+        lambda self, rec: calls.append(rec) or "promoted")
+    noted = []
+    monkeypatch.setattr(
+        refresh_mod.RefreshController, "note_window",
+        lambda self, w: noted.append(len(w)))
+    assert cli_main(["--dir", model_set, "watch",
+                     "--iterations", "1", "--interval-s", "0"]) == 0
+    assert calls and calls[0]["state"] == "breach"
+    # every observed window also fed the controller as retrain fodder
+    assert noted == [len(df)]
 
 
 # ---------------------------------------------------------------------------
@@ -468,3 +495,100 @@ def test_bench_regress_flags_drop_and_bound_flip(tmp_path):
     log = _bench_log(tmp_path, rec(1, 100.0), rec(2, 10.0))
     assert br.main(["--log", log]) == 0
     assert br.main(["--log", str(tmp_path / "absent.jsonl")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# webhook alert sink: a REAL bounded-timeout HTTP POST, retried through
+# the obs.webhook site, absorbed by the alert fan-out when dead
+# ---------------------------------------------------------------------------
+
+def _webhook_server():
+    import http.server
+    import threading
+    received = []
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *_a):   # keep pytest output quiet
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, received
+
+
+def test_webhook_sink_posts_and_retries_through_fault(monkeypatch):
+    """The sink delivers the breach record to a live receiver, and a
+    transient fault at the obs.webhook site is retried away — the
+    POST still lands."""
+    from shifu_tpu.obs.health import slo as slo_mod
+    srv, received = _webhook_server()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/alert"
+        monkeypatch.setenv("SHIFU_TPU_ALERT_WEBHOOK", url)
+        monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.01")
+        slo_mod.webhook_sink({"slo": "drift", "state": "breach",
+                              "value": 0.41})
+        assert received and received[-1]["slo"] == "drift"
+        monkeypatch.setenv("SHIFU_TPU_FAULT", "obs.webhook:oserror:1")
+        resilience.reset_faults()
+        slo_mod.webhook_sink({"slo": "auc", "state": "warn"})
+        assert received[-1]["slo"] == "auc"
+        assert len(received) == 2   # retry did not double-deliver
+    finally:
+        srv.shutdown()
+
+
+def test_dead_webhook_never_fails_the_watch_tick(tmp_path, monkeypatch,
+                                                 caplog):
+    """Nothing listens on the configured port: the bounded timeout +
+    retry budget exhausts, the failure raises out of the sink, and the
+    alert fan-out ABSORBS it — the transition still reaches the other
+    sinks (alerts.jsonl) and the caller never sees an error."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("SHIFU_TPU_ALERT_WEBHOOK",
+                       f"http://127.0.0.1:{port}/alert")
+    monkeypatch.setenv("SHIFU_TPU_ALERT_WEBHOOK_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_ATTEMPTS", "2")
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.01")
+    root = str(tmp_path)
+    ev = SloEvaluator(root, slos=[], clear=1)
+    with caplog.at_level(logging.WARNING):
+        ev.alert({"slo": "lat", "state": "breach", "value": 999.0})
+    assert "webhook_sink" in caplog.text and "absorbed" in caplog.text
+    alerts = os.path.join(root, "tmp", "metrics", "alerts.jsonl")
+    recs = [json.loads(l) for l in open(alerts) if l.strip()]
+    assert recs and recs[-1]["slo"] == "lat"
+
+
+def test_bench_regress_gates_refresh_invariants(tmp_path):
+    """The refresh record's gates are absolute (no trailing history
+    needed): swap cheaper than re-warm, zero swap compile misses,
+    guardrail verdict promote."""
+    import importlib
+    br = importlib.import_module("tools.bench_regress")
+
+    def rec(**kw):
+        r = {"task": "refresh", "backend": "cpu", "ts": 1,
+             "breach_to_promoted_s": 30.0, "swap_s": 0.01,
+             "rewarm_s": 1.2, "swap_compile_misses": 0,
+             "guardrail": {"decision": "promote"}}
+        r.update(kw)
+        return r
+
+    assert br.main(["--log", _bench_log(tmp_path, rec())]) == 0
+    assert br.main(["--log", _bench_log(
+        tmp_path, rec(swap_s=2.0))]) == 1           # lost to re-warm
+    assert br.main(["--log", _bench_log(
+        tmp_path, rec(swap_compile_misses=3))]) == 1  # swap recompiled
+    assert br.main(["--log", _bench_log(
+        tmp_path, rec(guardrail={"decision": "hold"}))]) == 1

@@ -101,6 +101,31 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
         newest, history = recs[-1], recs[:-1]
         tp = _throughput(newest)
         label = f"{task}/{backend}"
+        # continuous-refresh records (bench --task refresh) carry no
+        # throughput key; their gates are absolute invariants, checked
+        # BEFORE the throughput skip: the hot in-place swap must stay
+        # cheaper than the evict+re-warm fallback it replaces, must
+        # never recompile, and only guardrail-promoted challengers may
+        # appear in a published record
+        if task == "refresh":
+            sw, rw = newest.get("swap_s"), newest.get("rewarm_s")
+            if isinstance(sw, (int, float)) and \
+                    isinstance(rw, (int, float)) and sw > rw:
+                findings.append(
+                    f"{label}: swap_s {sw:.4g} exceeds rewarm_s "
+                    f"{rw:.4g} — the in-place swap lost to the "
+                    "evict+re-warm fallback")
+            scm = newest.get("swap_compile_misses")
+            if isinstance(scm, (int, float)) and scm > 0:
+                findings.append(
+                    f"{label}: swap_compile_misses {scm:g} — the hot "
+                    "swap recompiled resident executables")
+            gr = newest.get("guardrail")
+            if isinstance(gr, dict) and gr.get("decision") != "promote":
+                findings.append(
+                    f"{label}: guardrail decision "
+                    f"{gr.get('decision')!r} in a published refresh "
+                    "record — only promoted runs belong in the log")
         if tp is None:
             print(f"  {label}: no throughput key — skipped")
             continue

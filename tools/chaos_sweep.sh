@@ -46,6 +46,54 @@ print(make_model_set(sys.argv[1], np.random.default_rng(7), n_rows=300,
 PYEOF
 }
 
+run_refresh_drill() {  # $1 = model-set dir, $2 = site; the standard
+  # init->eval pipeline never reaches the refresh.* sites, so they get
+  # the closed-loop drill: train+publish an incumbent, warm a fleet,
+  # inject the fault into a breach-triggered refresh, and hold the
+  # invariant — the incumbent keeps serving and HEAD is either unmoved
+  # or cleanly rolled back, with no .tmp residue.
+  python - "$1" "$2" <<'PYEOF'
+import os, sys
+import numpy as np
+ms, site = sys.argv[1], sys.argv[2]
+from shifu_tpu.cli import main as cli_main
+for cmd in ("init", "stats", "norm", "train"):
+    assert cli_main(["--dir", ms, cmd]) == 0, cmd
+from shifu_tpu import registry, resilience
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.serve.fleet import FleetService
+from shifu_tpu.obs.health.refresh import RefreshController
+import pandas as pd
+reg = os.path.join(os.path.dirname(ms), "reg")
+v1 = registry.publish(reg, "m", os.path.join(ms, "models"), ladder=(1, 4))
+hdr = open(os.path.join(ms, "data", ".pig_header")).read().strip().split("|")
+df = pd.read_csv(os.path.join(ms, "data", "part-00000"), sep="|",
+                 names=hdr, dtype=str)
+with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+    _, _, man = registry.resolve(reg, "m")
+    x = np.random.default_rng(3).normal(
+        0, 1, (2, man["input_dim"])).astype(np.float32)
+    ctl = RefreshController(ProcessorContext.load(ms), registry_root=reg,
+                            model_name="m", fleet=fleet, tolerance=0.5)
+    ctl.note_window(df)
+    resilience.reset_faults()
+    outcome = ctl.handle_breach({"slo": "drift", "state": "breach"})
+    # invariant: whatever the fault did, the fleet still answers and
+    # HEAD names a complete version
+    fleet.submit("m", dense=x)
+    head = registry.head(reg, "m")
+    assert head is not None
+    registry.resolve(reg, "m")   # raises if HEAD dangles
+    if outcome not in ("promoted",):
+        assert head == v1, (outcome, head)
+stranded = [os.path.join(d, f) for d, _, fs in os.walk(ms)
+            for f in fs if f.startswith(".tmp.")]
+assert not stranded, stranded
+print(f"refresh drill at {site}: outcome={outcome}, HEAD={head}, "
+      "incumbent kept serving")
+PYEOF
+}
+
 pass=0 fail=0 hang=0
 declare -a HUNG BROKE
 
@@ -56,13 +104,24 @@ for site in $SITES; do
 
   log="$WORK/$site.log"
   rc=0
-  for cmd in init stats norm train eval; do
-    SHIFU_TPU_FAULT="$site:$KIND:1" \
-      timeout -k 10 "$PER_SITE_TIMEOUT" \
-      python -m shifu_tpu.cli --dir "$ms" "$cmd" >>"$log" 2>&1
-    rc=$?
-    [ "$rc" -ne 0 ] && break
-  done
+  case "$site" in
+    refresh.*)
+      SHIFU_TPU_FAULT="$site:$KIND:1" \
+        timeout -k 10 "$PER_SITE_TIMEOUT" \
+        bash -c "$(declare -f run_refresh_drill); run_refresh_drill '$ms' '$site'" \
+        >>"$log" 2>&1
+      rc=$?
+      ;;
+    *)
+      for cmd in init stats norm train eval; do
+        SHIFU_TPU_FAULT="$site:$KIND:1" \
+          timeout -k 10 "$PER_SITE_TIMEOUT" \
+          python -m shifu_tpu.cli --dir "$ms" "$cmd" >>"$log" 2>&1
+        rc=$?
+        [ "$rc" -ne 0 ] && break
+      done
+      ;;
+  esac
 
   if [ "$rc" -eq 0 ]; then
     echo "PASS  $site (fault absorbed, pipeline succeeded)"

@@ -54,6 +54,11 @@ task_dist_stats builds its record from ``profiling.SHARD_FIELDS``,
 every member must be README-documented, and bench.py must reference
 the tuple.
 
+The continuous-refresh bench is pinned likewise: bench.py
+task_refresh builds its record from ``profiling.REFRESH_FIELDS``,
+every member must be README-documented (the Continuous refresh
+section), and bench.py must reference the tuple.
+
 The health plane is pinned likewise: every metrics.jsonl point is
 ``profiling.METRIC_FIELDS`` (built by obs/health/store.py), every SLO
 record is ``profiling.HEALTH_FIELDS`` (built by obs/health/slo.py),
@@ -97,7 +102,7 @@ def documented_fields() -> set:
         set(fleet_fields()) | set(dag_fields()) | \
         set(dag_summary_fields()) | set(trace_fields()) | \
         set(metric_fields()) | set(health_fields()) | \
-        set(shard_fields())
+        set(shard_fields()) | set(refresh_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -188,6 +193,10 @@ def health_fields() -> tuple:
 
 def shard_fields() -> tuple:
     return _profiling_tuple("SHARD_FIELDS")
+
+
+def refresh_fields() -> tuple:
+    return _profiling_tuple("REFRESH_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -376,6 +385,33 @@ def check_shard_docs() -> int:
     return 0
 
 
+def check_refresh_docs() -> int:
+    """Every REFRESH_FIELDS member (bench.py task_refresh's record
+    schema, the breach→promote closed-loop bench) must be
+    backtick-documented in README's Continuous refresh section, and
+    task_refresh must build its record from the tuple — the literal
+    check asserts bench.py references `REFRESH_FIELDS` so the record
+    cannot silently drift from the pinned schema."""
+    fields = refresh_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("refresh schema drift: REFRESH_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "REFRESH_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the refresh record from "
+              "profiling.REFRESH_FIELDS", file=sys.stderr)
+        return 1
+    print(f"continuous refresh: all {len(fields)} REFRESH_FIELDS "
+          "documented in README and pinned in bench.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -440,6 +476,8 @@ def main(argv) -> int:
     if check_health_docs():
         return 1
     if check_shard_docs():
+        return 1
+    if check_refresh_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
