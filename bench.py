@@ -130,6 +130,15 @@ SERVE_MIX = (1, 4, 16, 64)
 REFRESH_BENCH_ROWS = 2000
 REFRESH_BENCH_EPOCHS = 12
 
+# streaming-ingest bench (data/ingest.py row log): enough rows that
+# the append path amortizes segment seals, appended in trickle-sized
+# batches as a feed would deliver them; small segments so the
+# throughput number includes real seal (sha256 + two-rename commit)
+# work, not just buffering
+INGEST_BENCH_ROWS = 20_000
+INGEST_BENCH_BATCH = 64
+INGEST_BENCH_SEGMENT_ROWS = 2048
+
 # v5e HBM bandwidth (GB/s) for the roofline estimate in extra
 TPU_HBM_GBPS = 819.0
 
@@ -1971,6 +1980,118 @@ def task_refresh():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def task_ingest():
+    """Streaming-ingest bench: sustained append throughput through the
+    sealing row log (data/ingest.py) and the end-to-end breach-
+    detection latency — wall seconds from appending a drifted batch to
+    the drift monitor flagging a breach off a committed exactly-once
+    `read_window`. Also replays the breach window's committed range
+    through a FRESH RowLog handle and records whether the re-read was
+    byte-identical (the exactly-once audit invariant
+    tools/bench_regress.py gates). Record keys are pinned by
+    profiling.INGEST_FIELDS."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.data.ingest import (RowLog, WATCH_CONSUMER,
+                                       frame_from_rows, rows_from_frame)
+    from shifu_tpu.obs.health.drift import RollingDrift
+    from shifu_tpu.processor.base import ProcessorContext
+    from shifu_tpu.profiling import INGEST_FIELDS
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.synth import make_model_set
+
+    tmp = tempfile.mkdtemp(prefix="shifu_ingest_bench_")
+    try:
+        rng = np.random.default_rng(16)
+        ms = make_model_set(os.path.join(tmp, "set"), rng, n_rows=600)
+        for cmd in ("init", "stats"):   # freeze the drift baseline bins
+            if cli_main(["--dir", ms, cmd]) != 0:
+                raise RuntimeError(f"ingest bench: {cmd} failed")
+        header = open(os.path.join(ms, "data", ".pig_header")) \
+            .read().strip().split("|")
+        base = [l.rstrip("\n") for l in
+                open(os.path.join(ms, "data", "part-00000"))]
+
+        log_root = os.path.join(tmp, "rowlog")
+        rl = RowLog(log_root, header=header, delimiter="|",
+                    partitions=2,
+                    segment_rows=INGEST_BENCH_SEGMENT_ROWS)
+
+        # 1. sustained append rows/s, trickle batches, seals included
+        feed = [base[i % len(base)] for i in range(INGEST_BENCH_ROWS)]
+        t0 = time.monotonic()
+        for i in range(0, len(feed), INGEST_BENCH_BATCH):
+            rl.append(feed[i:i + INGEST_BENCH_BATCH])
+        rl.seal_all()
+        append_s = time.monotonic() - t0
+        rows_per_s = INGEST_BENCH_ROWS / max(append_s, 1e-9)
+        _log(f"[ingest] {INGEST_BENCH_ROWS} rows in {append_s:.2f}s "
+             f"({rows_per_s:,.0f} rows/s)")
+
+        # drain the backlog so the latency clock below measures only
+        # the drifted batch's path, not baseline chew
+        while True:
+            win = rl.read_window(WATCH_CONSUMER)
+            if win is None:
+                break
+            rl.commit(WATCH_CONSUMER, win.end)
+
+        # 2. breach latency: append a drifted batch (every num_* value
+        # +5.0 piles into the top frozen bin → large PSI) and clock
+        # until the monitor's snapshot flags it off a committed window
+        drift = RollingDrift(ProcessorContext.load(ms))
+        df = frame_from_rows(base[:512], header, "|")
+        for col in df.columns:
+            if col.startswith("num_"):
+                df[col] = [f"{float(s) + 5.0:.6f}" if s not in
+                           ("", "?") else s for s in df[col]]
+        drifted_rows = rows_from_frame(df, "|")
+        t0 = time.monotonic()
+        rl.append(drifted_rows)
+        rl.seal_all()
+        start = rl.committed_offset(WATCH_CONSUMER)
+        win = rl.read_window(WATCH_CONSUMER)
+        snap = drift.observe(frame_from_rows(win.lines, header, "|"))
+        rl.commit(WATCH_CONSUMER, win.end)
+        breach_latency_s = time.monotonic() - t0
+        if not snap["drifted"]:
+            raise RuntimeError(
+                f"ingest bench: drifted batch not flagged "
+                f"(psi_max={snap['psi_max']:.3f})")
+        _log(f"[ingest] breach detected in {breach_latency_s * 1e3:.1f}"
+             f"ms (psi_max {snap['psi_max']:.3f})")
+
+        # 3. exactly-once audit: the committed range re-read through a
+        # FRESH handle must be byte-identical to what was observed
+        def _digest(lines):
+            return hashlib.sha256(
+                "\n".join(lines).encode("utf-8")).hexdigest()
+        replay = RowLog(log_root).read_range(start, win.end)
+        bitwise = _digest(replay) == _digest(win.lines)
+
+        segments = sum(p["sealed_segments"]
+                       for p in rl.inventory()["partitions"])
+        rec = {"rows": INGEST_BENCH_ROWS,
+               "rows_per_s": round(rows_per_s, 1),
+               "segments": segments,
+               "breach_latency_s": round(breach_latency_s, 4),
+               "bitwise_identical": bitwise}
+        assert set(rec) == set(INGEST_FIELDS), (
+            "ingest record drifted from profiling.INGEST_FIELDS")
+        _persist("ingest", jax.default_backend(), rec)
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def task_cpu_denom():
     """Measured same-host CPU denominator: nn / nn_wide / gbt bench
     shapes on the JAX CPU backend (this host), giving vs_baseline a
@@ -2438,6 +2559,8 @@ def main():
         return task_fleet()
     if args.task == "refresh":
         return task_refresh()
+    if args.task == "ingest":
+        return task_ingest()
     if args.task == "rf":
         return task_rf()
     if args.task == "cpu_denom":

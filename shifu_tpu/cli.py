@@ -242,6 +242,22 @@ def cmd_registry(args) -> int:
     raise SystemExit(f"registry: unknown action {args.action!r}")
 
 
+def cmd_ingest(args) -> int:
+    """`shifu ingest` — durable streaming row-log tooling (the ingest
+    twin of `shifu ckpt`): `ingest ls` prints a JSON inventory of one
+    log — partitions with sealed/open segment counts, total sealed
+    rows, and every consumer's committed offset plus its lag in rows.
+    Pure file operations — no device is touched."""
+    import json as _json
+
+    from shifu_tpu.data.ingest import RowLog
+
+    if args.action == "ls":
+        print(_json.dumps(RowLog(args.log).inventory(), indent=1))
+        return 0
+    raise SystemExit(f"ingest: unknown action {args.action!r}")
+
+
 def cmd_watch(args) -> int:
     """`shifu watch` — the long-running model health loop: rolling
     PSI/KS drift over data arriving at the training dataPath, SLO
@@ -255,17 +271,21 @@ def cmd_watch(args) -> int:
     alert-only behavior."""
     from shifu_tpu.obs.health import watch as watch_mod
     ctx = _ctx(args)
+    ingest_log = None
+    if args.ingest:
+        from shifu_tpu.data.ingest import RowLog
+        ingest_log = RowLog(args.ingest)
     refresh = None
     if not args.monitor_only:
         from shifu_tpu.obs.health.refresh import RefreshController
         refresh = RefreshController(
             ctx, registry_root=args.registry, model_name=args.model_name,
-            eval_name=args.eval_set)
+            eval_name=args.eval_set, ingest_log=ingest_log)
     return watch_mod.run_monitor(
         ctx,
         interval_s=args.interval_s,
         iterations=args.iterations if args.iterations > 0 else None,
-        refresh=refresh)
+        refresh=refresh, ingest_log=ingest_log)
 
 
 _SPARK_BARS = "▁▂▃▄▅▆▇█"
@@ -763,7 +783,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--iterations", type=int, default=0,
                    help="stop after N ticks (0 = run until "
                         "SIGTERM/SIGINT)")
+    p.add_argument("--ingest", default=None, metavar="LOG",
+                   help="consume drift windows from this durable row "
+                        "log (data/ingest.py) with exactly-once "
+                        "offset commits instead of the deprecated "
+                        "dataPath tail")
     p.set_defaults(fn=cmd_watch)
+    p = sub.add_parser("ingest",
+                       help="streaming row-log tooling: `ingest ls` "
+                            "prints partitions, segments and "
+                            "per-consumer offsets/lag as JSON")
+    p.add_argument("action", choices=["ls"])
+    p.add_argument("--log", required=True, metavar="DIR",
+                   help="row-log root (local path or scheme:// URL)")
+    p.set_defaults(fn=cmd_ingest)
     p = sub.add_parser("health",
                        help="SLO health over the metrics store: "
                             "status, trends, recent breaches")

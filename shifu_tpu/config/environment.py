@@ -354,6 +354,18 @@ _declare("SHIFU_TPU_REFRESH_COOLDOWN_S", "float", 900.0,
          "during an in-flight refresh or inside the cooldown are "
          "coalesced (counted, visible in `shifu health`), so a "
          "flapping PSI signal cannot stack retrains")
+_declare("SHIFU_TPU_INGEST_SEGMENT_ROWS", "int", 4096,
+         "rows a row-log partition buffers before its open segment "
+         "seals into an immutable seg-*.rows file (data/ingest.py; "
+         "smaller = lower latency to readers, more segment files)")
+_declare("SHIFU_TPU_INGEST_SEGMENT_AGE_S", "float", 30.0,
+         "max seconds a non-empty open row-log segment may buffer "
+         "before the next append seals it regardless of row count, "
+         "bounding how stale a slow trickle can keep readers")
+_declare("SHIFU_TPU_INGEST_WINDOW_ROWS", "int", 65_536,
+         "max rows one `shifu watch --ingest` tick consumes from the "
+         "row log per read_window (the drift window size cap; the "
+         "rest stays committed for the next tick)")
 # --- bench / tools (read outside the package) ---
 _declare("SHIFU_TPU_BENCH_ATTEMPTS", "int", 2,
          "re-measure attempts per bench workload", scope="bench")

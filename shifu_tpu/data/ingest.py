@@ -1,0 +1,503 @@
+"""Durable streaming ingest plane: a partitioned, append-only row log
+with exactly-once window consumption (ROADMAP item 5).
+
+The reference's data plane assumes Hadoop-era batch appends — rows
+land in part files and every consumer re-reads the table. The live
+plane (watch → drift → refresh) instead needs a durable, replayable
+log: `shifu watch` tailing a flat file races the writer (torn lines),
+loses its place on SIGKILL, and can never re-read the window that
+fired a retrain. `RowLog` is that substrate, built from the same
+write-tmp-then-rename + fault-site discipline as the registry.
+
+Layout (one log root, local path or any fsspec ``scheme://`` URL):
+
+    <root>/log.json                 header, delimiter, partitions
+    <root>/part-K/manifest.json     sealed-segment list for partition K
+    <root>/part-K/seg-NNNNNN.rows   immutable newline-delimited rows
+    <root>/offsets/<consumer>.json  committed read position
+
+WRITER. ``append(rows)`` buffers into per-partition open segments;
+a segment seals into an immutable ``seg-NNNNNN.rows`` file when it
+reaches ``SHIFU_TPU_INGEST_SEGMENT_ROWS`` rows or has been open for
+``SHIFU_TPU_INGEST_SEGMENT_AGE_S`` seconds. A seal is the registry's
+two-rename discipline (`registry.publish`): the segment file commits
+first (`fault_point("ingest.seal")` + `atomic_write`), then the
+partition manifest (row count, per-segment sha256) commits the
+reference. A kill between the renames leaves a complete-but-
+unreferenced segment file and the PREVIOUS manifest — the rerun
+re-seals under the same sequence number, atomically replacing the
+orphan, and ``.tmp.*`` residue is swept on open. Unsealed buffered
+rows are the only thing a killed writer loses (the producer's
+at-least-once retry covers them).
+
+READER. Named consumers (``watch``, ``refresh``, ``eval``) each hold
+a committed offset per partition. ``read_window(consumer, max_rows)``
+returns the next unconsumed rows in a deterministic order (partitions
+ascending, segments ascending, rows in file order) WITHOUT moving the
+offset; the caller applies the window downstream (drift observe,
+training-set materialization) and only then calls
+``commit(consumer, window.end)`` — `fault_point("ingest.offset")` +
+`atomic_write`. A crash anywhere between read and commit replays the
+window instead of skipping it: at-least-once delivery + idempotent,
+keyed window application = exactly-once effect. Segments are
+immutable and offsets only move on commit, so ``read_range(start,
+end)`` re-reads any committed window bitwise — the refresh manifest
+records exactly that (segment, offset) range, making a promoted
+model's training data auditable byte-for-byte.
+
+MULTI-HOST. Partitions shard across hosts with the PR-14 chunk-
+ownership rule (`iter_raw_table_keyed` is the read-side twin):
+host i owns partitions ``k % nhosts == i`` (`owned_partitions`), so
+writers never contend — each partition has exactly one manifest
+writer — and a merged read over all partitions equals the
+single-writer log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from shifu_tpu.config.environment import knob_float, knob_int
+from shifu_tpu.resilience import atomic_write, fault_point, sweep_stale
+
+LOG_FILE = "log.json"
+MANIFEST_FILE = "manifest.json"
+OFFSETS_DIR = "offsets"
+
+# consumer names the health plane registers; anything else is fine
+# too (an offset file per name), these are just the spelled contract
+WATCH_CONSUMER = "watch"
+REFRESH_CONSUMER = "refresh"
+EVAL_CONSUMER = "eval"
+
+_SEG_FMT = "seg-{:06d}.rows"
+
+
+def _is_remote(path: str) -> bool:
+    from shifu_tpu.data.fs import has_scheme
+    return has_scheme(path)
+
+
+def _join(root: str, *parts: str) -> str:
+    if _is_remote(root):
+        return "/".join([root.rstrip("/")] + [p.strip("/") for p in parts])
+    return os.path.join(root, *parts)
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    """Load one JSON file, local or remote; None when absent."""
+    try:
+        if _is_remote(path):
+            from shifu_tpu.data.fs import _fs_and_path
+            fs, p = _fs_and_path(path)
+            if not fs.exists(p):
+                return None
+            with fs.open(p, "r") as f:
+                return json.load(f)
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _write_json(path: str, obj: Dict[str, Any]) -> None:
+    with atomic_write(path) as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+
+
+def _read_text(path: str) -> str:
+    if _is_remote(path):
+        from shifu_tpu.data.fs import _fs_and_path
+        fs, p = _fs_and_path(path)
+        with fs.open(p, "r") as f:
+            return f.read()
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _mkdirs(path: str) -> None:
+    if _is_remote(path):
+        from shifu_tpu.data.fs import _fs_and_path
+        fs, p = _fs_and_path(path)
+        fs.makedirs(p, exist_ok=True)
+        return
+    os.makedirs(path, exist_ok=True)
+
+
+def rows_from_frame(df, delimiter: str = "|") -> List[str]:
+    """A DataFrame as raw log rows (delimiter-joined, no newline) —
+    the writer-side bridge from the tabular world. NaN → empty field,
+    matching the raw-table text conventions."""
+    vals = df.astype(object).where(df.notna(), "")
+    return [delimiter.join(str(v) for v in row)
+            for row in vals.itertuples(index=False)]
+
+
+def frame_from_rows(lines: Sequence[str], header: Sequence[str],
+                    delimiter: str = "|"):
+    """Raw log rows back to a string-typed DataFrame under the log's
+    schema header — the reader-side bridge (same dtype conventions as
+    the raw-table reader, so drift/refresh see identical values)."""
+    import pandas as pd
+    buf = io.StringIO("".join(line + "\n" for line in lines))
+    return pd.read_csv(buf, sep=delimiter, names=list(header),
+                       dtype=str, keep_default_na=False, header=None,
+                       engine="python")
+
+
+@dataclass
+class Window:
+    """One read_window result: the raw rows plus the (segment, offset)
+    range they span. `start`/`end` map partition → {"seq", "row"}
+    (rows consumed within segment `seq`, 1-based sequence numbers);
+    committing `end` marks the window consumed."""
+    lines: List[str]
+    start: Dict[str, Dict[str, int]]
+    end: Dict[str, Dict[str, int]]
+
+    @property
+    def rows(self) -> int:
+        return len(self.lines)
+
+    def range_record(self) -> Dict[str, Any]:
+        """The replayable range for manifests/audit trails."""
+        return {"start": self.start, "end": self.end, "rows": self.rows}
+
+
+class RowLog:
+    """One partitioned append-only row log rooted at `root`.
+
+    Opening an existing log needs only `root` (schema comes from
+    ``log.json``); creating a new one needs `header`. Both writer and
+    reader state live on storage — any number of processes may open
+    the same log, as long as each partition has one writer (the
+    ``k % nhosts`` ownership rule) and each consumer name one reader.
+    """
+
+    def __init__(self, root: str, header: Optional[Sequence[str]] = None,
+                 delimiter: str = "|", partitions: int = 1,
+                 segment_rows: Optional[int] = None,
+                 segment_age_s: Optional[float] = None):
+        self.root = root
+        self.segment_rows = int(
+            segment_rows if segment_rows is not None
+            else knob_int("SHIFU_TPU_INGEST_SEGMENT_ROWS"))
+        self.segment_age_s = float(
+            segment_age_s if segment_age_s is not None
+            else knob_float("SHIFU_TPU_INGEST_SEGMENT_AGE_S"))
+        meta = _read_json(_join(root, LOG_FILE))
+        if meta is None:
+            if header is None:
+                raise FileNotFoundError(
+                    f"ingest: no log at {root!r} (pass header= to "
+                    "create one)")
+            _mkdirs(root)
+            meta = {"format": 1, "header": list(header),
+                    "delimiter": delimiter,
+                    "partitions": int(max(partitions, 1))}
+            # idempotent create: concurrent openers write identical
+            # bytes, and the atomic rename makes either copy whole
+            _write_json(_join(root, LOG_FILE), meta)
+        self.header: List[str] = list(meta["header"])
+        self.delimiter: str = meta["delimiter"]
+        self.partitions: int = int(meta["partitions"])
+        # startup hygiene: a killed writer/committer leaves only
+        # invisible dot-temps — sweep them so the tree stays clean
+        sweep_stale(root)
+        sweep_stale(_join(root, OFFSETS_DIR))
+        for k in range(self.partitions):
+            sweep_stale(_join(root, f"part-{k}"))
+        self._open_rows: Dict[int, List[str]] = {}
+        self._open_since: Dict[int, float] = {}
+        self._rr = 0   # round-robin cursor for unpinned appends
+
+    # -- paths -----------------------------------------------------------
+
+    def _part_dir(self, part: int) -> str:
+        return _join(self.root, f"part-{part}")
+
+    def _manifest_path(self, part: int) -> str:
+        return _join(self._part_dir(part), MANIFEST_FILE)
+
+    def _seg_path(self, part: int, seq: int) -> str:
+        return _join(self._part_dir(part), _SEG_FMT.format(seq))
+
+    def _offset_path(self, consumer: str) -> str:
+        return _join(self.root, OFFSETS_DIR, f"{consumer}.json")
+
+    def _manifest(self, part: int) -> Dict[str, Any]:
+        return _read_json(self._manifest_path(part)) or {"segments": []}
+
+    # -- writer ----------------------------------------------------------
+
+    def owned_partitions(self, shard: Optional[Tuple[int, int]] = None
+                         ) -> List[int]:
+        """The partitions THIS host writes: ``k % nhosts == host`` —
+        the same ownership rule the sharded raw-table reader uses per
+        chunk (`iter_raw_table_keyed`). Unsharded → all partitions."""
+        if shard is None:
+            from shifu_tpu.parallel import dist
+            shard = dist.data_shard()
+        if shard is None:
+            return list(range(self.partitions))
+        idx, n = shard
+        return [k for k in range(self.partitions) if k % n == idx]
+
+    def append(self, rows: Iterable[str],
+               part: Optional[int] = None) -> int:
+        """Buffer rows (delimiter-joined lines, no newline) into the
+        open segment of `part` (None = round-robin over this host's
+        owned partitions), sealing any segment that crosses the row or
+        age threshold. Returns rows accepted. The `ingest.append`
+        fault fires before anything is buffered, so an injected fault
+        loses no rows — the producer retries the whole batch."""
+        fault_point("ingest.append")
+        rows = list(rows)
+        for line in rows:
+            if "\n" in line or "\r" in line:
+                raise ValueError("ingest append: a row may not contain "
+                                 "a newline (one row per line)")
+        if part is None:
+            owned = self.owned_partitions()
+            if not owned:
+                raise RuntimeError("ingest append: this host owns no "
+                                   "partitions")
+            for line in rows:
+                k = owned[self._rr % len(owned)]
+                self._rr += 1
+                self._buffer(k, [line])
+        else:
+            if not 0 <= part < self.partitions:
+                raise ValueError(
+                    f"ingest append: partition {part} out of range "
+                    f"(log has {self.partitions})")
+            self._buffer(part, rows)
+        self.maybe_seal()
+        return len(rows)
+
+    def _buffer(self, part: int, rows: List[str]) -> None:
+        buf = self._open_rows.setdefault(part, [])
+        if not buf:
+            self._open_since[part] = time.monotonic()
+        buf.extend(rows)
+
+    def maybe_seal(self) -> List[Tuple[int, int]]:
+        """Seal every open segment past its row or age threshold.
+        Returns the (part, seq) pairs sealed."""
+        sealed = []
+        now = time.monotonic()
+        for part in sorted(self._open_rows):
+            buf = self._open_rows.get(part) or []
+            if not buf:
+                continue
+            age = now - self._open_since.get(part, now)
+            if len(buf) >= self.segment_rows or age >= self.segment_age_s:
+                sealed.append((part, self.seal(part)))
+        return sealed
+
+    def seal_all(self) -> List[Tuple[int, int]]:
+        """Force-seal every non-empty open segment (shutdown, bench
+        boundaries, tests)."""
+        return [(part, self.seal(part))
+                for part in sorted(self._open_rows)
+                if self._open_rows.get(part)]
+
+    def seal(self, part: int) -> int:
+        """Seal partition `part`'s open segment: commit the immutable
+        segment file, then commit the manifest referencing it — the
+        registry's two-rename discipline. A kill before commit 1
+        leaves only a swept dot-temp; between the commits, a complete-
+        but-unreferenced segment file and the previous manifest (the
+        rerun re-seals seq atomically over the orphan). Returns the
+        sealed sequence number."""
+        buf = self._open_rows.get(part)
+        if not buf:
+            raise ValueError(f"ingest seal: partition {part} has no "
+                             "open rows")
+        manifest = self._manifest(part)
+        seq = len(manifest["segments"]) + 1
+        data = "".join(line + "\n" for line in buf)
+        _mkdirs(self._part_dir(part))
+        # commit 1: the immutable segment file appears atomically
+        fault_point("ingest.seal")
+        with atomic_write(self._seg_path(part, seq)) as f:
+            f.write(data)
+        sha = hashlib.sha256(data.encode("utf-8")).hexdigest()
+        manifest["segments"].append(
+            {"name": _SEG_FMT.format(seq), "rows": len(buf),
+             "sha256": sha,
+             "sealed": time.strftime("%Y-%m-%dT%H:%M:%S")})
+        # commit 2: the manifest references it — only now do readers
+        # see the segment
+        fault_point("ingest.seal")
+        _write_json(self._manifest_path(part), manifest)
+        self._open_rows[part] = []
+        self._open_since.pop(part, None)
+        return seq
+
+    def open_rows(self, part: Optional[int] = None) -> int:
+        """Buffered-but-unsealed rows (this writer's only volatile
+        state)."""
+        if part is not None:
+            return len(self._open_rows.get(part) or [])
+        return sum(len(v) for v in self._open_rows.values())
+
+    # -- reader ----------------------------------------------------------
+
+    def committed_offset(self, consumer: str) -> Dict[str, Dict[str, int]]:
+        """partition → {"seq", "row"}: `row` rows of segment `seq`
+        consumed (seq is 1-based; a partition never read starts at
+        seq 1, row 0)."""
+        rec = _read_json(self._offset_path(consumer)) or {}
+        parts = rec.get("parts", {})
+        out = {}
+        for k in range(self.partitions):
+            p = parts.get(str(k), {})
+            out[str(k)] = {"seq": int(p.get("seq", 1)),
+                           "row": int(p.get("row", 0))}
+        return out
+
+    def read_window(self, consumer: str,
+                    max_rows: Optional[int] = None) -> Optional[Window]:
+        """The next unconsumed rows for `consumer` — deterministic
+        order (partitions ascending, then segments ascending), offset
+        NOT moved. Returns None when nothing new is sealed. Re-reading
+        before commit returns byte-identical rows as long as the log
+        did not grow; `read_range` over the returned range is bitwise
+        stable forever."""
+        start = self.committed_offset(consumer)
+        end = {k: dict(v) for k, v in start.items()}
+        lines: List[str] = []
+        budget = max_rows if max_rows is not None else float("inf")
+        for part in range(self.partitions):
+            if budget <= 0:
+                break
+            key = str(part)
+            segments = self._manifest(part)["segments"]
+            seq, row = end[key]["seq"], end[key]["row"]
+            while budget > 0 and seq <= len(segments):
+                seg = segments[seq - 1]
+                if row >= seg["rows"]:
+                    seq, row = seq + 1, 0
+                    continue
+                seg_lines = _read_text(
+                    self._seg_path(part, seq)).splitlines()
+                if len(seg_lines) != seg["rows"]:
+                    raise RuntimeError(
+                        f"ingest: segment part-{part}/{seg['name']} "
+                        f"carries {len(seg_lines)} rows, manifest says "
+                        f"{seg['rows']} — refusing a corrupt read")
+                avail = seg["rows"] - row
+                take = avail if budget == float("inf") \
+                    else min(avail, int(budget))
+                lines.extend(seg_lines[row:row + take])
+                row += take
+                budget -= take
+                if row >= seg["rows"] and seq < len(segments):
+                    seq, row = seq + 1, 0
+            end[key] = {"seq": seq, "row": row}
+        if not lines:
+            return None
+        return Window(lines=lines, start=start, end=end)
+
+    def read_range(self, start: Dict[str, Dict[str, int]],
+                   end: Dict[str, Dict[str, int]]) -> List[str]:
+        """Re-read a committed (segment, offset) range bitwise —
+        segments are immutable, so this returns the exact rows a past
+        window delivered (the refresh-manifest audit path)."""
+        lines: List[str] = []
+        for part in range(self.partitions):
+            key = str(part)
+            s = start.get(key, {"seq": 1, "row": 0})
+            e = end.get(key, s)
+            segments = self._manifest(part)["segments"]
+            seq, row = int(s["seq"]), int(s["row"])
+            e_seq, e_row = int(e["seq"]), int(e["row"])
+            while (seq, row) < (e_seq, e_row) and seq <= len(segments):
+                seg = segments[seq - 1]
+                stop = e_row if seq == e_seq else seg["rows"]
+                if stop > row:
+                    seg_lines = _read_text(
+                        self._seg_path(part, seq)).splitlines()
+                    lines.extend(seg_lines[row:stop])
+                seq, row = seq + 1, 0
+        return lines
+
+    def commit(self, consumer: str,
+               end: Dict[str, Dict[str, int]]) -> None:
+        """Atomically commit `consumer`'s offset to `end` — called
+        only AFTER the window's downstream effect committed (drift
+        observed, training set materialized), so a crash replays the
+        window rather than skipping it."""
+        _mkdirs(_join(self.root, OFFSETS_DIR))
+        fault_point("ingest.offset")
+        _write_json(self._offset_path(consumer),
+                    {"consumer": consumer,
+                     "parts": {k: {"seq": int(v["seq"]),
+                                   "row": int(v["row"])}
+                               for k, v in end.items()},
+                     "committed": time.strftime("%Y-%m-%dT%H:%M:%S")})
+
+    # -- observability ---------------------------------------------------
+
+    def sealed_rows(self) -> int:
+        return sum(seg["rows"] for k in range(self.partitions)
+                   for seg in self._manifest(k)["segments"])
+
+    def consumed_rows(self, consumer: str) -> int:
+        total = 0
+        offset = self.committed_offset(consumer)
+        for part in range(self.partitions):
+            segments = self._manifest(part)["segments"]
+            o = offset[str(part)]
+            for i, seg in enumerate(segments, start=1):
+                if i < o["seq"]:
+                    total += seg["rows"]
+                elif i == o["seq"]:
+                    total += min(int(o["row"]), seg["rows"])
+        return total
+
+    def lag(self, consumer: str) -> int:
+        """Sealed rows the consumer has not committed yet."""
+        return self.sealed_rows() - self.consumed_rows(consumer)
+
+    def consumers(self) -> List[str]:
+        d = _join(self.root, OFFSETS_DIR)
+        try:
+            if _is_remote(d):
+                from shifu_tpu.data.fs import _fs_and_path
+                fs, p = _fs_and_path(d)
+                names = [q.rstrip("/").rsplit("/", 1)[-1]
+                         for q in fs.ls(p, detail=False)]
+            else:
+                names = os.listdir(d)
+        except (OSError, FileNotFoundError):
+            return []
+        return sorted(n[:-5] for n in names
+                      if n.endswith(".json") and not n.startswith("."))
+
+    def inventory(self) -> Dict[str, Any]:
+        """The `shifu ingest ls` record: partitions, sealed/open
+        segments, per-consumer committed offsets + lag in rows."""
+        parts = []
+        for k in range(self.partitions):
+            segs = self._manifest(k)["segments"]
+            parts.append({"partition": k, "sealed_segments": len(segs),
+                          "sealed_rows": sum(s["rows"] for s in segs),
+                          "open_rows": self.open_rows(k)})
+        return {
+            "root": self.root, "header": self.header,
+            "delimiter": self.delimiter, "partitions": parts,
+            "sealed_rows": self.sealed_rows(),
+            "consumers": [
+                {"name": c, "offset": self.committed_offset(c),
+                 "committed_rows": self.consumed_rows(c),
+                 "lag_rows": self.lag(c)}
+                for c in self.consumers()],
+        }

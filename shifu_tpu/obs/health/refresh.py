@@ -12,7 +12,13 @@ item 1.
              on, and point its dataPath at the accumulated drift
              window (the rows the watch loop saw arrive — capped at
              ``SHIFU_TPU_REFRESH_WINDOW_ROWS``; no window yet → the
-             full training table). `fault_point("refresh.schedule")`.
+             full training table). With an ingest row log bound
+             (`shifu watch --ingest`), the window is instead read
+             from the ``refresh`` consumer offset and materialized
+             byte-for-byte; the exact (segment, offset) range lands
+             in the published manifest (``refresh.ingest_window``)
+             and the offset commits only after the materialization.
+             `fault_point("refresh.schedule")`.
 
   train      norm + train inside the clone, in process — the
              continuous-training path restores the incumbent params
@@ -94,8 +100,16 @@ class RefreshController:
                  cooldown_s: Optional[float] = None,
                  tolerance: Optional[float] = None,
                  window_rows: Optional[int] = None,
-                 post_train=None):
+                 post_train=None, ingest_log=None):
         self.ctx = ctx
+        # durable row log (data/ingest.py): when bound, the challenger
+        # trains on a window read from the `refresh` consumer offset,
+        # materialized byte-for-byte and recorded in the publish
+        # manifest as a replayable (segment, offset) range
+        if isinstance(ingest_log, str):
+            from shifu_tpu.data.ingest import RowLog
+            ingest_log = RowLog(ingest_log)
+        self.ingest_log = ingest_log
         self.registry_root = registry_root
         self.model_name = model_name
         self.fleet = fleet
@@ -211,11 +225,27 @@ class RefreshController:
                             run=run_name):
             # -- schedule: challenger workspace --------------------------
             resilience.fault_point("refresh.schedule")
-            window = self._take_window()
+            window, win = None, None
+            if self.ingest_log is not None:
+                from shifu_tpu.data.ingest import REFRESH_CONSUMER
+                win = self.ingest_log.read_window(
+                    REFRESH_CONSUMER, max_rows=self.window_rows)
+            if win is None:
+                window = self._take_window()
+            w_rows = win.rows if win is not None \
+                else (0 if window is None else len(window))
             st.event("refresh", phase="scheduled",
                      slo=record.get("slo", "?"), run=run_name,
-                     window_rows=0 if window is None else len(window))
-            clone = self._prepare_challenger(run_name, window)
+                     window_rows=w_rows)
+            clone = self._prepare_challenger(run_name, window,
+                                             raw_window=win)
+            if win is not None:
+                # the training-set materialization IS this consumer's
+                # downstream commit point: the window now exists
+                # byte-for-byte in the clone, so the offset may move —
+                # a crash before this line replays the window, never
+                # skips it
+                self.ingest_log.commit(REFRESH_CONSUMER, win.end)
 
             # -- train: warm-start incremental epochs --------------------
             t0 = time.monotonic()
@@ -255,14 +285,20 @@ class RefreshController:
             t0 = time.monotonic()
             resilience.fault_point("refresh.promote")
             prev_head = registry.head(self.registry_root, self.model_name)
+            refresh_block = {
+                "run": run_name, "slo": record.get("slo", "?"),
+                "incumbent_auc": verdict["incumbent"],
+                "challenger_auc": verdict["challenger"],
+                "refreshed_from": prev_head}
+            if win is not None:
+                # the exact (segment, offset) range retrained on —
+                # `RowLog.read_range(start, end)` re-reads it bitwise
+                refresh_block["ingest_window"] = dict(
+                    win.range_record(), log=self.ingest_log.root)
             version = registry.publish(
                 self.registry_root, self.model_name,
                 os.path.join(clone, "models"),
-                extra={"refresh": {
-                    "run": run_name, "slo": record.get("slo", "?"),
-                    "incumbent_auc": verdict["incumbent"],
-                    "challenger_auc": verdict["challenger"],
-                    "refreshed_from": prev_head}})
+                extra={"refresh": refresh_block})
             data_pipeline.add_stage_time("refresh_promote_s",
                                          time.monotonic() - t0)
 
@@ -293,13 +329,17 @@ class RefreshController:
 
     # -- phases ------------------------------------------------------------
 
-    def _prepare_challenger(self, run_name: str, window) -> str:
+    def _prepare_challenger(self, run_name: str, window,
+                            raw_window=None) -> str:
         """Materialize the challenger workspace: parent ModelConfig
         (paths absolutized) with isContinuous on, ColumnConfig copied,
         the incumbent's model files seeded into models/ for the warm
         start, and — when a drift window accumulated — its own private
-        dataPath holding exactly those rows. Re-running after a kill
-        rebuilds from scratch (the clone is disposable state)."""
+        dataPath holding exactly those rows (`raw_window`, an ingest
+        `Window`, is written byte-for-byte from the log's raw lines so
+        the recorded offset range IS the training data). Re-running
+        after a kill rebuilds from scratch (the clone is disposable
+        state)."""
         import json as _json
 
         from shifu_tpu.pipeline.nodes import _absolutize
@@ -318,7 +358,14 @@ class RefreshController:
         raw.setdefault("train", {})["isContinuous"] = True
         raw.setdefault("basic", {})["name"] = \
             f"{raw.get('basic', {}).get('name', 'model')}:{run_name}"
-        if window is not None and len(window):
+        if raw_window is not None and raw_window.rows:
+            raw["dataSet"]["dataPath"], raw["dataSet"]["headerPath"] = \
+                self._write_window_raw(clone, raw_window.lines,
+                                       self.ingest_log.header,
+                                       self.ingest_log.delimiter)
+            raw["dataSet"]["dataDelimiter"] = self.ingest_log.delimiter
+            raw["dataSet"]["headerDelimiter"] = self.ingest_log.delimiter
+        elif window is not None and len(window):
             raw["dataSet"]["dataPath"], raw["dataSet"]["headerPath"] = \
                 self._write_window(clone, window,
                                    raw["dataSet"].get("dataDelimiter", "|"))
@@ -338,6 +385,23 @@ class RefreshController:
         for src in spec_mod.list_models(inc):
             shutil.copy2(src, os.path.join(dst, os.path.basename(src)))
         return clone
+
+    @staticmethod
+    def _write_window_raw(clone: str, lines, header, delim: str):
+        """The ingest window as a private raw table, written from the
+        log's raw lines UNMODIFIED — `sha256(part-00000)` equals the
+        hash of `RowLog.read_range` over the recorded range, so the
+        promoted model's training data audits byte-for-byte."""
+        wdir = os.path.join(clone, "window")
+        os.makedirs(wdir, exist_ok=True)
+        header_path = os.path.join(wdir, ".pig_header")
+        with open(header_path, "w", encoding="utf-8") as f:
+            f.write(delim.join(str(c) for c in header) + "\n")
+        with open(os.path.join(wdir, "part-00000"), "w",
+                  encoding="utf-8") as f:
+            for line in lines:
+                f.write(line + "\n")
+        return wdir, header_path
 
     @staticmethod
     def _write_window(clone: str, window, delim: str):

@@ -2,9 +2,16 @@
 
 Every ``SHIFU_TPU_WATCH_INTERVAL_S`` seconds the loop takes one tick:
 
-  1. collect the next data window — in production mode that is any
-     rows appended to the training dataPath since the last tick (the
-     arriving-data tail); tests inject windows directly;
+  1. collect the next data window — with ``--ingest <log>`` that is
+     the next committed rows of the durable row log
+     (`data/ingest.py`), consumed exactly-once: the ``watch``
+     consumer offset commits only AFTER the window's drift observe
+     lands, so a killed watch replays the window instead of skipping
+     it. Without a log the legacy dataPath tail runs (DEPRECATED: no
+     durability, no replay, no resume guarantee — kept for flat-file
+     setups; it is line-atomic, consuming only up to each part
+     file's last newline and carrying a torn partial into the next
+     tick). Tests inject windows directly;
   2. feed the window to the `RollingDrift` monitor inside a
      `watch.window` span + fault site — a poisoned window is logged,
      counted, and SKIPPED, never fatal (absorbed, the chaos drill);
@@ -27,6 +34,7 @@ also fed to the controller as retrain fodder. Without a controller
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, Iterable, Optional
 
@@ -53,28 +61,71 @@ def on_breach(record: Dict, refresh=None) -> Optional[str]:
     return None
 
 
-def _production_window(ctx, seen_rows: int):
-    """Rows appended to the training dataPath since the last tick
-    (None when nothing new). A rewritten-shorter table resets the
-    cursor — treat the whole table as a fresh window."""
-    from shifu_tpu.data.reader import read_raw_table
-    df = read_raw_table(ctx.model_config)
-    if len(df) < seen_rows:
-        seen_rows = 0
-    if len(df) == seen_rows:
-        return None, seen_rows
-    return df.iloc[seen_rows:].reset_index(drop=True), len(df)
+def _production_window(ctx, tail: Dict):
+    """DEPRECATED raw tail (use `--ingest <log>` for durable,
+    replayable windows): rows appended to the training dataPath since
+    the last tick (None when nothing new), tracked as a byte cursor
+    per part file. Line-atomic — only bytes up to each file's last
+    newline are consumed, so a row the writer is mid-append on (no
+    trailing ``\\n`` yet) is carried into the next tick whole instead
+    of delivered torn. A rewritten-shorter file resets its cursor —
+    its whole content is a fresh window. Parquet parts (immutable
+    whole-file appends, no torn-line race) fall back to the
+    whole-table row slice."""
+    from shifu_tpu.data import reader
+    ds = ctx.model_config.dataSet
+    try:
+        files = reader.expand_data_files(ds.dataPath)
+    except FileNotFoundError:
+        return None, tail
+    if any(f.endswith(".parquet") for f in files) or \
+            any(not os.path.isfile(f) for f in files):
+        df = reader.read_raw_table(ctx.model_config)
+        seen = tail.get("__rows__", 0)
+        if len(df) < seen:
+            seen = 0
+        tail["__rows__"] = len(df)
+        if len(df) == seen:
+            return None, tail
+        return df.iloc[seen:].reset_index(drop=True), tail
+    lines = []
+    for path in files:
+        pos = tail.get(path, 0)
+        size = os.path.getsize(path)
+        if size < pos:   # rewritten shorter: fresh window
+            pos = 0
+        if size <= pos:
+            continue
+        with open(path, "rb") as f:
+            f.seek(pos)
+            chunk = f.read(size - pos)
+        cut = chunk.rfind(b"\n")
+        if cut < 0:
+            continue   # no complete line yet — carry the partial
+        lines.extend(chunk[:cut].decode("utf-8",
+                                        "replace").splitlines())
+        tail[path] = pos + cut + 1
+    if not lines:
+        return None, tail
+    from shifu_tpu.data.ingest import frame_from_rows
+    header = reader.read_header(ds)
+    return frame_from_rows(lines, header, ds.dataDelimiter), tail
 
 
 def run_monitor(ctx, interval_s: Optional[float] = None,
                 iterations: Optional[int] = None,
                 windows: Optional[Iterable] = None,
-                refresh=None) -> int:
+                refresh=None, ingest_log=None) -> int:
     """The monitor loop. `iterations` bounds the run (None = until
     SIGTERM); `windows` injects an explicit window sequence (tests,
     replays) instead of tailing the dataPath; `refresh` attaches a
-    `RefreshController` so breaches retrain instead of just alert."""
+    `RefreshController` so breaches retrain instead of just alert;
+    `ingest_log` (a `data.ingest.RowLog` or its root path) consumes
+    drift windows from the durable row log with exactly-once offset
+    commits instead of the deprecated dataPath tail."""
     from shifu_tpu import resilience
+    from shifu_tpu.config.environment import knob_int
+    from shifu_tpu.data import ingest as ingest_mod
 
     root = ctx.path_finder.root
     st = health_store.store(root)
@@ -83,26 +134,42 @@ def run_monitor(ctx, interval_s: Optional[float] = None,
     drift = RollingDrift(ctx)
     slo = SloEvaluator(root)
     injected = iter(windows) if windows is not None else None
-    seen_rows = 0
+    if isinstance(ingest_log, str):
+        ingest_log = ingest_mod.RowLog(ingest_log)
+    tail: Dict = {}
     ticks = windows_ok = windows_failed = 0
     log.info("watch: monitoring %s every %.1fs (%d features with "
-             "frozen bins)", root, interval, drift.n_features)
+             "frozen bins)%s", root, interval, drift.n_features,
+             f" from row log {ingest_log.root}" if ingest_log else "")
 
     with resilience.graceful_shutdown("watching"):
         while not resilience.preempt_requested():
             tick_t0 = time.monotonic()
 
             # 1. next window
-            df = None
+            df, win = None, None
             if injected is not None:
                 df = next(injected, None)
                 if df is None and iterations is None:
                     break   # replay exhausted
+            elif ingest_log is not None:
+                win = ingest_log.read_window(
+                    ingest_mod.WATCH_CONSUMER,
+                    max_rows=knob_int("SHIFU_TPU_INGEST_WINDOW_ROWS"))
+                if win is not None:
+                    df = ingest_mod.frame_from_rows(
+                        win.lines, ingest_log.header,
+                        ingest_log.delimiter)
             else:
-                df, seen_rows = _production_window(ctx, seen_rows)
+                df, tail = _production_window(ctx, tail)
 
             # 2. drift over the window — absorbed: a bad window can
-            # never kill the monitor
+            # never kill the monitor. With a row log the consumer
+            # offset commits only AFTER the observe landed (and the
+            # window reached the refresh controller): a crash or an
+            # absorbed fault before the commit REPLAYS the window
+            # next tick — at-least-once delivery, idempotent drift
+            # application, never a skipped window.
             if df is not None and len(df):
                 try:
                     with obs_trace.span("watch.window", rows=len(df)):
@@ -111,6 +178,9 @@ def run_monitor(ctx, interval_s: Optional[float] = None,
                     _emit_drift(st, snap)
                     if refresh is not None:
                         refresh.note_window(df)
+                    if win is not None:
+                        ingest_log.commit(ingest_mod.WATCH_CONSUMER,
+                                          win.end)
                     windows_ok += 1
                 except Exception as e:  # noqa: BLE001 — absorbed
                     windows_failed += 1

@@ -382,6 +382,17 @@ FLEET_FIELDS = ("models_resident", "evictions", "rewarm_s",
 REFRESH_FIELDS = ("breach_to_promoted_s", "swap_s", "rewarm_s",
                   "swap_compile_misses", "guardrail")
 
+# the streaming-ingest bench record schema: bench.py --task ingest
+# builds its JSON record from exactly these keys — rows appended,
+# sustained append throughput through the sealing row log, segments
+# sealed, wall seconds from appending a drifted batch to the drift
+# monitor's breach snapshot off a committed read_window, and whether a
+# re-read of the same committed range (fresh RowLog handle) was
+# byte-identical. tools/check_steps_schema.py pins README docs to this
+# tuple the same way it pins REFRESH_FIELDS.
+INGEST_FIELDS = ("rows", "rows_per_s", "segments",
+                 "breach_latency_s", "bitwise_identical")
+
 # the pipeline DAG scheduler's record schema: a scheduled step attaches
 # one `dag` block to its steps.jsonl record — DAG_SUMMARY_FIELDS are
 # the block's top-level keys, DAG_FIELDS the schema of each entry in

@@ -152,6 +152,7 @@ FAULT_SITES = (
     "obs.metrics_flush", "obs.alert", "obs.webhook", "watch.window",
     "refresh.schedule", "refresh.guardrail", "refresh.promote",
     "refresh.swap",
+    "ingest.append", "ingest.seal", "ingest.offset",
 )
 
 

@@ -50,6 +50,13 @@ collectives = the test-rig stand-in for DCN), then one of two modes:
   streaming path computes per-chunk Pearson moments on the host-LOCAL
   mesh and replays them through the striped merge; correlation.csv
   must come out bitwise identical to a 1-process run.
+- ``--mode ingest``: the sharded streaming-ingest drill. --out is a
+  pre-created row-log root (data/ingest.py); with
+  SHIFU_TPU_DATA_SHARD=auto each process owns the partitions
+  ``k % nproc == pid`` (the PR-14 chunk-ownership idiom) and appends
+  only the rows routed to its partitions (row j → partition j % P), so
+  a 2-process log must merge-read identical to a 1-process one. Each
+  process prints its owned set for the disjointness assertion.
 
 Usage: python multihost_worker.py --port P --nproc N --pid I --out F
 """
@@ -67,7 +74,7 @@ ap.add_argument("--local-devices", type=int, default=2)
 ap.add_argument("--mode",
                 choices=("train", "barrier-kill", "barrier-stall",
                          "preempt-drill", "preempt-resume",
-                         "stats", "stats-kill", "corr"),
+                         "stats", "stats-kill", "corr", "ingest"),
                 default="train")
 args = ap.parse_args()
 
@@ -206,6 +213,24 @@ if args.mode in ("stats", "stats-kill", "corr"):
     # os._exit: the distributed runtime's atexit teardown could block
     # if a peer already exited
     os._exit(int(rc or 0))
+
+if args.mode == "ingest":
+    from shifu_tpu.data.ingest import RowLog  # noqa: E402
+
+    lg = RowLog(args.out)   # pre-created by the test; header in log.json
+    owned = lg.owned_partitions()
+    print(f"OWNED {args.pid} {sorted(owned)}", flush=True)
+    n_rows = 240
+    for j in range(n_rows):
+        part = j % lg.partitions
+        if part not in owned:
+            continue   # a peer's partition — never written from here
+        lg.append([f"{j}|row{j}"], part=part)
+    lg.seal_all()
+    print(f"INGEST_DONE {args.pid}", file=sys.stderr, flush=True)
+    # os._exit: the distributed runtime's atexit teardown could block
+    # if a peer already exited
+    os._exit(0)
 
 import numpy as np  # noqa: E402
 

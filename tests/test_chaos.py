@@ -10,12 +10,16 @@ CLI pipeline and hold the hang-proofing contract:
 
 ``tools/chaos_sweep.sh`` runs the full matrix — every registered site,
 a complete init→stats→norm→train→eval pipeline per site (the
-``refresh.*`` sites get a closed-loop breach→promote drill there
+``refresh.*`` and ``ingest.*`` sites get closed-loop drills there
 instead, since the batch pipeline never reaches them); this module is
 the in-tree subset kept fast enough for tier-1. The ``refresh.*``
 class is drilled per-site in ``tests/test_refresh.py`` (in-process
 fault, rerun-recovers, swap rollback, and SIGKILL across a process
-boundary) — also tier-1.
+boundary) — also tier-1. The ``ingest.*`` class (the streaming
+row-log's durability seams) is drilled per-site BELOW: in-process
+fault surfaces naming the site and a rerun recovers, plus SIGKILL
+across a process boundary at each seam with the exactly-once window
+invariant (the committed range re-reads bitwise) held throughout.
 """
 
 import os
@@ -241,6 +245,126 @@ def test_kill_during_background_save_falls_back_to_previous_step(
     np.testing.assert_array_equal(st["w"],
                                   np.arange(16, dtype=np.float32))
     np.testing.assert_array_equal(st["b"], np.float64(1.0))
+
+
+# ---------------------------------------------------------------------------
+# streaming-ingest drills (the row log's durability seams)
+# ---------------------------------------------------------------------------
+
+_INGEST_SITES = ["ingest.append", "ingest.seal", "ingest.offset"]
+
+
+def _ingest_batch():
+    return [f"{i}|x{i}" for i in range(10)]
+
+
+def _ingest_all_lines(root):
+    """Every committed row, via the bitwise audit path."""
+    from shifu_tpu.data.ingest import RowLog
+    lg = RowLog(root)
+    return lg.read_range({"0": {"seq": 1, "row": 0}},
+                         lg.committed_offset("watch"))
+
+
+def test_ingest_chaos_sites_are_registered():
+    for site in _INGEST_SITES:
+        assert site in resilience.FAULT_SITES, site
+
+
+@pytest.mark.parametrize("site", _INGEST_SITES)
+def test_ingest_fault_surfaces_and_rerun_recovers(
+        site, tmp_path, monkeypatch):
+    """In-process drill at each row-log seam: the injected fault
+    surfaces promptly NAMING the site (ingest faults belong to the
+    feed's retry loop, not silent absorption), the durable state is
+    never torn, and a clean rerun delivers the batch exactly once —
+    the committed window replays bitwise."""
+    from shifu_tpu.data.ingest import RowLog
+
+    root = str(tmp_path / "rowlog")
+    monkeypatch.setenv("SHIFU_TPU_FAULT", f"{site}:oserror:1")
+    resilience.reset_faults()
+
+    def _cycle():
+        lg = RowLog(root, header=["a", "b"], segment_rows=4)
+        lg.append(_ingest_batch())
+        lg.seal_all()
+        win = lg.read_window("watch")
+        lg.commit("watch", win.end)
+        return win
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError,
+                       match=f"injected oserror at {site}"):
+        _cycle()
+    assert time.monotonic() - t0 < 60, f"{site}: faulted cycle hung"
+    assert not _no_tmp_residue(root)
+
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    win = _cycle()
+    assert win is not None and win.lines[-10:] == _ingest_batch()
+    # one or two whole batches depending on where the fault landed —
+    # never a torn, duplicated, or interleaved row; the committed
+    # range replays bitwise through a fresh handle
+    lines = _ingest_all_lines(root)
+    assert len(lines) in (10, 20) and all(
+        lines[k:k + 10] == _ingest_batch()
+        for k in range(0, len(lines), 10)), lines
+    assert _ingest_all_lines(root) == lines
+    assert not _no_tmp_residue(root)
+
+
+_INGEST_KILL_DRILL = textwrap.dedent("""\
+    import sys
+    from shifu_tpu.data.ingest import RowLog
+    lg = RowLog(sys.argv[1], header=["a", "b"], segment_rows=4)
+    lg.append([f"{i}|x{i}" for i in range(10)])
+    lg.seal_all()
+    w = lg.read_window("watch")
+    lg.commit("watch", w.end)
+    print("UNREACHABLE")
+""")
+
+
+@pytest.mark.parametrize("site,nth", [
+    ("ingest.append", 1),
+    ("ingest.seal", 1),    # killed before the segment file appears
+    ("ingest.seal", 2),    # killed between segment and manifest commit
+    ("ingest.offset", 1),  # killed before the consumer offset lands
+])
+def test_ingest_kill_drill_recovers_exactly_once(tmp_path, site, nth):
+    """SIGKILL across a process boundary at each row-log seam: the
+    writer dies mid-commit, the rerun recovers (an orphan segment is
+    overwritten, a stale offset replays rather than skips), and the
+    committed window re-reads byte-identical with no dot-temp
+    residue."""
+    root = str(tmp_path / "rowlog")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SHIFU_TPU_FAULT=f"{site}:kill:{nth}",
+               SHIFU_TPU_RETRY_BASE_S="0.01",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, "-c", _INGEST_KILL_DRILL, root],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=300)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stdout,
+                                             r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert not _no_tmp_residue(root) if os.path.isdir(root) else True
+
+    env.pop("SHIFU_TPU_FAULT")
+    r = subprocess.run([sys.executable, "-c", _INGEST_KILL_DRILL, root],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=300)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+
+    lines = _ingest_all_lines(root)
+    assert len(lines) in (10, 20) and all(
+        lines[k:k + 10] == _ingest_batch()
+        for k in range(0, len(lines), 10)), lines
+    assert _ingest_all_lines(root) == lines   # bitwise on replay
+    assert not _no_tmp_residue(root)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,398 @@
+"""Durable streaming ingest plane (``data/ingest.py``) — the
+partitioned row log and its exactly-once window contract:
+
+- round trips: append → seal (by row count and by age) → window reads
+  in deterministic order, with the consumer offset committing only on
+  an explicit `commit` — an uncommitted window REPLAYS bitwise;
+- durability: reopen, `read_range` over any committed range is
+  byte-identical forever (immutable segments), a truncated segment is
+  refused loudly, no dot-temp residue anywhere;
+- the fsspec twin: the same contract over a `memory://` log root;
+- the legacy dataPath tail's line-atomicity regression (a slow writer
+  mid-append never delivers a torn row — satellite of the ingest PR);
+- the acceptance drill: shifted rows appended to the log → `shifu
+  watch --ingest` drift breach → refresh retrains on the committed
+  window → the promoted manifest records the exact (segment, offset)
+  range and `read_range` re-reads the training bytes exactly.
+
+SIGKILL crash drills for the ``ingest.*`` fault sites live in
+``tests/test_chaos.py``; the 2-process sharded-writer drill in
+``tests/test_multihost.py``.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from shifu_tpu import registry, resilience
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.data.ingest import (REFRESH_CONSUMER, WATCH_CONSUMER,
+                                   RowLog, frame_from_rows,
+                                   rows_from_frame)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _ingest_isolation(monkeypatch):
+    for k in ("SHIFU_TPU_METRICS", "SHIFU_TPU_SLO_FILE", "SHIFU_TPU_FAULT",
+              "SHIFU_TPU_INGEST_SEGMENT_ROWS",
+              "SHIFU_TPU_INGEST_SEGMENT_AGE_S"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.01")
+    resilience.reset_faults()
+    yield
+    resilience.reset_faults()
+
+
+def _batch(n=10, tag=""):
+    return [f"{i}|v{tag}{i}" for i in range(n)]
+
+
+def _no_tmp_residue(root):
+    return [os.path.join(d, f) for d, _dirs, fs in os.walk(root)
+            for f in fs if f.startswith(".tmp.")]
+
+
+def _sha(lines):
+    return hashlib.sha256("\n".join(lines).encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the log itself
+# ---------------------------------------------------------------------------
+
+def test_round_trip_exactly_once_and_replay(tmp_path):
+    root = str(tmp_path / "log")
+    lg = RowLog(root, header=["a", "b"], segment_rows=4)
+    lg.append(_batch(10))
+    lg.seal_all()
+    assert lg.sealed_rows() == 10 and lg.open_rows() == 0
+
+    # an uncommitted window REPLAYS bitwise — reading moves nothing
+    w1 = lg.read_window(WATCH_CONSUMER)
+    w2 = lg.read_window(WATCH_CONSUMER)
+    assert w1.lines == w2.lines == _batch(10)
+    assert w1.start == w2.start and w1.end == w2.end
+    assert lg.lag(WATCH_CONSUMER) == 10
+
+    # commit moves exactly to the window's end; the next read is empty
+    lg.commit(WATCH_CONSUMER, w1.end)
+    assert lg.lag(WATCH_CONSUMER) == 0
+    assert lg.consumed_rows(WATCH_CONSUMER) == 10
+    assert lg.read_window(WATCH_CONSUMER) is None
+
+    # consumers are independent: a second one still sees everything
+    w3 = lg.read_window("eval")
+    assert w3.lines == _batch(10)
+
+    # max_rows caps the window and the remainder stays for next tick
+    lg.append(_batch(6, tag="x"))
+    lg.seal_all()
+    w4 = lg.read_window(WATCH_CONSUMER, max_rows=4)
+    assert len(w4.lines) == 4
+    lg.commit(WATCH_CONSUMER, w4.end)
+    w5 = lg.read_window(WATCH_CONSUMER)
+    assert w4.lines + w5.lines == _batch(6, tag="x")
+    assert not _no_tmp_residue(root)
+
+
+def test_seal_by_age_bounds_trickle_staleness(tmp_path):
+    import time as _time
+    lg = RowLog(str(tmp_path / "log"), header=["a", "b"],
+                segment_rows=10_000, segment_age_s=0.05)
+    lg.append(["1|one"])
+    # nowhere near the row threshold and still young: stays buffered
+    assert lg.sealed_rows() == 0 and lg.open_rows() == 1
+    _time.sleep(0.06)
+    # the NEXT append finds the open segment over age and seals it —
+    # a slow trickle can never keep rows invisible to readers forever
+    lg.append(["2|two"])
+    assert lg.sealed_rows() == 2 and lg.open_rows() == 0
+    w = lg.read_window(WATCH_CONSUMER)
+    assert w.lines == ["1|one", "2|two"]
+
+
+def test_reopen_and_committed_range_reads_bitwise_forever(tmp_path):
+    root = str(tmp_path / "log")
+    lg = RowLog(root, header=["a", "b"], partitions=2, segment_rows=3)
+    lg.append(_batch(11))
+    lg.seal_all()
+    start = lg.committed_offset(WATCH_CONSUMER)
+    w = lg.read_window(WATCH_CONSUMER)
+    lg.commit(WATCH_CONSUMER, w.end)
+    d0 = _sha(w.lines)
+
+    # a FRESH handle (reopen: header/delimiter come from log.json)
+    lg2 = RowLog(root)
+    assert lg2.header == ["a", "b"] and lg2.delimiter == "|"
+    assert _sha(lg2.read_range(start, w.end)) == d0
+
+    # ... and the range stays byte-identical after the log GROWS
+    lg2.append(_batch(5, tag="later"))
+    lg2.seal_all()
+    assert _sha(RowLog(root).read_range(start, w.end)) == d0
+    assert not _no_tmp_residue(root)
+
+
+def test_multi_partition_order_is_deterministic(tmp_path):
+    root = str(tmp_path / "log")
+    lg = RowLog(root, header=["a", "b"], partitions=3, segment_rows=2)
+    rows = _batch(13)
+    for r in rows:
+        lg.append([r])
+    lg.seal_all()
+    w1 = RowLog(root).read_window(WATCH_CONSUMER)
+    w2 = RowLog(root).read_window(WATCH_CONSUMER)
+    # identical across handles (partitions ascending, segments
+    # ascending) and nothing lost or duplicated across partitions
+    assert w1.lines == w2.lines
+    assert sorted(w1.lines) == sorted(rows)
+
+
+def test_truncated_segment_is_refused_loudly(tmp_path):
+    root = str(tmp_path / "log")
+    lg = RowLog(root, header=["a", "b"], segment_rows=4)
+    lg.append(_batch(4))
+    lg.seal_all()
+    seg = os.path.join(root, "part-0", "seg-000001.rows")
+    with open(seg, encoding="utf-8") as f:
+        content = f.read()
+    with open(seg, "w", encoding="utf-8") as f:
+        f.write(content.splitlines(True)[0])   # 1 row where 4 promised
+    with pytest.raises(RuntimeError, match="corrupt"):
+        RowLog(root).read_window(WATCH_CONSUMER)
+
+
+def test_frame_round_trip_preserves_missing_tokens():
+    import pandas as pd
+    df = pd.DataFrame({"a": ["1.5", "", "x"], "b": ["", "?", "z"]})
+    lines = rows_from_frame(df, "|")
+    assert lines == ["1.5|", "|?", "x|z"]
+    back = frame_from_rows(lines, ["a", "b"], "|")
+    assert back.values.tolist() == df.values.tolist()
+
+
+def test_memory_fsspec_twin_round_trips(tmp_path):
+    pytest.importorskip("fsspec")
+    root = "memory://ingest_twin/log"
+    lg = RowLog(root, header=["a", "b"], segment_rows=4)
+    lg.append(_batch(9))
+    lg.seal_all()
+    start = lg.committed_offset(WATCH_CONSUMER)
+    w = lg.read_window(WATCH_CONSUMER)
+    assert w.lines == _batch(9)
+    lg.commit(WATCH_CONSUMER, w.end)
+    # reopen over the remote scheme: offsets, ranges, inventory
+    lg2 = RowLog(root)
+    assert lg2.lag(WATCH_CONSUMER) == 0
+    assert _sha(lg2.read_range(start, w.end)) == _sha(w.lines)
+    inv = lg2.inventory()
+    assert inv["sealed_rows"] == 9
+    assert inv["consumers"][0]["lag_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# legacy tail: line-atomicity regression (torn-final-line race)
+# ---------------------------------------------------------------------------
+
+def test_legacy_tail_never_delivers_a_torn_row(tmp_path):
+    """A slow writer mid-append (bytes flushed up to the middle of a
+    row, no newline yet) must NOT surface a torn row: the tail
+    consumes only up to the last newline and carries the partial into
+    the tick where the writer finishes it."""
+    from shifu_tpu.obs.health.watch import _production_window
+    from shifu_tpu.processor.base import ProcessorContext
+    from tests.synth import make_model_set
+
+    ms = make_model_set(tmp_path, np.random.default_rng(5), n_rows=60)
+    assert cli_main(["--dir", ms, "init"]) == 0
+    ctx = ProcessorContext.load(ms)
+    part = os.path.join(ms, "data", "part-00000")
+    template = open(part, encoding="utf-8").readline().strip()
+
+    # tick 1 consumes the whole existing table (ends in a newline)
+    tail = {}
+    df, tail = _production_window(ctx, tail)
+    base_rows = len(df)
+    assert base_rows == 48   # the 80% training split of 60 rows
+
+    # the slow writer lands one complete row and HALF of the next
+    half = len(template) // 2
+    with open(part, "a", encoding="utf-8") as f:
+        f.write(template + "\n" + template[:half])
+        f.flush()
+    df, tail = _production_window(ctx, tail)
+    assert df is not None and len(df) == 1   # the torn row held back
+    assert list(df.iloc[0]) == template.split("|")
+
+    # nothing new completed → no window, cursor still parked before
+    # the partial
+    df, tail = _production_window(ctx, tail)
+    assert df is None
+
+    # the writer finishes the row (plus one more): both arrive WHOLE
+    with open(part, "a", encoding="utf-8") as f:
+        f.write(template[half:] + "\n" + template + "\n")
+        f.flush()
+    df, tail = _production_window(ctx, tail)
+    assert df is not None and len(df) == 2
+    assert list(df.iloc[0]) == template.split("|")
+    assert list(df.iloc[1]) == template.split("|")
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill: log → watch --ingest → breach → refresh → audit
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained_set(tmp_path_factory):
+    """ONE trained tiny model set for the module (private rng — the
+    golden-file tests share the session stream); tests copy it."""
+    from tests.synth import make_model_set
+    base = tmp_path_factory.mktemp("ingest_base")
+    ms = make_model_set(base, np.random.default_rng(23), n_rows=400)
+    cfg_path = os.path.join(ms, "ModelConfig.json")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    cfg["train"]["numTrainEpochs"] = 8
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f, indent=2)
+    for cmd in ("init", "stats", "norm", "train"):
+        assert cli_main(["--dir", ms, cmd]) == 0, cmd
+    return ms
+
+
+def _clone_set(trained_set, tmp_path):
+    ms = os.path.join(str(tmp_path), "ModelSet")
+    shutil.copytree(trained_set, ms)
+    return ms
+
+
+def _shifted_rows(trained_set, delta=0.5):
+    import pandas as pd
+    hdr = open(os.path.join(trained_set, "data",
+                            ".pig_header")).read().strip().split("|")
+    df = pd.read_csv(os.path.join(trained_set, "data", "part-00000"),
+                     sep="|", names=hdr, dtype=str,
+                     keep_default_na=False)
+    for col in df.columns:
+        if not col.startswith("num_"):
+            continue
+        v = df[col].to_numpy(dtype=object).copy()
+        for i, s in enumerate(v):
+            try:
+                v[i] = f"{float(s) + delta:.6f}"
+            except (TypeError, ValueError):
+                pass
+        df[col] = v
+    return hdr, rows_from_frame(df, "|")
+
+
+def _drift_slo(ms):
+    with open(os.path.join(ms, "slo.json"), "w") as f:
+        json.dump({"slos": [
+            {"name": "drift", "metric": "drift.psi_max", "op": "<=",
+             "warn": 0.02, "breach": 0.05, "window_s": 86400.0,
+             "agg": "last"}]}, f)
+
+
+def test_watch_ingest_breach_refresh_records_auditable_range(
+        trained_set, tmp_path, monkeypatch):
+    """The whole plane, end to end: drifted rows appended to the row
+    log, ONE `watch --ingest` tick reads the committed window → PSI
+    breach → the refresh controller retrains on ITS OWN committed
+    window read → the promoted manifest records the exact (segment,
+    offset) range — and `read_range` over that recorded range re-reads
+    the challenger's training bytes exactly."""
+    from shifu_tpu.obs.health import watch as watch_mod
+    from shifu_tpu.obs.health.refresh import RefreshController
+    from shifu_tpu.processor.base import ProcessorContext
+
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    ms = _clone_set(trained_set, tmp_path)
+    reg = os.path.join(str(tmp_path), "reg")
+    v1 = registry.publish(reg, "m", os.path.join(ms, "models"),
+                          ladder=(1, 4))
+    _drift_slo(ms)
+
+    hdr, shifted = _shifted_rows(trained_set)
+    root = str(tmp_path / "rowlog")
+    lg = RowLog(root, header=hdr, segment_rows=128)
+    lg.append(shifted)
+    lg.seal_all()
+
+    ctx = ProcessorContext.load(ms)
+    ctl = RefreshController(ctx, registry_root=reg, model_name="m",
+                            tolerance=0.2, cooldown_s=0.0,
+                            ingest_log=lg)
+    rc = watch_mod.run_monitor(ctx, interval_s=0.0, iterations=1,
+                               refresh=ctl, ingest_log=lg)
+    assert rc == 0
+    assert ctl.last_outcome == "promoted", ctl.stats()
+    assert registry.head(reg, "m") == "v002"
+
+    # the manifest names the exact training window in log coordinates
+    _, _, man = registry.resolve(reg, "m")
+    assert man["refresh"]["refreshed_from"] == v1
+    iw = man["refresh"]["ingest_window"]
+    assert iw["log"] == root and iw["rows"] == len(shifted)
+
+    # audit: the recorded range re-reads the promoted model's actual
+    # training bytes, and does so identically through a fresh handle
+    replay = RowLog(root).read_range(iw["start"], iw["end"])
+    wdir = os.path.join(ms, "tmp", "refresh", "run0001", "window")
+    trained_on = [l.rstrip("\n") for l in
+                  open(os.path.join(wdir, "part-00000"),
+                       encoding="utf-8")]
+    assert replay == trained_on == shifted
+    assert _sha(RowLog(root).read_range(iw["start"], iw["end"])) \
+        == _sha(replay)
+
+    # both consumers committed exactly once — nothing skipped, nothing
+    # left to replay
+    assert lg.lag(WATCH_CONSUMER) == 0
+    assert lg.lag(REFRESH_CONSUMER) == 0
+    assert not _no_tmp_residue(root) and not _no_tmp_residue(reg)
+
+
+def test_cli_watch_ingest_and_inventory(tmp_path, monkeypatch, capsys):
+    """The CLI plumbing: `shifu watch --ingest <log> --monitor-only`
+    consumes the drifted window from the log (breach lands in the
+    store, offset commits), and `shifu ingest ls` reports the drained
+    consumer at zero lag."""
+    from shifu_tpu.obs.health import store as health_store
+    from tests.synth import make_model_set
+
+    ms = make_model_set(tmp_path, np.random.default_rng(9), n_rows=300)
+    for cmd in ("init", "stats"):
+        assert cli_main(["--dir", ms, cmd]) == 0
+    _drift_slo(ms)
+
+    hdr, shifted = _shifted_rows(ms, delta=5.0)
+    root = str(tmp_path / "rowlog")
+    lg = RowLog(root, header=hdr, segment_rows=64)
+    lg.append(shifted)
+    lg.seal_all()
+
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    assert cli_main(["--dir", ms, "watch", "--monitor-only",
+                     "--ingest", root,
+                     "--iterations", "1", "--interval-s", "0"]) == 0
+    st = health_store.MetricsStore(ms)
+    assert st.series("drift.psi_max")[-1][1] > 0.05
+    names = {e["name"] for e in st.events(limit=20)}
+    assert {"event.drift", "event.breach"} <= names
+
+    capsys.readouterr()
+    assert cli_main(["--dir", ms, "ingest", "ls", "--log", root]) == 0
+    inv = json.loads(capsys.readouterr().out)
+    assert inv["sealed_rows"] == len(shifted)
+    watch_row = next(c for c in inv["consumers"]
+                     if c["name"] == WATCH_CONSUMER)
+    assert watch_row["lag_rows"] == 0
+    assert watch_row["committed_rows"] == len(shifted)

@@ -422,6 +422,44 @@ def test_two_process_sharded_correlation_bitwise_identical(tmp_path):
         "sharded correlation diverged from the sequential run"
 
 
+def test_two_process_sharded_ingest_matches_single_writer(tmp_path):
+    """Sharded streaming ingest: 2 processes each own the row-log
+    partitions ``k % 2 == pid`` (disjoint by construction, asserted
+    from each worker's printed owned set) and append only the rows
+    routed to their partitions. The merged window read of the
+    2-process log must equal the 1-process single-writer log exactly —
+    same rows, same deterministic (partition-ascending,
+    segment-ascending) order."""
+    from shifu_tpu.data.ingest import RowLog
+
+    n_parts = 4
+    root1 = str(tmp_path / "log1")
+    root2 = str(tmp_path / "log2")
+    for r in (root1, root2):
+        RowLog(r, header=["a", "b"], partitions=n_parts,
+               segment_rows=16)
+    env = {"SHIFU_TPU_DATA_SHARD": "auto"}
+    outs = _run(2, root2, local_devices=1, mode="ingest",
+                env_extra=env)
+    _run(1, root1, local_devices=1, mode="ingest", env_extra=env)
+
+    owned = {}
+    for rc, so, se in outs:
+        for line in so.splitlines():
+            if line.startswith("OWNED "):
+                _, pid, parts = line.split(" ", 2)
+                owned[int(pid)] = eval(parts)  # noqa: S307 — our print
+    assert set(owned) == {0, 1}, owned
+    assert not set(owned[0]) & set(owned[1]), "ownership overlaps"
+    assert sorted(owned[0] + owned[1]) == list(range(n_parts))
+
+    w1 = RowLog(root1).read_window("watch")
+    w2 = RowLog(root2).read_window("watch")
+    assert w1 is not None and len(w1.lines) == 240
+    assert w2.lines == w1.lines, \
+        "sharded-writer log diverged from the single-writer log"
+
+
 def test_two_process_stats_survivor_escapes_midmerge_kill(tmp_path):
     """Mid-merge SIGKILL drill: process 1 dies INSIDE the first watched
     stats merge (fault site dist.allreduce_tree). The survivor must

@@ -126,6 +126,28 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
                     f"{label}: guardrail decision "
                     f"{gr.get('decision')!r} in a published refresh "
                     "record — only promoted runs belong in the log")
+        # streaming-ingest records: append throughput rides the generic
+        # rows_per_s gate and the replay verdict the generic
+        # bitwise_identical gate below; breach-detection latency
+        # (append → drift breach off a committed window) is
+        # lower-is-better, ceilinged vs its trailing median like the
+        # fleet p99s
+        if task == "ingest":
+            bl = newest.get("breach_latency_s")
+            if isinstance(bl, (int, float)):
+                hv = sorted(
+                    float(r["breach_latency_s"]) for r in history
+                    if isinstance(r.get("breach_latency_s"),
+                                  (int, float)))
+                if len(hv) >= min_history:
+                    median = hv[len(hv) // 2]
+                    ceil = median * (1.0 + threshold_pct / 100.0)
+                    if bl > ceil:
+                        findings.append(
+                            f"{label}: breach_latency_s {bl:.4g} is "
+                            f"{100.0 * (bl - median) / median:.1f}% "
+                            f"above the trailing median {median:.4g} "
+                            f"(threshold {threshold_pct:.0f}%)")
         if tp is None:
             print(f"  {label}: no throughput key — skipped")
             continue

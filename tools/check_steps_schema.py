@@ -59,6 +59,11 @@ task_refresh builds its record from ``profiling.REFRESH_FIELDS``,
 every member must be README-documented (the Continuous refresh
 section), and bench.py must reference the tuple.
 
+The streaming-ingest bench is pinned likewise: bench.py task_ingest
+builds its record from ``profiling.INGEST_FIELDS``, every member must
+be README-documented (the Streaming ingest section), and bench.py
+must reference the tuple.
+
 The health plane is pinned likewise: every metrics.jsonl point is
 ``profiling.METRIC_FIELDS`` (built by obs/health/store.py), every SLO
 record is ``profiling.HEALTH_FIELDS`` (built by obs/health/slo.py),
@@ -102,7 +107,8 @@ def documented_fields() -> set:
         set(fleet_fields()) | set(dag_fields()) | \
         set(dag_summary_fields()) | set(trace_fields()) | \
         set(metric_fields()) | set(health_fields()) | \
-        set(shard_fields()) | set(refresh_fields())
+        set(shard_fields()) | set(refresh_fields()) | \
+        set(ingest_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -197,6 +203,10 @@ def shard_fields() -> tuple:
 
 def refresh_fields() -> tuple:
     return _profiling_tuple("REFRESH_FIELDS")
+
+
+def ingest_fields() -> tuple:
+    return _profiling_tuple("INGEST_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -412,6 +422,33 @@ def check_refresh_docs() -> int:
     return 0
 
 
+def check_ingest_docs() -> int:
+    """Every INGEST_FIELDS member (bench.py task_ingest's record
+    schema, the streaming row-log bench) must be backtick-documented
+    in README's Streaming ingest section, and task_ingest must build
+    its record from the tuple — the literal check asserts bench.py
+    references `INGEST_FIELDS` so the record cannot silently drift
+    from the pinned schema."""
+    fields = ingest_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("ingest schema drift: INGEST_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "INGEST_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the ingest record from "
+              "profiling.INGEST_FIELDS", file=sys.stderr)
+        return 1
+    print(f"streaming ingest: all {len(fields)} INGEST_FIELDS "
+          "documented in README and pinned in bench.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -478,6 +515,8 @@ def main(argv) -> int:
     if check_shard_docs():
         return 1
     if check_refresh_docs():
+        return 1
+    if check_ingest_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
