@@ -124,6 +124,20 @@ SERVE_FEATURES = 30
 SERVE_HIDDEN = (64, 32)
 SERVE_MIX = (1, 4, 16, 64)
 
+# tree-serving bench (fused Pallas ensemble kernel behind the same
+# service): a published GBT sized like a production scoring model —
+# wide enough that binning is real work, deep enough that the
+# whole-ensemble walk dominates — served over the same mixed Poisson
+# load as the NN plane, plus an offline fused-vs-xla A/B throughput
+SERVE_TREE_NUM = 20       # numeric columns
+SERVE_TREE_CAT = 2        # categorical columns
+SERVE_TREE_VOCAB = 8
+SERVE_TREE_TREES = 16
+SERVE_TREE_DEPTH = 5
+SERVE_TREE_BINS = 32
+SERVE_TREE_ROWS = 4000    # training rows
+SERVE_TREE_AB_ROWS = 20_000  # offline A/B batch
+
 # closed-loop refresh bench (breach → retrain → guardrail → promote →
 # hot swap): sized so the warm-start retrain is the dominant term, as
 # in production, while the whole loop stays CPU-runnable
@@ -1635,6 +1649,183 @@ def task_serving():
     print(json.dumps(record))
 
 
+def task_serving_tree():
+    """Tree-ensemble serving bench: the same open-loop Poisson load as
+    `task_serving`, but against a published GBT served on the fused
+    Pallas ensemble kernel (ops/pallas_trees.py — in-register binning +
+    whole-ensemble VMEM walk, one launch per row tile). Reports the
+    SERVING_FIELDS plus TREE_SERVE_FIELDS: an offline A/B of the fused
+    route vs the interpretive bin_dataset + predict_trees walk on the
+    same batch, and per-request-size p99s. On CPU the kernel runs in
+    Pallas interpret mode — the A/B there validates the plumbing, not
+    the speedup (tools/bench_regress.py only gates fused_speedup ≥ 1
+    on TPU records)."""
+    import queue as queue_mod
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from shifu_tpu import profiling
+    from shifu_tpu.config.environment import knob_float
+    from shifu_tpu.data import pipeline
+    from shifu_tpu.models import gbdt
+    from shifu_tpu.models.spec import save_model
+    from shifu_tpu.ops import pallas_trees
+    from shifu_tpu.serve.service import ScorerService
+
+    qps = knob_float("SHIFU_TPU_SERVE_BENCH_QPS")
+    duration = knob_float("SHIFU_TPU_SERVE_BENCH_SECONDS")
+    max_delay_ms = knob_float("SHIFU_TPU_SERVE_MAX_DELAY_MS")
+
+    # train + publish a GBT on synthetic cleaned features (NaN-missing
+    # numeric + coded categoricals), the exact block layout the serving
+    # plane ships (raw_dense/raw_codes)
+    rng = np.random.default_rng(7)
+    dense = rng.normal(0, 1, (SERVE_TREE_ROWS, SERVE_TREE_NUM)) \
+        .astype(np.float32)
+    dense[rng.random(dense.shape) < 0.02] = np.nan  # real missing traffic
+    codes = rng.integers(0, SERVE_TREE_VOCAB,
+                         (SERVE_TREE_ROWS, SERVE_TREE_CAT)) \
+        .astype(np.int32)
+    y = ((np.nan_to_num(dense[:, 0]) + np.nan_to_num(dense[:, 1])
+          + 0.3 * codes[:, 0]) > 0.9).astype(np.float32)
+    # n_bins-2 interior quantile boundaries → n_bins-1 value slots +
+    # the shared missing slot, the train_tree._tables_and_cfg layout
+    qs = np.linspace(0, 1, SERVE_TREE_BINS)[1:-1]
+    num_cuts = np.nanquantile(dense, qs, axis=0).astype(np.float32)
+    tables = gbdt.make_bin_tables(
+        num_cuts, [np.arange(SERVE_TREE_VOCAB, dtype=np.int32)
+                   for _ in range(SERVE_TREE_CAT)], SERVE_TREE_BINS)
+    bins = gbdt.bin_dataset(tables, dense, codes, SERVE_TREE_BINS)
+    cfg = gbdt.TreeConfig(max_depth=SERVE_TREE_DEPTH,
+                          n_bins=SERVE_TREE_BINS,
+                          learning_rate=0.1, loss="log")
+    trees, _ = gbdt.build_gbt(cfg, bins, y,
+                              np.ones(SERVE_TREE_ROWS, np.float32),
+                              SERVE_TREE_TREES)
+    meta = {"kind": "gbt",
+            "treeConfig": {"max_depth": cfg.max_depth,
+                           "n_bins": cfg.n_bins,
+                           "learning_rate": cfg.learning_rate,
+                           "loss": cfg.loss}}
+    params = {"trees": jax.tree.map(np.asarray, trees),
+              "tables": tables}
+    root = tempfile.mkdtemp(prefix="shifu_serve_tree_bench_")
+    save_model(os.path.join(root, "models", "model0.npz"), "gbt",
+               meta, params)
+
+    # offline fused-vs-xla A/B on one large batch: the serve-path
+    # before/after number, measured on whatever route each name pins
+    ab_dense = dense[rng.integers(0, SERVE_TREE_ROWS,
+                                  SERVE_TREE_AB_ROWS)]
+    ab_codes = codes[rng.integers(0, SERVE_TREE_ROWS,
+                                  SERVE_TREE_AB_ROWS)]
+
+    def _ab(route):
+        gbdt.predict(meta, params, ab_dense, ab_codes, route=route)
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            gbdt.predict(meta, params, ab_dense, ab_codes, route=route)
+        return reps * SERVE_TREE_AB_ROWS / (time.perf_counter() - t0)
+
+    xla_rows_per_s = _ab("xla")
+    fused_rows_per_s = _ab("pallas")
+    tree_route = pallas_trees.tree_fused_mode()
+    _log(f"[serving_tree] A/B: fused {fused_rows_per_s:,.0f} rows/s vs "
+         f"xla walk {xla_rows_per_s:,.0f} rows/s "
+         f"(x{fused_rows_per_s / xla_rows_per_s:.2f}, serve route "
+         f"{tree_route})")
+
+    service = ScorerService(models_dir=os.path.join(root, "models"),
+                            workspace_root=root)
+    pool_d = dense[:max(SERVE_MIX)]
+    pool_c = codes[:max(SERVE_MIX)]
+    service.start(proto={"raw_dense": pool_d[:1],
+                         "raw_codes": pool_c[:1]})
+    warm_s = service.stats()["warm_s"]
+    _log(f"[serving_tree] warm: {len(service.ladder)} buckets in "
+         f"{warm_s:.2f}s")
+    pipeline.drain_stage_timers()  # warmup compiles are not steady state
+
+    n_req = max(int(qps * duration), 1)
+    gaps = rng.exponential(1.0 / qps, n_req)
+    sizes = rng.choice(SERVE_MIX, n_req)
+    reqs, req_sizes, rejected = [], [], 0
+    t_start = time.monotonic()
+    t_next = t_start
+    for i in range(n_req):
+        t_next += gaps[i]
+        lag = t_next - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        try:
+            reqs.append(service.submit_async(
+                raw_dense=pool_d[:sizes[i]],
+                raw_codes=pool_c[:sizes[i]]))
+            req_sizes.append(int(sizes[i]))
+        except queue_mod.Full:
+            rejected += 1
+    lat, dev = [], []
+    for r in reqs:
+        r.wait(60.0)
+        lat.append(r.timing["total_s"])
+        dev.append(r.timing["device_s"])
+    elapsed = time.monotonic() - t_start
+    service.close()
+
+    steady = pipeline.drain_stage_timers()
+    misses = int(steady.get("compile_cache_misses", 0))
+    lat = np.asarray(lat)
+    p50, p95, p99 = (np.percentile(lat, [50, 95, 99]) * 1e3
+                     if lat.size else (0.0, 0.0, 0.0))
+    budget_ms = float(np.percentile(dev, 95)) * 1e3 if dev else 0.0
+    by_class = {}
+    for sz in SERVE_MIX:
+        cls = lat[np.asarray(req_sizes) == sz]
+        if cls.size:
+            by_class[str(sz)] = round(
+                float(np.percentile(cls, 99)) * 1e3, 3)
+    bstats = service.stats()["batcher"]
+    rows_per_s = bstats["rows"] / elapsed
+    stats = {
+        "qps_offered": qps,
+        "qps_sustained": round(len(reqs) / elapsed, 2),
+        "requests": len(reqs),
+        "rejected": rejected,
+        "rows_per_s": round(rows_per_s, 2),
+        "p50_ms": round(float(p50), 3),
+        "p95_ms": round(float(p95), 3),
+        "p99_ms": round(float(p99), 3),
+        "batch_occupancy": round(bstats["occupancy_mean"], 4),
+        "rows_per_batch": round(bstats["rows_per_batch"], 2),
+        "serve_warm_s": round(warm_s, 3),
+        "device_step_budget_ms": round(budget_ms, 3),
+        "compile_cache_misses_steady": misses,
+        "tree_route": tree_route,
+        "fused_rows_per_s": round(fused_rows_per_s, 1),
+        "xla_rows_per_s": round(xla_rows_per_s, 1),
+        "fused_speedup": round(fused_rows_per_s / xla_rows_per_s, 3),
+    }
+    if misses:
+        _log(f"[serving_tree] WARNING: {misses} steady-state "
+             "compile-cache misses — the shape-bucket discipline "
+             "leaked a shape")
+    record = {k: stats[k] for k in (profiling.SERVING_FIELDS
+                                    + profiling.TREE_SERVE_FIELDS)}
+    record["p99_ms_by_class"] = by_class
+    record["roofline"] = profiling.roofline(
+        "SERVE-TREE",
+        *profiling.tree_row_costs(SERVE_TREE_NUM + SERVE_TREE_CAT,
+                                  SERVE_TREE_BINS, SERVE_TREE_DEPTH,
+                                  n_trees=SERVE_TREE_TREES,
+                                  phase="infer"),
+        rows_per_s)
+    print(json.dumps(record))
+
+
 def task_fleet():
     """Multi-tenant fleet bench: N registry-published models (mixed
     priority classes) behind one `FleetService` under shifted
@@ -2385,6 +2576,12 @@ def _workload(task):
                      "evals": len(PIPE_EVALS)},
         "rf": {"rows": RF_ROWS, "cols": GBT_COLS, "trees": RF_TREES,
                "depth": RF_DEPTH},
+        "serving_tree": {"num": SERVE_TREE_NUM, "cat": SERVE_TREE_CAT,
+                         "trees": SERVE_TREE_TREES,
+                         "depth": SERVE_TREE_DEPTH,
+                         "bins": SERVE_TREE_BINS,
+                         "mix": list(SERVE_MIX),
+                         "ab_rows": SERVE_TREE_AB_ROWS},
         "cpu_denom": {"nn": [N_ROWS, N_FEATURES, HIDDEN],
                       "nn_wide": [CPU_WIDE_ROWS, WIDE_FEATURES,
                                   list(WIDE_HIDDEN)],
@@ -2555,6 +2752,8 @@ def main():
         return task_pipeline()
     if args.task == "serving":
         return task_serving()
+    if args.task == "serving_tree":
+        return task_serving_tree()
     if args.task == "fleet":
         return task_fleet()
     if args.task == "refresh":
@@ -2629,6 +2828,9 @@ def main():
                  f"{BENCH_EPOCHS} epochs)", timeout=2400)
             step("serving", "serving-plane bench (open-loop Poisson, "
                  f"mix {SERVE_MIX})", timeout=1800)
+            step("serving_tree", "tree-serving bench (fused ensemble "
+                 f"kernel, {SERVE_TREE_TREES} trees depth "
+                 f"{SERVE_TREE_DEPTH}, mix {SERVE_MIX})", timeout=1800)
             step("gbt", f"GBT end-to-end train bench ({GBT_ROWS}x"
                  f"{GBT_COLS}, {GBT_TREES} trees)", timeout=3000)
             step("gbt_stream", "streaming GBT state-tier bench "
@@ -2704,6 +2906,14 @@ def main():
         extra["serve_p99_ms"] = round(sv["p99_ms"], 2)
         extra["serve_occupancy"] = round(sv["batch_occupancy"], 3)
         extra["serve_steady_misses"] = sv["compile_cache_misses_steady"]
+
+    def _fill_serving_tree(st_):
+        extra["serve_tree_rows_per_s"] = round(st_["rows_per_s"], 1)
+        extra["serve_tree_p99_ms"] = round(st_["p99_ms"], 2)
+        extra["serve_tree_route"] = st_["tree_route"]
+        extra["serve_tree_fused_speedup"] = st_["fused_speedup"]
+        extra["serve_tree_steady_misses"] = \
+            st_["compile_cache_misses_steady"]
 
     def _fill_hists(hp):
         hx = res.get("hist_xla")
@@ -2824,6 +3034,7 @@ def main():
     fill("gbt", _fill_gbt)
     fill("gbt_stream", _fill_gbt_stream)
     fill("serving", _fill_serving)
+    fill("serving_tree", _fill_serving_tree)
     fill("streaming", _fill_streaming)
 
     # per-family roofline blocks (profiling.roofline): every task that

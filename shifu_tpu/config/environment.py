@@ -247,6 +247,18 @@ _declare("SHIFU_TPU_SCORE_FUSED", "str", "auto",
 _declare("SHIFU_TPU_SPLIT_FUSED", "str", "auto",
          "fused GBT split-search kernel route (cumsum+gain+argmax in "
          "one pallas kernel): auto | pallas | xla")
+_declare("SHIFU_TPU_TREE_FUSED", "str", "auto",
+         "fused GBT/RF ensemble-inference kernel route (in-register "
+         "binning + whole-ensemble breadth-first walk + convert in "
+         "one pallas kernel): auto | pallas | xla")
+_declare("SHIFU_TPU_TREE_VMEM_MB", "int", 64,
+         "VMEM budget for the fused tree-inference kernel's row "
+         "tiling (pallas_trees._derive_row_tile)")
+_declare("SHIFU_TPU_TREE_SCAN", "bool", "1",
+         "1 = build_tree/build_forest and the resident streaming GBT "
+         "tier grow all levels inside one lax.fori_loop dispatch "
+         "(fixed-width level state, masked inactive nodes); 0 = the "
+         "per-level Python loop (depth+1 dispatches per tree)")
 _declare("SHIFU_TPU_GBT_RESIDENT_STATE", "str", "auto",
          "streaming GBT row-state tier: 1 keeps node/pred/grad/hess as "
          "device arrays (zero host syncs per level, one per round), 0 "

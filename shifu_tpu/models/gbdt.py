@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shifu_tpu.config.environment import knob_bool, knob_int, knob_str
-from shifu_tpu.data.pipeline import host_fetch
+from shifu_tpu.data.pipeline import add_stage_count, host_fetch
 
 if hasattr(jax, "shard_map"):
     def _shard_map(*, mesh, in_specs, out_specs, check_vma=False):
@@ -157,10 +157,16 @@ def make_fused_inputs(tables: Dict[str, np.ndarray],
     n_bins-1) becomes NaN so the kernel's NaN→missing rule lands it
     in the same slot."""
     num_cuts = np.asarray(tables["num_cuts"], np.float32)   # (K0, Cn)
-    vals_parts: List[np.ndarray] = []
+    vals_parts: List[Any] = []
     cut_parts: List[np.ndarray] = []
     if dense is not None and dense.shape[1]:
-        vals_parts.append(np.asarray(dense, np.float32).T)  # (Cn, R)
+        if isinstance(dense, jax.Array):
+            # the serving plane pre-placed the raw numeric block on
+            # device (its timed h2d stage) — transpose there; np.asarray
+            # would drag it back through the host
+            vals_parts.append(jnp.asarray(dense, jnp.float32).T)
+        else:
+            vals_parts.append(np.asarray(dense, np.float32).T)  # (Cn, R)
         cut_parts.append(np.ascontiguousarray(num_cuts.T))  # (Cn, K0)
     if codes is not None and codes.shape[1]:
         cat_map = tables["cat_map"]
@@ -178,7 +184,12 @@ def make_fused_inputs(tables: Dict[str, np.ndarray],
     k = max(p.shape[1] for p in cut_parts)
     cut_parts = [np.pad(p, ((0, 0), (0, k - p.shape[1])),
                         constant_values=np.inf) for p in cut_parts]
-    return FusedBins(np.ascontiguousarray(np.concatenate(vals_parts)),
+    if any(isinstance(p, jax.Array) for p in vals_parts):
+        valuesT = jnp.concatenate([jnp.asarray(p, jnp.float32)
+                                   for p in vals_parts])
+    else:
+        valuesT = np.ascontiguousarray(np.concatenate(vals_parts))
+    return FusedBins(valuesT,
                      np.ascontiguousarray(np.concatenate(cut_parts)))
 
 
@@ -312,6 +323,12 @@ def build_forest(cfg: TreeConfig, binsT, grad_T, hess_T, feature_masks,
     of re-walking T trees)."""
     c, r = binsT.shape
     n_trees = grad_T.shape[0]
+    if tree_scan_enabled() and cfg.max_depth >= 1:
+        trees, node_T = _grow_forest_scan(cfg, binsT, grad_T, hess_T,
+                                          feature_masks, mesh, subtract)
+        if return_nodes:
+            return trees, node_T
+        return trees
     trees = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (n_trees,) + a.shape),
         _empty_tree(cfg))
@@ -523,8 +540,16 @@ def _route_mode() -> str:
 def _route_level(cfg: TreeConfig, tree, binsT, node_of_row, depth: int):
     """Advance rows one level: bin <= split_bin → left child (2i+1);
     missing uses the node's default direction. binsT: (C, R)."""
-    level_offset = 2 ** depth - 1
-    n_level = 2 ** depth
+    return _route_level_at(cfg, tree, binsT, node_of_row,
+                           2 ** depth - 1, 2 ** depth)
+
+
+def _route_level_at(cfg: TreeConfig, tree, binsT, node_of_row,
+                    level_offset, n_level):
+    """_route_level core with level_offset/n_level as values rather
+    than a static depth — the same arithmetic op-for-op, so the
+    fori_loop scan builder (which traces them) routes bitwise like
+    the per-level builder."""
     node_feat = tree["feature"][node_of_row]               # (R,)
     node_bin = tree["bin"][node_of_row]
     node_dl = tree["default_left"][node_of_row]
@@ -581,6 +606,12 @@ def build_tree(cfg: TreeConfig, binsT, grad, hess, feature_mask, mesh=None,
     max_depth gathers over the (C, R) bin matrix per round.
     """
     c, r = binsT.shape
+    if tree_scan_enabled() and cfg.max_depth >= 1:
+        tree, node_of_row = _grow_tree_scan(cfg, binsT, grad, hess,
+                                            feature_mask, mesh, subtract)
+        if return_nodes:
+            return tree, node_of_row
+        return tree
     tree = _empty_tree(cfg)
     node_of_row = jnp.zeros(r, jnp.int32)  # all rows at root
 
@@ -667,6 +698,195 @@ def _subtract_siblings(prev_g, prev_h, gl, hl, split, n_level):
     g = jnp.stack([gl, gr], axis=-3).reshape(lead + (n_level, c, b))
     h = jnp.stack([hl, hr], axis=-3).reshape(lead + (n_level, c, b))
     return g, h
+
+
+# ---------------------------------------------------------------------------
+# Single-dispatch builds — all levels inside one lax.fori_loop
+# ---------------------------------------------------------------------------
+
+def tree_scan_enabled() -> bool:
+    """SHIFU_TPU_TREE_SCAN=1 grows every level of build_tree /
+    build_forest / the single-chunk resident streaming tier inside ONE
+    lax.fori_loop-over-levels jit — one dispatch per tree (or per
+    lockstep forest round) instead of (depth+1). Read at TRACE time
+    like the other build knobs."""
+    return knob_bool("SHIFU_TPU_TREE_SCAN")
+
+
+def _fold_splits_masked(cfg: TreeConfig, tree, s, level_offset, n_level,
+                        n_max: int):
+    """_fold_splits at a FIXED n_max slot width with traced
+    level_offset/n_level: slots past the live level get an
+    out-of-range scatter id and DROP, so a fori_loop level body reuses
+    one shape for every depth without clobbering later levels' nodes.
+    For live slots the written values are the same expressions as
+    _fold_splits — bitwise parity per node."""
+    rng = jnp.arange(n_max)
+    ids = level_offset + rng
+    safe = jnp.where(rng < n_level, ids, cfg.n_nodes)  # OOB → dropped
+    can_split = (s["gain"] > cfg.min_info_gain) & jnp.isfinite(s["gain"])
+    tree = dict(tree)
+    tree["feature"] = tree["feature"].at[safe].set(
+        jnp.where(can_split, s["feature"], -1), mode="drop")
+    tree["bin"] = tree["bin"].at[safe].set(s["bin"], mode="drop")
+    tree["default_left"] = tree["default_left"].at[safe].set(
+        s["default_left"], mode="drop")
+    tree["gain"] = tree["gain"].at[safe].set(
+        jnp.where(can_split, s["gain"], 0.0), mode="drop")
+    g_tot = s["g_tot"] if s["g_tot"].ndim == 1 else s["g_tot"][:, 0]
+    h_tot = s["h_tot"] if s["h_tot"].ndim == 1 else s["h_tot"][:, 0]
+    val = -g_tot / (h_tot + cfg.reg_lambda)
+    tree["is_leaf"] = tree["is_leaf"].at[safe].set(~can_split,
+                                                   mode="drop")
+    tree["leaf_value"] = tree["leaf_value"].at[safe].set(
+        jnp.where(can_split, 0.0, val), mode="drop")
+    return tree
+
+
+def _parent_split_mask_at(is_leaf, feature, prev_offset, n_slots: int):
+    """_parent_split_mask at a fixed n_slots width with a traced
+    prev_offset. Slots past the real parent level read ids that spill
+    into the (still-empty) current level — feature -1 there masks them
+    False, so phantom parents can never subtract."""
+    parent_ids = prev_offset + jnp.arange(n_slots)
+    return (~is_leaf[..., parent_ids]) & (feature[..., parent_ids] >= 0)
+
+
+def _grow_tree_scan(cfg: TreeConfig, binsT, grad, hess, feature_mask,
+                    mesh, subtract, node0=None):
+    """build_tree's level loop as one lax.fori_loop over depths
+    1..max_depth-1 (depth 0 and the final leaf level peel off
+    statically — the first has no parent state, the last no splits).
+    Every in-loop level runs at the fixed width n_max = 2^max_depth:
+    dead slots carry zero histograms, scatter-drop out of the fold,
+    and subtract as masked zeros — the same per-cell adds and
+    per-node split math as the per-level loop, so trees are bitwise
+    identical on the XLA scatter path (tests/test_gbt_device.py pins
+    it). Returns (tree, node_of_row) like build_tree(return_nodes).
+
+    node0: optional initial row→node vector (the streaming tiers park
+    pad rows at -1, which dumps/ignores them exactly as the per-level
+    _stream_level_chunk does)."""
+    c, r = binsT.shape
+    n_max = 2 ** cfg.max_depth
+    fm = feature_mask
+    use_sub = _use_hist_subtract() if subtract is None else subtract
+    tree = _empty_tree(cfg)
+    node = jnp.zeros(r, jnp.int32) if node0 is None else node0
+
+    g, h = _level_histograms(binsT, node, grad, hess, 0, n_max,
+                             cfg.n_bins, mesh=mesh)
+    tree = _fold_splits_masked(cfg, tree, _best_splits((g, h), cfg, fm),
+                               0, 1, n_max)
+    node = _route_level_at(cfg, tree, binsT, node, 0, 1)
+
+    def body(d, carry):
+        tree, node, prev_g, prev_h = carry
+        offset = jnp.left_shift(1, d) - 1
+        width = jnp.left_shift(1, d)
+        if use_sub:
+            half = _left_half_nodes(node, offset, width)
+            gl, hl = _level_histograms(binsT, half, grad, hess, offset,
+                                       n_max, cfg.n_bins, mesh=mesh)
+            split = _parent_split_mask_at(
+                tree["is_leaf"], tree["feature"],
+                jnp.left_shift(1, d - 1) - 1, n_max // 2)
+            g, h = _subtract_siblings(
+                prev_g[:n_max // 2], prev_h[:n_max // 2],
+                gl[:n_max // 2], hl[:n_max // 2], split, n_max)
+        else:
+            g, h = _level_histograms(binsT, node, grad, hess, offset,
+                                     n_max, cfg.n_bins, mesh=mesh)
+        s = _best_splits((g, h), cfg, fm)
+        tree = _fold_splits_masked(cfg, tree, s, offset, width, n_max)
+        node = _route_level_at(cfg, tree, binsT, node, offset, width)
+        return tree, node, g, h
+
+    if cfg.max_depth > 1:
+        tree, node, g, h = jax.lax.fori_loop(1, cfg.max_depth, body,
+                                             (tree, node, g, h))
+    # final level: width is exactly n_max (static) — reuse the
+    # per-level builder's own histogram step for bitwise parity
+    g_f, h_f = _child_level_histograms(
+        cfg, binsT, node, grad, hess, cfg.max_depth,
+        g[:n_max // 2] if n_max > 1 else g,
+        h[:n_max // 2] if n_max > 1 else h,
+        tree["is_leaf"], tree["feature"], mesh, subtract)
+    tree = _final_leaves(cfg, tree, g_f, h_f)
+    return tree, node
+
+
+def _forest_apply_level_masked(cfg: TreeConfig, trees, g, h,
+                               feature_masks, offset, width, n_max: int):
+    """_forest_apply_level at the fixed scan width (one split search
+    over T·n_max slots; dead slots drop out of the masked fold)."""
+    t, p, c, b = g.shape
+    mask2 = jnp.repeat(feature_masks, p, axis=0)           # (T·P, C)
+    s = _best_splits((g.reshape(t * p, c, b), h.reshape(t * p, c, b)),
+                     cfg, mask2)
+    s_T = jax.tree.map(lambda a: a.reshape((t, p) + a.shape[1:]), s)
+    return jax.vmap(lambda tr, sv: _fold_splits_masked(
+        cfg, tr, sv, offset, width, n_max))(trees, s_T)
+
+
+def _grow_forest_scan(cfg: TreeConfig, binsT, grad_T, hess_T,
+                      feature_masks, mesh, subtract):
+    """build_forest's lockstep level loop inside one fori_loop — the
+    forest twin of _grow_tree_scan: a whole bagged round is ONE
+    dispatch. Returns (trees, node_T)."""
+    c, r = binsT.shape
+    n_trees = grad_T.shape[0]
+    n_max = 2 ** cfg.max_depth
+    use_sub = _use_hist_subtract() if subtract is None else subtract
+    trees = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_trees,) + a.shape),
+        _empty_tree(cfg))
+    node_T = jnp.zeros((n_trees, r), jnp.int32)
+
+    g, h = _forest_level_histograms(binsT, node_T, grad_T, hess_T, 0,
+                                    n_max, cfg.n_bins, mesh=mesh)
+    trees = _forest_apply_level_masked(cfg, trees, g, h, feature_masks,
+                                       0, 1, n_max)
+    node_T = jax.vmap(lambda t, n: _route_level_at(
+        cfg, t, binsT, n, 0, 1))(trees, node_T)
+
+    def body(d, carry):
+        trees, node_T, prev_g, prev_h = carry
+        offset = jnp.left_shift(1, d) - 1
+        width = jnp.left_shift(1, d)
+        if use_sub:
+            half_T = _left_half_nodes(node_T, offset, width)
+            gl, hl = _forest_level_histograms(binsT, half_T, grad_T,
+                                              hess_T, offset, n_max,
+                                              cfg.n_bins, mesh=mesh)
+            split = _parent_split_mask_at(
+                trees["is_leaf"], trees["feature"],
+                jnp.left_shift(1, d - 1) - 1, n_max // 2)
+            g, h = _subtract_siblings(
+                prev_g[:, :n_max // 2], prev_h[:, :n_max // 2],
+                gl[:, :n_max // 2], hl[:, :n_max // 2], split, n_max)
+        else:
+            g, h = _forest_level_histograms(binsT, node_T, grad_T,
+                                            hess_T, offset, n_max,
+                                            cfg.n_bins, mesh=mesh)
+        trees = _forest_apply_level_masked(cfg, trees, g, h,
+                                           feature_masks, offset, width,
+                                           n_max)
+        node_T = jax.vmap(lambda t, n: _route_level_at(
+            cfg, t, binsT, n, offset, width))(trees, node_T)
+        return trees, node_T, g, h
+
+    if cfg.max_depth > 1:
+        trees, node_T, g, h = jax.lax.fori_loop(1, cfg.max_depth, body,
+                                                (trees, node_T, g, h))
+    g_f, h_f = _forest_child_histograms(
+        cfg, binsT, node_T, grad_T, hess_T, cfg.max_depth,
+        g[:, :n_max // 2] if n_max > 1 else g,
+        h[:, :n_max // 2] if n_max > 1 else h,
+        trees, mesh, subtract)
+    trees = jax.vmap(lambda t, gh, hh: _final_leaves(cfg, t, gh, hh)
+                     )(trees, g_f, h_f)
+    return trees, node_T
 
 
 def _walk_trees(trees, binsT, max_depth: int, n_bins: int):
@@ -1254,6 +1474,7 @@ def _build_tree_streaming(cfg: TreeConfig, bins_mm, grad_of_chunk,
             # overlaps device compute, THEN sync on the routed nodes
             node_c, g, h = _stream_level_chunk(
                 cfg, tree, *cur, depth=depth, mesh=hist_mesh, half=half)
+            add_stage_count("tree_build_dispatches")
             if ci + 1 < len(bounds):
                 cur = put(bounds[ci + 1])
             node_host[a:b] = host_fetch(node_c)[:b - a]
@@ -1299,6 +1520,7 @@ def _build_tree_streaming_device(cfg: TreeConfig, bins_put, n_chunks: int,
             node_c, g, h = _stream_level_chunk(
                 cfg, tree, cur, node_state[ci], grad_state[ci],
                 hess_state[ci], depth=depth, mesh=hist_mesh, half=half)
+            add_stage_count("tree_build_dispatches")
             if ci + 1 < n_chunks:
                 cur = bins_put(ci + 1)  # h2d overlaps device compute
             node_state[ci] = node_c
@@ -1315,6 +1537,18 @@ def _build_tree_streaming_device(cfg: TreeConfig, bins_put, n_chunks: int,
         else:
             tree = _final_leaves(cfg, tree, g_acc, h_acc)
     return tree
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _build_tree_fused_resident(cfg: TreeConfig, binsT_c, node0, grad_c,
+                               hess_c, fm, mesh=None):
+    """Whole-tree single-dispatch build for the resident streaming
+    tier when the data is ONE chunk: the fori_loop scan builder grows
+    every level inside this jit, so a round costs one dispatch instead
+    of (max_depth+1). node0 carries the pad rows at -1 (hist dump slot
+    + routing no-op), exactly like _stream_level_chunk."""
+    return _grow_tree_scan(cfg, binsT_c.astype(jnp.int32), grad_c,
+                           hess_c, fm, mesh, None, node0=node0)
 
 
 def _build_gbt_streaming_resident(cfg: TreeConfig, bins_mm, y_mm, w_mm,
@@ -1392,6 +1626,12 @@ def _build_gbt_streaming_resident(cfg: TreeConfig, bins_mm, y_mm, w_mm,
 
     grad_state: List[Any] = [None] * n_chunks
     hess_state: List[Any] = [None] * n_chunks
+    # single-chunk data + the scan builder ⇒ the bins chunk stays
+    # resident across rounds and a whole tree is ONE dispatch per round
+    # (counted via tree_build_dispatches; tests/test_gbt_device.py)
+    resident_fused = (n_chunks == 1 and tree_scan_enabled()
+                      and cfg.max_depth >= 1)
+    bins_resident = bins_put(0) if resident_fused else None
     val_errs: List[float] = []
     best_val, bad = np.inf, 0
     for t in range(n_trees):
@@ -1399,9 +1639,16 @@ def _build_gbt_streaming_resident(cfg: TreeConfig, bins_mm, y_mm, w_mm,
         for ci in range(n_chunks):  # on-device gradient refresh
             grad_state[ci], hess_state[ci] = _grad_chunk(
                 y_dev[ci], pred_dev[ci], w_dev[ci], loss=cfg.loss)
-        tree = _build_tree_streaming_device(
-            cfg, bins_put, n_chunks, node_state, grad_state, hess_state,
-            fm, hist_mesh)
+        if resident_fused:
+            tree, node_c = _build_tree_fused_resident(
+                cfg, bins_resident, node_state[0], grad_state[0],
+                hess_state[0], jnp.asarray(fm), mesh=hist_mesh)
+            node_state[0] = node_c
+            add_stage_count("tree_build_dispatches")
+        else:
+            tree = _build_tree_streaming_device(
+                cfg, bins_put, n_chunks, node_state, grad_state,
+                hess_state, fm, hist_mesh)
         trees.append(tree)
         for ci in range(n_chunks):  # leaf gather — no IO, no sync
             pred_dev[ci] = _apply_contrib_chunk(
@@ -1631,13 +1878,41 @@ def bin_dataset(tables: Dict[str, np.ndarray], dense: np.ndarray,
 
 
 def predict(meta: Dict[str, Any], params: Any, dense: np.ndarray,
-            codes: Optional[np.ndarray]) -> np.ndarray:
-    """Score a saved GBT/RF spec on raw cleaned features."""
+            codes: Optional[np.ndarray],
+            route: Optional[str] = None) -> np.ndarray:
+    """Score a saved GBT/RF spec on raw cleaned features.
+
+    route: None follows SHIFU_TPU_TREE_FUSED (auto|pallas|xla); the
+    explicit values pin a path — "xla" is the interpretive
+    bin_dataset + predict_trees walk kept as the parity reference
+    (tests/test_pallas_trees.py), "pallas" the fused ensemble kernel
+    (ops/pallas_trees.py: in-register binning + whole-ensemble walk +
+    convert, one launch per row tile — no host bin_dataset pass).
+    `dense` may be a device array on the pallas route (the serving
+    plane's pre-placed h2d block rides through make_fused_inputs)."""
     from shifu_tpu.parallel import mesh as mesh_mod
     cfg_meta = meta["treeConfig"]
     n_bins = int(cfg_meta["n_bins"])
     tables = {"num_cuts": np.asarray(params["tables"]["num_cuts"]),
               "cat_map": np.asarray(params["tables"]["cat_map"])}
+    from shifu_tpu.ops import pallas_trees
+    mode = route or pallas_trees.tree_fused_mode()
+    if mode == "pallas":
+        fb = make_fused_inputs(tables, dense, codes, n_bins)
+        trees_np = jax.tree.map(np.asarray, params["trees"])
+        packed, _ = pallas_trees.pack_ensemble(trees_np)
+        scores = pallas_trees.predict_ensemble(
+            jnp.asarray(packed), jnp.asarray(fb.valuesT),
+            jnp.asarray(fb.cuts),
+            n_trees=int(trees_np["feature"].shape[0]),
+            kind=str(meta["kind"]),
+            loss=str(cfg_meta.get("loss", "squared")),
+            learning_rate=float(cfg_meta["learning_rate"]),
+            max_depth=int(cfg_meta["max_depth"]), n_bins=n_bins,
+            interpret=jax.default_backend() != "tpu")
+        return np.asarray(scores)
+    if isinstance(dense, jax.Array):  # xla walk is a host-numpy path
+        dense = np.asarray(dense)
     bins = bin_dataset(tables, dense, codes, n_bins)
     n_rows = bins.shape[0]
     trees = jax.tree.map(jnp.asarray, params["trees"])

@@ -1,0 +1,237 @@
+"""Pallas TPU kernel: fused GBT/RF ensemble inference.
+
+The tree serving path (`models/gbdt.predict`) used to run three host
+round-trips per request: a numpy `bin_dataset` pass over the raw
+cleaned features, the interpretive `_walk_trees` per-level gather walk
+(max_depth dispatches of cross-sublane gathers per tree), and a numpy
+convert (mean / lr·sum + clipped sigmoid). This kernel fuses all three
+for a whole ensemble × request batch in VMEM:
+
+- **in-register binning** — the raw (C, TR) value tile is binned by
+  the same `Σ(v >= cut)` compare-count as the fused histogram kernel
+  (`ops/pallas_hist.bins_from_values` semantics: clamp to n_bins-2,
+  NaN → the missing bin n_bins-1), so the per-request host-numpy
+  `bin_dataset` pass disappears. Categorical columns arrive
+  host-mapped to float bin ids with identity cuts (0.5, 1.5, …) via
+  `gbdt.make_fused_inputs` — exactly the FusedBins convention.
+- **gather-free breadth-first walk** — every tree's nodes ride ONE
+  packed (8, T·N) f32 block (sublanes: feature, split bin,
+  default_left, stop, leaf_value; see `pack_ensemble`). A one-hot of
+  each node's split feature contracts with the bin tile on the MXU
+  (exact: 0/1 × small ints at HIGHEST precision), yielding every
+  node's routed bin for every row at once; ones-outer-products
+  broadcast the per-node scalars into the same (S, TR) layout. The
+  walk itself is max_depth data-independent select steps over a
+  (T, N, TR) view — no gathers, no per-level dispatches — with
+  missing values routed by `default_left` and rows parked at leaves
+  (`stop`), matching `_walk_trees` decision-for-decision.
+- **in-kernel convert** — RF mean, GBT lr·sum with the exact
+  ±30-clip sigmoid of `gbdt.predict` for log loss.
+
+Routing: SHIFU_TPU_TREE_FUSED = auto (Pallas on TPU, XLA elsewhere) |
+pallas | xla — same contract as SHIFU_TPU_SCORE_FUSED /
+SHIFU_TPU_SPLIT_FUSED. `interpret=True` runs the kernel on CPU for
+tests; the interpretive `predict_trees` walk stays the pinned parity
+reference (tests/test_pallas_trees.py).
+
+Parity note: per-row routing is integer-exact, so tree STRUCTURE
+decisions bit-match the walk and scores are invariant to the row tile
+and to bucket padding (each row only sees its own lane). The final
+score may differ from the numpy reference at f32-ulp scale: the
+per-row leaf sum accumulates tree-by-tree where numpy's `sum(axis=0)`
+pairwise-reassociates, and jnp.exp vs np.exp in the sigmoid.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from shifu_tpu.config.environment import knob_int, knob_str
+
+__all__ = ["tree_fused_mode", "pack_ensemble", "predict_ensemble"]
+
+
+def tree_fused_mode() -> str:
+    """Fused tree-inference route: "pallas" | "xla"; "auto" resolves
+    by backend (Pallas on TPU, XLA fallback elsewhere)."""
+    mode = knob_str("SHIFU_TPU_TREE_FUSED").lower()
+    if mode in ("pallas", "xla"):
+        return mode
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def pack_ensemble(trees: Dict[str, Any]) -> Tuple[np.ndarray, int]:
+    """Flatten a (T, n_nodes) tree pytree into the kernel's packed
+    node block: (8, T·N_pad) f32, node axis padded to a sublane
+    multiple so the kernel's flat→(T, N_pad, TR) reshape stays
+    tile-aligned. Sublanes:
+
+      0 feature       split feature id, -1 for leaves/unset/pad
+      1 bin           split bin threshold (bin <= it goes left)
+      2 default_left  missing-value direction, 1.0 = left
+      3 stop          is_leaf | feature < 0 — the walk's park flag,
+                      precomputed host-side (pad nodes stop too)
+      4 leaf_value    0 on internal/pad nodes
+
+    Returns (packed, N_pad). Node ids stay perfect-binary-tree local
+    (children of i at 2i+1 / 2i+2 < n_nodes ≤ N_pad), so a walking
+    row can never land on a pad node."""
+    feat = np.asarray(trees["feature"], np.float32)
+    t, n = feat.shape
+    n_pad = max(8, -(-n // 8) * 8)
+
+    def lane(a, fill):
+        return np.pad(np.asarray(a, np.float32),
+                      ((0, 0), (0, n_pad - n)), constant_values=fill)
+
+    stop = (np.asarray(trees["is_leaf"], bool) |
+            (np.asarray(trees["feature"]) < 0))
+    packed = np.zeros((8, t * n_pad), np.float32)
+    packed[0] = lane(feat, -1.0).reshape(-1)
+    packed[1] = lane(trees["bin"], 0.0).reshape(-1)
+    packed[2] = lane(trees["default_left"], 0.0).reshape(-1)
+    packed[3] = lane(stop, 1.0).reshape(-1)
+    packed[4] = lane(trees["leaf_value"], 0.0).reshape(-1)
+    return packed, n_pad
+
+
+def _derive_row_tile(s_nodes: int, n_cols: int, n_cuts: int) -> int:
+    """Row tile sized to the SHIFU_TPU_TREE_VMEM_MB budget. Per grid
+    step the kernel keeps ~6 live (S, TR) f32 maps (routed bin,
+    go_left, stop, leaf value, the select and its masked operand)
+    plus the (C, TR) value/bin tiles and the resident (8, S) node
+    block + (C, K) cuts."""
+    budget = knob_int("SHIFU_TPU_TREE_VMEM_MB") << 20
+    fixed = 4 * (8 * s_nodes + n_cols * max(n_cuts, 1))
+    per_row = 4 * (6 * s_nodes + 3 * n_cols + 16)
+    tile = (budget - fixed) // max(per_row, 1)
+    tile = max(128, min(2048, (tile // 128) * 128))
+    return int(tile)
+
+
+def _tree_kernel(vals_ref, cuts_ref, nodes_ref, out_ref, *,
+                 n_trees: int, n_pad: int, n_cols: int, n_bins: int,
+                 n_cuts: int, max_depth: int, kind: str, loss: str,
+                 lr: float):
+    v = vals_ref[:, :]                                # (C, TR) raw
+    tr = v.shape[1]
+    s = n_trees * n_pad
+    # in-register binning — bins_from_values semantics (+inf pad cuts
+    # never fire for finite values; the clamp keeps the Σ at the last
+    # main bin when they do for +inf values)
+    bins = jnp.zeros(v.shape, jnp.float32)
+    for k in range(n_cuts):
+        bins += (v >= cuts_ref[:, k:k + 1]).astype(jnp.float32)
+    bins = jnp.minimum(bins, float(n_bins - 2))
+    bins = jnp.where(jnp.isnan(v), float(n_bins - 1), bins)
+
+    dot = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    # every node's routed bin for every row: one-hot(feature) × bins on
+    # the MXU — 0/1 times integer-valued f32, exact at HIGHEST
+    feat = nodes_ref[0:1, :]                          # (1, S)
+    oh = (jax.lax.broadcasted_iota(jnp.float32, (n_cols, s), 0)
+          == feat).astype(jnp.float32)                # (C, S)
+    rb = dot(oh, bins)                                # (S, TR)
+    # per-node scalars broadcast across rows as ones-outer-products
+    ones = jnp.ones((1, tr), jnp.float32)
+    sbin = dot(nodes_ref[1:2, :], ones)               # (S, TR)
+    dl = dot(nodes_ref[2:3, :], ones)
+    stop = dot(nodes_ref[3:4, :], ones)
+    lval = dot(nodes_ref[4:5, :], ones)
+
+    miss = rb == float(n_bins - 1)
+    go_left = jnp.where(miss, dl > 0.0,
+                        rb <= sbin).astype(jnp.float32)
+    # flat (S, TR) → (T, N_pad, TR): N_pad is a sublane multiple so the
+    # split is tile-aligned; the walk is select-only from here on
+    gl3 = go_left.reshape(n_trees, n_pad, tr)
+    st3 = stop.reshape(n_trees, n_pad, tr)
+    lv3 = lval.reshape(n_trees, n_pad, tr)
+    iota_n = jax.lax.broadcasted_iota(jnp.float32,
+                                      (n_trees, n_pad, tr), 1)
+    node = jnp.zeros((n_trees, 1, tr), jnp.float32)
+    for _ in range(max_depth):
+        sel = iota_n == node                          # (T, N_pad, TR)
+        gl_here = jnp.max(jnp.where(sel, gl3, 0.0), axis=1,
+                          keepdims=True)              # (T, 1, TR)
+        st_here = jnp.max(jnp.where(sel, st3, 0.0), axis=1,
+                          keepdims=True)
+        # left child 2i+1, right 2i+2 — node ids < 2^24 stay f32-exact
+        nxt = 2.0 * node + 2.0 - gl_here
+        node = jnp.where(st_here > 0.0, node, nxt)
+    sel = iota_n == node
+    contrib = jnp.sum(jnp.where(sel, lv3, 0.0), axis=1,
+                      keepdims=True)                  # (T, 1, TR)
+    total = jnp.sum(contrib, axis=0)                  # (1, TR)
+
+    if kind == "rf":
+        score = total / float(n_trees)
+    else:
+        raw = float(lr) * total
+        if loss.startswith("log"):
+            raw = jnp.clip(raw, -30.0, 30.0)          # predict()'s clip
+            score = 1.0 / (1.0 + jnp.exp(-raw))
+        else:
+            score = raw
+    out_ref[:, :] = jnp.broadcast_to(score, out_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_trees", "kind", "loss", "learning_rate", "max_depth", "n_bins",
+    "row_tile", "interpret"))
+def _predict_ensemble_pallas(nodes, valuesT, cuts, n_trees: int,
+                             kind: str, loss: str, learning_rate: float,
+                             max_depth: int, n_bins: int, row_tile: int,
+                             interpret: bool):
+    c, r = valuesT.shape
+    s = nodes.shape[1]
+    k = cuts.shape[1]
+    pad_r = (-r) % row_tile
+    vp = jnp.pad(valuesT.astype(jnp.float32), ((0, 0), (0, pad_r)))
+    rp = r + pad_r
+    grid = (rp // row_tile,)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _tree_kernel, n_trees=n_trees, n_pad=s // n_trees,
+            n_cols=c, n_bins=n_bins, n_cuts=k, max_depth=max_depth,
+            kind=kind, loss=loss, lr=learning_rate),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((c, row_tile), lambda i: (0, i)),
+            pl.BlockSpec((c, k), lambda i: (0, 0)),
+            pl.BlockSpec((8, s), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, row_tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, rp), jnp.float32),
+        interpret=interpret,
+    )(vp, cuts.astype(jnp.float32), nodes)
+    return out[0, :r]
+
+
+def predict_ensemble(nodes, valuesT, cuts, *, n_trees: int, kind: str,
+                     loss: str = "squared", learning_rate: float = 0.1,
+                     max_depth: int, n_bins: int, row_tile: int = 0,
+                     interpret: bool = False):
+    """Packed ensemble (`pack_ensemble`) + FusedBins-style raw inputs
+    (`gbdt.make_fused_inputs`: valuesT (C, R) f32 NaN-missing, cuts
+    (C, K) +inf-padded) → (R,) final scores with `gbdt.predict`
+    convert semantics (RF mean; GBT lr·sum, log loss → ±30-clip
+    sigmoid). One kernel launch per row tile — no host binning, no
+    per-level walk dispatches."""
+    if not row_tile:
+        row_tile = _derive_row_tile(nodes.shape[1], valuesT.shape[0],
+                                    cuts.shape[1])
+    return _predict_ensemble_pallas(
+        nodes, valuesT, cuts, n_trees=n_trees, kind=kind, loss=loss,
+        learning_rate=float(learning_rate), max_depth=max_depth,
+        n_bins=n_bins, row_tile=row_tile, interpret=interpret)

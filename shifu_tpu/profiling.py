@@ -363,6 +363,18 @@ SERVING_FIELDS = ("qps_offered", "qps_sustained", "requests",
                   "serve_warm_s", "device_step_budget_ms",
                   "compile_cache_misses_steady")
 
+# the tree-serving bench's extra keys (bench.py task_serving_tree
+# emits SERVING_FIELDS plus exactly these, plus a per-request-size
+# p99_ms_by_class map and the shared `roofline` block): the route the
+# service actually served on (SHIFU_TPU_TREE_FUSED resolution), the
+# A/B batch-predict throughput of the fused ensemble kernel vs the
+# interpretive bin_dataset+walk reference, and their ratio —
+# tools/bench_regress.py gates fused_speedup ≥ 1 on TPU records and
+# tools/check_steps_schema.py pins README docs to this tuple the same
+# way it pins SERVING_FIELDS.
+TREE_SERVE_FIELDS = ("tree_route", "fused_rows_per_s",
+                     "xla_rows_per_s", "fused_speedup")
+
 # the fleet bench / FleetService summary schema: serve/fleet.py builds
 # its stats()["fleet"] block (and bench.py task_fleet its JSON record)
 # from exactly these keys — resident model count, LRU evictions, total
@@ -485,13 +497,34 @@ def mtl_row_costs(input_dim: int, hidden_dims, n_tasks: int,
 
 
 def tree_row_costs(n_cols: int, n_bins: int, max_depth: int,
-                   n_trees: int = 1, subtract: bool = True):
-    """GBT/RF level building: each level contracts a node one-hot
-    (slots×R) against a gradient-weighted bin one-hot (R×C·n_bins) on
-    the MXU, twice (grad + hess); sibling subtraction halves the slots
-    actually built below the root. Bytes: the int32 bin row (or f32
-    value row on the fused path) plus grad/hess are re-read per level.
+                   n_trees: int = 1, subtract: bool = True,
+                   phase: str = "build"):
+    """GBT/RF per-row costs, by phase.
+
+    phase="build" — level building: each level contracts a node
+    one-hot (slots×R) against a gradient-weighted bin one-hot
+    (R×C·n_bins) on the MXU, twice (grad + hess); sibling subtraction
+    halves the slots actually built below the root. Bytes: the int32
+    bin row (or f32 value row on the fused path) plus grad/hess are
+    re-read per level.
+
+    phase="infer" — the fused ensemble-inference kernel
+    (ops/pallas_trees): in-register binning compares every value
+    against its cut row, the one-hot feature contraction computes
+    every packed node's routed bin on the MXU (S = n_trees · padded
+    node slots), and the breadth-first walk runs max_depth select
+    steps over the (T, N, row) view. Bytes: the raw f32 value row in,
+    one f32 score out — the node block and cuts stay VMEM-resident
+    across the whole row tile.
     """
+    if phase == "infer":
+        s = n_trees * (2 ** (int(max_depth) + 1) - 1)
+        flops = (int(n_cols) * max(int(n_bins) - 2, 1)   # binning
+                 + 2 * int(n_cols) * s                   # routed bins
+                 + 4 * s                                 # broadcasts
+                 + 3 * int(max_depth) * s)               # select walk
+        bytes_ = 4 * int(n_cols) + 4
+        return float(flops), float(bytes_)
     flops = 0.0
     for d in range(int(max_depth)):
         slots = 2 ** d

@@ -142,27 +142,58 @@ def warm_scores(scorer: Any, proto: Dict[str, Optional[np.ndarray]],
     return len(ladder)
 
 
-def aot_compile(scorer: Any, input_dim: int,
+def _tree_fused_blocks(meta: Dict[str, Any], params: Any,
+                       raw_dense: Optional[np.ndarray],
+                       raw_codes: Optional[np.ndarray]) -> Tuple[
+                           np.ndarray, Any, Any, Dict[str, Any]]:
+    """Derive the fused tree-kernel inputs for one GBT/RF model from
+    its params + a raw request block pair: (packed node block,
+    FusedBins valuesT, cuts, static kwargs for predict_ensemble)."""
+    import jax
+
+    from shifu_tpu.models import gbdt
+    from shifu_tpu.ops import pallas_trees
+
+    cfg_meta = meta["treeConfig"]
+    n_bins = int(cfg_meta["n_bins"])
+    tables = {"num_cuts": np.asarray(params["tables"]["num_cuts"]),
+              "cat_map": np.asarray(params["tables"]["cat_map"])}
+    fb = gbdt.make_fused_inputs(tables, raw_dense, raw_codes, n_bins)
+    trees_np = jax.tree.map(np.asarray, params["trees"])
+    packed, _ = pallas_trees.pack_ensemble(trees_np)
+    statics = {"n_trees": int(trees_np["feature"].shape[0]),
+               "loss": str(cfg_meta.get("loss", "squared")),
+               "learning_rate": float(cfg_meta["learning_rate"]),
+               "max_depth": int(cfg_meta["max_depth"]),
+               "n_bins": n_bins}
+    return packed, fb.valuesT, fb.cuts, statics
+
+
+def aot_compile(scorer: Any, proto: Dict[str, Optional[np.ndarray]],
                 ladder: Tuple[int, ...]) -> Tuple[
                     Dict[Tuple[int, int], Any], Dict[int, Any]]:
-    """`jit(forward).lower().compile()` per NN-family model × bucket.
+    """`jit(...).lower().compile()` per model × bucket.
 
     Returns ``(executables, device_params)``:
     ``executables[(model_index, bucket)]`` is a compiled executable
-    whose signature is ``exe(params, x)`` — the param pytree is a
-    RUNTIME ARGUMENT, not a baked closure constant — and
-    ``device_params[model_index]`` is the incumbent's pytree already
-    placed on device.  Because the executable only fixes the params'
-    tree structure/shapes/dtypes, a model refresh can place new
-    same-shaped params into the resident executables without touching
-    XLA (`serve.service.ScorerService.swap_params`); shape or dtype
+    whose params are RUNTIME ARGUMENTS, not baked closure constants —
+    ``exe(params, x)`` for NN-family models, ``exe(nodes, valuesT,
+    cuts)`` for tree models (the `ops/pallas_trees.predict_ensemble`
+    kernel over the packed node block + FusedBins-style raw inputs) —
+    and ``device_params[model_index]`` is the incumbent's pytree
+    already placed on device.  Because an executable only fixes tree
+    structure/shapes/dtypes, a model refresh can place new same-shaped
+    params into the resident executables without touching XLA
+    (`serve.service.ScorerService.swap_params`); shape or dtype
     changes fail the structural check there and fall back to a full
-    evict/re-warm.  Non-jit model kinds (tree walks, external
-    SavedModels) have no persistent executable to pre-build and are
-    skipped — `warm_scores` covers them.  The lowered computation
-    hashes into the persistent XLA compile cache when
-    `profiling.enable_compile_cache` is active, so the next process
-    start of the same service compiles nothing.
+    evict/re-warm.  NN-family models need a ``dense`` proto block,
+    tree models ``raw_dense`` (and ``raw_codes`` when categorical) —
+    models whose blocks are absent, and kinds with no persistent
+    executable (external SavedModels), are skipped; `warm_scores`
+    covers them.  The lowered computations hash into the persistent
+    XLA compile cache when `profiling.enable_compile_cache` is
+    active, so the next process start of the same service compiles
+    nothing.
     """
     import jax
     import jax.numpy as jnp
@@ -172,26 +203,52 @@ def aot_compile(scorer: Any, input_dim: int,
     out: Dict[Tuple[int, int], Any] = {}
     dev_params: Dict[int, Any] = {}
     for i, (kind, meta, params) in enumerate(scorer.models):
-        if kind not in ("nn", "lr"):
-            continue
-        sd = dict(meta["spec"])
-        sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
-        sd["activations"] = tuple(sd.get("activations", ()))
-        spec = nn_mod.MLPSpec(**sd)
-        d_params = jax.tree.map(jnp.asarray, params)
-        dev_params[i] = d_params
+        if kind in ("nn", "lr") and proto.get("dense") is not None:
+            input_dim = int(np.asarray(proto["dense"]).shape[1])
+            sd = dict(meta["spec"])
+            sd["hidden_dims"] = tuple(sd.get("hidden_dims", ()))
+            sd["activations"] = tuple(sd.get("activations", ()))
+            spec = nn_mod.MLPSpec(**sd)
+            d_params = jax.tree.map(jnp.asarray, params)
+            dev_params[i] = d_params
 
-        def fwd(p, x, _spec=spec):
-            return nn_mod.forward(_spec, p, x)
+            def fwd(p, x, _spec=spec):
+                return nn_mod.forward(_spec, p, x)
 
-        # once-per-model AOT compile at service start — the loop IS the
-        # compile site, not a hot path
-        jitted = jax.jit(fwd)  # lint: disable=jit-in-loop -- AOT warmup compiles each model once at startup
-        p_struct = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), d_params)
-        for bucket in ladder:
-            shape = jax.ShapeDtypeStruct((bucket, input_dim), jnp.float32)
-            out[(i, bucket)] = jitted.lower(p_struct, shape).compile()
+            # once-per-model AOT compile at service start — the loop IS
+            # the compile site, not a hot path
+            jitted = jax.jit(fwd)  # lint: disable=jit-in-loop -- AOT warmup compiles each model once at startup
+            p_struct = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                d_params)
+            for bucket in ladder:
+                shape = jax.ShapeDtypeStruct((bucket, input_dim),
+                                             jnp.float32)
+                out[(i, bucket)] = jitted.lower(p_struct, shape).compile()
+        elif kind in ("gbt", "rf") and proto.get("raw_dense") is not None:
+            from shifu_tpu.ops import pallas_trees
+            packed, valuesT, cuts, statics = _tree_fused_blocks(
+                meta, params, np.asarray(proto["raw_dense"]),
+                (None if proto.get("raw_codes") is None
+                 else np.asarray(proto["raw_codes"])))
+            dev_params[i] = jax.tree.map(jnp.asarray, params)
+            interpret = jax.default_backend() != "tpu"
+
+            def tfwd(nodes, vT, ct, _kind=kind, _st=statics,
+                     _ip=interpret):
+                return pallas_trees.predict_ensemble(
+                    nodes, vT, ct, kind=_kind, interpret=_ip, **_st)
+
+            jitted = jax.jit(tfwd)  # lint: disable=jit-in-loop -- AOT warmup compiles each model once at startup
+            n_struct = jax.ShapeDtypeStruct(packed.shape, jnp.float32)
+            c_struct = jax.ShapeDtypeStruct(np.asarray(cuts).shape,
+                                            jnp.float32)
+            n_cols = np.asarray(valuesT).shape[0]
+            for bucket in ladder:
+                v_struct = jax.ShapeDtypeStruct((n_cols, bucket),
+                                                jnp.float32)
+                out[(i, bucket)] = jitted.lower(
+                    n_struct, v_struct, c_struct).compile()
     return out, dev_params
 
 
@@ -206,14 +263,35 @@ def aot_selfcheck(executables: Dict[Tuple[int, int], Any],
     interpretive reference is recomputed with the same params, so the
     check is exactly 'resident executable == what a cold re-warm of
     these params would score'."""
+    import jax
+
     from shifu_tpu.eval.scorer import score_matrix
 
     for (i, bucket), exe in executables.items():
         kind, meta, _ = scorer.models[i]
         params = params_by_model[i]
-        dense = pad_rows(np.asarray(proto["dense"], np.float32), bucket)
-        got = np.asarray(exe(params, dense)).reshape(-1)
-        want = np.asarray(score_matrix(kind, meta, params, dense)).reshape(-1)
+        if kind in ("gbt", "rf"):
+            from shifu_tpu.models import gbdt
+            import jax.numpy as jnp
+            rd = pad_rows(np.asarray(proto["raw_dense"], np.float32),
+                          bucket)
+            rc = None if proto.get("raw_codes") is None else pad_rows(
+                np.asarray(proto["raw_codes"]), bucket)
+            np_params = jax.tree.map(np.asarray, params)
+            packed, valuesT, cuts, _ = _tree_fused_blocks(
+                meta, np_params, rd, rc)
+            got = np.asarray(exe(jnp.asarray(packed),
+                                 jnp.asarray(valuesT),
+                                 jnp.asarray(cuts))).reshape(-1)
+            # reference: the interpretive bin_dataset + walk route
+            want = np.asarray(gbdt.predict(
+                meta, np_params, rd, rc, route="xla")).reshape(-1)
+        else:
+            dense = pad_rows(np.asarray(proto["dense"], np.float32),
+                             bucket)
+            got = np.asarray(exe(params, dense)).reshape(-1)
+            want = np.asarray(
+                score_matrix(kind, meta, params, dense)).reshape(-1)
         if not np.allclose(got, want, rtol=1e-5, atol=1e-6):
             raise AssertionError(
                 f"AOT executable for model{i} bucket {bucket} deviates "

@@ -15,11 +15,16 @@ micro-batch depends on arrival timing.
 
 Per-request latency decomposes into queue / pad / h2d / device / d2h:
 queue is measured by the batcher, pad is host-side batch assembly,
-h2d is an explicit `jax.device_put` of the padded dense block (taken
-only when the whole ensemble is NN-family so the placed array is the
-one the matmul reads), device is the `Scorer.score` call, and d2h is
-per-request result extraction.  For mixed ensembles the transfer
-happens inside `score_matrix` and is accounted under device.
+h2d is an explicit `jax.device_put` of the padded feature block that
+the device kernel actually reads — the dense block for an all-NN
+ensemble, the raw_dense block for an all-tree ensemble riding the
+fused Pallas route (SHIFU_TPU_TREE_FUSED; `make_fused_inputs`
+transposes the pre-placed array device-side and
+`ops/pallas_trees.predict_ensemble` bins it in-register) — device is
+the `Scorer.score` call, and d2h is per-request result extraction.
+For mixed ensembles (and tree ensembles on the interpretive XLA
+walk) the transfer happens inside `score_matrix` and is accounted
+under device.
 """
 
 from __future__ import annotations
@@ -110,6 +115,17 @@ class ScorerService:
         return self.norm is None and all(
             kind in ("nn", "lr") for kind, _, _ in self.scorer.models)
 
+    # same for the raw numeric block of an all-tree ensemble on the
+    # fused kernel route — predict_ensemble reads it directly, so the
+    # placement is the request's real h2d and gets timed as such
+    @property
+    def _tree_preplace(self) -> bool:
+        from shifu_tpu.ops.pallas_trees import tree_fused_mode
+        return (self.norm is None and tree_fused_mode() == "pallas"
+                and bool(self.scorer.models) and all(
+                    kind in ("gbt", "rf")
+                    for kind, _, _ in self.scorer.models))
+
     # -- lifecycle -----------------------------------------------------
     def start(self, proto: Optional[Dict[str, np.ndarray]] = None
               ) -> "ScorerService":
@@ -127,9 +143,10 @@ class ScorerService:
                      if v is not None}
             self._schema = frozenset(proto)
             self._proto = proto
-            if self._aot_enabled and "dense" in proto:
+            if self._aot_enabled and ("dense" in proto
+                                      or "raw_dense" in proto):
                 self._aot_executables, self._aot_params = aot.aot_compile(
-                    self.scorer, int(proto["dense"].shape[1]), self.ladder)
+                    self.scorer, proto, self.ladder)
                 aot.aot_selfcheck(self._aot_executables, self._aot_params,
                                   self.scorer, proto)
             self._warmed_buckets = aot.warm_scores(
@@ -269,10 +286,12 @@ class ScorerService:
         cand: Dict[int, Any] = {}
         for i, (kind, meta, params) in enumerate(new):
             if i in self._aot_params or (self._aot_enabled and
-                                         kind in ("nn", "lr")):
+                                         kind in ("nn", "lr",
+                                                  "gbt", "rf")):
                 cand[i] = jax.tree.map(jnp.asarray, params)
         if self._aot_executables and self._proto is not None \
-                and "dense" in self._proto:
+                and ("dense" in self._proto
+                     or "raw_dense" in self._proto):
             check = dict(self._aot_params)
             check.update(cand)
             aot.aot_selfcheck(self._aot_executables, check,
@@ -306,6 +325,15 @@ class ScorerService:
             padded["dense"] = jax.device_put(
                 np.asarray(padded["dense"], np.float32), jax.devices()[0])
             jax.block_until_ready(padded["dense"])
+            t_h2d = time.monotonic()
+        elif self._tree_preplace and "raw_dense" in padded:
+            import jax
+            # the fused tree kernel bins this block in-register; the
+            # (small, host-mapped) categorical codes stay host-side
+            padded["raw_dense"] = jax.device_put(
+                np.asarray(padded["raw_dense"], np.float32),
+                jax.devices()[0])
+            jax.block_until_ready(padded["raw_dense"])
             t_h2d = time.monotonic()
 
         # tree ensembles may serve raw blocks only; score_matrix's tree

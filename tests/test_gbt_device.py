@@ -218,3 +218,98 @@ def test_val_metric_aligned_across_builders(rng, monkeypatch):
                                         chunk_rows=256, n_val=n_val)
     np.testing.assert_allclose(res_e, host_e, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(host_e, dev_e, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# single-dispatch builds (SHIFU_TPU_TREE_SCAN): the fori_loop-over-
+# levels builder must be BITWISE identical to the per-level host loop,
+# and the resident streaming tier must build each tree in ONE dispatch
+# ---------------------------------------------------------------------------
+
+def _tree_bitwise(a, b, ctx=""):
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=f"{ctx}:{k}")
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 5])
+@pytest.mark.parametrize("subtract", [False, True])
+def test_scan_tree_bitwise_matches_per_level(rng, monkeypatch, depth,
+                                             subtract):
+    """build_tree with the level scan on vs off: identical histograms
+    scatter in identical row order, the masked folds write identical
+    values, so the whole tree (and the landing nodes) is bit-equal —
+    not allclose, equal."""
+    bins, y = _case(rng, n=700, c=6)
+    binsT = jnp.asarray(np.ascontiguousarray(bins.T))
+    grad = jnp.asarray(-(y - 0.5))
+    hess = jnp.ones_like(grad)
+    fm = jnp.ones(6, jnp.float32)
+    cfg = _cfg(depth=depth)
+
+    def build(scan):
+        monkeypatch.setenv("SHIFU_TPU_TREE_SCAN", scan)
+        jax.clear_caches()  # scan mode resolves at trace time
+        return gbdt.build_tree(cfg, binsT, grad, hess, fm,
+                               subtract=subtract, return_nodes=True)
+
+    t_loop, n_loop = build("0")
+    t_scan, n_scan = build("1")
+    _tree_bitwise(t_loop, t_scan, f"d{depth}/sub{subtract}")
+    np.testing.assert_array_equal(np.asarray(n_loop), np.asarray(n_scan))
+
+
+@pytest.mark.parametrize("subtract", [False, True])
+def test_scan_forest_bitwise_matches_per_level(rng, monkeypatch,
+                                               subtract):
+    """build_forest (the lockstep multi-tree builder) under the same
+    scan flip — per-tree feature masks and sibling subtraction
+    included."""
+    bins, y = _case(rng, n=600, c=5)
+    binsT = jnp.asarray(np.ascontiguousarray(bins.T))
+    grad_T = jnp.asarray(np.stack([-y, -y * 0.5, y - 0.3])
+                         .astype(np.float32))
+    hess_T = jnp.ones_like(grad_T)
+    masks = jnp.asarray((rng.random((3, 5)) > 0.3).astype(np.float32))
+    cfg = _cfg(depth=3)
+
+    def build(scan):
+        monkeypatch.setenv("SHIFU_TPU_TREE_SCAN", scan)
+        jax.clear_caches()
+        return gbdt.build_forest(cfg, binsT, grad_T, hess_T, masks,
+                                 subtract=subtract, return_nodes=True)
+
+    (t_loop, n_loop), (t_scan, n_scan) = build("0"), build("1")
+    _tree_bitwise(t_loop, t_scan, f"forest/sub{subtract}")
+    np.testing.assert_array_equal(np.asarray(n_loop), np.asarray(n_scan))
+
+
+def test_resident_single_chunk_one_dispatch_per_tree(rng, monkeypatch):
+    """THE dispatch gate: a single-chunk resident build with the scan
+    on launches ONE device computation per tree (counted by the
+    pipeline tree_build_dispatches counter); with the scan off it pays
+    one per level plus the final-leaf pass. Trees bitwise identical
+    either way, and the resident zero-host-sync contract holds on
+    both paths."""
+    bins, y = _case(rng, n=800)
+    w = np.ones_like(y)
+    cfg = _cfg(loss="log")
+    n_trees = 3
+    monkeypatch.setenv("SHIFU_TPU_GBT_RESIDENT_STATE", "1")
+
+    def run(scan):
+        monkeypatch.setenv("SHIFU_TPU_TREE_SCAN", scan)
+        jax.clear_caches()
+        drain_stage_timers()
+        trees, _ = gbdt.build_gbt_streaming(cfg, bins, y, w, n_trees,
+                                            chunk_rows=1 << 20)
+        return trees, drain_stage_timers()
+
+    t_off, timers_off = run("0")
+    t_on, timers_on = run("1")
+    _tree_bitwise(t_off, t_on, "resident")
+    assert timers_on.get("tree_build_dispatches") == n_trees, timers_on
+    assert timers_off.get("tree_build_dispatches") == \
+        n_trees * (cfg.max_depth + 1), timers_off
+    assert timers_on.get("host_syncs", 0) == 0, timers_on
+    assert timers_off.get("host_syncs", 0) == 0, timers_off

@@ -132,6 +132,28 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
         # (append → drift breach off a committed window) is
         # lower-is-better, ceilinged vs its trailing median like the
         # fleet p99s
+        # tree-serving records (bench --task serving_tree): rows/s
+        # rides the generic throughput gate and the per-size p99s the
+        # generic p99_ms_by_class gate below; two absolute invariants
+        # are checked here — the steady-state serve loop must never
+        # recompile, and on the accelerator the fused Pallas ensemble
+        # kernel must beat the interpretive bin+walk path it replaced
+        # (CPU records are exempt: there the kernel runs in Pallas
+        # interpret mode, which validates plumbing, not speed)
+        if task == "serving_tree":
+            ccm = newest.get("compile_cache_misses_steady")
+            if isinstance(ccm, (int, float)) and ccm > 0:
+                findings.append(
+                    f"{label}: compile_cache_misses_steady {ccm:g} — "
+                    "the tree-serving shape-bucket discipline leaked "
+                    "a shape")
+            fs = newest.get("fused_speedup")
+            if backend == "tpu" and isinstance(fs, (int, float)) \
+                    and fs < 1.0:
+                findings.append(
+                    f"{label}: fused_speedup {fs:.3f} < 1 — the fused "
+                    "ensemble kernel lost to the xla bin+walk path "
+                    "it replaced")
         if task == "ingest":
             bl = newest.get("breach_latency_s")
             if isinstance(bl, (int, float)):

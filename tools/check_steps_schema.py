@@ -30,6 +30,8 @@ ships stale docs.
 The serving bench record is pinned likewise: its schema is
 ``profiling.SERVING_FIELDS`` (AST-read), every field must be
 README-documented, and bench.py must build the record from the tuple.
+The tree-serving bench (task_serving_tree) extends that record with
+``profiling.TREE_SERVE_FIELDS``, pinned the same way.
 
 The fleet summary block is pinned likewise: ``stats()["fleet"]`` from
 serve/fleet.py and the bench.py task_fleet record are both
@@ -104,7 +106,7 @@ def documented_fields() -> set:
     # members of the pinned block schemas (roofline/serving/dag) are
     # documented as those blocks' keys, not inputPipeline stages
     pinned = set(roofline_fields()) | set(serving_fields()) | \
-        set(fleet_fields()) | set(dag_fields()) | \
+        set(tree_serve_fields()) | set(fleet_fields()) | set(dag_fields()) | \
         set(dag_summary_fields()) | set(trace_fields()) | \
         set(metric_fields()) | set(health_fields()) | \
         set(shard_fields()) | set(refresh_fields()) | \
@@ -171,6 +173,10 @@ def roofline_fields() -> tuple:
 
 def serving_fields() -> tuple:
     return _profiling_tuple("SERVING_FIELDS")
+
+
+def tree_serve_fields() -> tuple:
+    return _profiling_tuple("TREE_SERVE_FIELDS")
 
 
 def fleet_fields() -> tuple:
@@ -250,6 +256,33 @@ def check_serving_docs() -> int:
         return 1
     print(f"serving bench: all {len(fields)} SERVING_FIELDS documented "
           "in README and pinned in bench.py")
+    return 0
+
+
+def check_tree_serve_docs() -> int:
+    """Every TREE_SERVE_FIELDS member (the keys bench.py
+    task_serving_tree adds on top of SERVING_FIELDS) must be
+    backtick-documented in README, and task_serving_tree must build
+    its record from the tuple — the literal check asserts bench.py
+    references `TREE_SERVE_FIELDS` so the record cannot silently
+    drift from the pinned schema."""
+    fields = tree_serve_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("tree-serving schema drift: TREE_SERVE_FIELDS member(s) "
+              f"never documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "TREE_SERVE_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the tree-serving record from "
+              "profiling.TREE_SERVE_FIELDS", file=sys.stderr)
+        return 1
+    print(f"tree serving bench: all {len(fields)} TREE_SERVE_FIELDS "
+          "documented in README and pinned in bench.py")
     return 0
 
 
@@ -503,6 +536,8 @@ def main(argv) -> int:
     if check_roofline_docs():
         return 1
     if check_serving_docs():
+        return 1
+    if check_tree_serve_docs():
         return 1
     if check_fleet_docs():
         return 1
