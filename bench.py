@@ -2283,6 +2283,194 @@ def task_ingest():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def task_canary():
+    """Live-promotion bench: train + publish an incumbent, warm a
+    `FleetService`, start a concurrent client, then drive BOTH live
+    cycles end to end — (1) an injected drift breach through
+    RefreshController's live mode (warm-start retrain → shadow arm →
+    canary arm → LIVE verdict → promote), and (2) a sabotaged slow
+    challenger whose canary p99 breaches the live band and rolls back
+    automatically. Record keys are pinned by profiling.CANARY_FIELDS;
+    tools/bench_regress.py gates failed_requests == 0 absolutely and
+    rollback_recovery_s against its trailing median."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import pandas as pd
+
+    import jax
+
+    from shifu_tpu import registry
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.obs.health.canary import CanaryController
+    from shifu_tpu.obs.health.refresh import RefreshController
+    from shifu_tpu.processor.base import ProcessorContext
+    from shifu_tpu.profiling import CANARY_FIELDS
+    from shifu_tpu.serve.fleet import FleetService
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.synth import make_model_set
+
+    # staged-controller settings sized for the bench: real quorums but
+    # a window the concurrent client fills in seconds. The PSI band is
+    # wide open — a warm-retrained twin scored on a small synthetic
+    # batch legitimately lands its mass in different histogram bins
+    # (the gate semantics live in tests/test_canary.py's decide-rule
+    # matrix; this bench prices the loop and records the evidence).
+    kw = dict(shadow_pct=0.5, canary_pct=0.5, min_requests=16,
+              window_s=120.0, psi_max=100.0, p99_factor=20.0,
+              slo_p99_ms=5000.0, poll_s=0.01)
+
+    tmp = tempfile.mkdtemp(prefix="shifu_canary_bench_")
+    try:
+        rng = np.random.default_rng(18)
+        ms = make_model_set(os.path.join(tmp, "set"), rng,
+                            n_rows=REFRESH_BENCH_ROWS)
+        cfg_path = os.path.join(ms, "ModelConfig.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        cfg["train"]["numTrainEpochs"] = REFRESH_BENCH_EPOCHS
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f, indent=2)
+        for cmd in ("init", "stats", "norm", "train"):
+            if cli_main(["--dir", ms, cmd]) != 0:
+                raise RuntimeError(f"canary bench: {cmd} failed")
+        reg = os.path.join(tmp, "registry")
+        registry.publish(reg, "m", os.path.join(ms, "models"),
+                         ladder=(1, 16))
+        hdr = open(os.path.join(ms, "data", ".pig_header")) \
+            .read().strip().split("|")
+        df = pd.read_csv(os.path.join(ms, "data", "part-00000"),
+                         sep="|", names=hdr, dtype=str)
+
+        with FleetService(reg, workspace_root=ms,
+                          hbm_budget_mb=0) as fleet:
+            _, _, man = registry.resolve(reg, "m")
+            x = rng.normal(0, 1, (8, man["input_dim"])) \
+                .astype(np.float32)
+            fleet.submit("m", dense=x)   # resident + AOT-warm
+
+            # the live client: the arms' evidence IS this traffic, and
+            # the headline invariant is that it never sees a failure
+            stop, failures, served = threading.Event(), [], [0]
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        fleet.submit_timed("m", dense=x, timeout=30.0)
+                        served[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(e)
+
+            th = threading.Thread(target=client, daemon=True)
+            th.start()
+            try:
+                # -- cycle 1: breach → retrain → shadow → canary →
+                #    live verdict → promote --------------------------
+                ctl = RefreshController(ProcessorContext.load(ms),
+                                        registry_root=reg,
+                                        model_name="m", fleet=fleet,
+                                        cooldown_s=0.0,
+                                        canary=dict(kw))
+                ctl.note_window(df)
+                t0 = time.monotonic()
+                outcome = ctl.handle_breach({"slo": "drift",
+                                             "state": "breach"})
+                breach_to_live_s = time.monotonic() - t0
+                if outcome != "promoted":
+                    raise RuntimeError(
+                        f"canary bench: live cycle outcome={outcome} "
+                        f"({ctl.stats()})")
+                v2, _, man2 = registry.resolve(reg, "m")
+                block = man2["canary"]
+                win = block["live_window"]
+                _log(f"[canary] breach→live-promoted({v2}) in "
+                     f"{breach_to_live_s:.2f}s "
+                     f"(requests {win['requests']}, "
+                     f"arm_psi {win['arm_psi']})")
+
+                # -- cycle 2: sabotaged challenger → live p99 breach
+                #    → automatic rollback ----------------------------
+                orig_start = fleet.start_arms
+
+                def sabotaged_start(name, challenger_dir, **skw):
+                    out = orig_start(name, challenger_dir, **skw)
+                    svc = fleet._arms[name].service
+                    orig_submit = svc.submit_timed
+
+                    def slow_submit(timeout=30.0, **blocks):
+                        # p99 ≈ 400ms — far past max(slo, factor ×
+                        # primary) even with the primary's p99
+                        # inflated by the hammering client
+                        time.sleep(0.4)
+                        o, timing = orig_submit(timeout=timeout,
+                                                **blocks)
+                        timing["total_s"] += 0.4
+                        return o, timing
+
+                    svc.submit_timed = slow_submit
+                    return out
+
+                class _TimedRollback(CanaryController):
+                    # breach verdict → incumbent re-pinned, arm down,
+                    # fleet proven serving it — the recovery latency
+                    # tools/bench_regress.py gates
+                    rollback_s = None
+
+                    def _rollback(self, *a, **rkw):
+                        t0 = time.monotonic()
+                        out = super()._rollback(*a, **rkw)
+                        self.rollback_s = time.monotonic() - t0
+                        return out
+
+                fleet.start_arms = sabotaged_start
+                try:
+                    sab = _TimedRollback(
+                        fleet, reg, "m", store_root=ms,
+                        **dict(kw, slo_p99_ms=50.0, p99_factor=1.5,
+                               min_requests=8))
+                    res = sab.run(os.path.join(ms, "models"), "sab01")
+                finally:
+                    fleet.start_arms = orig_start
+                if res["outcome"] != "rolled_back" or \
+                        sab.rollback_s is None:
+                    raise RuntimeError(
+                        f"canary bench: sabotage outcome={res}")
+                if registry.head(reg, "m") != v2:
+                    raise RuntimeError(
+                        "canary bench: rollback did not re-pin HEAD")
+                fleet.submit("m", dense=x)   # incumbent still answers
+                _log(f"[canary] sabotage rolled back in "
+                     f"{sab.rollback_s * 1e3:.1f}ms "
+                     f"({res['verdict']['reason']})")
+            finally:
+                stop.set()
+                th.join(timeout=30)
+
+        if failures:
+            _log(f"[canary] WARNING: {len(failures)} client failures "
+                 f"(first: {failures[0]!r})")
+        rec = {"breach_to_live_s": round(breach_to_live_s, 3),
+               "rollback_recovery_s": round(sab.rollback_s, 4),
+               "failed_requests": len(failures),
+               "shadow_requests": int(win["requests"]["shadow"]),
+               "canary_requests": int(win["requests"]["canary"]),
+               "arm_psi": win["arm_psi"],
+               "promote_verdict": {"decision": block["verdict"],
+                                   "reason": block["reason"]},
+               "rollback_verdict": {
+                   "decision": res["verdict"]["verdict"],
+                   "reason": res["verdict"]["reason"]}}
+        assert set(rec) == set(CANARY_FIELDS), (
+            "canary record drifted from profiling.CANARY_FIELDS")
+        _persist("canary", jax.default_backend(), rec)
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def task_cpu_denom():
     """Measured same-host CPU denominator: nn / nn_wide / gbt bench
     shapes on the JAX CPU backend (this host), giving vs_baseline a
@@ -2760,6 +2948,8 @@ def main():
         return task_refresh()
     if args.task == "ingest":
         return task_ingest()
+    if args.task == "canary":
+        return task_canary()
     if args.task == "rf":
         return task_rf()
     if args.task == "cpu_denom":

@@ -281,6 +281,13 @@ def cmd_watch(args) -> int:
         refresh = RefreshController(
             ctx, registry_root=args.registry, model_name=args.model_name,
             eval_name=args.eval_set, ingest_log=ingest_log)
+    if args.registry and args.model_name:
+        # a canary run a SIGKILL interrupted left its state file in a
+        # non-terminal phase — resolve it (rollback to the recorded
+        # baseline) before this watch can breach into a new refresh
+        from shifu_tpu.obs.health.canary import CanaryController
+        CanaryController.recover(args.registry, args.model_name,
+                                 store_root=ctx.path_finder.root)
     return watch_mod.run_monitor(
         ctx,
         interval_s=args.interval_s,
@@ -303,11 +310,57 @@ def _spark(values) -> str:
     return "".join(_SPARK_BARS[int((v - lo) * scale)] for v in vals)
 
 
+def _canary_lines(st) -> list:
+    """Live-promotion status lines from the metrics store: the last
+    canary phase transition per model plus the freshest per-arm p99
+    and between-arms PSI gauges the fleet flushed. Read-only and
+    empty-safe — no arms ever started means no lines."""
+    phases = {}
+    for ev in st.events(limit=50, names=["canary"]):
+        tags = ev.get("tags") or {}
+        model = tags.get("model")
+        if model:
+            phases[model] = dict(tags, ts=ev.get("ts", 0))
+    if not phases:
+        return []
+    p99 = {}   # (model, arm) → last value
+    for p in st.read_points(names=["serve.arm_p99_ms"]):
+        t = p.get("tags") or {}
+        v = p.get("value")
+        if isinstance(v, dict):   # rollup
+            v = v.get("last")
+        if isinstance(v, (int, float)) and t.get("model") \
+                and t.get("arm"):
+            p99[(t["model"], t["arm"])] = float(v)
+    psi = {}
+    for p in st.read_points(names=["canary.arm_psi"]):
+        t = p.get("tags") or {}
+        v = p.get("value")
+        if isinstance(v, dict):
+            v = v.get("last")
+        if isinstance(v, (int, float)) and t.get("model"):
+            psi[t["model"]] = float(v)
+    lines = ["canary arms:"]
+    for model, tags in sorted(phases.items()):
+        bits = [f"phase={tags.get('phase', '?')}"]
+        for k in ("run", "version", "shadow_pct", "canary_pct"):
+            if k in tags:
+                bits.append(f"{k}={tags[k]}")
+        arm_bits = [f"p99[{arm}]={p99[(m, arm)]:.3f}ms"
+                    for (m, arm) in sorted(p99) if m == model]
+        bits.extend(arm_bits)
+        if model in psi:
+            bits.append(f"arm_psi={psi[model]:.4f}")
+        lines.append(f"  {model}: " + " ".join(bits))
+    return lines
+
+
 def cmd_health(args) -> int:
     """`shifu health` — current SLO state over the metrics store:
     per-rule status with a sparkline trend of the underlying metric,
-    plus the recent breach/warn event tail. Read-only (works without
-    SHIFU_TPU_METRICS set — it inspects history already recorded)."""
+    the live-promotion (canary) arm status, plus the recent
+    breach/warn event tail. Read-only (works without SHIFU_TPU_METRICS
+    set — it inspects history already recorded)."""
     from shifu_tpu.obs.health import slo as slo_mod
     from shifu_tpu.obs.health import store as health_store
     root = args.dir
@@ -324,6 +377,8 @@ def cmd_health(args) -> int:
         print(f"{s['name']:<{name_w}}  {s['state']:<6} {val:>10}  "
               f"{s['metric']:<{met_w}}  "
               f"{_spark([v for _, v in series])}")
+    for line in _canary_lines(st):
+        print(line)
     events = state["recent_events"]
     if events:
         print("recent events:")
@@ -542,9 +597,11 @@ def _top_render(root: str) -> str:
     # a corrupt store must not break the monitor)
     try:
         from shifu_tpu.obs.health import store as health_store
-        events = health_store.store(root).events(
+        _st = health_store.store(root)
+        lines.extend(_canary_lines(_st))
+        events = _st.events(
             limit=5, names=["drift", "breach", "warn", "recovered",
-                            "refresh"])
+                            "refresh", "canary", "fleet_drift"])
         if events:
             lines.append("health/drift events:")
             for ev in events:

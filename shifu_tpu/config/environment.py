@@ -374,6 +374,39 @@ _declare("SHIFU_TPU_INGEST_SEGMENT_AGE_S", "float", 30.0,
          "max seconds a non-empty open row-log segment may buffer "
          "before the next append seals it regardless of row count, "
          "bounding how stale a slow trickle can keep readers")
+_declare("SHIFU_TPU_SHADOW_PCT", "float", 0.0,
+         "fraction of live requests mirrored to a challenger arm "
+         "during the shadow phase (response discarded, latency + "
+         "score sketch recorded per arm); 0 = shadow plane off "
+         "unless a canary run sets it live")
+_declare("SHIFU_TPU_CANARY_PCT", "float", 0.05,
+         "fraction of live requests the canary phase routes to the "
+         "challenger arm (deterministic per-request assignment; the "
+         "rest stay on the incumbent primary)")
+_declare("SHIFU_TPU_SHADOW_QUEUE", "int", 64,
+         "bounded depth of the shadow mirror queue; a full queue "
+         "DROPS the mirror (drop-counted) instead of slowing the "
+         "primary request path")
+_declare("SHIFU_TPU_CANARY_MIN_REQUESTS", "int", 32,
+         "min scored requests PER ARM before a canary phase may "
+         "decide (shadow → canary and canary → verdict both wait "
+         "for this much live evidence)")
+_declare("SHIFU_TPU_CANARY_WINDOW_S", "float", 60.0,
+         "max seconds a canary phase waits for its per-arm request "
+         "quorum; expiry without quorum rolls the challenger back "
+         "(no evidence ⇒ no promotion)")
+_declare("SHIFU_TPU_CANARY_PSI_MAX", "float", 0.25,
+         "max score-distribution PSI between the incumbent and "
+         "challenger arms a live verdict may promote through "
+         "(above = the challenger scores a different population)")
+_declare("SHIFU_TPU_CANARY_P99_FACTOR", "float", 1.5,
+         "max challenger-arm p99 as a multiple of the incumbent "
+         "arm's p99 during canary; above = SLO breach, automatic "
+         "rollback")
+_declare("SHIFU_TPU_FLEET_REFRESH_BUDGET", "int", 1,
+         "max tenant refreshes a fleet drift tick may schedule — a "
+         "breach storm (N tenants drifting at once) defers the rest "
+         "to later ticks instead of launching N concurrent retrains")
 _declare("SHIFU_TPU_INGEST_WINDOW_ROWS", "int", 65_536,
          "max rows one `shifu watch --ingest` tick consumes from the "
          "row log per read_window (the drift window size cap; the "

@@ -92,6 +92,13 @@ class RefreshController:
     → publish moves HEAD; the next serve restart picks it up).
     `post_train` is a test seam called with the challenger workspace
     dir after training, before the guardrail (the sabotage drill).
+    `canary` switches promotion to LIVE mode: instead of the offline
+    eval guardrail, the trained challenger goes through the staged
+    shadow→canary controller (obs/health/canary.py) and the verdict
+    comes from real traffic — pass True for knob-driven defaults or a
+    dict of CanaryController overrides (shadow_pct, canary_pct,
+    min_requests, window_s, psi_max, p99_factor, slo_p99_ms, poll_s).
+    Live mode requires registry_root + model_name + fleet.
     """
 
     def __init__(self, ctx, registry_root: Optional[str] = None,
@@ -100,7 +107,7 @@ class RefreshController:
                  cooldown_s: Optional[float] = None,
                  tolerance: Optional[float] = None,
                  window_rows: Optional[int] = None,
-                 post_train=None, ingest_log=None):
+                 post_train=None, ingest_log=None, canary=None):
         self.ctx = ctx
         # durable row log (data/ingest.py): when bound, the challenger
         # trains on a window read from the `refresh` consumer offset,
@@ -121,6 +128,7 @@ class RefreshController:
         self.window_rows = int(window_rows if window_rows is not None
                                else knob_int("SHIFU_TPU_REFRESH_WINDOW_ROWS"))
         self.post_train = post_train
+        self.canary = canary
         self.runs = 0
         self.promoted = 0
         self.held = 0
@@ -255,6 +263,12 @@ class RefreshController:
             if self.post_train is not None:
                 self.post_train(clone)
 
+            # -- live mode: verdict from real traffic, not the eval ------
+            if self.canary and self.registry_root and self.model_name \
+                    and self.fleet is not None:
+                return self._canary_promote(clone, run_name, record,
+                                            win, st, t_breach)
+
             # -- guardrail: challenger vs incumbent on held-out eval -----
             t0 = time.monotonic()
             verdict = self.guardrail(os.path.join(clone, "models"))
@@ -326,6 +340,47 @@ class RefreshController:
                      "breach→promoted)", run_name, self.model_name,
                      version, swap, wall)
             return "promoted"
+
+    def _canary_promote(self, clone: str, run_name: str, record: Dict,
+                        win, st, t_breach: float) -> str:
+        """Live promotion path: hand the trained challenger to the
+        staged shadow→canary controller and map its traffic-derived
+        verdict onto this controller's outcomes. The offline eval
+        never runs — decide() reads the arms."""
+        from shifu_tpu import registry
+        from shifu_tpu.obs.health.canary import CanaryController
+
+        prev_head = registry.head(self.registry_root, self.model_name)
+        refresh_block = {"run": run_name, "slo": record.get("slo", "?"),
+                         "refreshed_from": prev_head, "mode": "live"}
+        if win is not None:
+            refresh_block["ingest_window"] = dict(
+                win.range_record(), log=self.ingest_log.root)
+        overrides = self.canary if isinstance(self.canary, dict) else {}
+        ctl = CanaryController(
+            self.fleet, self.registry_root, self.model_name,
+            store_root=self.ctx.path_finder.root, **overrides)
+        result = ctl.run(os.path.join(clone, "models"), run_name,
+                         refresh_block=refresh_block)
+        if result["outcome"] == "promoted":
+            self.promoted += 1
+            wall = time.monotonic() - t_breach
+            st.emit("refresh.breach_to_promoted_s", wall, kind="gauge",
+                    run=run_name)
+            st.event("refresh", phase="promoted", run=run_name,
+                     version=result["version"],
+                     swap=result.get("swap", "none"),
+                     mode="live", breach_to_promoted_s=round(wall, 3))
+            log.info("refresh: %s live-promoted as %s/%s (%.2fs "
+                     "breach→promoted)", run_name, self.model_name,
+                     result["version"], wall)
+            return "promoted"
+        self.rolled_back += 1
+        st.event("refresh", phase="rolled_back", run=run_name,
+                 version=result["version"],
+                 to=result.get("prev_head") or "?", mode="live",
+                 error=result["verdict"].get("reason", "")[:200])
+        return "rolled_back"
 
     # -- phases ------------------------------------------------------------
 

@@ -284,5 +284,6 @@ def health_state(root: str) -> Dict:
                          state=state, value=value,
                          samples=len(series)))
     events = st.events(limit=5, names=["breach", "warn", "recovered",
-                                       "refresh"])
+                                       "refresh", "canary",
+                                       "fleet_drift"])
     return {"status": worst, "slos": slos, "recent_events": events}

@@ -223,6 +223,157 @@ def run_monitor(ctx, interval_s: Optional[float] = None,
     return 0
 
 
+class FleetDriftWatch:
+    """Per-tenant drift + SLO loops inside ONE fleet watch tick, with
+    fleet-wide breach-storm coalescing.
+
+    A multi-model fleet serves N tenants, each with its own training
+    baseline — drift is a PER-TENANT question (tenant A's feature mix
+    shifting says nothing about tenant B), but retrain capacity is a
+    FLEET-wide resource. Each registered tenant gets its own
+    `RollingDrift` (frozen against that tenant's training bins) and
+    its own `SloEvaluator` (that tenant's workspace SLOs). One
+    `tick()` evaluates every tenant and collects the breach
+    transitions; at most ``SHIFU_TPU_FLEET_REFRESH_BUDGET`` of them
+    schedule a refresh THIS tick — the rest are deferred into a FIFO
+    (one slot per tenant: a tenant already pending just refreshes its
+    breach record) and drain under the same budget on later ticks, so
+    a correlated storm (an upstream pipeline change drifting all N
+    tenants at once) becomes a bounded rolling retrain, never N
+    concurrent training runs fighting for the accelerator.
+
+    Per-tenant refresh controllers keep their own in-flight/cooldown
+    coalescing on top — the budget bounds scheduling, the controller
+    bounds repetition.
+    """
+
+    def __init__(self, store_root: str,
+                 refresh_budget: Optional[int] = None):
+        from shifu_tpu.config.environment import knob_int
+        self.store_root = store_root
+        self.budget = int(refresh_budget if refresh_budget is not None
+                          else knob_int("SHIFU_TPU_FLEET_REFRESH_BUDGET"))
+        self.budget = max(self.budget, 1)
+        self._tenants: Dict[str, Dict] = {}
+        self._pending: Dict[str, Dict] = {}   # tenant → breach record
+        self.ticks = 0
+        self.breaches = 0
+        self.scheduled = 0
+        self.deferred = 0
+
+    def add_tenant(self, name: str, ctx, refresh=None) -> None:
+        """Register one tenant: its ProcessorContext (frozen training
+        bins → RollingDrift baseline; workspace root → SLOs) and an
+        optional RefreshController that breaches schedule into."""
+        self._tenants[name] = {
+            "ctx": ctx, "drift": RollingDrift(ctx),
+            "slo": SloEvaluator(ctx.path_finder.root),
+            "refresh": refresh, "windows": 0, "last_snap": None}
+        log.info("fleet-drift: tenant %s registered (%d features)",
+                 name, self._tenants[name]["drift"].n_features)
+
+    def observe(self, name: str, df) -> Optional[Dict]:
+        """Feed one arriving window to one tenant's drift monitor.
+        Absorbed: a poisoned window is skipped and counted, exactly
+        like the single-model watch tick."""
+        t = self._tenants[name]
+        st = health_store.store(self.store_root)
+        if df is None or not len(df):
+            return None
+        try:
+            with obs_trace.span("watch.window", rows=len(df),
+                                tenant=name):
+                from shifu_tpu import resilience
+                resilience.fault_point("watch.window")
+                snap = t["drift"].observe(df)
+        except Exception as e:  # noqa: BLE001 — absorbed
+            st.counter("watch.window_failed", tenant=name)
+            log.warning("fleet-drift: %s window skipped (absorbed): %s",
+                        name, e)
+            return None
+        t["windows"] += 1
+        t["last_snap"] = snap
+        # the tenant's OWN store first — its SloEvaluator reads drift
+        # series from the tenant workspace; the fleet store gets the
+        # same points tenant-tagged for fleet-wide dashboards
+        try:
+            st_tenant = health_store.store(t["ctx"].path_finder.root)
+            st_tenant.emit("drift.psi_max", snap["psi_max"],
+                           window=snap["window"])
+            st_tenant.emit("drift.psi_mean", snap["psi_mean"],
+                           window=snap["window"])
+            st_tenant.flush()
+        except Exception as e:  # noqa: BLE001 — absorbed
+            log.warning("fleet-drift: %s tenant store emit failed "
+                        "(absorbed): %s", name, e)
+        st.emit("drift.psi_max", snap["psi_max"], tenant=name,
+                window=snap["window"])
+        st.emit("drift.psi_mean", snap["psi_mean"], tenant=name,
+                window=snap["window"])
+        if snap["drifted"]:
+            st.event("drift", tenant=name,
+                     features=",".join(snap["drifted"]),
+                     psi_max=snap["psi_max"], window=snap["window"])
+        if t["refresh"] is not None:
+            t["refresh"].note_window(df)
+        return snap
+
+    def tick(self) -> Dict[str, str]:
+        """Evaluate every tenant's SLOs, then schedule breaches under
+        the fleet budget. Returns {tenant: outcome} for every tenant
+        acted on this tick (scheduled outcome or "deferred")."""
+        self.ticks += 1
+        st = health_store.store(self.store_root)
+        for name, t in self._tenants.items():
+            with obs_trace.span("watch.evaluate", tenant=name):
+                t["slo"].evaluate()
+            for rec in t["slo"].drain_transitions():
+                if rec["state"] != "breach":
+                    continue
+                self.breaches += 1
+                # one slot per tenant: a tenant already queued just
+                # gets the newest breach record, not a second slot
+                self._pending[name] = dict(rec, tenant=name)
+        outcomes: Dict[str, str] = {}
+        launched = 0
+        for name in list(self._pending):
+            if launched >= self.budget:
+                break
+            rec = self._pending.pop(name)
+            launched += 1
+            self.scheduled += 1
+            outcomes[name] = on_breach(
+                rec, self._tenants[name]["refresh"]) or "alerted"
+        if self._pending:
+            self.deferred += len(self._pending)
+            st.counter("watch.fleet_deferred",
+                       value=len(self._pending))
+            st.event("fleet_drift", phase="storm",
+                     deferred=",".join(sorted(self._pending)),
+                     budget=self.budget, launched=launched)
+            log.warning("fleet-drift: breach storm — %d tenant(s) "
+                        "deferred past the budget of %d (%s)",
+                        len(self._pending), self.budget,
+                        sorted(self._pending))
+            for name in self._pending:
+                outcomes.setdefault(name, "deferred")
+        try:
+            st.flush()
+        except Exception as e:  # noqa: BLE001 — absorbed
+            log.warning("fleet-drift: flush failed (absorbed): %s", e)
+        return outcomes
+
+    def stats(self) -> Dict:
+        return {"tenants": {n: {"windows": t["windows"],
+                                "psi_max": (t["last_snap"] or
+                                            {}).get("psi_max")}
+                            for n, t in self._tenants.items()},
+                "ticks": self.ticks, "breaches": self.breaches,
+                "scheduled": self.scheduled, "deferred": self.deferred,
+                "pending": sorted(self._pending),
+                "budget": self.budget}
+
+
 def _emit_drift(st, snap: Dict) -> None:
     """Snapshot → metric points + a `drift` event when any feature is
     over threshold."""

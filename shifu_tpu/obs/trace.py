@@ -88,6 +88,13 @@ SPAN_FAMILIES: Dict[str, Tuple[str, ...]] = {
     # challenger-vs-incumbent eval decision, one rollback span per
     # registry rollback + live re-swap
     "refresh": ("run", "guardrail", "rollback"),
+    # live promotion: one run span per staged shadow→canary→promoted
+    # cycle, one decide span per live-arm comparison, one rollback
+    # span per canary breach (registry rollback + arm teardown)
+    "canary": ("run", "decide", "rollback"),
+    # shadow plane: one score span per mirrored request the side
+    # thread replays against the challenger arm (discarded response)
+    "shadow": ("score",),
 }
 
 

@@ -405,6 +405,22 @@ REFRESH_FIELDS = ("breach_to_promoted_s", "swap_s", "rewarm_s",
 INGEST_FIELDS = ("rows", "rows_per_s", "segments",
                  "breach_latency_s", "bitwise_identical")
 
+# the live-promotion bench record schema: bench.py --task canary
+# builds its JSON record from exactly these keys — wall seconds from
+# the injected breach to the live-arm verdict (shadow + canary phases
+# included), wall seconds from a sabotaged canary's breach verdict to
+# the fleet serving the re-pinned incumbent again, requests the
+# concurrent client FAILED during both cycles (tools/bench_regress.py
+# gates this == 0 absolutely and the rollback latency against its
+# trailing median), per-arm request counts, the final
+# score-distribution PSI between arms, and the two verdicts.
+# tools/check_steps_schema.py pins README docs to this tuple the same
+# way it pins REFRESH_FIELDS.
+CANARY_FIELDS = ("breach_to_live_s", "rollback_recovery_s",
+                 "failed_requests", "shadow_requests",
+                 "canary_requests", "arm_psi", "promote_verdict",
+                 "rollback_verdict")
+
 # the pipeline DAG scheduler's record schema: a scheduled step attaches
 # one `dag` block to its steps.jsonl record — DAG_SUMMARY_FIELDS are
 # the block's top-level keys, DAG_FIELDS the schema of each entry in

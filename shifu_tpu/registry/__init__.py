@@ -9,6 +9,7 @@ HEAD pointer commit.
 from shifu_tpu.registry.registry import (  # noqa: F401
     HEAD_FILE,
     MANIFEST_FILE,
+    annotate,
     gc,
     head,
     ls,

@@ -235,6 +235,22 @@ def publish(root: str, name: str, models_dir: str,
     return version
 
 
+def annotate(root: str, name: str, version: str,
+             extra: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge `extra` into a published version's manifest atomically
+    (write-tmp-then-rename). The artifact files stay immutable — this
+    records facts learned AFTER publish (the live canary verdict and
+    its observed window) on the version they are about. Returns the
+    updated manifest."""
+    v, vdir, manifest = resolve(root, name, version)
+    manifest.update(extra)
+    with atomic_write(os.path.join(vdir, MANIFEST_FILE)) as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    log.info("registry: annotated %s/%s with %s", name, v,
+             sorted(extra))
+    return manifest
+
+
 def rollback(root: str, name: str,
              to: Optional[str] = None) -> str:
     """Point HEAD at `to` (default: the version preceding the current

@@ -153,6 +153,7 @@ FAULT_SITES = (
     "refresh.schedule", "refresh.guardrail", "refresh.promote",
     "refresh.swap",
     "ingest.append", "ingest.seal", "ingest.offset",
+    "shadow.score", "canary.start", "canary.decide", "canary.rollback",
 )
 
 

@@ -1,0 +1,617 @@
+"""Live-traffic promotion (tier-1): shadow scoring → canary arms →
+traffic-derived verdict → promote or automatic rollback, plus
+per-tenant fleet drift with breach-storm coalescing.
+
+Contracts drilled here:
+
+- END-TO-END: a drift breach schedules the warm retrain, the trained
+  challenger warms as a fleet ARM (primary pinned), shadow traffic
+  builds score evidence, a canary fraction of REAL traffic scores on
+  the challenger, and the LIVE verdict (between-arms score PSI +
+  per-arm p99) promotes — observed by a concurrently-scoring client
+  with ZERO failed requests; the published manifest records the
+  verdict and the observed live window.
+- SABOTAGED TWIN: a challenger whose arm serves slow degrades the
+  canary p99 past the live SLO band → automatic rollback mid-canary:
+  HEAD back on the incumbent, same scores as before, zero client
+  failures (canary routing just switches off).
+- DETERMINISM: arm assignment is a pure function of the admission
+  sequence — same order ⇒ same arms, any window routes ≈ pct.
+- SHADOW ISOLATION: a failing or overloaded shadow plane is counted
+  (errors, drops) and NEVER fails or slows the primary.
+- CHAOS: an injected fault at EVERY canary.*/shadow.* site leaves the
+  incumbent serving and the registry consistent (HEAD unmoved or
+  recovered to baseline), with no `.tmp` residue. SIGKILL mid-canary
+  holds the invariant across a process boundary: the persisted state
+  file lets the rerun roll back to the recorded baseline.
+- FLEET DRIFT: per-tenant RollingDrift+SLO loops in one fleet tick;
+  N tenants breaching at once schedule at most the refresh budget and
+  defer the rest (bounded rolling retrain, never a storm).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu import registry, resilience
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.obs.health import store as health_store
+from shifu_tpu.obs.health.canary import (CanaryController, read_state,
+                                         state_path)
+from shifu_tpu.obs.health.refresh import RefreshController
+from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.serve.fleet import FleetService, arm_assign
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LADDER = (1, 4)
+
+
+@pytest.fixture(autouse=True)
+def _canary_isolation(monkeypatch):
+    for k in ("SHIFU_TPU_METRICS", "SHIFU_TPU_SLO_FILE",
+              "SHIFU_TPU_ALERT_WEBHOOK", "SHIFU_TPU_TRACE",
+              "SHIFU_TPU_FAULT", "SHIFU_TPU_SHADOW_PCT",
+              "SHIFU_TPU_CANARY_PCT"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SHIFU_TPU_RETRY_BASE_S", "0.01")
+    resilience.reset_faults()
+    yield
+    resilience.reset_faults()
+
+
+@pytest.fixture(scope="module")
+def trained_set(tmp_path_factory):
+    """ONE trained tiny model set per module; tests copy it."""
+    from tests.synth import make_model_set
+    base = tmp_path_factory.mktemp("canary_base")
+    ms = make_model_set(base, np.random.default_rng(23), n_rows=400)
+    cfg_path = os.path.join(ms, "ModelConfig.json")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    cfg["train"]["numTrainEpochs"] = 8
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f, indent=2)
+    for cmd in ("init", "stats", "norm", "train"):
+        assert cli_main(["--dir", ms, cmd]) == 0, cmd
+    return ms
+
+
+def _clone_set(trained_set, tmp_path):
+    ms = os.path.join(str(tmp_path), "ModelSet")
+    shutil.copytree(trained_set, ms)
+    return ms
+
+
+def _raw_frame(trained_set):
+    import pandas as pd
+    hdr = open(os.path.join(trained_set, "data",
+                            ".pig_header")).read().strip().split("|")
+    return pd.read_csv(os.path.join(trained_set, "data", "part-00000"),
+                       sep="|", names=hdr, dtype=str)
+
+
+def _shift_numerics(df, delta):
+    out = df.copy()
+    for col in out.columns:
+        if not col.startswith("num_"):
+            continue
+        v = out[col].to_numpy(dtype=object).copy()
+        for i, s in enumerate(v):
+            try:
+                v[i] = f"{float(s) + delta:.6f}"
+            except (TypeError, ValueError):
+                pass
+        out[col] = v
+    return out
+
+
+def _publish_incumbent(ms, tmp_path, name="m"):
+    reg = os.path.join(str(tmp_path), "reg")
+    v1 = registry.publish(reg, name, os.path.join(ms, "models"),
+                          ladder=LADDER)
+    return reg, v1
+
+
+def _no_tmp_residue(root):
+    return [os.path.join(d, f) for d, _dirs, fs in os.walk(root)
+            for f in fs if f.startswith(".tmp.")]
+
+
+# fast staged-controller settings: tiny quorum, generous window. The
+# PSI band is wide open here because a warm-RETRAINED twin scored on a
+# tiny synthetic batch legitimately lands its mass in different
+# 16-bin buckets (the gate semantics are pinned by the decide-rule
+# matrix below; the drills assert the evidence is recorded)
+_CANARY_KW = dict(shadow_pct=0.5, canary_pct=0.5, min_requests=10,
+                  window_s=60.0, psi_max=100.0, p99_factor=20.0,
+                  slo_p99_ms=5000.0, poll_s=0.01)
+
+
+def _live_client(fleet, x, stop, failures, served, arms_seen):
+    while not stop.is_set():
+        try:
+            _, timing = fleet.submit_timed("m", dense=x, timeout=30.0)
+            served[0] += 1
+            arms_seen.add(timing.get("arm"))
+        except Exception as e:  # noqa: BLE001 — any miss fails
+            failures.append(e)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: breach → retrain → shadow → canary → LIVE
+# verdict promotes, under a concurrently-scoring client
+# ---------------------------------------------------------------------------
+
+def test_live_promotion_drill_end_to_end(trained_set, tmp_path,
+                                         monkeypatch):
+    from shifu_tpu.obs.health import watch as watch_mod
+
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    ms = _clone_set(trained_set, tmp_path)
+    reg, v1 = _publish_incumbent(ms, tmp_path)
+    with open(os.path.join(ms, "slo.json"), "w") as f:
+        json.dump({"slos": [
+            {"name": "drift", "metric": "drift.psi_max", "op": "<=",
+             "warn": 0.02, "breach": 0.05, "window_s": 86400.0,
+             "agg": "last"}]}, f)
+    df = _raw_frame(trained_set)
+    shifted = _shift_numerics(df, delta=0.5)
+
+    with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+        _, _, man = registry.resolve(reg, "m")
+        x = np.random.default_rng(3).normal(
+            0, 1, (3, man["input_dim"])).astype(np.float32)
+        before = np.asarray(fleet.submit("m", dense=x)["mean"])
+        ctl = RefreshController(ProcessorContext.load(ms),
+                                registry_root=reg, model_name="m",
+                                fleet=fleet, cooldown_s=0.0,
+                                canary=dict(_CANARY_KW))
+        ctl.note_window(df)
+
+        stop, failures, served = threading.Event(), [], [0]
+        arms_seen = set()
+        t = threading.Thread(target=_live_client,
+                             args=(fleet, x, stop, failures, served,
+                                   arms_seen), daemon=True)
+        t.start()
+        try:
+            rc = watch_mod.run_monitor(ProcessorContext.load(ms),
+                                       interval_s=0.0, iterations=1,
+                                       windows=[shifted], refresh=ctl)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+
+        assert rc == 0
+        assert ctl.last_outcome == "promoted", ctl.stats()
+        # the verdict came from LIVE arms and is recorded on the
+        # published version together with the observed window
+        assert registry.head(reg, "m") == "v002"
+        _, _, man2 = registry.resolve(reg, "m")
+        assert man2["canary"]["verdict"] == "promote"
+        assert man2["canary"]["baseline"] == v1
+        win = man2["canary"]["live_window"]
+        assert win["requests"]["canary"] >= _CANARY_KW["min_requests"]
+        assert win["requests"]["shadow"] >= _CANARY_KW["min_requests"]
+        assert win["arm_psi"] is not None
+        assert man2["refresh"]["mode"] == "live"
+        # the client rode shadow AND canary phases with zero failures,
+        # and real traffic actually scored on both arms
+        assert not failures, failures[:3]
+        assert served[0] > 0
+        assert {"primary", "canary"} <= arms_seen
+        # promotion swapped the fleet in place and tore the arm down
+        assert fleet.arm_stats("m") is None
+        assert not fleet._entries["m"].pinned
+        after = np.asarray(fleet.submit("m", dense=x)["mean"])
+        assert not np.array_equal(before, after)
+        # terminal phase ⇒ no state file survives
+        assert read_state(reg, "m") is None
+
+    st = health_store.store(ms)
+    phases = [e["tags"]["phase"] for e in st.events(limit=50,
+                                                    names=["canary"])]
+    for want in ("shadow", "canary", "promoted"):
+        assert want in phases, phases
+    assert not _no_tmp_residue(ms) and not _no_tmp_residue(reg)
+
+
+def test_slow_challenger_rolls_back_mid_canary(trained_set, tmp_path,
+                                               monkeypatch):
+    """The sabotaged twin: the challenger arm serves SLOW, its canary
+    p99 breaches the live band, and the controller rolls back
+    automatically — zero client failures, incumbent untouched."""
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    ms = _clone_set(trained_set, tmp_path)
+    reg, v1 = _publish_incumbent(ms, tmp_path)
+
+    with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+        _, _, man = registry.resolve(reg, "m")
+        x = np.random.default_rng(3).normal(
+            0, 1, (3, man["input_dim"])).astype(np.float32)
+        before = np.asarray(fleet.submit("m", dense=x)["mean"])
+
+        orig_start = fleet.start_arms
+
+        def sabotaged_start(name, challenger_dir, **kw):
+            out = orig_start(name, challenger_dir, **kw)
+            svc = fleet._arms[name].service
+            orig_submit = svc.submit_timed
+
+            def slow_submit(timeout=30.0, **blocks):
+                # p99 ≈ 400ms — far past max(slo, factor × primary)
+                # even with the primary's p99 inflated by a hammering
+                # client on a loaded CPU box
+                time.sleep(0.4)
+                out, timing = orig_submit(timeout=timeout, **blocks)
+                timing["total_s"] += 0.4
+                return out, timing
+
+            svc.submit_timed = slow_submit
+            return out
+
+        monkeypatch.setattr(fleet, "start_arms", sabotaged_start)
+
+        stop, failures, served = threading.Event(), [], [0]
+        arms_seen = set()
+        t = threading.Thread(target=_live_client,
+                             args=(fleet, x, stop, failures, served,
+                                   arms_seen), daemon=True)
+        t.start()
+        try:
+            kw = dict(_CANARY_KW, slo_p99_ms=50.0, p99_factor=1.5,
+                      min_requests=8)
+            ctl = CanaryController(fleet, reg, "m", store_root=ms,
+                                   **kw)
+            result = ctl.run(os.path.join(ms, "models"), "sab01")
+        finally:
+            stop.set()
+            t.join(timeout=30)
+
+        assert result["outcome"] == "rolled_back"
+        assert "p99" in result["verdict"]["reason"]
+        # HEAD re-pinned to the baseline; the optimistically-published
+        # version stays as an audited orphan carrying the verdict
+        assert registry.head(reg, "m") == v1
+        _, _, man_orphan = registry.resolve(reg, "m",
+                                            result["version"])
+        assert man_orphan["canary"]["verdict"] == "rollback"
+        # zero failed requests THROUGH the breach and rollback — the
+        # slow canary still answered, then routing switched off
+        assert not failures, failures[:3]
+        assert served[0] > 0
+        assert fleet.arm_stats("m") is None
+        after = np.asarray(fleet.submit("m", dense=x)["mean"])
+        np.testing.assert_array_equal(before, after)
+        assert read_state(reg, "m") is None
+
+    st = health_store.store(ms)
+    phases = [e["tags"]["phase"] for e in st.events(limit=50,
+                                                    names=["canary"])]
+    assert "rolled_back" in phases, phases
+    assert not _no_tmp_residue(ms) and not _no_tmp_residue(reg)
+
+
+# ---------------------------------------------------------------------------
+# determinism + shadow isolation
+# ---------------------------------------------------------------------------
+
+def test_arm_assignment_is_deterministic_and_proportional():
+    a = [arm_assign(i, 0.25) for i in range(4000)]
+    b = [arm_assign(i, 0.25) for i in range(4000)]
+    assert a == b                       # pure function of (seq, pct)
+    rate = sum(a) / len(a)
+    assert 0.2 < rate < 0.3             # low-discrepancy ≈ pct
+    assert not any(arm_assign(i, 0.0) for i in range(100))
+    assert all(arm_assign(i, 1.0) for i in range(100))
+
+
+def test_shadow_failures_never_touch_the_primary(trained_set, tmp_path,
+                                                 monkeypatch):
+    """Every shadow score faults (injected at shadow.score) — the
+    primary keeps answering, the errors are counted, nothing
+    propagates."""
+    ms = _clone_set(trained_set, tmp_path)
+    reg, _v1 = _publish_incumbent(ms, tmp_path)
+    with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+        _, _, man = registry.resolve(reg, "m")
+        x = np.random.default_rng(3).normal(
+            0, 1, (3, man["input_dim"])).astype(np.float32)
+        fleet.submit("m", dense=x)   # resident before the arm starts
+        monkeypatch.setenv("SHIFU_TPU_FAULT", "shadow.score:oserror:1")
+        resilience.reset_faults()
+        fleet.start_arms("m", os.path.join(ms, "models"),
+                         version="sh01", shadow_pct=1.0)
+        for _ in range(20):
+            fleet.submit("m", dense=x)   # must never raise
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            a = fleet.arm_stats("m")
+            if a["shadow_errors"] + a["requests"]["shadow"] \
+                    + a["shadow_dropped"] >= 20:
+                break
+            time.sleep(0.02)
+        a = fleet.arm_stats("m")
+        assert a["shadow_errors"] >= 1, a
+        assert a["requests"]["primary"] >= 20
+        fleet.stop_arms("m")
+        # idempotent teardown, pin released
+        fleet.stop_arms("m")
+        assert not fleet._entries["m"].pinned
+
+
+# ---------------------------------------------------------------------------
+# live decision rule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("stats,want", [
+    # healthy: PSI low, p99 inside band, no fallbacks
+    ({"arm_psi": 0.01, "p99_ms": {"canary": 5.0, "primary": 5.0},
+      "canary_fallbacks": 0}, "promote"),
+    # no evidence ⇒ no promotion
+    ({"arm_psi": None, "p99_ms": {}, "canary_fallbacks": 0},
+     "rollback"),
+    # challenger scores a different population
+    ({"arm_psi": 0.9, "p99_ms": {"canary": 5.0, "primary": 5.0},
+      "canary_fallbacks": 0}, "rollback"),
+    # latency breach beyond max(slo, factor × primary)
+    ({"arm_psi": 0.01, "p99_ms": {"canary": 200.0, "primary": 5.0},
+      "canary_fallbacks": 0}, "rollback"),
+    # the challenger failed real requests (absorbed by fallback)
+    ({"arm_psi": 0.01, "p99_ms": {"canary": 5.0, "primary": 5.0},
+      "canary_fallbacks": 2}, "rollback"),
+    # small jitter under the absolute SLO never rolls back
+    ({"arm_psi": 0.01, "p99_ms": {"canary": 9.0, "primary": 5.0},
+      "canary_fallbacks": 0}, "promote"),
+])
+def test_live_decision_rule(stats, want):
+    decision, _reason = CanaryController.decide(
+        stats, psi_max=0.25, p99_factor=1.5, slo_p99_ms=50.0)
+    assert decision == want
+
+
+# ---------------------------------------------------------------------------
+# chaos: every canary.* site — incumbent serving, registry consistent
+# ---------------------------------------------------------------------------
+
+def _quick_controller(fleet, reg, ms, **kw):
+    """min_requests=0 drives the state machine through every phase
+    without traffic (decide then says 'no evidence' ⇒ rollback) — the
+    fault sites still fire in order, which is what chaos drills."""
+    base = dict(_CANARY_KW, min_requests=0, window_s=10.0)
+    base.update(kw)
+    return CanaryController(fleet, reg, "m", store_root=ms, **base)
+
+
+@pytest.mark.parametrize("site", ["canary.start", "canary.decide",
+                                  "canary.rollback"])
+def test_canary_fault_leaves_incumbent_serving(site, trained_set,
+                                               tmp_path, monkeypatch):
+    assert site in resilience.FAULT_SITES
+    ms = _clone_set(trained_set, tmp_path)
+    reg, v1 = _publish_incumbent(ms, tmp_path)
+    with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+        _, _, man = registry.resolve(reg, "m")
+        x = np.random.default_rng(3).normal(
+            0, 1, (3, man["input_dim"])).astype(np.float32)
+        before = np.asarray(fleet.submit("m", dense=x)["mean"])
+        monkeypatch.setenv("SHIFU_TPU_FAULT", f"{site}:oserror:1")
+        resilience.reset_faults()
+        ctl = _quick_controller(fleet, reg, ms)
+        with pytest.raises(OSError, match=site):
+            ctl.run(os.path.join(ms, "models"), "chaos1")
+
+        # traffic safety: no arm left running, primary still answers
+        # the same scores
+        assert fleet.arm_stats("m") is None
+        after = np.asarray(fleet.submit("m", dense=x)["mean"])
+        np.testing.assert_array_equal(before, after)
+        # registry: readable, and recovery converges HEAD back to the
+        # baseline no matter where the fault landed
+        registry.resolve(reg, "m")
+        monkeypatch.delenv("SHIFU_TPU_FAULT")
+        resilience.reset_faults()
+        CanaryController.recover(reg, "m", fleet=fleet, store_root=ms)
+        assert registry.head(reg, "m") == v1
+        assert read_state(reg, "m") is None
+        assert not fleet._entries["m"].pinned
+
+        # rerun after the fault cleared drives a full clean cycle
+        # (no traffic ⇒ the verdict is a clean no-evidence rollback)
+        result = _quick_controller(fleet, reg, ms).run(
+            os.path.join(ms, "models"), "chaos2")
+        assert result["outcome"] == "rolled_back"
+        assert registry.head(reg, "m") == v1
+        assert read_state(reg, "m") is None
+    assert not _no_tmp_residue(ms) and not _no_tmp_residue(reg)
+
+
+_KILL_DRILL = textwrap.dedent("""\
+    import os, sys
+    ms, reg = sys.argv[1], sys.argv[2]
+    from shifu_tpu.obs.health.canary import CanaryController
+    from shifu_tpu.serve.fleet import FleetService
+    with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+        ctl = CanaryController(fleet, reg, "m", store_root=ms,
+                               shadow_pct=0.5, canary_pct=0.5,
+                               min_requests=0, window_s=10.0,
+                               psi_max=3.0, p99_factor=20.0,
+                               slo_p99_ms=5000.0, poll_s=0.01)
+        # the injected SIGKILL fires at canary.decide — raise if the
+        # run somehow completes
+        ctl.run(os.path.join(ms, "models"), "kill01")
+    raise SystemExit("canary survived an injected kill")
+""")
+
+
+def test_sigkill_mid_canary_rerun_rolls_back(trained_set, tmp_path):
+    """SIGKILL at the decide point across a real process boundary: the
+    persisted state file names the baseline, the rerun's recover rolls
+    HEAD back to it, and the registry never dangles."""
+    ms = _clone_set(trained_set, tmp_path)
+    reg, v1 = _publish_incumbent(ms, tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               SHIFU_TPU_FAULT="canary.decide:kill:1")
+    env.pop("SHIFU_TPU_METRICS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_DRILL, ms, reg],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -9, (proc.returncode, proc.stdout,
+                                   proc.stderr)
+    # the crash happened AFTER the optimistic publish: HEAD names the
+    # challenger, the state file names the baseline — exactly the
+    # situation recover() exists for
+    state = read_state(reg, "m")
+    assert state is not None and state["prev_head"] == v1
+    assert state["phase"] in ("shadow", "canary")
+    registry.resolve(reg, "m")   # readable either way
+    assert CanaryController.recover(reg, "m") == "rolled_back"
+    assert registry.head(reg, "m") == v1
+    assert read_state(reg, "m") is None
+    # the abandoned version records WHY it never went live
+    _, _, man = registry.resolve(reg, "m", state["version"])
+    assert man["canary"]["verdict"] == "rollback"
+    assert "interrupted" in man["canary"]["reason"]
+    # recover is idempotent
+    assert CanaryController.recover(reg, "m") is None
+    assert not _no_tmp_residue(ms) and not _no_tmp_residue(reg)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant fleet drift + breach-storm coalescing
+# ---------------------------------------------------------------------------
+
+class _StubRefresh:
+    def __init__(self, name):
+        self.name = name
+        self.windows = 0
+        self.breaches = []
+
+    def note_window(self, df):
+        self.windows += 1
+
+    def handle_breach(self, rec):
+        self.breaches.append(rec)
+        return "promoted"
+
+
+def test_fleet_drift_per_tenant_with_budget(trained_set, tmp_path,
+                                            monkeypatch):
+    from shifu_tpu.obs.health.watch import FleetDriftWatch
+
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    roots, stubs = {}, {}
+    for tenant in ("a", "b", "c"):
+        ms = os.path.join(str(tmp_path), f"tenant_{tenant}")
+        shutil.copytree(trained_set, ms)
+        with open(os.path.join(ms, "slo.json"), "w") as f:
+            json.dump({"slos": [
+                {"name": f"drift_{tenant}", "metric": "drift.psi_max",
+                 "op": "<=", "warn": 0.02, "breach": 0.05,
+                 "window_s": 86400.0, "agg": "last"}]}, f)
+        roots[tenant] = ms
+        stubs[tenant] = _StubRefresh(tenant)
+
+    fw_root = os.path.join(str(tmp_path), "fleet_ws")
+    os.makedirs(fw_root)
+    fw = FleetDriftWatch(fw_root, refresh_budget=1)
+    for tenant, ms in roots.items():
+        fw.add_tenant(tenant, ProcessorContext.load(ms),
+                      refresh=stubs[tenant])
+
+    df = _raw_frame(trained_set)
+    shifted = _shift_numerics(df, delta=0.5)
+    # all three tenants drift in the SAME tick — the storm
+    for tenant in roots:
+        snap = fw.observe(tenant, shifted)
+        assert snap is not None and snap["psi_max"] > 0.05
+
+    out1 = fw.tick()
+    # budget 1: exactly one tenant refreshed, the other two deferred
+    scheduled1 = [t for t, o in out1.items() if o == "promoted"]
+    assert len(scheduled1) == 1
+    assert sorted(t for t, o in out1.items() if o == "deferred") == \
+        sorted(set(roots) - set(scheduled1))
+    s = fw.stats()
+    assert s["breaches"] == 3 and s["scheduled"] == 1
+    assert len(s["pending"]) == 2
+
+    # the deferred tenants drain one per tick — a bounded rolling
+    # retrain, never three concurrent ones
+    out2 = fw.tick()
+    out3 = fw.tick()
+    done = scheduled1 + \
+        [t for t, o in out2.items() if o == "promoted"] + \
+        [t for t, o in out3.items() if o == "promoted"]
+    assert sorted(done) == ["a", "b", "c"]
+    assert fw.stats()["pending"] == []
+    for tenant, stub in stubs.items():
+        assert len(stub.breaches) == 1, tenant
+        assert stub.breaches[0]["tenant"] == tenant
+        assert stub.windows == 1
+
+    # the storm is visible in the fleet store
+    st = health_store.store(fw_root)
+    storms = [e for e in st.events(limit=20, names=["fleet_drift"])
+              if e["tags"].get("phase") == "storm"]
+    assert storms and storms[0]["tags"]["budget"] == 1
+
+
+def test_fleet_drift_poisoned_window_is_absorbed(trained_set, tmp_path,
+                                                 monkeypatch):
+    from shifu_tpu.obs.health.watch import FleetDriftWatch
+
+    ms = os.path.join(str(tmp_path), "tenant_a")
+    shutil.copytree(trained_set, ms)
+    fw_root = os.path.join(str(tmp_path), "fleet_ws")
+    os.makedirs(fw_root)
+    fw = FleetDriftWatch(fw_root)
+    fw.add_tenant("a", ProcessorContext.load(ms))
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "watch.window:oserror:1")
+    resilience.reset_faults()
+    assert fw.observe("a", _raw_frame(trained_set)) is None
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    snap = fw.observe("a", _raw_frame(trained_set))
+    assert snap is not None
+    assert fw.stats()["tenants"]["a"]["windows"] == 1
+
+
+# ---------------------------------------------------------------------------
+# surfacing: the arm header + health/top status lines
+# ---------------------------------------------------------------------------
+
+def test_health_and_top_surface_canary_state(trained_set, tmp_path,
+                                             monkeypatch, capsys):
+    monkeypatch.setenv("SHIFU_TPU_METRICS", "1")
+    ms = _clone_set(trained_set, tmp_path)
+    st = health_store.store(ms)
+    st.event("canary", model="m", phase="canary", run="run0007",
+             version="v002", canary_pct=0.05)
+    st.emit("serve.arm_p99_ms", 4.2, kind="gauge", model="m",
+            arm="primary")
+    st.emit("serve.arm_p99_ms", 4.9, kind="gauge", model="m",
+            arm="canary")
+    st.emit("canary.arm_psi", 0.0123, kind="gauge", model="m")
+    st.flush()
+
+    monkeypatch.delenv("SHIFU_TPU_METRICS")
+    capsys.readouterr()
+    cli_main(["--dir", ms, "health"])
+    out = capsys.readouterr().out
+    assert "canary arms:" in out
+    assert "phase=canary" in out and "canary_pct=0.05" in out
+    assert "p99[primary]=4.200ms" in out and "p99[canary]=4.900ms" in out
+    assert "arm_psi=0.0123" in out
+
+    cli_main(["--dir", ms, "top"])
+    out = capsys.readouterr().out
+    assert "canary arms:" in out and "phase=canary" in out

@@ -260,16 +260,28 @@ def _run_family_pipeline(root, algorithm):
     return ctx
 
 
-@pytest.mark.parametrize("algorithm,kind,norm_type,params", [
+@pytest.mark.parametrize("algorithm,kind,norm_type,params,epochs,tol", [
     ("WDL", "wdl", "ZSCALE_INDEX",
      {"NumHiddenNodes": [8], "ActivationFunc": ["relu"], "EmbedSize": 4,
-      "LearningRate": 0.05}),
+      "LearningRate": 0.05}, None, (2e-3, 2e-4)),
+    # MTL runs a PINNED short horizon with a TIGHT tolerance. At the
+    # synth default of 40 epochs the two meshes diverge chaotically
+    # (measured leaf deltas: 1e-10 @ 1 epoch, 0 @ 2, 6e-8 @ 8,
+    # ~1e-4 @ 32, ~0.15 @ 40 — pure float-order amplification through
+    # the epoch scan plus a best-val-epoch selection flip, NOT a
+    # model-axis semantics bug: the 'model'-sharded head psum sums
+    # partial products in a different order than the replicated
+    # matmul). 8 epochs is past several optimizer steps on every
+    # shard yet before chaos outruns float32, so a REAL regression in
+    # the head-sharding math (wrong psum, dropped shard, stale
+    # replicated trunk) fails loudly while benign reduction-order
+    # noise stays ~4 orders of magnitude under the gate.
     ("MTL", "mtl", "ZSCALE",
      {"NumHiddenNodes": [8], "ActivationFunc": ["relu"],
-      "LearningRate": 0.05}),
+      "LearningRate": 0.05}, 8, (1e-4, 1e-5)),
 ])
 def test_model_axis_parity(tmp_path, monkeypatch, algorithm, kind,
-                           norm_type, params):
+                           norm_type, params, epochs, tol):
     """SHIFU_TPU_MESH_MODEL=2 (data=4 × model=2 mesh; WDL embedding /
     MTL head rows sharded over 'model') trains the same model as the
     pure data mesh — the product model-parallel path (VERDICT r3 next
@@ -286,11 +298,13 @@ def test_model_axis_parity(tmp_path, monkeypatch, algorithm, kind,
                               n_rows=1200, algorithm=algorithm,
                               norm_type=norm_type,
                               train_params=dict(params))
+        mcp = os.path.join(root, "ModelConfig.json")
+        mc = json_mod.load(open(mcp))
         if algorithm == "MTL":
-            mcp = os.path.join(root, "ModelConfig.json")
-            mc = json_mod.load(open(mcp))
             mc["dataSet"]["targetColumnName"] = "diagnosis|diagnosis"
-            json_mod.dump(mc, open(mcp, "w"))
+        if epochs is not None:
+            mc["train"]["numTrainEpochs"] = epochs
+        json_mod.dump(mc, open(mcp, "w"))
         return root
 
     monkeypatch.delenv("SHIFU_TPU_MESH_MODEL", raising=False)
@@ -303,9 +317,10 @@ def test_model_axis_parity(tmp_path, monkeypatch, algorithm, kind,
     flat_d = jax.tree.leaves(p_d)
     flat_m = jax.tree.leaves(p_m)
     assert len(flat_d) == len(flat_m)
+    rtol, atol = tol
     for a, b in zip(flat_d, flat_m):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-3, atol=2e-4)
+                                   rtol=rtol, atol=atol)
 
 
 # ---------------------------------------------------------------------------

@@ -126,6 +126,43 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
                     f"{label}: guardrail decision "
                     f"{gr.get('decision')!r} in a published refresh "
                     "record — only promoted runs belong in the log")
+        # live-promotion records (bench --task canary): two absolute
+        # invariants first — a live cycle that failed even ONE client
+        # request broke the headline promise (the primary never stops
+        # serving; canary errors fall back, rollback just switches
+        # routing off), and only guardrail-promoted live verdicts
+        # belong in a published record. Rollback recovery latency
+        # (breach verdict → incumbent re-pinned and serving) is
+        # lower-is-better, ceilinged vs its trailing median below
+        # like the ingest breach latency.
+        if task == "canary":
+            fr = newest.get("failed_requests")
+            if isinstance(fr, (int, float)) and fr > 0:
+                findings.append(
+                    f"{label}: failed_requests {fr:g} — a live "
+                    "promotion cycle dropped client requests")
+            pv = newest.get("promote_verdict")
+            if isinstance(pv, dict) and pv.get("decision") != "promote":
+                findings.append(
+                    f"{label}: promote_verdict "
+                    f"{pv.get('decision')!r} in a published canary "
+                    "record — only live-promoted runs belong in the "
+                    "log")
+            rr = newest.get("rollback_recovery_s")
+            if isinstance(rr, (int, float)):
+                hv = sorted(
+                    float(r["rollback_recovery_s"]) for r in history
+                    if isinstance(r.get("rollback_recovery_s"),
+                                  (int, float)))
+                if len(hv) >= min_history:
+                    median = hv[len(hv) // 2]
+                    ceil = median * (1.0 + threshold_pct / 100.0)
+                    if rr > ceil:
+                        findings.append(
+                            f"{label}: rollback_recovery_s {rr:.4g} "
+                            f"is {100.0 * (rr - median) / median:.1f}%"
+                            f" above the trailing median {median:.4g}"
+                            f" (threshold {threshold_pct:.0f}%)")
         # streaming-ingest records: append throughput rides the generic
         # rows_per_s gate and the replay verdict the generic
         # bitwise_identical gate below; breach-detection latency

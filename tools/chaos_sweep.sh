@@ -94,6 +94,76 @@ print(f"refresh drill at {site}: outcome={outcome}, HEAD={head}, "
 PYEOF
 }
 
+run_canary_drill() {  # $1 = model-set dir, $2 = site; the standard
+  # pipeline never reaches the live-promotion sites, so canary.* and
+  # shadow.* get the closed-loop drill: publish an incumbent, warm a
+  # fleet, drive a staged live promotion under live traffic with the
+  # fault armed, and hold the invariant — the primary answers before,
+  # during and after, recover() leaves HEAD on the incumbent, and no
+  # canary state file or .tmp residue survives.
+  python - "$1" "$2" <<'PYEOF'
+import os, sys, threading, time, traceback
+import numpy as np
+ms, site = sys.argv[1], sys.argv[2]
+from shifu_tpu.cli import main as cli_main
+for cmd in ("init", "stats", "norm", "train"):
+    assert cli_main(["--dir", ms, cmd]) == 0, cmd
+from shifu_tpu import registry, resilience
+from shifu_tpu.obs.health.canary import CanaryController, read_state
+from shifu_tpu.serve.fleet import FleetService
+reg = os.path.join(os.path.dirname(ms), "reg")
+v1 = registry.publish(reg, "m", os.path.join(ms, "models"), ladder=(1, 4))
+with FleetService(reg, workspace_root=ms, hbm_budget_mb=0) as fleet:
+    _, _, man = registry.resolve(reg, "m")
+    x = np.random.default_rng(3).normal(
+        0, 1, (4, man["input_dim"])).astype(np.float32)
+    fleet.submit("m", dense=x)
+    stop = threading.Event()
+    def client():  # the live traffic the arms mirror and sample
+        while not stop.is_set():
+            try:
+                fleet.submit_timed("m", dense=x, timeout=30.0)
+            except Exception:
+                pass
+            time.sleep(0.01)
+    threading.Thread(target=client, daemon=True).start()
+    # tiny quorum so every stage transition is reached in seconds;
+    # psi_max=-1 forces the decide verdict onto the rollback branch
+    # (any PSI exceeds it), so one pass walks start -> shadow ->
+    # canary -> decide -> rollback and every canary.* site fires
+    ctl = CanaryController(fleet, reg, "m", store_root=ms,
+                           shadow_pct=1.0, canary_pct=0.5,
+                           min_requests=4, window_s=60.0,
+                           psi_max=-1.0, poll_s=0.01)
+    resilience.reset_faults()
+    err = None
+    try:
+        outcome = ctl.run(os.path.join(ms, "models"), "drill")["outcome"]
+    except Exception as e:
+        err, outcome = e, "raised"
+        traceback.print_exc()
+        CanaryController.recover(reg, "m", fleet=fleet, store_root=ms)
+    stop.set()
+    # invariant: whatever the fault did, the primary still answers,
+    # HEAD names a complete version, the arm is down, and no canary
+    # state file survives recovery
+    fleet.submit("m", dense=x)
+    head = registry.head(reg, "m")
+    registry.resolve(reg, "m")   # raises if HEAD dangles
+    assert read_state(reg, "m") is None
+    assert fleet.arm_stats("m") is None
+    if outcome != "promoted":
+        assert head == v1, (outcome, head)
+stranded = [os.path.join(d, f) for d, _, fs in os.walk(reg)
+            for f in fs if f.startswith(".tmp.")]
+assert not stranded, stranded
+print(f"canary drill at {site}: outcome={outcome}, HEAD={head}, "
+      "primary kept serving")
+if err is not None:
+    raise err
+PYEOF
+}
+
 run_ingest_drill() {  # $1 = work dir, $2 = site; the pipeline never
   # touches the row log, so the ingest.* sites get the closed-loop
   # drill: append + seal + exactly-once window read under the fault,
@@ -179,6 +249,13 @@ for site in $SITES; do
       SHIFU_TPU_FAULT="$site:$KIND:1" \
         timeout -k 10 "$PER_SITE_TIMEOUT" \
         bash -c "$(declare -f run_ingest_drill); run_ingest_drill '$dest' '$site'" \
+        >>"$log" 2>&1
+      rc=$?
+      ;;
+    canary.*|shadow.*)
+      SHIFU_TPU_FAULT="$site:$KIND:1" \
+        timeout -k 10 "$PER_SITE_TIMEOUT" \
+        bash -c "$(declare -f run_canary_drill); run_canary_drill '$ms' '$site'" \
         >>"$log" 2>&1
       rc=$?
       ;;

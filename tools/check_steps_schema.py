@@ -66,6 +66,11 @@ builds its record from ``profiling.INGEST_FIELDS``, every member must
 be README-documented (the Streaming ingest section), and bench.py
 must reference the tuple.
 
+The live-promotion bench is pinned likewise: bench.py task_canary
+builds its record from ``profiling.CANARY_FIELDS``, every member must
+be README-documented (the Live promotion section), and bench.py must
+reference the tuple.
+
 The health plane is pinned likewise: every metrics.jsonl point is
 ``profiling.METRIC_FIELDS`` (built by obs/health/store.py), every SLO
 record is ``profiling.HEALTH_FIELDS`` (built by obs/health/slo.py),
@@ -110,7 +115,7 @@ def documented_fields() -> set:
         set(dag_summary_fields()) | set(trace_fields()) | \
         set(metric_fields()) | set(health_fields()) | \
         set(shard_fields()) | set(refresh_fields()) | \
-        set(ingest_fields())
+        set(ingest_fields()) | set(canary_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -213,6 +218,10 @@ def refresh_fields() -> tuple:
 
 def ingest_fields() -> tuple:
     return _profiling_tuple("INGEST_FIELDS")
+
+
+def canary_fields() -> tuple:
+    return _profiling_tuple("CANARY_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -482,6 +491,33 @@ def check_ingest_docs() -> int:
     return 0
 
 
+def check_canary_docs() -> int:
+    """Every CANARY_FIELDS member (bench.py task_canary's record
+    schema, the live-promotion bench) must be backtick-documented in
+    README's Live promotion section, and task_canary must build its
+    record from the tuple — the literal check asserts bench.py
+    references `CANARY_FIELDS` so the record cannot silently drift
+    from the pinned schema."""
+    fields = canary_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("canary schema drift: CANARY_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "CANARY_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the canary record from "
+              "profiling.CANARY_FIELDS", file=sys.stderr)
+        return 1
+    print(f"live promotion: all {len(fields)} CANARY_FIELDS "
+          "documented in README and pinned in bench.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -552,6 +588,8 @@ def main(argv) -> int:
     if check_refresh_docs():
         return 1
     if check_ingest_docs():
+        return 1
+    if check_canary_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])
