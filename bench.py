@@ -30,6 +30,7 @@ import sys
 import time
 
 from shifu_tpu.config.environment import knob_bool, knob_int
+from shifu_tpu.resilience import absorbed, atomic_write, make_lock
 
 REFERENCE_WORKER_ROW_EPOCHS_PER_SEC = 2.0e6  # see module docstring
 
@@ -772,7 +773,9 @@ def _ensure_stream_layout(rows, feats, chunk=1_000_000, seed=11):
         # half-written layout for the prefix-reuse path
         try:
             os.remove(done_p)
-        except OSError:
+        except FileNotFoundError:
+            # no sidecar to drop; any other failure must raise or a
+            # half-written layout could stay blessed
             pass
         rng = np.random.default_rng(seed)
         beta = rng.normal(0, 1, feats).astype(np.float32)
@@ -801,7 +804,7 @@ def _ensure_stream_layout(rows, feats, chunk=1_000_000, seed=11):
             wm[a:b] = 1.0
         for m in (dm, tm, wm):
             m.flush()
-        with open(done_p, "w") as f:
+        with atomic_write(done_p, "w") as f:
             json.dump({"rows": rows, "feats": feats, "seed": seed,
                        "chunk": chunk, "complete": True}, f)
     return (np.load(dense_p, mmap_mode="r"),
@@ -1131,8 +1134,8 @@ def _ensure_gbt_stream_layout():
              f"({rows * cols * 4 / 1e6:.0f} MB) to {GBT_STREAM_DIR}...")
         try:
             os.remove(done_p)   # crash mid-write must not bless files
-        except OSError:
-            pass
+        except FileNotFoundError:
+            pass  # absent is fine; other failures must raise
         rng = np.random.default_rng(seed)
         beta = rng.normal(0, 1, cols).astype(np.float32)
         bm = np.lib.format.open_memmap(bins_p, mode="w+",
@@ -1152,7 +1155,7 @@ def _ensure_gbt_stream_layout():
                 .astype(np.float32)
         bm.flush()
         tm.flush()
-        with open(done_p, "w") as f:
+        with atomic_write(done_p, "w") as f:
             json.dump(want, f)
     return (np.load(bins_p, mmap_mode="r"),
             np.load(tags_p, mmap_mode="r"))
@@ -1286,15 +1289,16 @@ def _ensure_pipeline_set():
         for d, sl in ((data_dir, slice(0, PIPE_ROWS)),
                       (eval_dir, slice(PIPE_ROWS, half)),
                       (eval_dir2, slice(half, n))):
-            with open(os.path.join(d, ".pig_header"), "w") as f:
+            with atomic_write(os.path.join(d, ".pig_header"),
+                              "w") as f:
                 f.write(header + "\n")
             df.iloc[sl].to_csv(os.path.join(d, "part-00000"), sep="|",
                                header=False, index=False)
-        with open(os.path.join(root, "columns", "meta.column.names"),
-                  "w") as f:
+        with atomic_write(os.path.join(root, "columns",
+                                       "meta.column.names"), "w") as f:
             f.write("rowid\n")
-        with open(os.path.join(root, "columns",
-                               "categorical.column.names"), "w") as f:
+        with atomic_write(os.path.join(root, "columns",
+                      "categorical.column.names"), "w") as f:
             f.write("".join(f"cat_{j}\n" for j in range(PIPE_CAT)))
         mc = {
             "basic": {"name": "BenchPipeline", "author": "bench",
@@ -1372,9 +1376,10 @@ def _ensure_pipeline_set():
                 for name, d in (("Eval1", eval_dir),
                                 ("Eval2", eval_dir2))],
         }
-        with open(os.path.join(root, "ModelConfig.json"), "w") as f:
+        with atomic_write(os.path.join(root, "ModelConfig.json"),
+                          "w") as f:
             json.dump(mc, f, indent=2)
-        with open(stamp, "w") as f:
+        with atomic_write(stamp, "w") as f:
             json.dump(want, f)
     # reset derived state so every run exercises the full pipeline
     _reset_pipeline_derived(root)
@@ -1940,7 +1945,7 @@ def task_fleet():
 
     ex = ThreadPoolExecutor(max_workers=64)
     counts = {"ok": 0, "shed": 0, "rejected": 0}
-    clock = threading.Lock()
+    clock = make_lock("bench.fleet-clock")
 
     def fire(name, size):
         try:
@@ -2088,7 +2093,7 @@ def task_refresh():
         with open(cfg_path) as f:
             cfg = json.load(f)
         cfg["train"]["numTrainEpochs"] = REFRESH_BENCH_EPOCHS
-        with open(cfg_path, "w") as f:
+        with atomic_write(cfg_path, "w") as f:
             json.dump(cfg, f, indent=2)
         for cmd in ("init", "stats", "norm", "train"):
             if cli_main(["--dir", ms, cmd]) != 0:
@@ -2332,7 +2337,7 @@ def task_canary():
         with open(cfg_path) as f:
             cfg = json.load(f)
         cfg["train"]["numTrainEpochs"] = REFRESH_BENCH_EPOCHS
-        with open(cfg_path, "w") as f:
+        with atomic_write(cfg_path, "w") as f:
             json.dump(cfg, f, indent=2)
         for cmd in ("init", "stats", "norm", "train"):
             if cli_main(["--dir", ms, cmd]) != 0:
@@ -2633,8 +2638,8 @@ def task_dist_stats():
         n_parts = hosts * 4   # several files per shard
         per = (len(lines) + n_parts - 1) // n_parts
         for i in range(n_parts):
-            with open(os.path.join(data_dir, f"part-{i:05d}"),
-                      "w") as f:
+            with atomic_write(os.path.join(data_dir, f"part-{i:05d}"),
+                              "w") as f:
                 f.writelines(lines[i * per:(i + 1) * per])
         if cli_main(["--dir", base, "init"]) != 0:
             raise RuntimeError("init failed")
@@ -2902,8 +2907,8 @@ def _honor_pinned_platform():
         import jax
         try:
             jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+        except Exception as exc:
+            absorbed("bench.jax-platform", exc)
 
 
 def main():

@@ -1,12 +1,41 @@
 """CLI: `python -m shifu_tpu.analysis [paths...] [--json] [--rule R]
-[--knobs-md]`. Exit code 1 when any finding is active, 0 when clean.
+[--changed[=<git-ref>]] [--timings] [--budget-s S] [--knobs-md]`.
+Exit code 1 when any finding is active (or the wall budget is blown),
+0 when clean.
+
+`--changed` reports per-file findings only for files touched vs the
+git ref (default HEAD, plus uncommitted changes); the whole-program
+pass and cross-file registry sweeps still scan everything, so
+call-graph reachability and dead-entry detection stay global.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+import time
+
+
+def changed_files(repo: str, ref: str) -> set:
+    """Absolute paths of .py files that differ from `ref` (committed
+    diff + working-tree changes + untracked files)."""
+    out = set()
+    cmds = [["git", "diff", "--name-only", ref],
+            ["git", "ls-files", "--others", "--exclude-standard"]]
+    for cmd in cmds:
+        r = subprocess.run(cmd, cwd=repo, capture_output=True,
+                           text=True, timeout=60)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"--changed: {' '.join(cmd)} failed: "
+                f"{r.stderr.strip() or r.stdout.strip()}")
+        for line in r.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(os.path.abspath(os.path.join(repo, line)))
+    return out
 
 
 def main(argv=None) -> int:
@@ -20,6 +49,17 @@ def main(argv=None) -> int:
                     help="machine-readable findings")
     ap.add_argument("--rule", action="append", default=None,
                     help="run only this rule (repeatable)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="GIT_REF",
+                    help="report findings only for files changed vs "
+                         "the ref (default HEAD); the whole-program "
+                         "pass still scans everything")
+    ap.add_argument("--timings", action="store_true",
+                    help="print per-rule wall time after the findings")
+    ap.add_argument("--budget-s", type=float, default=None,
+                    metavar="S",
+                    help="fail (exit 1) if total lint wall time "
+                         "exceeds S seconds — the lint.sh gate")
     ap.add_argument("--list-rules", action="store_true",
                     help="print rule names and exit")
     ap.add_argument("--knobs-md", action="store_true",
@@ -36,13 +76,39 @@ def main(argv=None) -> int:
         return 0
 
     from shifu_tpu.analysis import engine
-    paths = args.paths or [os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))]
-    report = engine.run(paths, rules=args.rule)
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [pkg_dir]
+
+    only = None
+    if args.changed is not None:
+        repo = os.path.dirname(pkg_dir)
+        try:
+            only = changed_files(repo, args.changed)
+        except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 2
+        if not only:
+            print(f"0 finding(s): no .py files changed vs "
+                  f"{args.changed}")
+            return 0
+
+    t0 = time.perf_counter()
+    report = engine.run(paths, rules=args.rule, only=only)
+    wall_s = time.perf_counter() - t0
     out = engine.render_json(report) if args.json \
         else engine.render_human(report)
     print(out)
-    return 1 if report.findings else 0
+    if args.timings and not args.json:
+        print("per-rule wall time:")
+        print(engine.render_timings(report))
+        print(f"  wall (incl. imports): {wall_s * 1e3:9.1f} ms")
+    rc = 1 if report.findings else 0
+    if args.budget_s is not None and wall_s > args.budget_s:
+        print(f"lint: WALL BUDGET EXCEEDED — {wall_s:.2f}s > "
+              f"{args.budget_s:.2f}s budget; profile with --timings "
+              "and fix the slow rule", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,5 +1,12 @@
 """AST lint engine: file walking, suppressions, rule dispatch, output.
 
+The engine runs in TWO passes. Pass 1 parses every file once and
+builds the whole-program model (`analysis/program.py`: project-wide
+symbol table, call graph, thread entry points, lock scopes) into
+``ctx["program"]``. Pass 2 dispatches rules over the cached trees.
+Per-file rules keep the original contract unchanged; whole-program
+rules opt in by reading ``ctx["program"]``.
+
 A rule module (see `rules/`) exposes:
 
     RULES: tuple of rule-name strings it can emit
@@ -17,6 +24,12 @@ Suppressions are same-line trailing comments:
 `disable=all` silences every rule on that line. Cross-file findings
 from `finalize` hooks point at registries, not code lines, and cannot
 be suppressed inline — fix the registry instead.
+
+Incremental mode: `run(paths, only=...)` still scans and models every
+file (whole-program semantics and the cross-file registries need the
+full view) but reports per-file findings only for paths in `only` —
+the `--changed[=<git-ref>]` CLI mode. Per-rule wall time is recorded
+in `Report.timings` for `--timings` and the lint.sh wall budget.
 """
 
 from __future__ import annotations
@@ -26,8 +39,10 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
-from typing import Dict, Iterable, List, NamedTuple, Set, Tuple
+from typing import (Dict, Iterable, List, NamedTuple, Optional, Set,
+                    Tuple)
 
 
 class Finding(NamedTuple):
@@ -47,6 +62,7 @@ class Report(NamedTuple):
     suppressed: List[Finding]      # findings silenced by inline comments
     suppression_lines: int         # lint-disable comments in scanned code
     files: int
+    timings: Dict[str, float] = {}  # stage/rule-module -> wall seconds
 
 
 # rule list ends at the first whitespace so a trailing free-form
@@ -91,7 +107,7 @@ def collect_suppressions(source: str) -> Dict[int, Set[str]]:
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
             out.setdefault(tok.start[0], set()).update(rules)
     except tokenize.TokenError:
-        pass
+        return out          # partial map from a truncated token stream
     return out
 
 
@@ -101,39 +117,71 @@ def _rule_modules():
     return RULE_MODULES
 
 
-def run(paths: Iterable[str], rules: Iterable[str] = None) -> Report:
+def run(paths: Iterable[str], rules: Iterable[str] = None,
+        only: Optional[Iterable[str]] = None) -> Report:
     """Lint every .py under `paths`. `rules` optionally restricts to a
-    subset of rule names (finalize hooks still run for selected rules)."""
+    subset of rule names (finalize hooks still run for selected
+    rules). `only` restricts REPORTED per-file findings to those paths
+    (absolute-path compared) while the scan itself stays global."""
     modules = _rule_modules()
     selected = set(rules) if rules is not None else None
+    only_set: Optional[Set[str]] = None
+    if only is not None:
+        only_set = {os.path.abspath(p) for p in only}
     ctx: dict = {"paths": list(paths)}
     active: List[Finding] = []
     suppressed: List[Finding] = []
     suppression_lines = 0
+    timings: Dict[str, float] = {}
     files = iter_py_files(paths)
 
+    def _reported(path: str) -> bool:
+        return only_set is None or os.path.abspath(path) in only_set
+
+    # -- pass 1: parse once, build the whole-program model ------------
+    t0 = time.perf_counter()
+    parsed: List[Tuple[str, ast.Module, str]] = []
     for path in files:
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
             tree = ast.parse(source, filename=path)
         except (OSError, SyntaxError) as e:
-            active.append(Finding("parse-error", path, 1, 0, str(e)))
+            if _reported(path):
+                active.append(Finding("parse-error", path, 1, 0,
+                                      str(e)))
             continue
+        parsed.append((path, tree, source))
+    timings["parse"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    from shifu_tpu.analysis import program as program_mod
+    ctx["program"] = program_mod.build(
+        (p, t) for p, t, _ in parsed)
+    timings["whole-program"] = time.perf_counter() - t0
+
+    # -- pass 2: rule dispatch over the cached trees ------------------
+    def _key(mod) -> str:
+        return mod.RULES[0]
+
+    for path, tree, source in parsed:
         sup = collect_suppressions(source)
         suppression_lines += len(sup)
         found: List[Finding] = []
         for mod in modules:
             if selected is not None and not (set(mod.RULES) & selected):
                 continue
+            t0 = time.perf_counter()
             found.extend(mod.check(tree, path, ctx))
+            timings[_key(mod)] = timings.get(_key(mod), 0.0) + \
+                time.perf_counter() - t0
         for f in found:
             if selected is not None and f.rule not in selected:
                 continue
             disabled = sup.get(f.line, set())
             if f.rule in disabled or "all" in disabled:
                 suppressed.append(f)
-            else:
+            elif _reported(f.path):
                 active.append(f)
 
     for mod in modules:
@@ -141,12 +189,16 @@ def run(paths: Iterable[str], rules: Iterable[str] = None) -> Report:
             continue
         fin = getattr(mod, "finalize", None)
         if fin is not None:
+            t0 = time.perf_counter()
             for f in fin(ctx):
                 if selected is None or f.rule in selected:
                     active.append(f)
+            timings[_key(mod)] = timings.get(_key(mod), 0.0) + \
+                time.perf_counter() - t0
 
     active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return Report(active, suppressed, suppression_lines, len(files))
+    return Report(active, suppressed, suppression_lines, len(files),
+                  timings)
 
 
 def render_human(report: Report) -> str:
@@ -163,7 +215,21 @@ def render_json(report: Report) -> str:
         "suppressed": [f._asdict() for f in report.suppressed],
         "files": report.files,
         "suppressionLines": report.suppression_lines,
+        "timings": {k: round(v, 6)
+                    for k, v in sorted(report.timings.items())},
     }, indent=2, sort_keys=True)
+
+
+def render_timings(report: Report) -> str:
+    """Per-rule wall-time table (``--timings``), slowest first, plus
+    the total the lint.sh budget gates on."""
+    rows = sorted(report.timings.items(), key=lambda kv: -kv[1])
+    width = max((len(k) for k, _ in rows), default=4)
+    lines = [f"  {k:<{width}}  {v * 1e3:9.1f} ms" for k, v in rows]
+    total = sum(report.timings.values())
+    lines.append(f"  {'TOTAL':<{width}}  {total * 1e3:9.1f} ms "
+                 f"({report.files} files)")
+    return "\n".join(lines)
 
 
 # --- shared AST helpers used by several rule modules -----------------------

@@ -151,11 +151,17 @@ def _find_path(src: str, dst: str) -> Optional[List[str]]:
 
 
 class CheckedLock:
-    """`threading.Lock` wrapper that participates in order checking."""
+    """`threading.Lock`/`RLock` wrapper that participates in order
+    checking. With `reentrant=True` the underlying lock is an RLock
+    and same-thread re-acquire is legal (and records no edges — a
+    lock never orders against itself); held-time is still accounted
+    per acquisition site, nested acquires included."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, reentrant: bool = False):
         self.name = name
-        self._lock = threading.Lock()
+        self.reentrant = reentrant
+        self._lock = threading.RLock() if reentrant \
+            else threading.Lock()
         global _atexit_registered
         if not _atexit_registered:
             _atexit_registered = True
@@ -163,7 +169,8 @@ class CheckedLock:
 
     def _before_acquire(self) -> None:
         held = _held()
-        if any(i == id(self) for _, i, _t, _s in held):
+        if not self.reentrant and \
+                any(i == id(self) for _, i, _t, _s in held):
             raise LockOrderError(
                 f"thread {threading.current_thread().name!r} "
                 f"re-acquired non-reentrant lock '{self.name}' it "
@@ -218,9 +225,13 @@ class CheckedLock:
         self.release()
 
 
-def make_lock(name: str, force: Optional[bool] = None):
-    """A lock for the runtime modules: plain `threading.Lock` unless
-    SHIFU_TPU_LOCKCHECK=1 (or `force=True`), then a `CheckedLock`
-    registered in the global order graph under `name`."""
+def make_lock(name: str, force: Optional[bool] = None,
+              reentrant: bool = False):
+    """A lock for the runtime modules: plain `threading.Lock` (or
+    `RLock` with `reentrant=True`) unless SHIFU_TPU_LOCKCHECK=1 (or
+    `force=True`), then a `CheckedLock` registered in the global
+    order graph under `name`."""
     use = enabled() if force is None else force
-    return CheckedLock(name) if use else threading.Lock()
+    if use:
+        return CheckedLock(name, reentrant=reentrant)
+    return threading.RLock() if reentrant else threading.Lock()

@@ -1,0 +1,431 @@
+"""Whole-program model for the lint engine (pass 1 of 2).
+
+`build(files)` parses every file once and assembles a `Program`:
+
+  * a project-wide symbol table — every function/method definition
+    keyed by qualified name (``pkg.module.Class.method``), with the
+    per-module import map needed to resolve calls across files;
+  * a call graph — edges from each function to the definitions its
+    call sites resolve to (module-local names, ``from x import y``
+    names, ``mod.func`` attribute chains through import aliases, and
+    ``self.method`` within a class), each edge annotated with whether
+    the call site sits inside a ``with <lock>:`` scope;
+  * thread entry points — ``threading.Thread(target=f)``,
+    ``executor.submit(f, ...)`` and daemon-worker starts, resolved to
+    their target definitions;
+  * lock-acquisition scopes — writes and calls lexically inside
+    ``with <something named *lock*>:`` / ``with make_lock(...):`` are
+    tagged so rules can attribute mutations to a holding lock;
+  * shared-state writes — ``self.attr = ...`` and ``global``-declared
+    name assignments per function (local variable writes are not
+    shared state and are never recorded).
+
+Rules opt in by reading ``ctx["program"]`` (the engine stores the
+`Program` there before pass 2); per-file rules that never look at it
+keep their existing `check`/`finalize` contract unchanged.
+
+Resolution is deliberately name-based and best-effort: a call the
+resolver cannot place simply has no edge (under-approximate
+reachability, never a crash). That bias keeps thread-shared-mutation
+findings high-precision — every reported write really is on a path
+from a thread entry point the resolver could prove.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, NamedTuple, Optional, Set, Tuple
+
+from shifu_tpu.analysis.engine import dotted
+
+# with-items guarding on one of these are lock scopes (same shape the
+# blocking-under-lock rule matches, plus the make_lock seam itself)
+# `with self._cond:` (a Condition) acquires the condition's lock, so
+# cond-named with-contexts are mutual exclusion too
+_LOCK_RE = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+
+# executor-shaped receivers whose .submit(fn, ...) runs fn on a worker
+_SUBMIT_METHODS = {"submit", "apply_async", "start_new_thread"}
+
+
+class Write(NamedTuple):
+    """One shared-state mutation inside a function body."""
+    target: str          # "self.attr" or "global name"
+    lineno: int
+    col: int
+    locked: bool         # lexically inside a `with <lock>:` scope
+
+
+class Call(NamedTuple):
+    """One call site inside a function body (pre-resolution)."""
+    name: str            # dotted callee as written ("self.f", "mod.g")
+    lineno: int
+    locked: bool
+
+
+class FunctionInfo(NamedTuple):
+    qname: str           # "shifu_tpu.serve.fleet.FleetService.submit"
+    module: str          # "shifu_tpu.serve.fleet"
+    cls: str             # enclosing class name or ""
+    name: str            # leaf name
+    path: str
+    lineno: int
+    is_property: bool    # @property / @x.setter — accessor seam
+    writes: Tuple[Write, ...]
+    calls: Tuple[Call, ...]
+
+
+class ThreadEntry(NamedTuple):
+    """A function handed to a thread: Thread(target=...)/submit(...)."""
+    qname: str           # resolved target definition
+    via: str             # "Thread" | "submit" | ...
+    path: str
+    lineno: int
+
+
+def module_name(path: str) -> str:
+    """Dotted module name for `path`, rooted at the innermost package
+    directory chain (every ancestor with an __init__.py)."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    if parts[0] == "__init__":
+        parts = parts[1:] or parts
+    return ".".join(reversed(parts))
+
+
+def _decorator_names(node) -> Set[str]:
+    out: Set[str] = set()
+    for dec in node.decorator_list:
+        d = dec
+        if isinstance(d, ast.Call):
+            d = d.func
+        name = dotted(d)
+        if name:
+            out.add(name)
+            out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    node = expr
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1] if d else ""
+        if leaf == "make_lock":
+            return True
+        node = node.func
+    d = dotted(node)
+    leaf = d.rsplit(".", 1)[-1] if d else ""
+    return bool(leaf and _LOCK_RE.search(leaf))
+
+
+class _FnScanner(ast.NodeVisitor):
+    """Collects writes/calls (with lock context) from ONE function
+    body without descending into nested function/class definitions."""
+
+    def __init__(self):
+        self.writes: List[Write] = []
+        self.calls: List[Call] = []
+        self.globals: Set[str] = set()
+        self._lock_depth = 0
+
+    def _locked(self) -> bool:
+        return self._lock_depth > 0
+
+    # nested defs run on their own schedule — their bodies are scanned
+    # as their own FunctionInfo entries (visit_* intentionally no-ops)
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Global(self, node: ast.Global):
+        self.globals.update(node.names)
+
+    def visit_With(self, node: ast.With):
+        lockish = any(_is_lock_ctx(i.context_expr) for i in node.items)
+        for i in node.items:
+            self.visit(i.context_expr)
+        if lockish:
+            self._lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if lockish:
+            self._lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def _note_target(self, tgt: ast.AST, lineno: int, col: int):
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            self.writes.append(Write(f"self.{tgt.attr}", lineno, col,
+                                     self._locked()))
+        elif isinstance(tgt, ast.Name) and tgt.id in self.globals:
+            self.writes.append(Write(f"global {tgt.id}", lineno, col,
+                                     self._locked()))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._note_target(el, lineno, col)
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._note_target(tgt, node.lineno, node.col_offset)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._note_target(node.target, node.lineno, node.col_offset)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._note_target(node.target, node.lineno, node.col_offset)
+            self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted(node.func)
+        if name:
+            self.calls.append(Call(name, node.lineno, self._locked()))
+        self.generic_visit(node)
+
+
+class Program:
+    """The assembled whole-program model (see module docstring)."""
+
+    def __init__(self):
+        # qname -> FunctionInfo
+        self.functions: Dict[str, FunctionInfo] = {}
+        # module -> {local name: qname or module it aliases}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        # module -> {top-level def/class names}
+        self.module_defs: Dict[str, Set[str]] = {}
+        # (module, cls) -> {method names}
+        self.class_methods: Dict[Tuple[str, str], Set[str]] = {}
+        # path -> module
+        self.path_module: Dict[str, str] = {}
+        self.entries: List[ThreadEntry] = []
+        # resolved call graph: qname -> [(callee qname, locked)]
+        self._edges: Optional[Dict[str, List[Tuple[str, bool]]]] = None
+
+    # -- construction --------------------------------------------------
+    def add_module(self, path: str, tree: ast.Module) -> None:
+        mod = module_name(path)
+        self.path_module[path] = mod
+        imports = self.imports.setdefault(mod, {})
+        defs = self.module_defs.setdefault(mod, set())
+
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.add(node.name)
+                self._add_function(mod, "", node, path)
+            elif isinstance(node, ast.ClassDef):
+                defs.add(node.name)
+                methods = self.class_methods.setdefault(
+                    (mod, node.name), set())
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        methods.add(sub.name)
+                        self._add_function(mod, node.name, sub, path)
+        # thread entries can appear anywhere (module body, methods)
+        self._scan_entries(mod, tree, path)
+
+    def _add_function(self, mod: str, cls: str, node, path: str) -> None:
+        sc = _FnScanner()
+        for stmt in node.body:
+            sc.visit(stmt)
+        decs = _decorator_names(node)
+        qname = ".".join(p for p in (mod, cls, node.name) if p)
+        self.functions[qname] = FunctionInfo(
+            qname=qname, module=mod, cls=cls, name=node.name,
+            path=path, lineno=node.lineno,
+            is_property=bool(decs & {"property", "setter",
+                                     "cached_property"}),
+            writes=tuple(sc.writes), calls=tuple(sc.calls))
+        # nested defs (closures handed to threads) register too
+        for sub in ast.walk(node):
+            if sub is not node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                subcls = cls + "." + node.name if cls else node.name
+                if ".".join(p for p in (mod, subcls, sub.name)
+                            if p) not in self.functions:
+                    self._add_function(mod, subcls, sub, path)
+
+    def _scan_entries(self, mod: str, tree: ast.Module,
+                      path: str) -> None:
+        # enclosing (cls, fn) context for resolving self.X targets
+        def scan(node, cls: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child, child.name)
+                    continue
+                if isinstance(child, ast.Call):
+                    self._note_entry(mod, cls, child, path)
+                scan(child, cls)
+        scan(tree, "")
+
+    def _note_entry(self, mod: str, cls: str, call: ast.Call,
+                    path: str) -> None:
+        d = dotted(call.func)
+        leaf = d.rsplit(".", 1)[-1] if d else ""
+        target: Optional[ast.AST] = None
+        via = ""
+        if leaf == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target, via = kw.value, "Thread"
+        elif leaf in _SUBMIT_METHODS and call.args:
+            target, via = call.args[0], leaf
+        if target is None:
+            return
+        tname = dotted(target)
+        if not tname:
+            return
+        qname = self.resolve(mod, cls, tname)
+        if qname is not None:
+            self.entries.append(ThreadEntry(qname, via, path,
+                                            call.lineno))
+
+    # -- resolution ----------------------------------------------------
+    def resolve(self, mod: str, cls: str, name: str) -> Optional[str]:
+        """Resolve a dotted call-site name written inside (`mod`,
+        `cls`) to a known definition's qname, or None."""
+        if name.startswith("self.") and cls:
+            leaf = name[5:]
+            if "." in leaf:
+                return None
+            base_cls = cls.split(".")[0]
+            if leaf in self.class_methods.get((mod, base_cls), ()):
+                return f"{mod}.{base_cls}.{leaf}"
+            return None
+        head, _, rest = name.partition(".")
+        imports = self.imports.get(mod, {})
+        if not rest:
+            if name in self.module_defs.get(mod, ()):
+                return self._def_qname(mod, name)
+            full = imports.get(name)
+            if full:
+                m, _, f = full.rpartition(".")
+                return self._def_qname(m, f, follow=True)
+            return None
+        # mod.func / alias.func / pkg.mod.func chains
+        target_mod = imports.get(head, head)
+        for cand in (f"{target_mod}.{rest}", name):
+            m, _, f = cand.rpartition(".")
+            got = self._def_qname(m, f, follow=True)
+            if got is not None:
+                return got
+            # Class.method via an imported/aliased class
+            m2, _, c2 = m.rpartition(".")
+            if f in self.class_methods.get((m2, c2), ()):
+                return cand
+        return None
+
+    def _def_qname(self, mod: str, name: str,
+                   follow: bool = False) -> Optional[str]:
+        """qname of definition `name` in `mod`. A class resolves to
+        its __init__ (a call constructs one). With `follow`, chase one
+        re-export hop through `mod`'s import map (package __init__
+        re-exports)."""
+        if name in self.module_defs.get(mod, ()):
+            methods = self.class_methods.get((mod, name))
+            if methods is not None:       # it's a class: call = ctor
+                return f"{mod}.{name}.__init__" \
+                    if "__init__" in methods else None
+            return f"{mod}.{name}"
+        if follow:
+            full = self.imports.get(mod, {}).get(name)
+            if full:
+                m, _, f = full.rpartition(".")
+                return self._def_qname(m, f, follow=False)
+        return None
+
+    def edges(self) -> Dict[str, List[Tuple[str, bool]]]:
+        """Resolved call graph, built lazily once all modules are in."""
+        if self._edges is None:
+            out: Dict[str, List[Tuple[str, bool]]] = {}
+            for fn in self.functions.values():
+                lst = out.setdefault(fn.qname, [])
+                for call in fn.calls:
+                    callee = self.resolve(fn.module, fn.cls, call.name)
+                    if callee is not None and callee != fn.qname:
+                        lst.append((callee, call.locked))
+            self._edges = out
+        return self._edges
+
+    def reachable_from_threads(self) -> Dict[str, bool]:
+        """{qname: ever_reached_without_lock} over every function
+        reachable from a thread entry point. A function only ever
+        entered through locked call sites maps to False — its writes
+        are attributed to the caller's lock."""
+        edges = self.edges()
+        # state: False = only-locked paths so far, True = some
+        # unlocked path reaches it
+        state: Dict[str, bool] = {}
+        work: List[Tuple[str, bool]] = [
+            (e.qname, True) for e in self.entries]
+        while work:
+            qname, unlocked = work.pop()
+            prev = state.get(qname)
+            if prev is not None and (prev or prev == unlocked):
+                continue
+            state[qname] = unlocked if prev is None else (
+                prev or unlocked)
+            for callee, locked in edges.get(qname, ()):
+                work.append((callee, unlocked and not locked))
+        return state
+
+    def thread_witness(self, qname: str) -> str:
+        """A human-readable entry-point witness for an unlocked-path
+        reachability claim (best-effort: the first entry that reaches
+        `qname`)."""
+        edges = self.edges()
+        for e in self.entries:
+            seen: Set[str] = set()
+            stack = [(e.qname, [e.qname])]
+            while stack:
+                cur, trail = stack.pop()
+                if cur == qname:
+                    via = " -> ".join(t.rsplit(".", 2)[-1]
+                                      if t.count(".") < 2 else
+                                      ".".join(t.rsplit(".", 2)[-2:])
+                                      for t in trail)
+                    return (f"{e.via}@{os.path.basename(e.path)}:"
+                            f"{e.lineno} via {via}")
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                for callee, _locked in edges.get(cur, ()):
+                    stack.append((callee, trail + [callee]))
+        return "a thread entry point"
+
+
+def build(parsed: Iterable[Tuple[str, ast.Module]]) -> Program:
+    """Assemble the Program from (path, parsed tree) pairs — the
+    engine's pass 1."""
+    prog = Program()
+    for path, tree in parsed:
+        prog.add_module(path, tree)
+    return prog

@@ -5,11 +5,14 @@ ctx)` and optionally `finalize(ctx)`. Add new modules to
 `RULE_MODULES` to register them.
 """
 
-from shifu_tpu.analysis.rules import (collectives, dagsteps, deviceput,
-                                      faults, hotloop, javaprops, knobs,
-                                      locks, spans)
+from shifu_tpu.analysis.rules import (atomicwrite, collectives,
+                                      dagsteps, deviceput, faults,
+                                      hotloop, javaprops, knobs, locks,
+                                      rawlock, spans, swallowed,
+                                      threadshare)
 
 RULE_MODULES = (hotloop, knobs, faults, locks, deviceput, javaprops,
-                dagsteps, spans, collectives)
+                dagsteps, spans, collectives, rawlock, threadshare,
+                atomicwrite, swallowed)
 
 ALL_RULES = tuple(r for m in RULE_MODULES for r in m.RULES)

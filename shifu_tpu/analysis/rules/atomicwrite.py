@@ -1,0 +1,175 @@
+"""non-atomic-write: a file commit outside the sanctioned atomic-write
+seams. Every artifact this system publishes — checkpoints, manifests,
+registry versions, metrics, ingest segments — goes through the
+write-tmp-then-rename discipline (`resilience.atomic_write` /
+`atomic_path`, the `data/fs` remote twin, or the registry's two-rename
+publish); until now that discipline was convention enforced only by
+review. A direct `open(path, "w")`, `os.replace`/`os.rename`, or
+`json.dump` onto a live path means a kill mid-write leaves a torn
+file under the real name — the exact corruption the chaos drills
+exist to rule out.
+
+Flagged (outside the sanctioned modules):
+
+  * ``open(path, "w"/"x"/"+"-ish)`` — truncating/creating modes —
+    unless `path` is the staged temp yielded by an enclosing
+    ``with atomic_path(...) as tmp:`` block; pure append ("a"/"ab")
+    is exempt (append-only logs tear a tail line at worst);
+  * ``os.replace(...)`` / ``os.rename(...)`` — hand-rolled commits
+    belong in the seams so their fault points and kill drills cover
+    them;
+  * ``json.dump(obj, f)`` where `f` is not the handle yielded by an
+    enclosing ``with atomic_write(...) as f:`` (dumping into an
+    atomic handle is the idiom; dumping into a raw handle is covered
+    by flagging the `open`, so this only fires on e.g.
+    ``json.dump(x, open(p, "w"))`` one-liners).
+
+Sanctioned modules (the seams themselves): `resilience.py`,
+`data/fs.py`, and `registry/registry.py` (the two-rename
+publish/rollback/gc discipline, SIGKILL-drilled in tests/test_fleet).
+Reads (`open(path)` / mode "r"/"rb") never match.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("non-atomic-write",)
+
+_SANCTIONED_SUFFIXES = (
+    "shifu_tpu/resilience.py",
+    "shifu_tpu/data/fs.py",
+    "shifu_tpu/registry/registry.py",
+)
+_ATOMIC_CTXS = {"atomic_write", "atomic_path", "atomic_write_remote",
+                "AtomicFile"}
+_RENAMES = {"os.replace", "os.rename", "replace", "rename"}
+
+
+def _exempt(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(p.endswith(s) for s in _SANCTIONED_SUFFIXES)
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string of an open() call when it writes."""
+    mode = None
+    if len(call.args) >= 2:
+        a = call.args[1]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            mode = a.value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            mode = kw.value.value
+    if mode is None:
+        return None                      # default "r": a read
+    # pure append ("a"/"ab") is exempt: append-only event logs are
+    # their own discipline (worst case a torn tail line, never a torn
+    # file — the JSONL readers skip bad lines); "a+" read-modify-write
+    # is not append-only and stays flagged
+    return mode if any(c in mode for c in "wx+") else None
+
+
+class _Scope:
+    """Names bound by enclosing atomic with-blocks."""
+
+    def __init__(self):
+        self.atomic_names: List[Set[str]] = []
+
+    def all_names(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in self.atomic_names:
+            out |= s
+        return out
+
+
+def _atomic_item_names(node) -> Set[str]:
+    """with-targets of atomic_write/atomic_path items in this With."""
+    names: Set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        fn = expr.func if isinstance(expr, ast.Call) else expr
+        d = dotted(fn)
+        leaf = d.rsplit(".", 1)[-1] if d else ""
+        if leaf in _ATOMIC_CTXS and item.optional_vars is not None \
+                and isinstance(item.optional_vars, ast.Name):
+            names.add(item.optional_vars.id)
+    return names
+
+
+def _derives_from(node: ast.AST, names: Set[str]) -> bool:
+    """True when `node` mentions one of the atomic with-target names
+    (the staged temp path/handle, or a path joined from it)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+def _note_call(call: ast.Call, atomic: Set[str], path: str,
+               findings: List[Finding]) -> None:
+    d = dotted(call.func)
+    if not d:
+        return
+    if d in ("open", "io.open"):
+        mode = _write_mode(call)
+        if mode is not None and call.args and not \
+                _derives_from(call.args[0], atomic):
+            findings.append(Finding(
+                "non-atomic-write", path, call.lineno,
+                call.col_offset,
+                f"`open(..., {mode!r})` writes the live path "
+                "directly — a kill mid-write leaves a torn file; "
+                "stage through `resilience.atomic_write(path)` (or "
+                "open the temp from an enclosing `atomic_path`)"))
+    elif d in ("os.replace", "os.rename"):
+        if not (call.args and _derives_from(call.args[0], atomic)):
+            findings.append(Finding(
+                "non-atomic-write", path, call.lineno,
+                call.col_offset,
+                f"`{d}(...)` is a hand-rolled commit outside the "
+                "sanctioned atomic-write seams — route it through "
+                "`resilience.atomic_write`/`atomic_path` (or data/fs "
+                "for remote) so fault injection and kill drills "
+                "cover the rename"))
+    elif d == "json.dump" or d.endswith(".json.dump"):
+        # dumping into a freshly-opened raw handle is the only shape
+        # the open() check doesn't already own
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Call) \
+                and not _derives_from(call.args[1], atomic):
+            findings.append(Finding(
+                "non-atomic-write", path, call.lineno,
+                call.col_offset,
+                "`json.dump(..., open(...))` commits a live path "
+                "non-atomically; use `with resilience."
+                "atomic_write(path) as f: json.dump(obj, f)`"))
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    if _exempt(path):
+        return []
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, atomic: Set[str]):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                visit(item.context_expr, atomic)
+            inner = atomic | _atomic_item_names(node)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            _note_call(node, atomic, path, findings)
+        # nested defs keep the enclosing atomic names: a closure
+        # writing to the staged handle still commits atomically
+        for child in ast.iter_child_nodes(node):
+            visit(child, atomic)
+
+    for stmt in tree.body:
+        visit(stmt, set())
+    return findings

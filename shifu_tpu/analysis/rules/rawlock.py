@@ -1,0 +1,69 @@
+"""raw-lock: `threading.Lock()` / `threading.RLock()` constructed
+anywhere outside `analysis/lockcheck.py` bypasses the CheckedLock
+seam — the runtime lock-order race detector (SHIFU_TPU_LOCKCHECK=1)
+cannot see that lock, so an inversion against it never raises, its
+held-time histogram is never recorded, and the lock graph the chaos
+drills certify is silently incomplete. Construct every lock through
+`resilience.make_lock("module.purpose")` (reentrant=True for the rare
+RLock case) so the whole fleet's locking shows up in one DAG.
+
+`threading.Event`/`Condition`/`Semaphore` are not locks in the
+ordering sense and stay unfenced.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("raw-lock",)
+
+_LOCK_CTORS = {"Lock", "RLock"}
+# the CheckedLock implementation itself must construct raw locks
+_SANCTIONED_SUFFIXES = ("shifu_tpu/analysis/lockcheck.py",)
+
+
+def _exempt(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(p.endswith(s) for s in _SANCTIONED_SUFFIXES)
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    if _exempt(path):
+        return []
+    # only flag when the module actually means threading's Lock:
+    # `import threading` / `from threading import Lock|RLock`
+    imports_threading = False
+    from_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "threading" for a in node.names):
+                imports_threading = True
+        elif isinstance(node, ast.ImportFrom) and \
+                node.module == "threading":
+            from_names.update(a.asname or a.name for a in node.names)
+
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d:
+            continue
+        hit = (imports_threading and d in
+               {f"threading.{c}" for c in _LOCK_CTORS}) or \
+              (d in _LOCK_CTORS and d in from_names)
+        if hit:
+            leaf = d.rsplit(".", 1)[-1]
+            extra = ", reentrant=True" if leaf == "RLock" else ""
+            findings.append(Finding(
+                "raw-lock", path, node.lineno, node.col_offset,
+                f"`{d}()` bypasses the CheckedLock seam — "
+                "SHIFU_TPU_LOCKCHECK=1 cannot order-check or "
+                "histogram this lock; use "
+                f"`resilience.make_lock(\"module.purpose\"{extra})` "
+                "instead"))
+    return findings

@@ -1,0 +1,108 @@
+"""swallowed-exception: an ``except`` handler that absorbs the error
+and leaves NO evidence — no re-raise, no log line, no counter bump,
+no fault-site routing. The obs/serve planes have a deliberate
+"absorbed" contract (evidence-keeping must never fail a scored
+request, a metrics flush must never take down the batcher), but that
+contract requires the absorb to be *visible*: a bare ``except
+Exception: pass`` turns real faults into silent data loss that only a
+chaos drill finds a week later.
+
+A handler is fine when its body contains at least one of:
+
+  * a ``raise`` (re-raise or translate);
+  * a ``return``/``continue``/``break`` that routes a sentinel the
+    caller checks (explicit control flow is an answer, not silence);
+  * a logging call — any ``*.debug/info/warning/error/exception/
+    critical/log(...)`` or ``warnings.warn(...)`` / ``print(...)``;
+  * a counter bump (``x += 1`` / ``self.n_err += 1`` — monitoring
+    sees it), an assignment (recording a fallback), or any other
+    call (a fallback action is an answer; only the *silent* handler
+    — ``pass``/docstring/constant — is the bug class);
+  * a sanctioned absorb helper: ``resilience.absorbed(site, exc)``
+    (bumps the per-site absorb counter monitoring snapshots),
+    ``fault_point(...)`` (routes a registered fault site),
+    ``note_event(...)``, ``note_rejected``.
+
+Control-flow exception types are exempt — ``StopIteration``,
+``GeneratorExit``, ``queue.Empty``/``Full``, ``TimeoutError``,
+``FileNotFoundError``, ``KeyError``/``AttributeError``/
+``ImportError``/``ModuleNotFoundError`` probes (absence is an
+answer), and ``KeyboardInterrupt`` at a CLI boundary. Only handlers
+over ``Exception`` / ``BaseException`` / bare ``except`` / concrete
+error types are charged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("swallowed-exception",)
+
+# exception types where catching-and-dropping IS the protocol
+_EXEMPT_TYPES = {
+    "StopIteration", "StopAsyncIteration", "GeneratorExit",
+    "KeyboardInterrupt", "SystemExit",
+    "Empty", "Full", "queue.Empty", "queue.Full",
+    "TimeoutError", "asyncio.TimeoutError", "socket.timeout",
+    "FileNotFoundError", "NotADirectoryError",
+    "KeyError", "AttributeError", "IndexError",
+    "ImportError", "ModuleNotFoundError",
+    "UnicodeDecodeError", "UnicodeEncodeError", "UnicodeError",
+}
+
+# the recommended evidence routes, in preference order; any call in
+# the handler qualifies structurally, these are what fixes should use
+ABSORB_HELPERS = ("absorbed", "fault_point", "note_event",
+                  "note_rejected")
+
+
+def _handler_exempt(handler: ast.ExceptHandler) -> bool:
+    """True when every caught type is a control-flow exemption."""
+    t = handler.type
+    if t is None:
+        return False                       # bare except: charged
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        d = dotted(node)
+        leaf = d.rsplit(".", 1)[-1] if d else ""
+        if d not in _EXEMPT_TYPES and leaf not in _EXEMPT_TYPES:
+            return False
+    return True
+
+
+def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Continue,
+                             ast.Break, ast.Assert)):
+            return True
+        if isinstance(node, (ast.AugAssign, ast.Assign,
+                             ast.AnnAssign)):
+            return True                    # counter bump / fallback
+        if isinstance(node, ast.Call):
+            return True                    # fallback action / log /
+    return False                           # absorb helper
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if _handler_exempt(handler):
+                continue
+            if _leaves_evidence(handler):
+                continue
+            caught = dotted(handler.type) if handler.type is not None \
+                else "<bare>"
+            findings.append(Finding(
+                "swallowed-exception", path, handler.lineno,
+                handler.col_offset,
+                f"`except {caught}` absorbs the error with no "
+                "evidence — re-raise, log it, bump a counter, or "
+                "route it through `fault_point(...)`/`note_event` so "
+                "the absorb shows up in monitoring"))
+    return findings

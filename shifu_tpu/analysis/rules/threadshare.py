@@ -1,0 +1,72 @@
+"""thread-shared-mutation: an attribute or global written inside any
+function reachable from a thread entry point without an enclosing
+`with <make_lock(...)>:` scope — the exact bug class the ad-hoc
+Python threads (async checkpoint writers, batcher consumers, metrics
+flushers, ingest seals, shadow-scoring workers) can regress into.
+
+This is a WHOLE-PROGRAM rule: it consults ``ctx["program"]`` (the
+engine's pass-1 call graph), so a worker function in module A mutating
+shared state is caught even when the `Thread(target=...)` that makes
+it concurrent lives in module B — per-file AST matching provably
+cannot see that.
+
+Semantics (precision-biased — see `analysis/program.py`):
+
+  * writes = ``self.attr = ...`` / ``self.attr += ...`` and
+    ``global``-declared name assignments; local variables are never
+    shared state;
+  * a function is charged only when the call graph reaches it from a
+    ``Thread(target=...)`` / ``.submit(...)`` entry through at least
+    one path with no lock held; calls made inside a
+    ``with <lock>:`` scope propagate "locked" to the callee, so a
+    helper that is only ever invoked under the lock is covered;
+  * ``__init__`` (object not yet published to other threads),
+    ``@property``/``@x.setter`` accessors, and writes lexically
+    inside a lock scope are exempt.
+
+Findings on a single unsynchronized counter bump that monitoring may
+legitimately read racily should be fixed anyway (GIL-sized windows
+still tear read-modify-write pairs) or suppressed with a reason
+naming the single-writer argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from shifu_tpu.analysis.engine import Finding
+
+RULES = ("thread-shared-mutation",)
+
+_EXEMPT_FN = {"__init__", "__new__", "__init_subclass__"}
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    prog = ctx.get("program")
+    if prog is None:
+        return []
+    reach = ctx.get("_threadshare_reach")
+    if reach is None:
+        reach = ctx["_threadshare_reach"] = prog.reachable_from_threads()
+    findings: List[Finding] = []
+    for fn in prog.functions.values():
+        if fn.path != path:
+            continue
+        if fn.name in _EXEMPT_FN or fn.is_property:
+            continue
+        unlocked_reach = reach.get(fn.qname)
+        if not unlocked_reach:      # unreachable, or only under lock
+            continue
+        for w in fn.writes:
+            if w.locked:
+                continue
+            witness = prog.thread_witness(fn.qname)
+            findings.append(Finding(
+                "thread-shared-mutation", path, w.lineno, w.col,
+                f"`{w.target}` is written in `{fn.qname}` which is "
+                f"reachable from a thread entry point ({witness}) "
+                "with no lock held — wrap the write in a `with "
+                "<make_lock(...)>:` scope or confine the state to "
+                "one thread"))
+    return findings

@@ -560,8 +560,9 @@ def _top_render(root: str) -> str:
                     recs.append(json.loads(line))
                 except ValueError:
                     continue
-    except OSError:
-        pass
+    except OSError as e:
+        from shifu_tpu.resilience import absorbed
+        absorbed("cli.steps-read", e)
     recs = recs[-10:]
     if not recs:
         lines.append(f"no step records yet ({steps_path})")
@@ -611,8 +612,9 @@ def _top_render(root: str) -> str:
                 detail = " ".join(f"{k}={v}"
                                   for k, v in sorted(tags.items()))
                 lines.append(f"  {ts}  {ev.get('name', '?'):<16} {detail}")
-    except Exception:  # noqa: BLE001 — monitoring must not fail top
-        pass
+    except Exception as e:  # noqa: BLE001 — monitoring must not fail top
+        from shifu_tpu.resilience import absorbed
+        absorbed("cli.status-events", e)
     return "\n".join(lines)
 
 
@@ -940,8 +942,9 @@ def _honor_jax_platforms() -> None:
     try:
         import jax
         jax.config.update("jax_platforms", want)
-    except Exception:
-        pass
+    except Exception as e:
+        from shifu_tpu.resilience import absorbed
+        absorbed("cli.jax-platform", e)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

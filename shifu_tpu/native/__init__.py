@@ -15,14 +15,15 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
+
+from shifu_tpu.analysis.lockcheck import make_lock
 
 log = logging.getLogger("shifu_tpu")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fast_reader.c")
 _SO = os.path.join(_HERE, "_fast_reader.so")
-_lock = threading.Lock()
+_lock = make_lock("native.init")
 _lib = None
 _tried = False
 

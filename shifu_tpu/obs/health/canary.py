@@ -66,6 +66,7 @@ from typing import Any, Dict, Optional
 
 from shifu_tpu.config.environment import knob_float, knob_int
 from shifu_tpu.obs import trace as obs_trace
+from shifu_tpu.resilience import absorbed
 from shifu_tpu.obs.health import store as health_store
 
 log = logging.getLogger(__name__)
@@ -144,8 +145,8 @@ class CanaryController:
             st.event("canary", model=self.model_name, phase=phase,
                      **tags)
             st.flush()
-        except Exception:  # noqa: BLE001 — observability is absorbed
-            pass
+        except Exception as e:  # noqa: BLE001 — observability is absorbed
+            absorbed("canary.event-flush", e)
 
     # -- state file (the SIGKILL recovery record) -----------------------
 
@@ -158,8 +159,8 @@ class CanaryController:
     def _clear_state(self) -> None:
         try:
             os.remove(state_path(self.registry_root, self.model_name))
-        except OSError:
-            pass
+        except OSError as e:
+            absorbed("canary.state-clear", e)
 
     # -- the run ---------------------------------------------------------
 
@@ -381,8 +382,8 @@ class CanaryController:
                                 "reason": verdict["reason"],
                                 "run": run_name, "baseline": prev_head,
                                 "live_window": window}})
-            except OSError:
-                pass   # audit annotation is best-effort
+            except OSError as e:
+                absorbed("canary.audit", e)   # annotation is best-effort
             # 3. fleet: a re-swap proves serving == HEAD (noop when
             #    the primary never moved — which it didn't)
             swap = "none"
@@ -445,18 +446,18 @@ class CanaryController:
                                       "(recovered on rerun)",
                             "run": state.get("run"),
                             "baseline": prev}})
-        except (OSError, FileNotFoundError):
-            pass
+        except OSError as e:
+            absorbed("canary.audit-recover", e)
         try:
             os.remove(state_path(registry_root, model_name))
-        except OSError:
-            pass
+        except OSError as e:
+            absorbed("canary.state-clear", e)
         if fleet is not None:
             try:
                 fleet.stop_arms(model_name)
                 fleet.swap_in_place(model_name)
-            except Exception:  # noqa: BLE001 — fleet may be fresh
-                pass
+            except Exception as e:  # noqa: BLE001 — fleet may be fresh
+                absorbed("canary.fleet-reswap", e)
         if store_root:
             try:
                 st = health_store.store(store_root)
@@ -464,6 +465,6 @@ class CanaryController:
                          run=state.get("run"), version=version,
                          to=prev or "?")
                 st.flush()
-            except Exception:  # noqa: BLE001 — absorbed
-                pass
+            except Exception as e:  # noqa: BLE001 — absorbed
+                absorbed("canary.event-flush", e)
         return "rolled_back"

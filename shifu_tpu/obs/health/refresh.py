@@ -447,13 +447,14 @@ class RefreshController:
         log's raw lines UNMODIFIED — `sha256(part-00000)` equals the
         hash of `RowLog.read_range` over the recorded range, so the
         promoted model's training data audits byte-for-byte."""
+        from shifu_tpu.resilience import atomic_write
         wdir = os.path.join(clone, "window")
         os.makedirs(wdir, exist_ok=True)
         header_path = os.path.join(wdir, ".pig_header")
-        with open(header_path, "w", encoding="utf-8") as f:
+        with atomic_write(header_path, "w", encoding="utf-8") as f:
             f.write(delim.join(str(c) for c in header) + "\n")
-        with open(os.path.join(wdir, "part-00000"), "w",
-                  encoding="utf-8") as f:
+        with atomic_write(os.path.join(wdir, "part-00000"), "w",
+                          encoding="utf-8") as f:
             for line in lines:
                 f.write(line + "\n")
         return wdir, header_path
@@ -462,14 +463,15 @@ class RefreshController:
     def _write_window(clone: str, window, delim: str):
         """The drift window as a private raw table (pipe-delimited text
         with a .pig_header, the same layout the parent reads)."""
+        from shifu_tpu.resilience import atomic_write
         wdir = os.path.join(clone, "window")
         os.makedirs(wdir, exist_ok=True)
         header_path = os.path.join(wdir, ".pig_header")
-        with open(header_path, "w", encoding="utf-8") as f:
+        with atomic_write(header_path, "w", encoding="utf-8") as f:
             f.write(delim.join(str(c) for c in window.columns) + "\n")
         vals = window.astype(object).where(window.notna(), "")
-        with open(os.path.join(wdir, "part-00000"), "w",
-                  encoding="utf-8") as f:
+        with atomic_write(os.path.join(wdir, "part-00000"), "w",
+                          encoding="utf-8") as f:
             for row in vals.itertuples(index=False):
                 f.write(delim.join(str(v) for v in row) + "\n")
         return wdir, header_path

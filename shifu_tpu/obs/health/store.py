@@ -238,8 +238,9 @@ def _read_lines(path: str) -> List[dict]:
                     out.append(json.loads(line))
                 except ValueError:
                     continue
-    except OSError:
-        pass
+    except OSError as e:
+        from shifu_tpu.resilience import absorbed
+        absorbed("health.events-read", e)
     return out
 
 
@@ -280,8 +281,9 @@ def flush_step_record(root: str, rec: Dict) -> None:
         from shifu_tpu.obs import trace as obs_trace
         if obs_trace.active():
             tags["run_id"] = obs_trace.current_run_id()
-    except Exception:  # noqa: BLE001 — trace linkage is best-effort
-        pass
+    except Exception as e:  # noqa: BLE001 — trace linkage is best-effort
+        from shifu_tpu.resilience import absorbed
+        absorbed("health.trace-link", e)
     st.emit("step.wall_s", rec.get("wallSeconds", 0.0),
             rc=rec.get("rc"), **tags)
     wall = float(rec.get("wallSeconds") or 0.0)

@@ -58,7 +58,7 @@ import numpy as np
 from shifu_tpu.analysis.lockcheck import make_lock
 from shifu_tpu.config.environment import knob_float, knob_int, knob_str
 from shifu_tpu.obs import trace as obs_trace
-from shifu_tpu.resilience import fault_point
+from shifu_tpu.resilience import absorbed, fault_point
 
 log = logging.getLogger("shifu_tpu")
 
@@ -293,8 +293,8 @@ def _multi_process() -> bool:
         from jax._src import xla_bridge
         if getattr(xla_bridge, "_backends", None):
             return jax.process_count() > 1
-    except Exception:
-        pass
+    except Exception as e:
+        absorbed("dist.backend-probe", e)
     try:
         from jax._src import distributed
         return distributed.global_state.client is not None
@@ -349,8 +349,8 @@ def single_writer(tag: str):
     try:
         from shifu_tpu.train import checkpoint as _ckpt
         _ckpt.flush_saves(reraise=False)
-    except Exception:  # pragma: no cover — optional import cycle
-        pass
+    except Exception as e:  # pragma: no cover — optional import cycle
+        absorbed("dist.ckpt-flush", e)
     try:
         yield is_writer()
     except BaseException as e:

@@ -90,7 +90,7 @@ def _run_cli(root: str, cmd: Sequence[str], node: str,
     if env_extra:
         env.update(env_extra)
     argv = [sys.executable, "-m", "shifu_tpu", "--dir", root, *cmd]
-    with open(log_path, "w") as lf:
+    with open(log_path, "w") as lf:  # lint: disable=non-atomic-write -- live-tailed node log; must exist mid-run
         rc = subprocess.call(argv, stdout=lf, stderr=subprocess.STDOUT,
                              env=env)
     if rc != 0:

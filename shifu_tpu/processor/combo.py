@@ -32,6 +32,7 @@ import numpy as np
 
 from shifu_tpu.config.model_config import Algorithm, ModelConfig
 from shifu_tpu.processor.base import ProcessorContext
+from shifu_tpu.resilience import atomic_write
 
 log = logging.getLogger("shifu_tpu")
 
@@ -77,7 +78,7 @@ def new(ctx: ProcessorContext, algorithms: str) -> int:
         "assemble": {"name": f"{name}_assemble_{algs[-1].value}",
                      "algorithm": algs[-1].value},
     }
-    with open(_combo_path(ctx), "w") as f:
+    with atomic_write(_combo_path(ctx), "w") as f:
         json.dump(spec, f, indent=2)
     log.info("combo: %d sub-models + %s assemble → %s",
              len(spec["subModels"]), algs[-1].value, _combo_path(ctx))
@@ -121,7 +122,8 @@ def init(ctx: ProcessorContext) -> int:
         sub_mc = json.loads(json.dumps(mc_dict))  # deep copy
         sub_mc["basic"]["name"] = sub["name"]
         sub_mc["train"]["algorithm"] = sub["algorithm"]
-        with open(os.path.join(sub_dir, "ModelConfig.json"), "w") as f:
+        with atomic_write(os.path.join(sub_dir, "ModelConfig.json"),
+                          "w") as f:
             json.dump(sub_mc, f, indent=2)
         log.info("combo init: %s (%s)", sub_dir, sub["algorithm"])
     return 0
@@ -159,7 +161,7 @@ def _train_sub_node(root: str, sub_dir: str, name: str) -> None:
     env = dict(os.environ)
     env["SHIFU_TPU_COMPILE_CACHE_DIR"] = \
         os.path.join(root, "tmp", "jax_cache")
-    with open(log_path, "w") as lf:
+    with open(log_path, "w") as lf:  # lint: disable=non-atomic-write -- live-tailed subprocess log; must exist mid-run
         rc = subprocess.call(
             [sys.executable, "-m", "shifu_tpu.processor.combo", sub_dir],
             stdout=lf, stderr=subprocess.STDOUT, env=env)
@@ -419,7 +421,8 @@ def evaluate(ctx: ProcessorContext,
         out_dir = os.path.join(ctx.path_finder.root, "evals",
                                f"{ec.name}_combo")
         os.makedirs(out_dir, exist_ok=True)
-        with open(os.path.join(out_dir, "EvalPerformance.json"), "w") as f:
+        with atomic_write(os.path.join(out_dir, "EvalPerformance.json"),
+                          "w") as f:
             json.dump(perf, f, indent=1)
         log.info("combo eval[%s]: %d rows, AUC=%.4f", ec.name, len(final),
                  perf["areaUnderRoc"])

@@ -103,7 +103,8 @@ def run(ctx: ProcessorContext, df=None,
     from shifu_tpu.parallel import dist
     with dist.single_writer("datestat") as w:
         if w:   # identical stats on every host; one pen
-            with open(out, "w") as f:
+            from shifu_tpu.resilience import atomic_write
+            with atomic_write(out, "w") as f:
                 f.write("date,column," + ",".join(metrics) + "\n")
                 for d in range(len(uniq)):
                     for j, name in enumerate(dataset.num_names):

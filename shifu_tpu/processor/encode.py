@@ -65,9 +65,10 @@ def run(ctx: ProcessorContext, out_dir: Optional[str] = None) -> int:
     os.makedirs(out_dir, exist_ok=True)
     n_trees = leaves.shape[1]
     header = ["tag", "weight"] + [f"tree_{i}" for i in range(n_trees)]
-    with open(os.path.join(out_dir, ".pig_header"), "w") as f:
+    from shifu_tpu.resilience import atomic_write
+    with atomic_write(os.path.join(out_dir, ".pig_header"), "w") as f:
         f.write("|".join(header) + "\n")
-    with open(os.path.join(out_dir, "part-00000"), "w") as f:
+    with atomic_write(os.path.join(out_dir, "part-00000"), "w") as f:
         for i in range(leaves.shape[0]):
             f.write(f"{int(dset.tags[i])}|{dset.weights[i]:.6g}|"
                     + "|".join(str(int(v)) for v in leaves[i]) + "\n")

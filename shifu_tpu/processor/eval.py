@@ -561,8 +561,8 @@ def _run_one_streaming(ctx: ProcessorContext, ec: EvalConfig,
     # under the real name (not even a truncated file to clean up)
     score_f = AtomicFile(_opath(ctx.path_finder.eval_score_path(ec.name)))
     score_w = _ScoreCsvWriter(score_f)
-    dump_f = open(dump_path, "wb")
-    champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}
+    dump_f = open(dump_path, "wb")  # lint: disable=non-atomic-write -- dot-prefixed scratch sidecar, removed in the not-done cleanup
+    champ_fs = {c: open(p, "wb") for c, p in champ_dumps.items()}  # lint: disable=non-atomic-write -- dot-prefixed scratch sidecars, removed in the not-done cleanup
     try:
         # per-chunk matrix build on pipeline workers; scoring (JAX)
         # stays on this thread — the eval twin of the streaming

@@ -284,8 +284,9 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
         from shifu_tpu import resilience
         resilience.retry_stats(reset=True)
         resilience.drain_events()
-    except Exception:  # noqa: BLE001 — metrics must never fail a run
-        pass
+    except Exception as e:  # noqa: BLE001 — metrics must never fail a run
+        from shifu_tpu.resilience import absorbed
+        absorbed("metrics.pre-drain", e)
     t0 = time.time()
     try:
         yield rec
@@ -316,8 +317,9 @@ def step_metrics(root: str, step: str, extra: Optional[Dict] = None):
                     rec["restarts"] = max(restarts)
             if resilience.preempt_requested():
                 rec["preempted"] = True
-        except Exception:  # noqa: BLE001 — metrics must never fail a run
-            pass
+        except Exception as e:  # noqa: BLE001 — metrics must never fail a run
+            from shifu_tpu.resilience import absorbed
+            absorbed("metrics.enrich", e)
         try:
             mdir = os.path.join(root, "tmp", "metrics")
             os.makedirs(mdir, exist_ok=True)

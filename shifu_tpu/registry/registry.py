@@ -34,7 +34,8 @@ import numpy as np
 
 from shifu_tpu.config.environment import knob_int
 from shifu_tpu.models import spec as spec_mod
-from shifu_tpu.resilience import atomic_write, fault_point
+from shifu_tpu.resilience import (absorbed, atomic_write,
+                                  fault_point)
 
 log = logging.getLogger(__name__)
 
@@ -140,8 +141,8 @@ def _scrub_stale_tmp(model_dir: str) -> None:
             try:
                 shutil.rmtree(path) if os.path.isdir(path) \
                     else os.remove(path)
-            except OSError:
-                pass
+            except OSError as e:
+                absorbed("registry.gc-tmp", e)
 
 
 def _model_shape_meta(kind: str, meta: Dict[str, Any]
@@ -331,7 +332,7 @@ def ls(root: str) -> List[Dict[str, Any]]:
                 "ladder": manifest.get("ladder"),
                 "created": manifest.get("created"),
             })
-        except (OSError, ValueError):
-            pass
+        except (OSError, ValueError) as e:
+            absorbed("registry.ls-manifest", e)
         rows.append(row)
     return rows

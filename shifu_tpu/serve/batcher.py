@@ -97,8 +97,10 @@ class MicroBatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._carry: Optional[Request] = None
-        # consumer-thread-only counters; stats() reads them racily,
-        # which is fine for monitoring
+        # consumer-thread-written counters; the lock keeps stats()
+        # snapshots coherent (occupancy_mean vs batches) and shows the
+        # batcher in the LOCKCHECK=1 lock graph
+        self._stats_lock = resilience.make_lock("batcher.stats")
         self.batches = 0
         self.requests = 0
         self.rows = 0
@@ -146,7 +148,7 @@ class MicroBatcher:
     def _run(self) -> None:
         while not self._stop.is_set():
             opener = self._carry
-            self._carry = None
+            self._carry = None  # lint: disable=thread-shared-mutation -- consumer-thread-confined; close() touches it only after join()
             if opener is None:
                 try:
                     # short poll so close() is never waited on for long
@@ -173,7 +175,7 @@ class MicroBatcher:
             except queue.Empty:
                 break
             if rows + nxt.n > self.max_rows:
-                self._carry = nxt
+                self._carry = nxt  # lint: disable=thread-shared-mutation -- consumer-thread-confined carry-over
                 break
             batch.append(nxt)
             rows += nxt.n
@@ -182,10 +184,11 @@ class MicroBatcher:
             r.t_batched = t
             r.timing["queue_s"] = t - r.t_submit
             pipeline.add_stage_time("serve_queue_s", t - r.t_submit)
-        self.batches += 1
-        self.requests += len(batch)
-        self.rows += rows
-        self._occupancy_sum += rows / self.max_rows
+        with self._stats_lock:
+            self.batches += 1
+            self.requests += len(batch)
+            self.rows += rows
+            self._occupancy_sum += rows / self.max_rows
         pipeline.add_stage_count("serve_batches")
         # batch-formation span: opener admission → batch sealed
         obs_trace.record_span("serve.flush", opener.t_submit, t,
@@ -194,12 +197,13 @@ class MicroBatcher:
         return batch
 
     def stats(self) -> Dict[str, Any]:
-        b = max(self.batches, 1)
-        return {
-            "batches": self.batches,
-            "requests": self.requests,
-            "rows": self.rows,
-            "occupancy_mean": self._occupancy_sum / b,
-            "rows_per_batch": self.rows / b,
-            "queued_now": self._q.qsize(),
-        }
+        with self._stats_lock:
+            b = max(self.batches, 1)
+            return {
+                "batches": self.batches,
+                "requests": self.requests,
+                "rows": self.rows,
+                "occupancy_mean": self._occupancy_sum / b,
+                "rows_per_batch": self.rows / b,
+                "queued_now": self._q.qsize(),
+            }

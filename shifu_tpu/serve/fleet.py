@@ -76,7 +76,8 @@ from shifu_tpu import profiling, registry
 from shifu_tpu.config import environment as env
 from shifu_tpu.data import pipeline
 from shifu_tpu.obs import trace as obs_trace
-from shifu_tpu.resilience import fault_point
+from shifu_tpu.resilience import (absorbed, fault_point,
+                                  make_lock)
 from shifu_tpu.serve.service import ScorerService
 
 PRIORITIES = ("high", "low")
@@ -170,7 +171,7 @@ class _ArmState:
         self.canary_fallbacks = 0
         self.queue: "queue.Queue" = queue.Queue(maxsize=max(queue_depth, 1))
         self.worker: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.arm")
 
     def note(self, arm: str, total_s: float, out) -> None:
         """Fold one scored request into the arm's evidence: latency
@@ -191,8 +192,8 @@ class _ArmState:
                                         bins=ARM_SCORE_BINS,
                                         range=(0.0, 1.0))
                     self.hist[side] += h
-        except Exception:  # noqa: BLE001 — evidence-keeping must
-            pass           # never fail a scored request
+        except Exception as e:  # noqa: BLE001 — evidence-keeping
+            absorbed("fleet.arm-evidence", e)  # can't fail a request
 
     def p99_ms(self, arm: str) -> Optional[float]:
         with self._lock:
@@ -264,10 +265,11 @@ class FleetService:
             version, vdir, manifest = registry.resolve(
                 registry_root, name)
             self._entries[name] = _Entry(name, version, vdir, manifest)
-        self._lock = threading.RLock()
+        # reentrant: swap_in_place holds it across _ensure_resident
+        self._lock = make_lock("fleet.registry", reentrant=True)
         self._lat = {p: collections.deque(maxlen=max(window, 8))
                      for p in PRIORITIES}
-        self._lat_lock = threading.Lock()
+        self._lat_lock = make_lock("fleet.lat")
         self._shedding = False
         self._shed = {p: 0 for p in PRIORITIES}
         self._admitted = {p: 0 for p in PRIORITIES}
@@ -746,8 +748,8 @@ class FleetService:
                 st.emit("canary.fallbacks", a["canary_fallbacks"],
                         kind="counter", model=name)
             st.flush()
-        except Exception:  # noqa: BLE001 — absorbed by design
-            pass
+        except Exception as e:  # noqa: BLE001 — absorbed by design
+            absorbed("fleet.metrics-emit", e)
 
     def health_state(self) -> Optional[Dict[str, Any]]:
         if self._workspace_root is None:
@@ -788,8 +790,8 @@ class SloAutotuner:
                         and isinstance(p.get("value"), (int, float))]
                 if vals:
                     return float(np.median(vals[-20:]))
-            except Exception:  # noqa: BLE001 — fall back to live stats
-                pass
+            except Exception as e:  # noqa: BLE001 — fall back to live
+                absorbed("fleet.p99-probe", e)
         if entry.service is not None:
             lat = entry.service.stats().get("latency", {})
             if "p99_ms" in lat:
@@ -865,5 +867,5 @@ class SloAutotuner:
             st.emit("serve.autotune_delay_ms",
                     rec["max_delay_ms_after"], model=rec["model"])
             st.flush()
-        except Exception:  # noqa: BLE001 — absorbed by design
-            pass
+        except Exception as e:  # noqa: BLE001 — absorbed by design
+            absorbed("fleet.autotune-event", e)

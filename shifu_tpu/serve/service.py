@@ -39,6 +39,7 @@ import numpy as np
 
 from shifu_tpu.config import environment as env
 from shifu_tpu.data import pipeline
+from shifu_tpu.resilience import make_lock
 from shifu_tpu.eval.scorer import Scorer
 from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.serve import aot
@@ -101,7 +102,7 @@ class ScorerService:
         self._warmed_buckets = 0
         # consumer-thread-appended; stats() reads racily (monitoring)
         self._latencies: collections.deque = collections.deque(maxlen=8192)
-        self._schema_lock = threading.Lock()
+        self._schema_lock = make_lock("service.schema")
         # 429s by the rejected request's priority class (the fleet's
         # admission shed bumps "low" here too via note_rejected)
         self.rejected_by_class: Dict[str, int] = {"high": 0, "low": 0}

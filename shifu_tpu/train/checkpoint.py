@@ -183,7 +183,7 @@ def _publish_impl(ckpt_dir: str, step: int, snap: Any,
         fault_point("ckpt.publish")
         if os.path.exists(path):
             shutil.rmtree(path)
-        os.replace(tmp, path)
+        os.replace(tmp, path)  # lint: disable=non-atomic-write -- ckpt.publish IS the drilled tmp+rename commit seam
     else:
         from shifu_tpu.models.spec import save_model
         fault_point("ckpt.publish")
@@ -368,7 +368,7 @@ def _step_names(ckpt_dir: str) -> List[Tuple[int, str]]:
         try:
             out.append((int(name.split("_")[1].split(".")[0]), name))
         except ValueError:
-            pass
+            continue        # non-step entry name: not ours to list
     return out
 
 

@@ -9,6 +9,8 @@ import os
 
 import numpy as np
 
+from shifu_tpu.resilience import atomic_write
+
 
 def make_raw_frame(rng, n_rows: int = 2000, n_num: int = 6, n_cat: int = 2,
                    missing_rate: float = 0.02, n_classes: int = 2):
@@ -96,19 +98,21 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
         write_parquet_part(os.path.join(eval_dir, "part-00000.parquet"),
                            header, rows[split:], row_group_size=256)
     else:
-        with open(os.path.join(data_dir, ".pig_header"), "w") as f:
+        with atomic_write(os.path.join(data_dir, ".pig_header"), "w") as f:
             f.write("|".join(header) + "\n")
-        with open(os.path.join(data_dir, "part-00000"), "w") as f:
+        with atomic_write(os.path.join(data_dir, "part-00000"), "w") as f:
             for r in rows[:split]:
                 f.write("|".join(r) + "\n")
-        with open(os.path.join(eval_dir, ".pig_header"), "w") as f:
+        with atomic_write(os.path.join(eval_dir, ".pig_header"), "w") as f:
             f.write("|".join(header) + "\n")
-        with open(os.path.join(eval_dir, "part-00000"), "w") as f:
+        with atomic_write(os.path.join(eval_dir, "part-00000"), "w") as f:
             for r in rows[split:]:
                 f.write("|".join(r) + "\n")
-    with open(os.path.join(root, "columns", "meta.column.names"), "w") as f:
+    with atomic_write(os.path.join(root, "columns", "meta.column.names"),
+                      "w") as f:
         f.write("rowid\n")
-    with open(os.path.join(root, "columns", "categorical.column.names"), "w") as f:
+    with atomic_write(os.path.join(root, "columns",
+                                   "categorical.column.names"), "w") as f:
         f.write("cat_0\ncat_1\n")
 
     mc = {
@@ -167,10 +171,10 @@ def make_model_set(tmp_path, rng, n_rows: int = 2000, norm_type: str = "ZSCALE",
     }
     if seg_expressions:
         seg_file = os.path.join(root, "columns", "segments.txt")
-        with open(seg_file, "w") as f:
+        with atomic_write(seg_file, "w") as f:
             f.write("\n".join(seg_expressions) + "\n")
         mc["dataSet"]["segExpressionFile"] = seg_file
 
-    with open(os.path.join(root, "ModelConfig.json"), "w") as f:
+    with atomic_write(os.path.join(root, "ModelConfig.json"), "w") as f:
         json.dump(mc, f, indent=2)
     return root

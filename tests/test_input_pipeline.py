@@ -194,6 +194,9 @@ def test_map_prefetch_error_at_position():
 
 def test_map_prefetch_workers_zero_sequential(monkeypatch):
     monkeypatch.setenv("SHIFU_TPU_PREFETCH_WORKERS", "0")
+    # earlier tests' daemon workers may still be draining on a loaded
+    # machine — this test asserts WE spawn none, so settle first
+    _wait_no_pipeline_threads()
     seen_threads = []
     out = []
     for x in pipe.map_prefetch(lambda i: i + 100, range(5)):

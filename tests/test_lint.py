@@ -2,17 +2,19 @@
 
 Two layers:
 
-1. ``test_package_is_clean`` — the acceptance check from ISSUE 4: the
-   analyzer over the whole package (plus bench.py/tools, the
-   out-of-package knob readers) reports ZERO findings, with at most 5
-   justified inline suppressions. Any hot-path host sync, jit-in-loop,
-   undeclared knob, stale fault site or blocking-under-lock anyone
-   introduces from now on fails tier-1 here.
+1. ``test_package_is_clean`` — the acceptance check from ISSUE 4
+   (extended by ISSUE 19): the analyzer over the whole package (plus
+   bench.py/tools, the out-of-package knob readers) reports ZERO
+   findings across all fifteen rules — including the whole-program
+   concurrency/atomicity four — within a documented inline-suppression
+   budget where every entry carries a ``-- reason``.
 2. Per-rule fixtures — positive (a known violation is flagged),
    negative (the clean twin is not), suppressed (the violation with an
    inline ``# lint: disable=`` is silenced but counted) — plus unit
-   tests for the runtime lock-order detector, including the deliberate
-   A->B / B->A inversion that MUST raise.
+   tests for the runtime lock-order detector (including the deliberate
+   A->B / B->A inversion that MUST raise), the whole-program
+   call-graph model, and a cross-module thread-mutation fixture a
+   per-file engine provably cannot catch.
 """
 
 import ast
@@ -57,7 +59,18 @@ def test_package_is_clean():
     msgs = "\n".join(f.format() for f in report.findings)
     assert not report.findings, f"lint findings:\n{msgs}"
     assert report.files > 60, "walker found suspiciously few files"
-    assert len(report.suppressed) <= 5, (
+    # Suppression budget (every entry carries a `-- reason` inline):
+    #   5 non-atomic-write        2 live-tailed subprocess/node logs,
+    #                             the drilled ckpt tmp+rename publish
+    #                             seam, 2 dot-prefixed eval scratch
+    #                             sidecars
+    #   3 thread-shared-mutation  resilience._rules_cache idempotent
+    #                             memo (deliberately lock-free), 2
+    #                             consumer-thread-confined batcher
+    #                             carry-overs
+    #   2 jit-in-loop             aot warm/compile loops (cached jits)
+    #   2 host-sync-in-hot-loop   bench/profiler intentional syncs
+    assert len(report.suppressed) <= 12, (
         "suppression budget exceeded — justify or fix: "
         + "\n".join(f.format() for f in report.suppressed))
 
@@ -978,3 +991,517 @@ def test_javaprop_registry_entries_all_referenced():
                         rules=["java-property-key"])
     dead = [f for f in report.findings if "dead JAVA_PROPS" in f.message]
     assert not dead, "\n".join(f.format() for f in dead)
+
+
+# ---------------------------------------------------------------------------
+# raw-lock
+# ---------------------------------------------------------------------------
+
+def test_raw_lock_positive(tmp_path):
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _rlock = threading.RLock()
+    """
+    report = lint_source(tmp_path, src, rules=["raw-lock"])
+    assert rule_names(report).count("raw-lock") == 2
+    # the RLock variant must point at make_lock's reentrant spelling
+    assert any("reentrant=True" in f.message for f in report.findings)
+
+
+def test_raw_lock_from_import_positive(tmp_path):
+    src = """
+        from threading import Lock
+
+        _lock = Lock()
+    """
+    report = lint_source(tmp_path, src, rules=["raw-lock"])
+    assert rule_names(report) == ["raw-lock"]
+
+
+def test_raw_lock_negative(tmp_path):
+    src = """
+        import threading
+
+        from shifu_tpu.resilience import make_lock
+
+        _lock = make_lock("fixture.lock")
+        _rlock = make_lock("fixture.rlock", reentrant=True)
+        _stop = threading.Event()        # not a lock
+        _cond = threading.Condition()    # not in ordering scope
+
+
+        class Lock:                      # local class, not threading's
+            pass
+
+
+        _fake = Lock()
+    """
+    report = lint_source(tmp_path, src, rules=["raw-lock"])
+    assert "raw-lock" not in rule_names(report)
+
+
+def test_raw_lock_lockcheck_module_exempt(tmp_path):
+    (tmp_path / "shifu_tpu" / "analysis").mkdir(parents=True)
+    src = """
+        import threading
+
+        _graph_lock = threading.Lock()
+    """
+    report = lint_source(tmp_path, src,
+                         name="shifu_tpu/analysis/lockcheck.py",
+                         rules=["raw-lock"])
+    assert not report.findings
+
+
+def test_raw_lock_suppressed(tmp_path):
+    src = """
+        import threading
+
+        _lock = threading.Lock()  # lint: disable=raw-lock -- fixture
+    """
+    report = lint_source(tmp_path, src, rules=["raw-lock"])
+    assert not report.findings
+    assert any(f.rule == "raw-lock" for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# thread-shared-mutation
+# ---------------------------------------------------------------------------
+
+THREAD_SHARE_POSITIVE = """
+    import threading
+
+    from shifu_tpu.resilience import make_lock
+
+
+    class Worker:
+        def __init__(self):
+            self.count = 0           # __init__ writes are exempt
+            self.lock = make_lock("fixture.worker")
+
+        def start(self):
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            self.count += 1
+"""
+
+
+def test_thread_share_positive_with_witness(tmp_path):
+    report = lint_source(tmp_path, THREAD_SHARE_POSITIVE,
+                         rules=["thread-shared-mutation"])
+    assert rule_names(report) == ["thread-shared-mutation"]
+    f = report.findings[0]
+    assert "self.count" in f.message
+    # the message carries the entry-point witness, not just a claim
+    assert "Thread@fixture.py" in f.message and "via" in f.message
+
+
+def test_thread_share_negative_locked_write(tmp_path):
+    src = THREAD_SHARE_POSITIVE.replace(
+        "            self.count += 1",
+        "            with self.lock:\n"
+        "                self.count += 1")
+    report = lint_source(tmp_path, src,
+                         rules=["thread-shared-mutation"])
+    assert "thread-shared-mutation" not in rule_names(report)
+
+
+def test_thread_share_negative_unreached_writer(tmp_path):
+    src = """
+        class Plain:
+            def bump(self):
+                self.n = 1    # no thread entry reaches this
+    """
+    report = lint_source(tmp_path, src,
+                         rules=["thread-shared-mutation"])
+    assert not report.findings
+
+
+def test_thread_share_suppressed(tmp_path):
+    src = THREAD_SHARE_POSITIVE.replace(
+        "self.count += 1",
+        "self.count += 1  # lint: disable=thread-shared-mutation -- fixture")
+    report = lint_source(tmp_path, src,
+                         rules=["thread-shared-mutation"])
+    assert not report.findings
+    assert any(f.rule == "thread-shared-mutation"
+               for f in report.suppressed)
+
+
+CROSS_WORKER = """
+    counter = 0
+
+
+    def run_loop():
+        global counter
+        counter += 1
+"""
+
+CROSS_STARTER = """
+    import threading
+
+    from xworker import run_loop
+
+
+    def go():
+        t = threading.Thread(target=run_loop, daemon=True)
+        t.start()
+        return t
+"""
+
+
+def test_thread_share_cross_module_needs_whole_program(tmp_path):
+    """The ISSUE-19 acceptance fixture: the thread start lives in one
+    module, the unlocked shared write in another. Each file alone is
+    provably clean under per-file analysis (no entry / no write); only
+    the call-graph pass connects them."""
+    w = tmp_path / "xworker.py"
+    w.write_text(textwrap.dedent(CROSS_WORKER), encoding="utf-8")
+    s = tmp_path / "xstarter.py"
+    s.write_text(textwrap.dedent(CROSS_STARTER), encoding="utf-8")
+    assert not engine.run([str(w)],
+                          rules=["thread-shared-mutation"]).findings
+    assert not engine.run([str(s)],
+                          rules=["thread-shared-mutation"]).findings
+    report = engine.run([str(w), str(s)],
+                        rules=["thread-shared-mutation"])
+    assert rule_names(report) == ["thread-shared-mutation"]
+    f = report.findings[0]
+    assert f.path.endswith("xworker.py")
+    assert "global counter" in f.message
+    assert "Thread@xstarter.py" in f.message
+
+
+# ---------------------------------------------------------------------------
+# non-atomic-write
+# ---------------------------------------------------------------------------
+
+def test_non_atomic_write_positive(tmp_path):
+    src = """
+        import json
+        import os
+
+
+        def save(path, rows, tmp):
+            with open(path, "w", encoding="utf-8") as f:
+                f.write("hello")
+            os.replace(tmp, path)
+            os.rename(tmp, path + ".2")
+    """
+    report = lint_source(tmp_path, src, rules=["non-atomic-write"])
+    assert rule_names(report).count("non-atomic-write") == 3
+
+
+def test_non_atomic_write_negative(tmp_path):
+    src = """
+        from shifu_tpu.resilience import atomic_path, atomic_write
+
+
+        def save(path, log_path):
+            with atomic_write(path, "w", encoding="utf-8") as f:
+                f.write("hello")
+            with atomic_path(path) as tmp:
+                # staging into the atomic context's temp is the seam
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write("staged")
+            with open(log_path, "a", encoding="utf-8") as f:
+                f.write("line")        # append: torn tail at worst
+            with open(path, encoding="utf-8") as f:
+                return f.read()        # reads are never flagged
+    """
+    report = lint_source(tmp_path, src, rules=["non-atomic-write"])
+    assert "non-atomic-write" not in rule_names(report)
+
+
+def test_non_atomic_write_sanctioned_module_exempt(tmp_path):
+    (tmp_path / "shifu_tpu" / "data").mkdir(parents=True)
+    src = """
+        import os
+
+
+        def _commit(tmp, path):
+            os.replace(tmp, path)    # fs.py IS the atomic seam
+    """
+    report = lint_source(tmp_path, src,
+                         name="shifu_tpu/data/fs.py",
+                         rules=["non-atomic-write"])
+    assert not report.findings
+
+
+def test_non_atomic_write_suppressed(tmp_path):
+    src = """
+        def save(path):
+            with open(path, "w") as f:  # lint: disable=non-atomic-write -- fixture
+                f.write("x")
+    """
+    report = lint_source(tmp_path, src, rules=["non-atomic-write"])
+    assert not report.findings
+    assert any(f.rule == "non-atomic-write" for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+# ---------------------------------------------------------------------------
+
+def test_swallowed_exception_positive(tmp_path):
+    src = '''
+        def lossy(fn):
+            try:
+                return fn()
+            except Exception:
+                pass
+
+
+        def lossy2(fn):
+            try:
+                return fn()
+            except:
+                "docstring-shaped silence"
+    '''
+    report = lint_source(tmp_path, src, rules=["swallowed-exception"])
+    assert rule_names(report).count("swallowed-exception") == 2
+
+
+def test_swallowed_exception_negative(tmp_path):
+    src = """
+        import logging
+        import queue
+
+        log = logging.getLogger(__name__)
+
+
+        def ok(fn, q):
+            try:
+                return fn()
+            except ValueError:
+                log.warning("fell back")    # log line: evidence
+            try:
+                return q.get_nowait()
+            except queue.Empty:
+                pass                        # absence IS the answer
+            try:
+                return fn()
+            except RuntimeError:
+                raise                       # re-raise: evidence
+            try:
+                return fn()
+            except OSError:
+                fallback = None             # recorded fallback
+                return fallback
+    """
+    report = lint_source(tmp_path, src, rules=["swallowed-exception"])
+    assert "swallowed-exception" not in rule_names(report)
+
+
+def test_swallowed_exception_absorbed_helper_is_evidence(tmp_path):
+    src = """
+        from shifu_tpu.resilience import absorbed
+
+
+        def ok(fn):
+            try:
+                return fn()
+            except Exception as e:
+                absorbed("fixture.site", e)
+    """
+    report = lint_source(tmp_path, src, rules=["swallowed-exception"])
+    assert not report.findings
+
+
+def test_swallowed_exception_suppressed(tmp_path):
+    src = """
+        def lossy(fn):
+            try:
+                return fn()
+            except Exception:  # lint: disable=swallowed-exception -- fixture
+                pass
+    """
+    report = lint_source(tmp_path, src, rules=["swallowed-exception"])
+    assert not report.findings
+    assert any(f.rule == "swallowed-exception"
+               for f in report.suppressed)
+
+
+def test_absorbed_counter_runtime():
+    """The sanctioned-absorb helper leaves the monitoring evidence the
+    rule's message promises: a per-site counter snapshot."""
+    from shifu_tpu import resilience as res
+    before = res.absorb_counts().get("lint.fixture", 0)
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        res.absorbed("lint.fixture", e)
+    assert res.absorb_counts()["lint.fixture"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# whole-program model (pass 1): call graph, thread entries, lock scopes
+# ---------------------------------------------------------------------------
+
+def build_program(tmp_path, files):
+    """Assemble a Program from {name: source} the way engine pass 1
+    does."""
+    from shifu_tpu.analysis import program as program_mod
+    parsed = []
+    for name, src in files.items():
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+        parsed.append((str(p),
+                       ast.parse(p.read_text(encoding="utf-8"))))
+    return program_mod.build(parsed)
+
+
+def test_program_thread_and_submit_entries(tmp_path):
+    prog = build_program(tmp_path, {
+        "w.py": """
+            def job():
+                return 1
+
+
+            def other():
+                return 2
+        """,
+        "s.py": """
+            import threading
+
+            from w import job, other
+
+
+            def go(pool):
+                threading.Thread(target=job, daemon=True).start()
+                pool.submit(other)
+        """,
+    })
+    got = {(e.qname, e.via) for e in prog.entries}
+    assert ("w.job", "Thread") in got
+    assert ("w.other", "submit") in got
+
+
+def test_program_lock_scope_attribution(tmp_path):
+    prog = build_program(tmp_path, {"m.py": """
+        class C:
+            def bump(self):
+                with self._lock:
+                    self.a = 1
+                self.b = 2
+                with self._cond:   # Condition holds its lock too
+                    self.c = 3
+    """})
+    writes = {w.target: w.locked
+              for w in prog.functions["m.C.bump"].writes}
+    assert writes == {"self.a": True, "self.b": False, "self.c": True}
+
+
+def test_program_locked_call_edges_gate_reachability(tmp_path):
+    prog = build_program(tmp_path, {"m.py": """
+        import threading
+
+
+        class C:
+            def start(self):
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    self.guarded()
+                self.open_call()
+
+            def guarded(self):
+                self.x = 1
+
+            def open_call(self):
+                self.y = 2
+    """})
+    reach = prog.reachable_from_threads()
+    assert reach["m.C.run"] is True
+    # only ever entered through a locked call site: writes inside are
+    # attributed to the caller's lock
+    assert reach["m.C.guarded"] is False
+    assert reach["m.C.open_call"] is True
+    witness = prog.thread_witness("m.C.open_call")
+    assert witness.startswith("Thread@m.py:")
+    assert "C.run" in witness and "C.open_call" in witness
+
+
+def test_program_unresolvable_call_has_no_edge(tmp_path):
+    """Precision bias: a call the resolver cannot place produces no
+    edge — never false reachability."""
+    prog = build_program(tmp_path, {"m.py": """
+        import threading
+
+
+        def run(cb):
+            cb()                  # opaque callable: no edge
+
+
+        def go():
+            threading.Thread(target=run).start()
+    """})
+    edges = prog.edges()
+    assert edges.get("m.run", []) == []
+    assert prog.reachable_from_threads() == {"m.run": True}
+
+
+# ---------------------------------------------------------------------------
+# the converted make_lock sites in the LOCKCHECK=1 DAG report
+# ---------------------------------------------------------------------------
+
+def test_converted_locks_in_lockcheck_graph(tmp_path):
+    """ISSUE-19 acceptance: the five former raw-lock sites
+    (service.schema, fleet.arm, fleet.registry, fleet.lat,
+    native.init) plus the locks this PR introduced (batcher.stats,
+    resilience.absorb) all construct through make_lock, import clean
+    under SHIFU_TPU_LOCKCHECK=1, and show up in the DAG report once
+    exercised."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SHIFU_TPU_LOCKCHECK="1",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    prog = textwrap.dedent("""\
+        import json, os
+        import numpy as np
+
+        # minimal published registry: FleetService reads manifests
+        # only; model residency stays lazy
+        os.makedirs("reg/models/m1/v001", exist_ok=True)
+        with open("reg/models/m1/v001/manifest.json", "w") as f:
+            json.dump({"family": "NN"}, f)
+        with open("reg/models/m1/HEAD", "w") as f:
+            f.write("v001")
+        from shifu_tpu.models.spec import save_model
+        save_model("model0.npz", "lr", {"n_in": 3},
+                   {"w": np.zeros(3, np.float32),
+                    "b": np.zeros(1, np.float32)})
+
+        from shifu_tpu.analysis import lockcheck
+        from shifu_tpu import native, resilience
+        from shifu_tpu.serve import batcher, fleet, service
+
+        with native._lock:
+            pass
+        resilience.absorbed("lockcheck.fixture", None)
+        batcher.MicroBatcher(lambda b: None, max_rows=8).stats()
+        arm = fleet._ArmState("m", "v", "d", 0.1, 0.05, 16, 4)
+        with arm._lock:
+            pass
+        fl = fleet.FleetService("reg", hbm_budget_mb=0)
+        with fl._lock:
+            with fl._lock:      # fleet.registry is reentrant: legal
+                pass
+        with fl._lat_lock:
+            pass
+        svc = service.ScorerService(model_paths=["model0.npz"],
+                                    aot_compile=False)
+        with svc._schema_lock:
+            pass
+        print("HELD:" + ",".join(sorted(lockcheck.report()["held"])))
+    """)
+    r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, env=env, cwd=str(tmp_path), timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    held = set(r.stdout.split("HELD:")[1].strip().split(","))
+    assert {"service.schema", "fleet.arm", "fleet.registry",
+            "fleet.lat", "native.init", "batcher.stats",
+            "resilience.absorb"} <= held, held

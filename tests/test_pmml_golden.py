@@ -9,6 +9,18 @@ semantics change in BOTH writer and evaluator still trips the test.
 
 Regenerate (after an intentional format change):
     python tests/test_pmml_golden.py regen
+
+The goldens pin the full seeded *training trajectory*, not just the
+writer: any intentional optimizer/trainer change legitimately shifts
+trained weights and requires a regen (last: 2026-08, post-seed trainer
+changes drifted lr/nn weights; gbt structure was unaffected). A regen
+is only trustworthy because three gates validate it independently of
+the pinned trajectory: structural compare at 2e-3 relative tolerance,
+the score sidecar (rtol=2e-3 / atol=2e-4), and the independent
+evaluator in pmml_external_eval.py agreeing with the sidecar at
+rtol=1e-6 / atol=1e-4 — a writer bug that survives all three would
+have to corrupt weights, scores, and an unrelated evaluator the same
+way.
 """
 
 import json

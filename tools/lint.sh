@@ -2,10 +2,14 @@
 # Repo lint gate — exits non-zero on ANY finding. Four passes:
 #
 #   1. `python -m shifu_tpu.analysis` over the package AND the
-#      out-of-package knob readers (bench.py, tools/) — the seven
-#      repo-native rules: host-sync-in-hot-loop, jit-in-loop,
-#      donation-aliasing, undeclared-knob, unregistered-fault-site,
-#      blocking-under-lock, unsharded-device-put.
+#      out-of-package knob readers (bench.py, tools/) — all fifteen
+#      repo-native rules (see README "Static analysis" for the table),
+#      including the whole-program concurrency/atomicity four:
+#      raw-lock, thread-shared-mutation, non-atomic-write,
+#      swallowed-exception. Runs with --timings and a 10s wall budget:
+#      a rule that turns quadratic fails the gate loudly instead of
+#      silently taxing every push (`--changed` exists for the
+#      edit-loop; the gate always scans everything).
 #   2. `python -m compileall` — syntax across every tree we ship.
 #   3. hygiene: no tracked .pyc/__pycache__ artifacts, and the
 #      fault-site registry must agree with the chaos matrix driver
@@ -35,6 +39,7 @@ rc=0
 
 echo "== shifu_tpu.analysis (static rules) =="
 python -m shifu_tpu.analysis shifu_tpu/ bench.py tools/ tests/synth.py \
+  --timings --budget-s 10 \
   || rc=1
 
 echo "== compileall (syntax) =="
