@@ -29,7 +29,7 @@ import subprocess
 import sys
 import time
 
-from shifu_tpu.config.environment import knob_bool, knob_int
+from shifu_tpu.config.environment import knob_bool, knob_int, knob_str
 from shifu_tpu.resilience import absorbed, atomic_write, make_lock
 
 REFERENCE_WORKER_ROW_EPOCHS_PER_SEC = 2.0e6  # see module docstring
@@ -280,11 +280,18 @@ def _persist(task, backend, record):
     exists — perf evidence must survive a flaky end-of-round TPU (rounds
     1+2 both ended with value 0.0 because nothing was persisted
     mid-round). Committed to git whenever hardware cooperates."""
+    hdr = {"ts": round(time.time(), 1), "task": task,
+           "backend": backend}
+    # a run that fell back off the default backend stamps WHY into
+    # every record header (the probe exports the reason via env so
+    # task subprocesses inherit it): bench_regress keys fallback
+    # records into their own series instead of mixing trends
+    reason = knob_str("SHIFU_TPU_BENCH_FALLBACK_REASON")
+    if reason:
+        hdr["probe"] = {"fallback_reason": reason}
     try:
         with open(BENCH_LOCAL, "a") as f:
-            f.write(json.dumps({"ts": round(time.time(), 1),
-                                "task": task, "backend": backend,
-                                **record}) + "\n")
+            f.write(json.dumps({**hdr, **record}) + "\n")
     except OSError as e:  # persist failure must not kill the bench
         _log(f"warn: could not persist to {BENCH_LOCAL}: {e}")
 
@@ -1498,9 +1505,18 @@ def task_pipeline():
     t0 = time.time()
     for node in nodes:
         t1 = time.time()
+        # pin each node to its declared demand, exactly as the
+        # timeshared DAG leg exports it — on a multi-device host the
+        # fan-out trainers must compute on equal-sized meshes in both
+        # legs or the bitwise gate compares different programs
+        if node.device and node.devices is not None:
+            os.environ["SHIFU_TPU_MESH_DEVICES"] = str(node.devices)
+        else:
+            os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
         node.fn()
         phases[node.name] = round(time.time() - t1, 2)
         _log(f"[pipeline seq] {node.name}: {phases[node.name]:.1f}s")
+    os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
     seq_s = time.time() - t0
     seq_hashes = _pipeline_output_hashes(root, algs)
     with open(os.path.join(root, "evals", "Eval1",
@@ -1510,10 +1526,22 @@ def task_pipeline():
     _reset_pipeline_derived(root, keep_cache=True)
     nodes = pipeline_nodes(root, eval_sets=eval_sets, algorithms=algs,
                            resume=False)
-    t0 = time.time()
-    report = run_dag(nodes, workers=len(algs), root=root,
-                     label="pipeline")
-    dag_s = time.time() - t0
+    # this leg measures pure DAG scheduling under the legacy timeshared
+    # admission; the sliced-vs-timeshared comparison (and its own
+    # parity gate) is _pipeline_slice_ab's job below
+    slice_key = "SHIFU_TPU_DAG_SLICE"
+    saved_slice = os.environ.get(slice_key)   # save/restore, not a read
+    os.environ[slice_key] = "0"
+    try:
+        t0 = time.time()
+        report = run_dag(nodes, workers=len(algs), root=root,
+                         label="pipeline")
+        dag_s = time.time() - t0
+    finally:
+        if saved_slice is None:
+            os.environ.pop(slice_key, None)
+        else:
+            os.environ[slice_key] = saved_slice
     _log(f"[pipeline dag] wall {dag_s:.1f}s vs sequential {seq_s:.1f}s "
          f"(critical path {report['critical_path_s']:.1f}s, "
          f"occupancy {report['occupancy']:.2f})")
@@ -1523,8 +1551,16 @@ def task_pipeline():
         diff = sorted(k for k in set(seq_hashes) | set(dag_hashes)
                       if seq_hashes.get(k) != dag_hashes.get(k))
         _log(f"[pipeline] OUTPUT MISMATCH dag vs sequential: {diff[:10]}")
+    # sample the warm-cache miss count NOW: the slice A/B below runs on
+    # other mesh sizes/device assignments, whose first compiles are not
+    # this field's contract (it pins seq leg warms → dag leg hits)
+    fanout_misses = _pipeline_fanout_misses(root, algs)
 
-    print(json.dumps({
+    slice_block = _pipeline_slice_ab(root, algs, eval_sets)
+    if slice_block is not None:
+        bitwise = bitwise and slice_block.pop("_bitwise")
+
+    rec = {
         "phases": phases, "total_s": round(seq_s, 2),
         "auc": perf["areaUnderRoc"], "rows": PIPE_ROWS,
         "cols": PIPE_NUM + PIPE_CAT, "raw_mb": round(raw_mb, 1),
@@ -1536,8 +1572,102 @@ def task_pipeline():
         "dag_occupancy": report["occupancy"],
         "dag_workers": report["workers"],
         "bitwise_identical": bitwise,
-        "fanout_cache_misses": _pipeline_fanout_misses(root, algs),
-    }))
+        "fanout_cache_misses": fanout_misses,
+    }
+    if slice_block is not None:
+        rec["slice"] = slice_block
+    print(json.dumps(rec))
+
+
+def _pipeline_slice_ab(root, algs, eval_sets):
+    """Sliced-vs-timeshared A/B on an 8-fake-device host (multi-model
+    runs only). Leg A is the schedule hardware timesharing degrades to
+    under TPU process exclusivity: the same nodes walked sequentially,
+    each on a mesh of its declared demand. Leg B runs them through the
+    slice allocator (SHIFU_TPU_DAG_SLICE=1) so fan-out trainers hold
+    disjoint 8-way slices concurrently. Equal per-node mesh SIZES keep
+    the legs bitwise-comparable — a k-device mesh compiles the same
+    XLA program whichever k chips back it — so artifact parity proves
+    spatial multiplexing changed nothing but the wall clock. Returns
+    the record's `slice` block (profiling.SLICE_FIELDS) plus a
+    `_bitwise` verdict the caller folds into bitwise_identical, or
+    None when the run has no fan-out to multiplex. Both legs are
+    measured WARM (one untimed pass each) so the comparison is pure
+    schedule, not per-device-assignment first compiles."""
+    if len(algs) < 2:
+        return None
+    from shifu_tpu import profiling
+    from shifu_tpu.pipeline.nodes import pipeline_nodes
+    from shifu_tpu.pipeline.scheduler import run_dag
+
+    total = 8
+    keys = ("XLA_FLAGS", "SHIFU_TPU_DAG_SLICE", "SHIFU_TPU_DAG_DEVICES",
+            "SHIFU_TPU_MESH_DEVICES")
+    saved = {k: os.environ.get(k) for k in keys}
+    flags = [p for p in os.environ.get("XLA_FLAGS", "").split()
+             if not p.startswith("--xla_force_host_platform_device_count")]
+    try:
+        os.environ["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={total}"])
+        os.environ["SHIFU_TPU_DAG_DEVICES"] = str(total)
+
+        # each leg runs TWICE: an untimed warm pass, then the measured
+        # pass. XLA's persistent cache keys include the device
+        # ASSIGNMENT, so leg A's prefix-device programs can never serve
+        # leg B's non-prefix leases (or vice versa) — a cold timed leg
+        # would measure compiles, not the schedule under comparison
+        for timed in (False, True):
+            _reset_pipeline_derived(root, keep_cache=True)
+            nodes = pipeline_nodes(root, eval_sets=eval_sets,
+                                   algorithms=algs, resume=False)
+            t0 = time.time()
+            for node in nodes:
+                if node.device:
+                    os.environ["SHIFU_TPU_MESH_DEVICES"] = \
+                        str(node.devices or total)
+                else:
+                    os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
+                node.fn()
+            os.environ.pop("SHIFU_TPU_MESH_DEVICES", None)
+            if timed:
+                ts_s = time.time() - t0
+        ts_hashes = _pipeline_output_hashes(root, algs)
+
+        os.environ["SHIFU_TPU_DAG_SLICE"] = "1"
+        for timed in (False, True):
+            _reset_pipeline_derived(root, keep_cache=True)
+            nodes = pipeline_nodes(root, eval_sets=eval_sets,
+                                   algorithms=algs, resume=False)
+            t0 = time.time()
+            rep = run_dag(nodes, root=root,
+                          label="pipeline-sliced" if timed
+                          else "pipeline-sliced-warm")
+            if timed:
+                sl_s = time.time() - t0
+        sl_hashes = _pipeline_output_hashes(root, algs)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    parity = ts_hashes == sl_hashes
+    if not parity:
+        diff = sorted(k for k in set(ts_hashes) | set(sl_hashes)
+                      if ts_hashes.get(k) != sl_hashes.get(k))
+        _log(f"[pipeline slice] OUTPUT MISMATCH sliced vs timeshared: "
+             f"{diff[:10]}")
+    _log(f"[pipeline sliced] wall {sl_s:.1f}s vs timeshared {ts_s:.1f}s "
+         f"(max_concurrent {rep['max_concurrent']}, slice-weighted "
+         f"occupancy {rep['occupancy']:.2f}, bitwise={parity})")
+    leased = sum(1 for r in rep["nodes"] if r.get("devices"))
+    # profiling.SLICE_FIELDS is the pinned schema — build the block
+    # from the tuple so it cannot drift from the docs
+    block = dict(zip(profiling.SLICE_FIELDS, (
+        leased, rep["max_concurrent"], rep["occupancy"],
+        round(ts_s / sl_s, 2) if sl_s > 0 else None)))
+    block["_bitwise"] = parity
+    return block
 
 
 def task_serving():
@@ -2874,6 +3004,8 @@ def _resolve_backend(diags):
         probe["fallback"] = (f"JAX_PLATFORMS={pinned} pinned; default "
                              "backend unreachable and cpu fallback "
                              "suppressed")
+        os.environ["SHIFU_TPU_BENCH_FALLBACK_REASON"] = \
+            f"JAX_PLATFORMS={pinned} pinned; backend unreachable"
         return None, {}, probe
     _log(f"probe: default backend unreachable after {attempts} "
          f"attempt(s) x {probe_timeout}s — falling back to "
@@ -2883,6 +3015,9 @@ def _resolve_backend(diags):
                          f"attempt(s) x {probe_timeout}s — fell back to "
                          "cpu; any TPU numbers in this record are "
                          "persisted, not live")
+    os.environ["SHIFU_TPU_BENCH_FALLBACK_REASON"] = \
+        (f"backend unreachable after {attempts}x{probe_timeout}s probe "
+         "timeouts; ran on cpu")
     t0 = time.time()
     out, err = _run_task("probe", env_extra={"JAX_PLATFORMS": "cpu"},
                          timeout=probe_timeout)

@@ -6,13 +6,13 @@ ctx)` and optionally `finalize(ctx)`. Add new modules to
 """
 
 from shifu_tpu.analysis.rules import (atomicwrite, collectives,
-                                      dagsteps, deviceput, faults,
-                                      hotloop, javaprops, knobs, locks,
-                                      rawlock, spans, swallowed,
-                                      threadshare)
+                                      dagsteps, devicegrab, deviceput,
+                                      faults, hotloop, javaprops,
+                                      knobs, locks, rawlock, spans,
+                                      swallowed, threadshare)
 
 RULE_MODULES = (hotloop, knobs, faults, locks, deviceput, javaprops,
                 dagsteps, spans, collectives, rawlock, threadshare,
-                atomicwrite, swallowed)
+                atomicwrite, swallowed, devicegrab)
 
 ALL_RULES = tuple(r for m in RULE_MODULES for r in m.RULES)

@@ -1,0 +1,48 @@
+"""ungated-device-grab: `jax.devices()` / `jax.local_devices()` outside
+`parallel/mesh.py` bypasses the device-slice lease seam.
+
+The DAG scheduler leases concurrent nodes disjoint device slices and
+exports SHIFU_TPU_DEVICE_SLICE into the node process;
+`parallel.mesh.leased_devices()` is the one place that honors it, so
+every mesh, placement, and device count derived through `parallel/mesh`
+inherits the lease automatically. A raw `jax.devices()` call anywhere
+else sees the WHOLE pool: a leased trainer would plan meshes (or place
+arrays) over chips another node leased, silently defeating the
+isolation the allocator proved. Route device enumeration through
+`parallel.mesh` — `leased_devices()`, `leased_local_devices()`, or
+`device_inventory()` for pool sizing.
+
+Only the exact dotted calls `jax.devices(...)` and
+`jax.local_devices(...)` are flagged; `jax.local_device_count()` and
+plain references are not (counting is legitimate host-introspection in
+some contexts, and the repo idiom for enumeration is the dotted call).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from shifu_tpu.analysis.engine import Finding, dotted
+
+RULES = ("ungated-device-grab",)
+
+_GRABS = ("jax.devices", "jax.local_devices")
+
+
+def check(tree: ast.Module, path: str, ctx: dict) -> List[Finding]:
+    if path.replace("\\", "/").endswith("parallel/mesh.py"):
+        return []   # the lease seam itself — the one legitimate caller
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted(node.func) not in _GRABS:
+            continue
+        findings.append(Finding(
+            "ungated-device-grab", path, node.lineno, node.col_offset,
+            "jax.devices()/jax.local_devices() outside parallel/mesh.py "
+            "sees the whole pool and ignores the DAG scheduler's device-"
+            "slice lease — route through parallel.mesh.leased_devices() "
+            "(or device_inventory() for pool sizing)"))
+    return findings

@@ -130,8 +130,20 @@ _declare("SHIFU_TPU_FAULT", "str", None,
 _declare("SHIFU_TPU_RESUME", "flag", "0",
          "1 = skip steps whose completion manifest matches inputs")
 _declare("SHIFU_TPU_DAG_WORKERS", "int", 2,
-         "pipeline DAG scheduler: concurrent device-using nodes "
-         "(host-only nodes are admitted immediately)")
+         "pipeline DAG scheduler, timeshared mode: concurrent "
+         "device-using nodes (host-only nodes are admitted "
+         "immediately; sliced mode admits by device-slice leases)")
+_declare("SHIFU_TPU_DAG_SLICE", "str", "auto",
+         "DAG device-slice leases: auto = lease disjoint slices to "
+         "concurrent device nodes when the pool holds >1 device, "
+         "1 = force slicing, 0 = legacy timeshared admission")
+_declare("SHIFU_TPU_DAG_DEVICES", "int", None,
+         "device pool size the DAG slice allocator leases from "
+         "(None = probe the runtime via parallel.mesh; set it on "
+         "hardware so scheduling never probes a flaky accelerator)")
+_declare("SHIFU_TPU_DAG_DEMAND_CAP", "int", None,
+         "cap every DAG node's effective device demand (demand "
+         "override — A/B runs force equal-sized meshes with it)")
 _declare("SHIFU_TPU_MAX_RESTARTS", "int", 0,
          "supervised in-process restarts around the train step")
 _declare("SHIFU_TPU_ABORT_DIR", "str", None,
@@ -175,6 +187,10 @@ _declare("SHIFU_TPU_STREAM_TIMEOUT_S", "float", None,
          "timeout")
 _declare("SHIFU_TPU_MESH_DEVICES", "int", None,
          "cap the device count in the default mesh (None = all)")
+_declare("SHIFU_TPU_DEVICE_SLICE", "str", None,
+         "comma-separated device ids leased to THIS process by the "
+         "DAG scheduler; parallel.mesh.leased_devices filters every "
+         "mesh build to the slice (exported by run_dag, not hand-set)")
 _declare("SHIFU_TPU_MESH_MODEL", "int", 1,
          "devices on the 'model' mesh axis (WDL/MTL table sharding)")
 _declare("SHIFU_TPU_MESH_RULES", "str", None,
@@ -420,6 +436,13 @@ _declare("SHIFU_TPU_BENCH_PROBE_TIMEOUT_S", "int", 300,
 _declare("SHIFU_TPU_BENCH_PROBE_ATTEMPTS", "int", 3,
          "backend probe attempts before falling back to cpu",
          scope="bench")
+_declare("SHIFU_TPU_BENCH_FALLBACK_REASON", "str", None,
+         "why this bench run fell back off the default backend; set "
+         "by the probe (not by hand) so every BENCH_LOCAL.jsonl "
+         "record persisted afterwards — including from task "
+         "subprocesses — stamps probe.fallback_reason and "
+         "tools/bench_regress.py keeps fallback records out of the "
+         "genuine hardware trend", scope="bench")
 _declare("SHIFU_TPU_BENCH_REFRESH", "flag", "0",
          "1 = re-measure even when a baseline record exists",
          scope="bench")

@@ -111,6 +111,58 @@ def default_rules() -> MeshRules:
     return MeshRules(_parse_rules_env(raw) if raw else None)
 
 
+def leased_devices(devs: Optional[Sequence] = None):
+    """The device-slice lease seam: the devices THIS process may build
+    meshes over. When the DAG scheduler leased this process a slice it
+    exported SHIFU_TPU_DEVICE_SLICE=i,j,k — filter `devs` (default:
+    all devices) down to those ids so `default_mesh`/`local_mesh` and
+    every jit/shard_map path behind them inherit the placement with
+    zero call-site changes. No slice env means the whole set.
+
+    TPU runtimes that honor chip-visibility env (TPU_VISIBLE_DEVICES,
+    exported alongside the slice) renumber devices from 0, so the
+    leased ids may match nothing: when the visible set is already no
+    larger than the lease, visibility did the narrowing — return it.
+    A partial match or an oversized visible set is a placement bug and
+    raises rather than silently running on chips another node leased.
+    """
+    if devs is None:
+        devs = jax.devices()
+    devs = list(devs)
+    raw = knob_str("SHIFU_TPU_DEVICE_SLICE")
+    if not raw:
+        return devs
+    try:
+        want = {int(p) for p in raw.split(",") if p.strip()}
+    except ValueError as e:
+        raise ValueError(
+            f"bad SHIFU_TPU_DEVICE_SLICE={raw!r}: want comma-separated "
+            "device ids (the DAG scheduler exports this; do not hand-"
+            "edit)") from e
+    picked = [d for d in devs if d.id in want]
+    if len(picked) == len(want):
+        return picked
+    if not picked and len(devs) <= len(want):
+        return devs   # runtime renumbered after visibility narrowing
+    raise RuntimeError(
+        f"SHIFU_TPU_DEVICE_SLICE={raw!r} leased {len(want)} device(s) "
+        f"but only {len(picked)} of {len(devs)} visible ids match — "
+        "refusing to build a mesh over chips outside the lease")
+
+
+def leased_local_devices():
+    """`leased_devices` over this process's addressable devices — the
+    count the streaming data plane pads per-process chunk blocks to."""
+    return leased_devices(jax.local_devices())
+
+
+def device_inventory() -> int:
+    """The local device pool size the DAG slice allocator leases from
+    (probes the runtime; scheduler callers prefer SHIFU_TPU_DAG_DEVICES
+    so a flaky accelerator is never probed just to plan a schedule)."""
+    return len(jax.local_devices())
+
+
 def _knobbed_mesh(devs, cache_tag: str) -> Mesh:
     """The shared default_mesh/local_mesh body: apply the device-count
     cap and model-axis carve knobs to `devs` and cache the result."""
@@ -141,8 +193,10 @@ def default_mesh() -> Mesh:
     all chips, multi-host it is all global devices (DCN via
     parallel/dist.initialize). SHIFU_TPU_MESH_DEVICES=N caps the
     device count (tests use it to compare 8-device vs 1-device runs).
+    A process the DAG scheduler leased a device slice to builds over
+    ONLY that slice (`leased_devices`).
     """
-    return _knobbed_mesh(jax.devices(), "global")
+    return _knobbed_mesh(leased_devices(), "global")
 
 
 def local_mesh() -> Mesh:
@@ -156,7 +210,7 @@ def local_mesh() -> Mesh:
     single-host run does for that chunk (bitwise parity of the replay
     merge, given equal per-host device counts — the same assumption
     the trainer's 2×2-vs-1×4 drill pins)."""
-    return _knobbed_mesh(jax.local_devices(), "local")
+    return _knobbed_mesh(leased_local_devices(), "local")
 
 
 def reprobe_devices() -> int:
@@ -174,7 +228,7 @@ def reprobe_devices() -> int:
         jax.clear_backends()
     except Exception as e:  # noqa: BLE001 — best-effort
         log.debug("reprobe_devices: clear_backends unavailable (%s)", e)
-    n = len(jax.devices())
+    n = len(leased_devices())
     log.info("reprobe_devices: %d local device(s) visible", n)
     return n
 

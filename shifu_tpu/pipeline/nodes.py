@@ -17,6 +17,14 @@ grid-search fan-outs train siblings in clone workspaces under
 ``tmp/dag_models/<name>`` that share the parent's normalized data (by
 symlink) and its persistent XLA compile cache (PR 5) — the first
 sibling to compile a program populates the cache for the rest.
+
+Placement: fan-out siblings declare a device demand — an equal split
+of the pool (`_sibling_demand`) — so the scheduler's slice allocator
+leases them disjoint chips and they train simultaneously instead of
+timesharing. The node body accepts the scheduler's ``lease_env``
+keyword and merges it into the subprocess environment, which is the
+entire placement hand-off: the child's `parallel.mesh` builds every
+mesh over its slice.
 """
 
 from __future__ import annotations
@@ -29,7 +37,7 @@ import subprocess
 import sys
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
-from shifu_tpu.config.environment import knob_bool
+from shifu_tpu.config.environment import knob_bool, knob_int
 from shifu_tpu.pipeline.scheduler import Node
 
 log = logging.getLogger("shifu_tpu")
@@ -41,14 +49,19 @@ class StepSpec(NamedTuple):
     ``manifest``: the step brackets itself with `step_guard` and owns
     ``tmp/manifests/<name>.json``. ``family``: the guard name is
     per-instance (``<name>.<instance>``, e.g. ``eval.Eval1``).
-    ``device``: contends for SHIFU_TPU_DAG_WORKERS admission slots;
-    host-only steps bypass them and never queue behind a trainer."""
+    ``device``: contends for a device-slice lease (timeshared mode:
+    the SHIFU_TPU_DAG_WORKERS admission slots); host-only steps bypass
+    both and never queue behind a trainer. ``devices`` is the step's
+    device demand — None means "all" (the whole pool, exclusive);
+    fan-out builders override it per sibling with an equal split so
+    siblings run concurrently on disjoint slices."""
 
     deps: Tuple[str, ...]
     device: bool
     manifest: bool
     family: bool = False
     doc: str = ""
+    devices: Optional[int] = None
 
 
 # dependency structure of the processor pipeline, in terms of the
@@ -129,9 +142,40 @@ def _resume_enabled(resume: Optional[bool]) -> bool:
     return knob_bool("SHIFU_TPU_RESUME") if resume is None else bool(resume)
 
 
+def _merge_env(base: Optional[Dict[str, str]],
+               lease: Optional[Dict[str, str]]) -> Optional[Dict[str, str]]:
+    if not lease:
+        return base
+    out = dict(base or {})
+    out.update(lease)
+    return out
+
+
+def _sibling_demand(n_siblings: int) -> Optional[int]:
+    """Per-sibling device demand for a fan-out: an equal split of the
+    pool, at least one chip each. None (= demand the whole pool) when
+    there is a single sibling or the inventory is unknown or single-
+    device — the scheduler then serializes or timeshares exactly as
+    before. SHIFU_TPU_DAG_DEVICES avoids the runtime probe (preferred
+    on hardware)."""
+    if n_siblings <= 1:
+        return None
+    total = knob_int("SHIFU_TPU_DAG_DEVICES")
+    if not total:
+        try:
+            from shifu_tpu.parallel import mesh as mesh_mod
+            total = mesh_mod.device_inventory()
+        except Exception:  # noqa: BLE001 — no inventory → no demand
+            return None
+    if total and int(total) > 1:
+        return max(1, int(total) // n_siblings)
+    return None
+
+
 def _node(root: str, step: str, cmd: Sequence[str], deps: Tuple[str, ...],
           resume: bool, name: Optional[str] = None,
-          env_extra: Optional[Dict[str, str]] = None) -> Node:
+          env_extra: Optional[Dict[str, str]] = None,
+          devices: Optional[int] = None) -> Node:
     # longest registered dotted prefix: "eval.Eval1" → "eval",
     # "stats.seg.3" → "stats.seg" (family entries keep their own spec)
     key = step
@@ -146,8 +190,10 @@ def _node(root: str, step: str, cmd: Sequence[str], deps: Tuple[str, ...],
     else:
         done = _manifest_done(root, step)
     return Node(name=name,
-                fn=lambda: _run_cli(root, cmd, name, env_extra),
-                deps=deps, device=spec.device, done_check=done)
+                fn=lambda lease_env=None: _run_cli(
+                    root, cmd, name, _merge_env(env_extra, lease_env)),
+                deps=deps, device=spec.device, done_check=done,
+                devices=devices if devices is not None else spec.devices)
 
 
 # ---------------------------------------------------------------------------
@@ -206,19 +252,23 @@ def pipeline_nodes(root: str, eval_sets: Sequence[str] = (),
     if len(algorithms) > 1:
         cache_env = {"SHIFU_TPU_COMPILE_CACHE_DIR":
                      os.path.join(root, "tmp", "jax_cache")}
+        share = _sibling_demand(len(algorithms))
         primary, train_name = algorithms[0], f"train.{algorithms[0]}"
         nodes.append(_node(root, "train", ["train"], ("norm",), res,
-                           name=train_name, env_extra=cache_env))
+                           name=train_name, env_extra=cache_env,
+                           devices=share))
         for alg in algorithms[1:]:
             nodes.append(variant_node(root, f"train.{alg}", ("norm",),
                                       algorithm=alg, resume=res,
-                                      env_extra=cache_env))
+                                      env_extra=cache_env,
+                                      devices=share))
     else:
         train_name = "train"
         nodes.append(_node(root, "train", ["train"], ("norm",), res))
+    ev_share = _sibling_demand(len(eval_sets))
     for ev in eval_sets:
         nodes.append(_node(root, f"eval.{ev}", ["eval", "-run", ev],
-                           (train_name,), res))
+                           (train_name,), res, devices=ev_share))
     if posttrain:
         nodes.append(_node(root, "posttrain", ["posttrain"],
                            (train_name,), res))
@@ -239,10 +289,11 @@ def grid_nodes(root: str, grid_params: Sequence[Dict],
     ]
     cache_env = {"SHIFU_TPU_COMPILE_CACHE_DIR":
                  os.path.join(root, "tmp", "jax_cache")}
+    share = _sibling_demand(len(grid_params))
     for i, params in enumerate(grid_params):
         nodes.append(variant_node(root, f"train.grid{i}", ("norm",),
                                   params=params, resume=res,
-                                  env_extra=cache_env))
+                                  env_extra=cache_env, devices=share))
     return nodes
 
 
@@ -250,20 +301,23 @@ def variant_node(root: str, name: str, deps: Tuple[str, ...],
                  algorithm: Optional[str] = None,
                  params: Optional[Dict] = None,
                  resume: bool = False,
-                 env_extra: Optional[Dict[str, str]] = None) -> Node:
+                 env_extra: Optional[Dict[str, str]] = None,
+                 devices: Optional[int] = None) -> Node:
     """A sibling trainer in a clone workspace under
     ``tmp/dag_models/<name>``: same data, same ColumnConfig, different
     algorithm and/or train params. The clone is prepared lazily inside
     the node body — after the parent's norm finished — and shares the
-    parent's compile cache via ``env_extra``."""
+    parent's compile cache via ``env_extra``. ``devices`` declares the
+    sibling's slice demand (fan-out builders pass the equal split)."""
     clone = variant_dir(root, name)
 
-    def fn() -> None:
+    def fn(lease_env: Optional[Dict[str, str]] = None) -> None:
         prepare_variant(root, clone, algorithm=algorithm, params=params)
-        _run_cli(clone, ["train"], name, env_extra)
+        _run_cli(clone, ["train"], name, _merge_env(env_extra, lease_env))
 
     done = _manifest_done(clone, "train") if resume else None
-    return Node(name=name, fn=fn, deps=deps, device=True, done_check=done)
+    return Node(name=name, fn=fn, deps=deps, device=True,
+                done_check=done, devices=devices)
 
 
 def variant_dir(root: str, name: str) -> str:

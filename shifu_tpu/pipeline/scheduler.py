@@ -1,16 +1,33 @@
-"""Bounded-worker DAG scheduler over pipeline nodes.
+"""Device-slice-leasing DAG scheduler over pipeline nodes.
 
 Execution model: the calling thread is the dispatcher; every node that
 becomes ready (all dependencies done) is handed to a fresh worker
-thread, subject to admission control — nodes tagged ``device=True``
-share ``SHIFU_TPU_DAG_WORKERS`` slots so fan-out trainers cannot
-oversubscribe the chips, while host-only nodes (export, posttrain,
-config checks) are admitted immediately and never queue behind a
-trainer. Node bodies are typically CLI subprocesses (see
-`pipeline.nodes`): one process per step keeps the per-process global
-state — abort scope, stage timers, retry counters — isolated exactly
-as it is in a sequential run, which is what makes the "bitwise
-identical outputs" guarantee cheap to keep.
+thread, subject to admission control. On a multi-device host the
+admission unit is a **device-slice lease**: each ``device=True`` node
+declares a demand (``devices=k``, default "all"), the allocator leases
+it a disjoint set of device indices out of the pool (first-fit over
+the smallest free indices, demand-descending dispatch tie-break), and
+exports the lease into the node subprocess via env —
+``SHIFU_TPU_DEVICE_SLICE=i,j,k`` plus the platform visibility
+variables (``XLA_FLAGS=--xla_force_host_platform_device_count`` on
+CPU, ``TPU_VISIBLE_DEVICES`` on hardware) — so concurrent trainers run
+*simultaneously on different chips* instead of timesharing them. A
+node whose demand cannot currently be met waits for leases to return;
+a demand larger than the pool raises up front (a lease never shrinks
+silently). The lease returns to the pool on node exit through the same
+paths that publish failure/poison. On a single device (or with
+``SHIFU_TPU_DAG_SLICE=0``) the scheduler falls back to the legacy
+timeshared counter: device nodes share ``SHIFU_TPU_DAG_WORKERS``
+admission slots. Host-only nodes (export, posttrain, config checks)
+are admitted immediately either way and never queue behind a trainer.
+
+Node bodies are typically CLI subprocesses (see `pipeline.nodes`): one
+process per step keeps the per-process global state — abort scope,
+stage timers, retry counters — isolated exactly as it is in a
+sequential run, which is what makes the "bitwise identical outputs"
+guarantee cheap to keep (a leased process builds its meshes over only
+its slice via `parallel.mesh.leased_devices`, and a k-device mesh
+compiles the same XLA program whichever k chips back it).
 
 Failure discipline mirrors `parallel/dist.py`: the FIRST failing node
 publishes an abort marker (`resilience.publish_abort("dag.<node>")`)
@@ -28,15 +45,16 @@ manifest parks the node in the ``cached`` state without running it.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from shifu_tpu import profiling, resilience
-from shifu_tpu.config.environment import knob_int
+from shifu_tpu.config.environment import knob_int, knob_str
 from shifu_tpu.obs import trace as obs_trace
 from shifu_tpu.resilience import fault_point
 
@@ -62,16 +80,24 @@ class DagError(RuntimeError):
 class Node:
     """One schedulable unit: a callable plus its dependency edges.
 
-    ``device=True`` nodes contend for the SHIFU_TPU_DAG_WORKERS
-    admission slots; host-only nodes bypass them. ``done_check`` is the
-    per-node RESUME test (True → skip as ``cached``), evaluated only
-    after the node's dependencies completed."""
+    ``device=True`` nodes contend for device-slice leases (timeshared
+    mode: the SHIFU_TPU_DAG_WORKERS admission slots); host-only nodes
+    bypass both. ``devices`` is the node's device demand — how many
+    chips its lease must hold; ``None`` means "all" (the whole pool,
+    exclusive). Fan-out siblings (variant trainers, grid arms, per-
+    eval-set scorers) declare small demands so they run concurrently
+    on disjoint slices. ``done_check`` is the per-node RESUME test
+    (True → skip as ``cached``), evaluated only after the node's
+    dependencies completed. If ``fn`` accepts a ``lease_env`` keyword
+    it receives the slice/visibility env dict to merge into its
+    subprocess environment (in-process callables may ignore it)."""
 
     name: str
-    fn: Callable[[], None]
+    fn: Callable[..., None]
     deps: Tuple[str, ...] = ()
     device: bool = True
     done_check: Optional[Callable[[], bool]] = None
+    devices: Optional[int] = None
 
 
 def _validate(nodes: Sequence[Node]):
@@ -147,7 +173,94 @@ class _RunState:
     end_t: Dict[str, float] = field(default_factory=dict)
     errors: Dict[str, BaseException] = field(default_factory=dict)
     device_running: int = 0
+    max_concurrent: int = 0
+    free: Set[int] = field(default_factory=set)      # sliced mode pool
+    leases: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    lease_size: Dict[str, int] = field(default_factory=dict)
     first_failure: Optional[Tuple[str, BaseException]] = None
+
+
+def _resolve_slicing(nodes: Sequence[Node]) -> Tuple[bool, Optional[int]]:
+    """Pick the admission mode: (sliced?, pool size). SHIFU_TPU_DAG_SLICE
+    auto → slice whenever the pool holds more than one device; 1 →
+    force slicing (inventory required); 0 → legacy timesharing. The
+    inventory comes from SHIFU_TPU_DAG_DEVICES when set — preferred on
+    hardware, so planning a schedule never probes (and possibly hangs
+    on) a flaky accelerator — else from a runtime probe, and only DAGs
+    that actually hold device nodes probe at all."""
+    mode = (knob_str("SHIFU_TPU_DAG_SLICE") or "auto").strip().lower()
+    if mode not in ("auto", "0", "1"):
+        raise ValueError(
+            f"SHIFU_TPU_DAG_SLICE={mode!r}: want auto, 1 or 0")
+    if mode == "0" or not any(n.device for n in nodes):
+        return False, None
+    total = knob_int("SHIFU_TPU_DAG_DEVICES")
+    if not total:
+        try:
+            from shifu_tpu.parallel import mesh as mesh_mod
+            total = mesh_mod.device_inventory()
+        except Exception as e:  # noqa: BLE001 — fall back to timesharing
+            if mode == "1":
+                raise RuntimeError(
+                    "SHIFU_TPU_DAG_SLICE=1 but the device inventory is "
+                    "unavailable — set SHIFU_TPU_DAG_DEVICES") from e
+            log.debug("dag: device inventory probe failed (%s) — "
+                      "timeshared admission", e)
+            return False, None
+    total = int(total)
+    if mode == "1":
+        return True, max(total, 1)
+    return (total > 1, total) if total > 1 else (False, None)
+
+
+def _effective_demand(node: Node, total: int) -> int:
+    """A device node's demand in devices: its declared ``devices`` (None
+    = the whole pool), capped by SHIFU_TPU_DAG_DEMAND_CAP (the demand
+    override knob — A/B runs use it to force equal-sized meshes)."""
+    if not node.device:
+        return 0
+    k = node.devices if node.devices is not None else total
+    cap = knob_int("SHIFU_TPU_DAG_DEMAND_CAP")
+    if cap:
+        k = min(int(k), int(cap))
+    return max(int(k), 1)
+
+
+def _lease_env(lease: Tuple[int, ...], total: int) -> Dict[str, str]:
+    """The env exported into a leased node subprocess: the slice itself
+    (parallel.mesh.leased_devices filters every mesh build to it) plus
+    both platform visibility variables — the CPU fake-device flag keeps
+    the child's device ids aligned with the parent's pool so the slice
+    ids resolve, and TPU_VISIBLE_DEVICES narrows real hardware (each is
+    inert on the other platform)."""
+    ids = ",".join(str(i) for i in lease)
+    flags = [p for p in os.environ.get("XLA_FLAGS", "").split()
+             if not p.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={total}")
+    return {"SHIFU_TPU_DEVICE_SLICE": ids,
+            "XLA_FLAGS": " ".join(flags),
+            "TPU_VISIBLE_DEVICES": ids}
+
+
+def _call_node(node: Node, lease_env: Optional[Dict[str, str]]) -> None:
+    """Invoke the node body, passing the lease env to callables that
+    accept it (pipeline.nodes subprocess wrappers do; bare lambdas in
+    host-only DAGs and in-process fan-outs run unleased — an in-process
+    body executes on the parent's own mesh, which IS its lease)."""
+    if lease_env:
+        try:
+            params = inspect.signature(node.fn).parameters.values()
+            takes = any(p.name == "lease_env"
+                        or p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in params)
+        except (TypeError, ValueError):
+            takes = False
+        if takes:
+            node.fn(lease_env=lease_env)
+            return
+        log.debug("dag: node %s takes no lease_env — body runs on the "
+                  "parent's own devices", node.name)
+    node.fn()
 
 
 def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
@@ -166,11 +279,30 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
     order = [n.name for n in nodes]
     if workers is None:
         workers = max(knob_int("SHIFU_TPU_DAG_WORKERS"), 1)
+    sliced, total = _resolve_slicing(nodes)
+    eff: Dict[str, int] = {}
+    if sliced:
+        eff = {n.name: _effective_demand(n, total) for n in nodes}
+        for n in nodes:
+            if n.device and eff[n.name] > total:
+                raise ValueError(
+                    f"DAG node {n.name!r} demands {eff[n.name]} "
+                    f"device(s) but the pool holds {total} — a demand "
+                    "that can never be met would wait forever, and a "
+                    "lease never shrinks silently (lower devices= or "
+                    "set SHIFU_TPU_DAG_DEMAND_CAP)")
+    # demand-descending dispatch tie-break: big slices first-fit before
+    # small ones fragment the pool (stable — equal demands keep their
+    # declaration order, and timeshared mode keeps it entirely)
+    dispatch = sorted(order, key=lambda k: -eff.get(k, 0)) if sliced \
+        else order
     if root:
         resilience.set_abort_scope(os.path.join(root, "tmp"))
         resilience.clear_abort()
 
     rs = _RunState()
+    if sliced:
+        rs.free = set(range(total))
     dep_left = {n.name: len(n.deps) for n in nodes}
     t0 = time.monotonic()
     for n in nodes:
@@ -204,6 +336,9 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
             rs.end_t[name] = now
             if by[name].device:
                 rs.device_running -= 1
+                lease = rs.leases.pop(name, None)
+                if lease is not None:
+                    rs.free.update(lease)   # lease back to the pool
             if err is None:
                 rs.state[name] = DONE
                 _mark_ready(name, now)
@@ -211,10 +346,10 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
                 _fail(name, err, now)
             cv.notify_all()
 
-    def _worker(node: Node) -> None:
+    def _worker(node: Node, lease_env: Optional[Dict[str, str]]) -> None:
         err: Optional[BaseException] = None
         try:
-            node.fn()
+            _call_node(node, lease_env)
         except BaseException as e:  # noqa: BLE001 — reported per node
             err = e
         _finish(node.name, err)
@@ -224,12 +359,16 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
             progressed = True
             while progressed:
                 progressed = False
-                for name in order:
+                for name in dispatch:
                     if rs.state[name] != "pending" or dep_left[name] > 0:
                         continue
                     node = by[name]
-                    if node.device and rs.device_running >= workers:
-                        continue
+                    if node.device:
+                        if sliced:
+                            if eff[name] > len(rs.free):
+                                continue   # wait for leases to return
+                        elif rs.device_running >= workers:
+                            continue
                     now = time.monotonic()
                     # per-node RESUME: a manifest completed by a prior
                     # run (and still matching its inputs) skips the node
@@ -253,12 +392,41 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
                         _fail(name, e, now)
                         progressed = True
                         continue
+                    lease_env: Optional[Dict[str, str]] = None
+                    if node.device and sliced:
+                        k = eff[name]
+                        lease = tuple(sorted(rs.free)[:k])
+                        rs.free.difference_update(lease)
+                        rs.leases[name] = lease
+                        rs.lease_size[name] = k
+                        # the lease-acquire seam: an injected fault
+                        # here returns the slice and poisons only this
+                        # node's descendants
+                        try:
+                            fault_point("dag.slice")
+                        except BaseException as e:  # noqa: BLE001
+                            rs.free.update(rs.leases.pop(name))
+                            rs.start_t[name] = rs.end_t[name] = now
+                            _fail(name, e, now)
+                            progressed = True
+                            continue
+                        lease_env = _lease_env(lease, total)
+                    elif node.device and node.devices is not None:
+                        # timeshared mode still honors an explicit
+                        # demand: cap the node's mesh so fan-out
+                        # siblings compute the same program a sliced
+                        # run would
+                        lease_env = {"SHIFU_TPU_MESH_DEVICES":
+                                     str(max(int(node.devices), 1))}
                     rs.state[name] = "running"
                     rs.start_t[name] = now
                     if node.device:
                         rs.device_running += 1
+                        rs.max_concurrent = max(rs.max_concurrent,
+                                                rs.device_running)
                     progressed = True
-                    threading.Thread(target=_worker, args=(node,),
+                    threading.Thread(target=_worker,
+                                     args=(node, lease_env),
                                      name=f"dag-{name}",
                                      daemon=True).start()
             if all(s in (DONE, CACHED, FAILED, POISONED)
@@ -266,6 +434,13 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
                 break
             cv.wait(timeout=1.0)
         wall = time.monotonic() - t0
+        if sliced and (rs.leases or len(rs.free) != total):
+            # every terminal path returns its lease; reaching here is a
+            # scheduler bug, not a user error — report loudly but do
+            # not mask the run's own outcome
+            log.error("dag[%s]: leaked device lease(s) %s — %d/%d "
+                      "indices free at exit", label,
+                      sorted(rs.leases), len(rs.free), total)
 
     if obs_trace.active():
         # one retro span per node (parent = the run root) with its
@@ -284,7 +459,8 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
                                   rs.end_t[name], parent=nid,
                                   track=f"dag.{name}")
 
-    report = _report(order, by, rs, workers, wall)
+    report = _report(order, by, rs, workers, wall,
+                     total if sliced else None)
     profiling.set_step_extra("dag", report)
     if rs.first_failure is not None:
         name, err = rs.first_failure
@@ -296,7 +472,9 @@ def run_dag(nodes: Sequence[Node], workers: Optional[int] = None,
     return report
 
 
-def _report(order, by, rs: _RunState, workers: int, wall: float) -> Dict:
+def _report(order, by, rs: _RunState, workers: int, wall: float,
+            total: Optional[int]) -> Dict:
+    sliced = total is not None
     run_s = {n: max(rs.end_t.get(n, 0.0) - rs.start_t.get(n, 0.0), 0.0)
              for n in order if n in rs.start_t}
     chain, cp_s = _critical_path(order, by, run_s)
@@ -306,18 +484,36 @@ def _report(order, by, rs: _RunState, workers: int, wall: float) -> Dict:
         queue_s = max(rs.start_t.get(name, 0.0)
                       - rs.ready_t.get(name, 0.0), 0.0) \
             if name in rs.start_t else 0.0
+        if not by[name].device:
+            dv: Optional[int] = 0
+        elif sliced:
+            dv = rs.lease_size.get(name, 0)   # 0: cached/poisoned/failed
+        else:
+            dv = None                          # timeshared: no lease
         # profiling.DAG_FIELDS is the pinned per-node schema — build the
         # record from the tuple so it cannot drift from the docs
         recs.append(dict(zip(profiling.DAG_FIELDS, (
             name, rs.state[name], list(by[name].deps),
             round(queue_s, 3), round(run_s.get(name, 0.0), 3),
-            name in on_chain))))
-    busy = sum(run_s.get(n, 0.0) for n in order if by[n].device)
+            dv, name in on_chain))))
+    if wall <= 0:
+        occ = 0.0
+    elif sliced:
+        # slice-weighted: a node busy on k of N chips contributes k/N —
+        # whole-node weighting would over-report occupancy under fan-out
+        busy = sum(run_s.get(n, 0.0) * rs.lease_size.get(n, 0)
+                   for n in order if by[n].device)
+        occ = round(busy / (wall * total), 3)
+    else:
+        busy = sum(run_s.get(n, 0.0) for n in order if by[n].device)
+        occ = round(busy / (wall * workers), 3)
     return {
         "workers": workers,
+        "total_devices": total,
         "wall_s": round(wall, 3),
         "critical_path_s": round(cp_s, 3),
-        "occupancy": round(busy / (wall * workers), 3) if wall > 0 else 0.0,
+        "occupancy": occ,
+        "max_concurrent": rs.max_concurrent,
         "failed": rs.first_failure[0] if rs.first_failure else None,
         "nodes": recs,
     }

@@ -248,7 +248,8 @@ def device_stats() -> Dict:
         # cache is None only if the internal attr moved in a jax
         # upgrade: fall back to reporting (the old behavior) rather
         # than silently losing metrics forever
-        devs = jax.devices()
+        from shifu_tpu.parallel import mesh as mesh_mod
+        devs = mesh_mod.leased_devices()
         out["backend"] = jax.default_backend()
         out["deviceCount"] = len(devs)
         st = devs[0].memory_stats() if hasattr(devs[0],
@@ -428,11 +429,31 @@ CANARY_FIELDS = ("breach_to_live_s", "rollback_recovery_s",
 # the block's top-level keys, DAG_FIELDS the schema of each entry in
 # its `nodes` list. pipeline/scheduler.py builds every per-node record
 # from DAG_FIELDS, and tools/check_steps_schema.py pins README docs to
-# both tuples the same way it pins ROOFLINE_FIELDS.
-DAG_FIELDS = ("node", "state", "deps", "queue_s", "run_s",
+# both tuples the same way it pins ROOFLINE_FIELDS. `devices` is the
+# size of the device slice the node held (0 for host/cached nodes,
+# null when the scheduler ran in legacy timeshared mode);
+# `total_devices` is the pool the slice allocator leased from (null in
+# timeshared mode), `max_concurrent` the peak number of device nodes
+# running at once, and `occupancy` is slice-weighted under slicing
+# (Σ run_s·devices / wall·total_devices).
+DAG_FIELDS = ("node", "state", "deps", "queue_s", "run_s", "devices",
               "critical_path")
-DAG_SUMMARY_FIELDS = ("workers", "wall_s", "critical_path_s",
-                      "occupancy", "failed", "nodes")
+DAG_SUMMARY_FIELDS = ("workers", "total_devices", "wall_s",
+                      "critical_path_s", "occupancy", "max_concurrent",
+                      "failed", "nodes")
+
+# bench task_pipeline's sliced-vs-timeshared A/B block: bench.py builds
+# the record's `slice` sub-dict from exactly this tuple — device slices
+# leased over the whole sliced DAG run, peak concurrently-running
+# device nodes, the slice-weighted occupancy of that run, and the
+# wall-clock speedup of disjoint-slice concurrency over the timeshared
+# sequential schedule (tools/bench_regress.py gates sliced_speedup ≥ 1
+# on TPU records — CPU exempt, the fake devices share cores — and
+# artifact parity between the two legs hard-fails the record's
+# top-level bitwise_identical). tools/check_steps_schema.py pins README
+# docs to this tuple the same way it pins REFRESH_FIELDS.
+SLICE_FIELDS = ("slices_leased", "max_concurrent", "occupancy",
+                "sliced_speedup")
 
 # the span tracer's per-step summary block: obs/trace.py attaches one
 # `trace` block (built from exactly this tuple) to the steps.jsonl

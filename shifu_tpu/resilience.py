@@ -148,7 +148,7 @@ FAULT_SITES = (
     "serve.route", "registry.publish",
     "dist.init", "dist.barrier", "dist.allgather",
     "dist.allreduce_tree",
-    "dist.preempt_marker", "dag.node", "obs.export",
+    "dist.preempt_marker", "dag.node", "dag.slice", "obs.export",
     "obs.metrics_flush", "obs.alert", "obs.webhook", "watch.window",
     "refresh.schedule", "refresh.guardrail", "refresh.promote",
     "refresh.swap",

@@ -321,19 +321,23 @@ class ScorerService:
         t_h2d = t_pad
         if self._preplace and "dense" in padded:
             import jax
+            from shifu_tpu.parallel import mesh as mesh_mod
             # single-device placement: score_matrix's shard_axis moves
-            # it onto the data mesh without a host round-trip
+            # it onto the data mesh without a host round-trip (first
+            # leased device — a sliced serving node stays on its slice)
             padded["dense"] = jax.device_put(
-                np.asarray(padded["dense"], np.float32), jax.devices()[0])
+                np.asarray(padded["dense"], np.float32),
+                mesh_mod.leased_devices()[0])
             jax.block_until_ready(padded["dense"])
             t_h2d = time.monotonic()
         elif self._tree_preplace and "raw_dense" in padded:
             import jax
+            from shifu_tpu.parallel import mesh as mesh_mod
             # the fused tree kernel bins this block in-register; the
             # (small, host-mapped) categorical codes stay host-side
             padded["raw_dense"] = jax.device_put(
                 np.asarray(padded["raw_dense"], np.float32),
-                jax.devices()[0])
+                mesh_mod.leased_devices()[0])
             jax.block_until_ready(padded["raw_dense"])
             t_h2d = time.monotonic()
 

@@ -390,7 +390,9 @@ def train_streaming_core(train_conf: ModelTrainConf,
         widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
         return np.pad(arr, widths)
 
-    ld = jax.local_device_count()
+    # per-process block padding must divide over the devices the local
+    # mesh actually uses — the leased view, not the raw runtime count
+    ld = len(mesh_mod.leased_local_devices())
 
     def host_assemble(bounds, with_bags: bool):
         """Worker-thread half of a chunk fetch: this process's slice of

@@ -167,6 +167,55 @@ def test_dag_node_fault_poisons_exactly_descendants(
     assert cli_main(["--dir", model_set, "test"]) == 0
 
 
+def test_dag_slice_fault_returns_lease_and_rerun_releases(
+        tmp_path, monkeypatch):
+    """`dag.slice` drill: a fault injected at the lease-acquire seam
+    fails exactly the first leased node, RETURNS its slice within the
+    same run — the independent whole-pool sibling can only be admitted
+    on the freed devices — poisons only its descendants, and a clean
+    rerun re-leases everything with no leaked slice."""
+    from shifu_tpu.pipeline.scheduler import DagError, Node, run_dag
+
+    monkeypatch.setenv("SHIFU_TPU_DAG_SLICE", "1")
+    monkeypatch.setenv("SHIFU_TPU_DAG_DEVICES", "8")
+    monkeypatch.setenv("SHIFU_TPU_FAULT", "dag.slice:oserror:1")
+    resilience.reset_faults()
+
+    def build(ran):
+        return [
+            Node("a", lambda lease_env=None: ran.append("a"), devices=8),
+            Node("b", lambda lease_env=None: ran.append("b"),
+                 deps=("a",), devices=4),
+            Node("c", lambda lease_env=None: ran.append("c"), devices=8),
+        ]
+
+    ran = []
+    t0 = time.monotonic()
+    with pytest.raises(DagError) as ei:
+        run_dag(build(ran), workers=2, root=str(tmp_path), label="t")
+    assert time.monotonic() - t0 < 120
+    assert "injected oserror at dag.slice" in str(ei.value.__cause__)
+    rep = ei.value.report
+    states = {r["node"]: r["state"] for r in rep["nodes"]}
+    assert states == {"a": "failed", "b": "poisoned", "c": "done"}
+    assert ran == ["c"]   # the whole-pool sibling got the freed slice
+    by = {r["node"]: r for r in rep["nodes"]}
+    assert by["a"]["devices"] == 8   # granted at the seam, then returned
+    assert by["b"]["devices"] == 0   # poisoned: never leased
+    assert by["c"]["devices"] == 8
+    resilience.clear_abort()
+    resilience.set_abort_scope(None)
+
+    # recoverable: clear the fault — a fresh run re-leases cleanly
+    monkeypatch.delenv("SHIFU_TPU_FAULT")
+    resilience.reset_faults()
+    ran2 = []
+    rep = run_dag(build(ran2), workers=2, root=str(tmp_path), label="t")
+    assert all(r["state"] == "done" for r in rep["nodes"])
+    assert sorted(ran2) == ["a", "b", "c"]
+    assert all(r["devices"] in (4, 8) for r in rep["nodes"])
+
+
 def test_chaos_sites_are_registered():
     """The subset exercised above must stay a subset of the canonical
     registry the full sweep (tools/chaos_sweep.sh) iterates, so the

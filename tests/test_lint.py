@@ -5,7 +5,7 @@ Two layers:
 1. ``test_package_is_clean`` — the acceptance check from ISSUE 4
    (extended by ISSUE 19): the analyzer over the whole package (plus
    bench.py/tools, the out-of-package knob readers) reports ZERO
-   findings across all fifteen rules — including the whole-program
+   findings across all sixteen rules — including the whole-program
    concurrency/atomicity four — within a documented inline-suppression
    budget where every entry carries a ``-- reason``.
 2. Per-rule fixtures — positive (a known violation is flagged),
@@ -860,6 +860,67 @@ def test_unsharded_device_put_suppressed(tmp_path):
     report = lint_source(tmp_path, src, rules=["unsharded-device-put"])
     assert "unsharded-device-put" not in rule_names(report)
     assert any(f.rule == "unsharded-device-put" for f in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# ungated-device-grab
+# ---------------------------------------------------------------------------
+
+def test_ungated_device_grab_positive(tmp_path):
+    src = """
+        import jax
+
+        def place(x):
+            first = jax.devices()[0]
+            mine = jax.local_devices()
+            return first, mine
+    """
+    report = lint_source(tmp_path, src, rules=["ungated-device-grab"])
+    assert rule_names(report).count("ungated-device-grab") == 2
+
+
+def test_ungated_device_grab_negative(tmp_path):
+    src = """
+        import jax
+        from shifu_tpu.parallel import mesh as mesh_mod
+
+        def place(x):
+            devs = mesh_mod.leased_devices()
+            mine = mesh_mod.leased_local_devices()
+            n = mesh_mod.device_inventory()
+            k = jax.local_device_count()     # a count, not a grab
+            ref = jax.devices                # reference, never called
+            return devs, mine, n, k, ref
+    """
+    report = lint_source(tmp_path, src, rules=["ungated-device-grab"])
+    assert "ungated-device-grab" not in rule_names(report)
+
+
+def test_ungated_device_grab_exempts_mesh_module(tmp_path):
+    """parallel/mesh.py IS the lease seam — its own jax.devices() calls
+    are the one place the whole pool may be read."""
+    (tmp_path / "parallel").mkdir()
+    src = """
+        import jax
+
+        def leased_devices():
+            return jax.devices()
+    """
+    report = lint_source(tmp_path, src, name="parallel/mesh.py",
+                         rules=["ungated-device-grab"])
+    assert "ungated-device-grab" not in rule_names(report)
+
+
+def test_ungated_device_grab_suppressed(tmp_path):
+    src = """
+        import jax
+
+        def probe():
+            return jax.devices()  # lint: disable=ungated-device-grab -- diag
+    """
+    report = lint_source(tmp_path, src, rules=["ungated-device-grab"])
+    assert "ungated-device-grab" not in rule_names(report)
+    assert any(f.rule == "ungated-device-grab" for f in report.suppressed)
 
 
 # ---------------------------------------------------------------------------

@@ -409,3 +409,38 @@ def test_mesh_topology_record():
     top = mesh_mod.mesh_topology(mesh_mod.make_mesh(4, 2))
     assert top == {"axes": ["data", "model"], "shape": [4, 2],
                    "devices": 8, "hosts": 1}
+
+
+def test_leased_devices_follow_slice_env(monkeypatch):
+    """The device-slice lease seam: SHIFU_TPU_DEVICE_SLICE filters the
+    devices default_mesh builds over; a partial id match refuses loudly
+    (never a silent shrink onto chips another node leased); a fully
+    renumbered visible set no larger than the lease passes through
+    (TPU_VISIBLE_DEVICES already did the narrowing)."""
+    import jax
+
+    from shifu_tpu.parallel import mesh as mesh_mod
+    monkeypatch.delenv("SHIFU_TPU_MESH_DEVICES", raising=False)
+    monkeypatch.setenv("SHIFU_TPU_DEVICE_SLICE", "2,5")
+    devs = mesh_mod.leased_devices()
+    assert sorted(d.id for d in devs) == [2, 5]
+    m = mesh_mod.default_mesh()
+    assert m.devices.size == 2
+    assert sorted(d.id for d in m.devices.flat) == [2, 5]
+    assert len(mesh_mod.leased_local_devices()) == 2
+    # partial match: id 2 resolves, 99 does not → refuse
+    monkeypatch.setenv("SHIFU_TPU_DEVICE_SLICE", "2,99")
+    with pytest.raises(RuntimeError, match="refusing"):
+        mesh_mod.leased_devices()
+    # renumbered visibility: nothing matches but the visible set is no
+    # larger than the lease — visibility narrowing already happened
+    monkeypatch.setenv("SHIFU_TPU_DEVICE_SLICE", "98,99")
+    got = mesh_mod.leased_devices(jax.devices()[:2])
+    assert [d.id for d in got] == [0, 1]
+    # malformed slice env names the knob
+    monkeypatch.setenv("SHIFU_TPU_DEVICE_SLICE", "2,x")
+    with pytest.raises(ValueError, match="SHIFU_TPU_DEVICE_SLICE"):
+        mesh_mod.leased_devices()
+    # no slice env → the whole set, untouched
+    monkeypatch.delenv("SHIFU_TPU_DEVICE_SLICE")
+    assert len(mesh_mod.leased_devices()) == len(jax.devices())

@@ -188,10 +188,20 @@ def test_pipeline_dag_bitwise_parity_and_resume(tmp_path, rng,
     algs = ["NN", "GBT"]
 
     # leg 1: the same node bodies, walked sequentially in list order
-    # (pipeline_nodes returns a topological order)
+    # (pipeline_nodes returns a topological order). The conftest rig
+    # exposes 8 fake devices, so leg 2 runs auto-SLICED and hands each
+    # of the two trainers a 4-device lease; pin the same per-node mesh
+    # SIZE here — parity depends on mesh size, never on which device
+    # indices back it (a k-device mesh compiles one XLA program).
     for n in pipeline_nodes(root, eval_sets=["Eval1"], algorithms=algs,
                             resume=False):
+        if n.device:
+            monkeypatch.setenv("SHIFU_TPU_MESH_DEVICES",
+                               str(n.devices or 8))
+        else:
+            monkeypatch.delenv("SHIFU_TPU_MESH_DEVICES", raising=False)
         n.fn()
+    monkeypatch.delenv("SHIFU_TPU_MESH_DEVICES", raising=False)
     seq = _hash_outputs(root, algs)
     assert os.path.exists(os.path.join(root, "evals", "Eval1",
                                        "EvalPerformance.json"))
@@ -217,3 +227,165 @@ def test_pipeline_dag_bitwise_parity_and_resume(tmp_path, rng,
                             "norm": CACHED, "train.NN": CACHED,
                             "train.GBT": CACHED, "eval.Eval1": DONE}
     assert _hash_outputs(root, algs) == seq
+
+
+# ---------------------------------------------------------------------------
+# device-slice allocator (synthetic graphs; conftest rig = 8 fake devices)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def sliced8(monkeypatch):
+    """Force sliced admission over a declared 8-device pool (no probe)."""
+    monkeypatch.setenv("SHIFU_TPU_DAG_SLICE", "1")
+    monkeypatch.setenv("SHIFU_TPU_DAG_DEVICES", "8")
+
+
+def test_dag_slice_leases_disjoint_and_env_exported(sliced8):
+    """Two demand-4 nodes on an 8-device pool run CONCURRENTLY (the
+    rendezvous barrier would break if they serialized) on provably
+    disjoint slices, and each receives the full lease env — the slice
+    ids plus both platform visibility variables."""
+    seen = {}
+    barrier = threading.Barrier(2)
+
+    def fn(name):
+        def run(lease_env=None):
+            seen[name] = lease_env
+            barrier.wait(timeout=30)
+        return run
+
+    rep = run_dag([Node("a", fn("a"), devices=4),
+                   Node("b", fn("b"), devices=4)], workers=4)
+    assert rep["total_devices"] == 8
+    assert rep["max_concurrent"] == 2
+    slices = {}
+    for name, env in seen.items():
+        ids = env["SHIFU_TPU_DEVICE_SLICE"]
+        slices[name] = {int(x) for x in ids.split(",")}
+        assert env["TPU_VISIBLE_DEVICES"] == ids
+        assert ("--xla_force_host_platform_device_count=8"
+                in env["XLA_FLAGS"])
+    assert len(slices["a"]) == len(slices["b"]) == 4
+    assert slices["a"].isdisjoint(slices["b"])
+    assert (slices["a"] | slices["b"]) <= set(range(8))
+    for rec in rep["nodes"]:
+        assert rec["devices"] == 4
+
+
+def test_dag_slice_demand_exceeding_pool_raises(sliced8):
+    """A demand the pool can never satisfy raises up front — a lease is
+    never silently shrunk and the node must not wait forever."""
+    with pytest.raises(ValueError, match="demands 9"):
+        run_dag([Node("big", lambda: None, devices=9)])
+
+
+def test_dag_slice_lease_returned_on_failure(sliced8, tmp_path):
+    """A failing demand-8 node must return its lease — the independent
+    demand-8 sibling can only be admitted afterwards — while the failed
+    node's descendant is poisoned without ever holding devices."""
+    ran = []
+
+    def boom(lease_env=None):
+        raise OSError("synthetic")
+
+    nodes = [
+        Node("a", boom, devices=8),
+        Node("c", lambda lease_env=None: ran.append("c"), deps=("a",),
+             devices=8),
+        Node("b", lambda lease_env=None: ran.append("b"), devices=8),
+    ]
+    with pytest.raises(DagError) as ei:
+        run_dag(nodes, workers=2, root=str(tmp_path), label="t")
+    rep = ei.value.report
+    assert _states(rep) == {"a": FAILED, "b": DONE, "c": POISONED}
+    assert ran == ["b"]
+    by = {r["node"]: r for r in rep["nodes"]}
+    assert by["a"]["devices"] == 8    # granted, then returned on failure
+    assert by["c"]["devices"] == 0    # poisoned: never leased
+    resilience.clear_abort()
+    resilience.set_abort_scope(None)
+
+
+def test_dag_slice_demand_descending_dispatch(sliced8):
+    """Big slices first-fit before small ones fragment the pool: with
+    declaration order [small(2), big(8)], the big node must not starve —
+    demand-descending tie-break dispatches it first."""
+    done_order = []
+    lock = threading.Lock()
+
+    def fn(name):
+        def run(lease_env=None):
+            with lock:
+                done_order.append(name)
+        return run
+
+    rep = run_dag([Node("small", fn("small"), devices=2),
+                   Node("big", fn("big"), devices=8)], workers=4)
+    assert done_order[0] == "big"
+    assert _states(rep) == {"small": DONE, "big": DONE}
+
+
+def test_dag_slice_disabled_keeps_timeshared_report(monkeypatch):
+    """SHIFU_TPU_DAG_SLICE=0 → legacy timeshared admission: no pool in
+    the summary, device nodes carry devices=None (no lease), host nodes
+    devices=0."""
+    monkeypatch.setenv("SHIFU_TPU_DAG_SLICE", "0")
+    rep = run_dag([Node("x", lambda: None),
+                   Node("h", lambda: None, device=False)], workers=1)
+    assert rep["total_devices"] is None
+    by = {r["node"]: r for r in rep["nodes"]}
+    assert by["x"]["devices"] is None
+    assert by["h"]["devices"] == 0
+
+
+def test_dag_timeshared_explicit_demand_caps_mesh(monkeypatch):
+    """Timeshared mode still honors a declared demand: the node gets
+    SHIFU_TPU_MESH_DEVICES so its mesh size matches what a sliced run
+    would compute (keeps A/B legs bitwise comparable)."""
+    monkeypatch.setenv("SHIFU_TPU_DAG_SLICE", "0")
+    seen = {}
+
+    def fn(lease_env=None):
+        seen["env"] = lease_env
+
+    run_dag([Node("x", fn, devices=2)], workers=1)
+    assert seen["env"] == {"SHIFU_TPU_MESH_DEVICES": "2"}
+
+
+def test_dag_slice_shrink_resume_matches(tmp_path, rng, monkeypatch):
+    """restore_resharded wiring for grid/refresh nodes resuming on a
+    smaller lease: train 10 epochs on the full 8-device pool with a
+    checkpoint, resume to 30 under a 4-device lease exported through
+    the same seam the scheduler uses (SHIFU_TPU_DEVICE_SLICE — on
+    NON-zero-based ids, proving placement independence) — trajectory
+    matches the uninterrupted run up to cross-mesh-size reduction
+    noise."""
+    import numpy as np
+
+    from shifu_tpu.config.model_config import ModelTrainConf
+    from shifu_tpu.train import checkpoint as ckpt
+    from shifu_tpu.train.trainer import train_nn
+
+    x = rng.normal(0, 1, (600, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    w = np.ones(600, np.float32)
+
+    def conf(epochs):
+        return ModelTrainConf.from_dict({
+            "numTrainEpochs": epochs, "baggingNum": 2,
+            "validSetRate": 0.2,
+            "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [6],
+                       "ActivationFunc": ["tanh"], "LearningRate": 0.1,
+                       "Propagation": "ADAM"}})
+
+    straight = train_nn(conf(30), x, y, w, seed=7)
+    d = str(tmp_path / "ck")
+    train_nn(conf(10), x, y, w, seed=7, checkpoint_dir=d,
+             checkpoint_interval=10)
+    assert ckpt.latest_step(d) == 10
+    monkeypatch.setenv("SHIFU_TPU_DEVICE_SLICE", "4,5,6,7")  # shrink 8→4
+    resumed = train_nn(conf(30), x, y, w, seed=7, checkpoint_dir=d,
+                       checkpoint_interval=10)
+    assert resumed.val_errors.shape[1] == 20
+    np.testing.assert_allclose(straight.val_errors[:, 10:],
+                               resumed.val_errors, rtol=2e-3, atol=2e-4)

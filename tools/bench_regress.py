@@ -84,7 +84,15 @@ def load_series(path: str) -> Dict[Tuple[str, str], List[Dict]]:
                 rec = json.loads(line)
             except ValueError:
                 continue
-            key = (str(rec.get("task", "?")), str(rec.get("backend", "?")))
+            backend = str(rec.get("backend", "?"))
+            probe = rec.get("probe")
+            if isinstance(probe, dict) and probe.get("fallback_reason"):
+                # a record stamped with a probe fallback ran somewhere
+                # it did not intend to (axon timeout → cpu): give it
+                # its own series so it never dilutes — or trips — the
+                # genuine hardware trend
+                backend += "+fallback"
+            key = (str(rec.get("task", "?")), backend)
             series.setdefault(key, []).append(rec)
     for recs in series.values():
         recs.sort(key=lambda r: r.get("ts", 0.0))
@@ -311,6 +319,21 @@ def check(path: str, threshold_pct: float, min_history: int) -> int:
                         f"{100.0 * (median - eff) / median:.1f}% below "
                         f"the trailing median {median:.3f} "
                         f"(threshold {threshold_pct:.0f}%)")
+        sl = newest.get("slice")
+        if isinstance(sl, dict):
+            # multi-device pipeline records carry the sliced-vs-
+            # timeshared A/B block: disjoint-slice concurrency must
+            # never lose to the sequential schedule it replaces.
+            # TPU records only — on one physical CPU the fake devices
+            # share cores, so overlap is contention-bound and the
+            # speedup hovers around 1 (CPU exempt, like fused_speedup)
+            ss = sl.get("sliced_speedup")
+            if backend == "tpu" and isinstance(ss, (int, float)) \
+                    and ss < 1.0:
+                findings.append(
+                    f"{label}: sliced_speedup {ss:.2f} < 1 — device-"
+                    "slice leasing lost to the timeshared sequential "
+                    "schedule")
         if newest.get("bitwise_identical") is False:
             findings.append(
                 f"{label}: bitwise_identical=false — sharded output "

@@ -227,6 +227,56 @@ print(f"ingest drill at {site}: {len(lines)} rows committed, replay "
 PYEOF
 }
 
+run_dag_slice_drill() {  # $1 = work dir, $2 = site; the single-command
+  # pipeline never builds a sliced device DAG, so dag.slice gets the
+  # closed-loop drill: a synthetic device DAG over a declared 8-device
+  # pool with the fault armed at the lease-acquire seam — the faulted
+  # node must fail naming the site, its slice must return to the pool
+  # WITHIN the run (the independent whole-pool sibling can only be
+  # admitted on the freed devices), and a clean rerun must re-lease
+  # everything with no leaked slice.
+  python - "$1" "$2" <<'PYEOF'
+import os, sys
+work, site = sys.argv[1], sys.argv[2]
+kind = os.environ["SHIFU_TPU_FAULT"].split(":")[1]
+from shifu_tpu import resilience
+from shifu_tpu.pipeline.scheduler import DagError, Node, run_dag
+os.environ["SHIFU_TPU_DAG_SLICE"] = "1"
+os.environ["SHIFU_TPU_DAG_DEVICES"] = "8"
+
+def build(ran):
+    return [
+        Node("a", lambda lease_env=None: ran.append("a"), devices=8),
+        Node("b", lambda lease_env=None: ran.append("b"),
+             deps=("a",), devices=4),
+        Node("c", lambda lease_env=None: ran.append("c"), devices=8),
+    ]
+
+resilience.reset_faults()
+ran = []
+try:
+    run_dag(build(ran), workers=2, root=work, label="drill")
+    raise SystemExit(f"fault at {site} never surfaced")
+except DagError as e:
+    assert f"injected {kind} at {site}" in str(e.__cause__), e
+    states = {r["node"]: r["state"] for r in e.report["nodes"]}
+    assert states == {"a": "failed", "b": "poisoned", "c": "done"}, states
+    assert ran == ["c"], ran   # c's demand-8 lease proves the return
+    by = {r["node"]: r for r in e.report["nodes"]}
+    assert by["a"]["devices"] == 8 and by["c"]["devices"] == 8
+resilience.clear_abort()
+# clean rerun: re-leases with no leaked slice
+os.environ.pop("SHIFU_TPU_FAULT", None)
+resilience.reset_faults()
+ran = []
+rep = run_dag(build(ran), workers=2, root=work, label="drill")
+assert all(r["state"] == "done" for r in rep["nodes"])
+assert sorted(ran) == ["a", "b", "c"]
+print(f"dag.slice drill: lease returned within-run, clean rerun "
+      f"re-leased {rep['total_devices']} devices, no leak")
+PYEOF
+}
+
 pass=0 fail=0 hang=0
 declare -a HUNG BROKE
 
@@ -249,6 +299,13 @@ for site in $SITES; do
       SHIFU_TPU_FAULT="$site:$KIND:1" \
         timeout -k 10 "$PER_SITE_TIMEOUT" \
         bash -c "$(declare -f run_ingest_drill); run_ingest_drill '$dest' '$site'" \
+        >>"$log" 2>&1
+      rc=$?
+      ;;
+    dag.slice)
+      SHIFU_TPU_FAULT="$site:$KIND:1" \
+        timeout -k 10 "$PER_SITE_TIMEOUT" \
+        bash -c "$(declare -f run_dag_slice_drill); run_dag_slice_drill '$dest' '$site'" \
         >>"$log" 2>&1
       rc=$?
       ;;

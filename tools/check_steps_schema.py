@@ -115,7 +115,8 @@ def documented_fields() -> set:
         set(dag_summary_fields()) | set(trace_fields()) | \
         set(metric_fields()) | set(health_fields()) | \
         set(shard_fields()) | set(refresh_fields()) | \
-        set(ingest_fields()) | set(canary_fields())
+        set(ingest_fields()) | set(canary_fields()) | \
+        set(slice_fields())
     return {tok for tok in _TOKEN.findall(text)
             if "per_s" not in tok and not tok.endswith("_frac")
             and tok not in pinned and tok not in _BENCH_ONLY}
@@ -222,6 +223,10 @@ def ingest_fields() -> tuple:
 
 def canary_fields() -> tuple:
     return _profiling_tuple("CANARY_FIELDS")
+
+
+def slice_fields() -> tuple:
+    return _profiling_tuple("SLICE_FIELDS")
 
 
 def check_roofline_docs() -> int:
@@ -518,6 +523,33 @@ def check_canary_docs() -> int:
     return 0
 
 
+def check_slice_docs() -> int:
+    """Every SLICE_FIELDS member (bench.py task_pipeline's sliced-vs-
+    timeshared A/B block) must be backtick-documented in README's
+    Pipeline DAG section, and bench.py must build the block from the
+    tuple — the literal check asserts bench.py references
+    `SLICE_FIELDS` so the record cannot silently drift from the pinned
+    schema."""
+    fields = slice_fields()
+    with open(README, encoding="utf-8") as f:
+        documented = set(re.findall(r"`([a-z][a-z0-9_]*)`", f.read()))
+    missing = sorted(set(fields) - documented)
+    if missing:
+        print("slice schema drift: SLICE_FIELDS member(s) never "
+              f"documented in README: {missing}", file=sys.stderr)
+        return 1
+    bench = os.path.join(REPO, "bench.py")
+    with open(bench, encoding="utf-8") as f:
+        uses = "SLICE_FIELDS" in f.read()
+    if not uses:
+        print("bench.py no longer builds the slice A/B block from "
+              "profiling.SLICE_FIELDS", file=sys.stderr)
+        return 1
+    print(f"slice A/B: all {len(fields)} SLICE_FIELDS documented in "
+          "README and pinned in bench.py")
+    return 0
+
+
 def log_fields(path: str) -> set:
     out = set()
     with open(path, encoding="utf-8") as f:
@@ -590,6 +622,8 @@ def main(argv) -> int:
     if check_ingest_docs():
         return 1
     if check_canary_docs():
+        return 1
+    if check_slice_docs():
         return 1
     if argv:
         seen = log_fields(argv[0])

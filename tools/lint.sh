@@ -2,7 +2,7 @@
 # Repo lint gate — exits non-zero on ANY finding. Four passes:
 #
 #   1. `python -m shifu_tpu.analysis` over the package AND the
-#      out-of-package knob readers (bench.py, tools/) — all fifteen
+#      out-of-package knob readers (bench.py, tools/) — all sixteen
 #      repo-native rules (see README "Static analysis" for the table),
 #      including the whole-program concurrency/atomicity four:
 #      raw-lock, thread-shared-mutation, non-atomic-write,
